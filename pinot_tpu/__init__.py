@@ -1,0 +1,34 @@
+"""pinot_tpu — a TPU-native realtime distributed OLAP datastore.
+
+A ground-up rebuild of the capabilities of LinkedIn Pinot v0.016
+(reference mounted at /root/reference) designed TPU-first:
+
+- columnar immutable segments staged into HBM as packed device arrays
+  (reference: pinot-core/.../segment/, PinotDataBuffer mmap buffers)
+- per-segment query execution (filter -> project -> aggregate/group-by)
+  as jit-compiled XLA kernels instead of a virtual-call operator tree
+  (reference: pinot-core/.../core/operator/)
+- segment parallelism via a leading segment axis sharded over a
+  `jax.sharding.Mesh`, with `psum`-style collectives replacing both the
+  intra-server MCombineOperator thread pools and most of the broker's
+  scatter-gather reduce (reference: MCombineOperator.java,
+  BrokerReduceService.java)
+- a host-side control plane (controller / broker / server roles) with
+  ideal-state vs observed-state semantics mirroring Helix
+  (reference: pinot-controller/.../PinotHelixResourceManager.java)
+
+Package layout:
+  common/    schema, table config, request/response model, DataTable wire format
+  pql/       PQL parser + filter-tree optimizer
+  segment/   segment build (two-pass), on-disk format, loader, device staging
+  engine/    the TPU query engine: predicate -> mask kernels, aggregation,
+             group-by scatter-add, selection top-k, per-segment executor
+  parallel/  multi-segment stacking + shard_map multi-chip execution
+  startree/  star-tree pre-aggregation
+  realtime/  mutable segments, stream providers, LLC-style commit FSM
+  controller/ broker/ server/ transport/  cluster topology
+  tools/     scan-based reference oracle, quickstarts, data generators, perf
+  utils/     metrics, tracing, retry
+"""
+
+__version__ = "0.1.0"

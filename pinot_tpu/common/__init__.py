@@ -1,0 +1,30 @@
+from pinot_tpu.common.schema import DataType, FieldType, FieldSpec, TimeFieldSpec, Schema
+from pinot_tpu.common.request import (
+    FilterOperator,
+    FilterQueryTree,
+    AggregationInfo,
+    GroupBy,
+    Selection,
+    SelectionSort,
+    BrokerRequest,
+)
+from pinot_tpu.common.response import BrokerResponse, AggregationResult, GroupByResult, SelectionResults
+
+__all__ = [
+    "DataType",
+    "FieldType",
+    "FieldSpec",
+    "TimeFieldSpec",
+    "Schema",
+    "FilterOperator",
+    "FilterQueryTree",
+    "AggregationInfo",
+    "GroupBy",
+    "Selection",
+    "SelectionSort",
+    "BrokerRequest",
+    "BrokerResponse",
+    "AggregationResult",
+    "GroupByResult",
+    "SelectionResults",
+]

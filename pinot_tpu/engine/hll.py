@@ -1,0 +1,107 @@
+"""HyperLogLog sketch shared by the TPU engine and the scan oracle.
+
+The reference uses clearspring's HyperLogLog with ``log2m = 8``
+(pinot-core ``startree/hll/HllConstants.java`` DEFAULT_LOG2M) for
+``distinctcounthll`` / ``fasthll``.  Here the sketch is a plain
+``uint8[m]`` register array — a representation that maps directly onto
+TPU ops: per-row (bucket, rho) pairs are precomputed per dictionary
+entry host-side, the device does a scatter-max into registers, and
+cross-segment / cross-chip merge is an elementwise ``maximum`` (instead
+of the reference's Java-serialized sketch objects,
+``DataTableCustomSerDe.java:49``).
+
+Hashing is a deterministic 64-bit hash (xxhash-style mixing over
+blake2b) — NOT Python's salted ``hash()`` — so oracle and engine agree
+bit-for-bit.
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+import struct
+from typing import Any, Iterable
+
+import numpy as np
+
+DEFAULT_LOG2M = 8  # HllConstants.java DEFAULT_LOG2M
+M = 1 << DEFAULT_LOG2M
+
+
+def value_hash64(value: Any) -> int:
+    """Deterministic 64-bit hash of an ingest value."""
+    if isinstance(value, float) and value.is_integer():
+        # Hash 5.0 and 5 identically so INT/LONG/FLOAT columns agree.
+        value = int(value)
+    data = repr(value).encode("utf-8")
+    return struct.unpack("<Q", hashlib.blake2b(data, digest_size=8).digest())[0]
+
+
+def bucket_and_rho(h: int, log2m: int = DEFAULT_LOG2M) -> tuple:
+    """Split a 64-bit hash into (register index, rank of first set bit)."""
+    m = 1 << log2m
+    bucket = h & (m - 1)
+    rest = h >> log2m
+    # rho = position of least-significant 1 bit in the remaining bits + 1
+    width = 64 - log2m
+    if rest == 0:
+        rho = width + 1
+    else:
+        rho = (rest & -rest).bit_length()
+    return bucket, rho
+
+
+def registers_from_values(values: Iterable[Any], log2m: int = DEFAULT_LOG2M) -> np.ndarray:
+    m = 1 << log2m
+    regs = np.zeros(m, dtype=np.uint8)
+    for v in values:
+        b, r = bucket_and_rho(value_hash64(v), log2m)
+        if r > regs[b]:
+            regs[b] = r
+    return regs
+
+
+def _alpha(m: int) -> float:
+    if m == 16:
+        return 0.673
+    if m == 32:
+        return 0.697
+    if m == 64:
+        return 0.709
+    return 0.7213 / (1.0 + 1.079 / m)
+
+
+def estimate_from_registers(regs: np.ndarray) -> int:
+    """Standard HLL estimator with small/large-range corrections
+    (the clearspring ``HyperLogLog.cardinality()`` algorithm)."""
+    regs = np.asarray(regs)
+    m = regs.shape[-1]
+    rsum = np.sum(np.power(2.0, -regs.astype(np.float64)), axis=-1)
+    estimate = _alpha(m) * m * m / rsum
+    zeros = np.sum(regs == 0, axis=-1)
+    if np.ndim(estimate) == 0:
+        return int(_correct(float(estimate), int(zeros), m))
+    out = np.empty(estimate.shape, dtype=np.int64)
+    flat_e, flat_z = estimate.ravel(), np.asarray(zeros).ravel()
+    for i in range(flat_e.size):
+        out.ravel()[i] = _correct(float(flat_e[i]), int(flat_z[i]), m)
+    return out
+
+
+def _correct(estimate: float, zeros: int, m: int) -> int:
+    if estimate <= 2.5 * m and zeros > 0:
+        # linear counting
+        return int(round(m * math.log(m / float(zeros))))
+    two64 = 2.0**64
+    if estimate > two64 / 30.0:
+        return int(round(-two64 * math.log(1.0 - estimate / two64)))
+    return int(round(estimate))
+
+
+def merge_registers(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.maximum(a, b)
+
+
+def hll_estimate_exact_values(values: Iterable[Any], log2m: int = DEFAULT_LOG2M) -> int:
+    """Estimate cardinality of a concrete value set through the sketch
+    (used by the oracle so engine and oracle agree exactly)."""
+    return int(estimate_from_registers(registers_from_values(values, log2m)))

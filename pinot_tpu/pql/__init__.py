@@ -1,0 +1,4 @@
+from pinot_tpu.pql.parser import parse_pql, PqlParseError
+from pinot_tpu.pql.optimizer import optimize_request
+
+__all__ = ["parse_pql", "PqlParseError", "optimize_request"]

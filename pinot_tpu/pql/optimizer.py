@@ -1,0 +1,73 @@
+"""Filter-tree optimizers, mirroring the reference broker's rewrites.
+
+Reference: pinot-transport ``requestHandler/BrokerRequestOptimizer.java``
+with ``FlattenNestedPredicatesFilterQueryTreeOptimizer.java`` and
+``MultipleOrEqualitiesToInClauseFilterQueryTreeOptimizer.java``.
+
+1. Flatten nested AND(AND(...)) / OR(OR(...)) into a single level.
+2. Collapse OR of EQUALITY/IN on the same column into one IN clause
+   (single-value IN degenerates back to EQUALITY).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from pinot_tpu.common.request import BrokerRequest, FilterOperator, FilterQueryTree
+
+
+def flatten(tree: FilterQueryTree) -> FilterQueryTree:
+    if tree.is_leaf:
+        return tree
+    new_children: List[FilterQueryTree] = []
+    for child in tree.children:
+        c = flatten(child)
+        if c.operator == tree.operator and not c.is_leaf:
+            new_children.extend(c.children)
+        else:
+            new_children.append(c)
+    if len(new_children) == 1:
+        return new_children[0]
+    return FilterQueryTree(operator=tree.operator, children=new_children)
+
+
+def or_equalities_to_in(tree: FilterQueryTree) -> FilterQueryTree:
+    if tree.is_leaf:
+        return tree
+    children = [or_equalities_to_in(c) for c in tree.children]
+    if tree.operator != FilterOperator.OR:
+        return FilterQueryTree(operator=tree.operator, children=children)
+
+    # Gather EQUALITY/IN leaves per column; keep everything else as-is.
+    by_column: dict = {}
+    others: List[FilterQueryTree] = []
+    for c in children:
+        if c.is_leaf and c.operator in (FilterOperator.EQUALITY, FilterOperator.IN) and c.column:
+            by_column.setdefault(c.column, [])
+            for v in c.values:
+                if v not in by_column[c.column]:
+                    by_column[c.column].append(v)
+        else:
+            others.append(c)
+
+    merged: List[FilterQueryTree] = []
+    for col, vals in by_column.items():
+        if len(vals) == 1:
+            merged.append(FilterQueryTree(operator=FilterOperator.EQUALITY, column=col, values=vals))
+        else:
+            merged.append(FilterQueryTree(operator=FilterOperator.IN, column=col, values=vals))
+
+    out = merged + others
+    if len(out) == 1:
+        return out[0]
+    return FilterQueryTree(operator=FilterOperator.OR, children=out)
+
+
+def optimize_filter(tree: Optional[FilterQueryTree]) -> Optional[FilterQueryTree]:
+    if tree is None:
+        return None
+    return flatten(or_equalities_to_in(flatten(tree)))
+
+
+def optimize_request(request: BrokerRequest) -> BrokerRequest:
+    request.filter = optimize_filter(request.filter)
+    return request

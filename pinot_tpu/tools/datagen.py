@@ -1,0 +1,126 @@
+"""Seeded data generators for tests, quickstarts, and benchmarks.
+
+Covers the role of the reference's ``pinot-tools`` data generator and the
+TPC-H harness in ``contrib/pinot-benchmark`` (lineitem-shaped generator
+below; real TPC-H data files aren't shipped, so the distribution is
+synthetic but shape- and cardinality-faithful for Q0-Q6).
+"""
+from __future__ import annotations
+
+import random
+import string
+from typing import Any, Dict, List, Optional, Sequence
+
+from pinot_tpu.common.schema import DataType, FieldSpec, FieldType, Schema, TimeFieldSpec
+
+Row = Dict[str, Any]
+
+
+def random_rows(
+    schema: Schema,
+    num_rows: int,
+    seed: int = 0,
+    cardinality: int = 20,
+    mv_max: int = 3,
+) -> List[Row]:
+    """Random rows for a schema with bounded per-column cardinality."""
+    rng = random.Random(seed)
+    # Fixed value pools per column so cardinality is bounded.
+    pools: Dict[str, List[Any]] = {}
+    for spec in schema.all_fields():
+        st = spec.stored_type
+        if st == DataType.STRING:
+            pools[spec.name] = [
+                "".join(rng.choices(string.ascii_lowercase, k=rng.randint(3, 8)))
+                for _ in range(cardinality)
+            ]
+        elif st in (DataType.INT, DataType.LONG):
+            pools[spec.name] = [rng.randint(0, 10_000) for _ in range(cardinality)]
+        else:
+            pools[spec.name] = [round(rng.uniform(-100, 100), 3) for _ in range(cardinality)]
+
+    rows: List[Row] = []
+    for _ in range(num_rows):
+        row: Row = {}
+        for spec in schema.all_fields():
+            pool = pools[spec.name]
+            if spec.single_value:
+                row[spec.name] = rng.choice(pool)
+            else:
+                row[spec.name] = [rng.choice(pool) for _ in range(rng.randint(1, mv_max))]
+        rows.append(row)
+    return rows
+
+
+def test_schema(with_mv: bool = True) -> Schema:
+    """A small mixed-type schema exercising every stored type."""
+    dims = [
+        FieldSpec("dimStr", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("dimInt", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("dimLong", DataType.LONG, FieldType.DIMENSION),
+    ]
+    if with_mv:
+        dims.append(FieldSpec("dimStrMV", DataType.STRING_ARRAY, FieldType.DIMENSION, single_value=False))
+        dims.append(FieldSpec("dimIntMV", DataType.INT_ARRAY, FieldType.DIMENSION, single_value=False))
+    metrics = [
+        FieldSpec("metInt", DataType.INT, FieldType.METRIC),
+        FieldSpec("metFloat", DataType.FLOAT, FieldType.METRIC),
+        FieldSpec("metDouble", DataType.DOUBLE, FieldType.METRIC),
+    ]
+    time_field = TimeFieldSpec("daysSinceEpoch", DataType.INT, time_unit="DAYS")
+    return Schema("testTable", dimensions=dims, metrics=metrics, time_field=time_field)
+
+
+# ---------------------------------------------------------------------------
+# TPC-H lineitem-shaped generator (contrib/pinot-benchmark workload shape)
+# ---------------------------------------------------------------------------
+
+_SHIP_MODES = ["RAIL", "FOB", "MAIL", "SHIP", "TRUCK", "AIR", "REG AIR"]
+_RETURN_FLAGS = ["R", "A", "N"]
+_LINE_STATUS = ["O", "F"]
+
+
+def lineitem_schema() -> Schema:
+    return Schema(
+        "lineitem",
+        dimensions=[
+            FieldSpec("l_returnflag", DataType.STRING),
+            FieldSpec("l_linestatus", DataType.STRING),
+            FieldSpec("l_shipmode", DataType.STRING),
+            FieldSpec("l_shipdate", DataType.STRING),
+            FieldSpec("l_receiptdate", DataType.STRING),
+        ],
+        metrics=[
+            FieldSpec("l_quantity", DataType.DOUBLE, FieldType.METRIC),
+            FieldSpec("l_extendedprice", DataType.DOUBLE, FieldType.METRIC),
+            FieldSpec("l_discount", DataType.DOUBLE, FieldType.METRIC),
+            FieldSpec("l_tax", DataType.DOUBLE, FieldType.METRIC),
+        ],
+    )
+
+
+def _rand_date(rng: random.Random, lo_year: int = 1992, hi_year: int = 1998) -> str:
+    y = rng.randint(lo_year, hi_year)
+    m = rng.randint(1, 12)
+    d = rng.randint(1, 28)
+    return f"{y:04d}-{m:02d}-{d:02d}"
+
+
+def lineitem_rows(num_rows: int, seed: int = 7) -> List[Row]:
+    rng = random.Random(seed)
+    rows: List[Row] = []
+    for _ in range(num_rows):
+        rows.append(
+            {
+                "l_returnflag": rng.choice(_RETURN_FLAGS),
+                "l_linestatus": rng.choice(_LINE_STATUS),
+                "l_shipmode": rng.choice(_SHIP_MODES),
+                "l_shipdate": _rand_date(rng),
+                "l_receiptdate": _rand_date(rng),
+                "l_quantity": float(rng.randint(1, 50)),
+                "l_extendedprice": round(rng.uniform(900.0, 105_000.0), 2),
+                "l_discount": round(rng.uniform(0.0, 0.1), 2),
+                "l_tax": round(rng.uniform(0.0, 0.08), 2),
+            }
+        )
+    return rows

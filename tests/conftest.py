@@ -1,0 +1,17 @@
+"""Test config: force an 8-device virtual CPU mesh and 64-bit mode.
+
+Multi-chip sharding is validated on a virtual CPU mesh
+(``xla_force_host_platform_device_count=8``) since only one real TPU
+chip is reachable; x64 is enabled so CPU test runs reproduce the
+reference's double-precision aggregation semantics exactly.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,146 @@
+"""PQL parser tests (grammar semantics of the reference PQL2.g4)."""
+import pytest
+
+from pinot_tpu.common.request import FilterOperator
+from pinot_tpu.pql import parse_pql, PqlParseError, optimize_request
+
+
+def test_simple_selection():
+    req = parse_pql("SELECT * FROM myTable")
+    assert req.table_name == "myTable"
+    assert req.is_selection
+    assert req.selection.columns == ["*"]
+    assert req.selection.size == 10  # reference default LIMIT 10
+
+
+def test_selection_with_columns_limit_offset():
+    req = parse_pql("select colA, colB from t limit 20, 30")
+    assert req.selection.columns == ["colA", "colB"]
+    assert req.selection.offset == 20
+    assert req.selection.size == 30
+
+
+def test_selection_order_by():
+    req = parse_pql("SELECT a FROM t ORDER BY b DESC, c LIMIT 5")
+    s = req.selection
+    assert [(x.column, x.ascending) for x in s.sorts] == [("b", False), ("c", True)]
+    assert s.size == 5
+
+
+def test_aggregation():
+    req = parse_pql("SELECT count(*), sum(runs), avg(hits) FROM baseball")
+    assert [a.function for a in req.aggregations] == ["count", "sum", "avg"]
+    assert [a.column for a in req.aggregations] == ["*", "runs", "hits"]
+    assert req.aggregations[0].display_name == "count_star"
+    assert req.aggregations[1].display_name == "sum_runs"
+
+
+def test_group_by_top():
+    req = parse_pql("SELECT sum(runs) FROM baseball GROUP BY playerName TOP 5")
+    assert req.is_group_by
+    assert req.group_by.columns == ["playerName"]
+    assert req.group_by.top_n == 5
+
+
+def test_group_by_default_top():
+    req = parse_pql("SELECT sum(x) FROM t GROUP BY a, b")
+    assert req.group_by.top_n == 10
+
+
+def test_where_equality_and_in():
+    req = parse_pql("SELECT count(*) FROM t WHERE a = 'x' AND b IN (1, 2, 3)")
+    f = req.filter
+    assert f.operator == FilterOperator.AND
+    eq, inp = f.children
+    assert eq.operator == FilterOperator.EQUALITY and eq.column == "a" and eq.values == ["x"]
+    assert inp.operator == FilterOperator.IN and inp.values == ["1", "2", "3"]
+
+
+def test_where_not_in_and_neq():
+    req = parse_pql("SELECT count(*) FROM t WHERE a NOT IN ('x','y') AND b <> 5")
+    ni, ne = req.filter.children
+    assert ni.operator == FilterOperator.NOT_IN
+    assert ne.operator == FilterOperator.NOT and ne.values == ["5"]
+
+
+def test_where_range_between():
+    req = parse_pql("SELECT count(*) FROM t WHERE x BETWEEN 10 AND 20")
+    f = req.filter
+    assert f.operator == FilterOperator.RANGE
+    assert f.range_spec.lower == "10" and f.range_spec.upper == "20"
+    assert f.range_spec.include_lower and f.range_spec.include_upper
+
+
+def test_where_range_comparisons():
+    req = parse_pql("SELECT count(*) FROM t WHERE x > 5 AND x <= 10")
+    lo, hi = req.filter.children
+    assert lo.range_spec.lower == "5" and not lo.range_spec.include_lower
+    assert hi.range_spec.upper == "10" and hi.range_spec.include_upper
+
+
+def test_and_binds_tighter_than_or():
+    req = parse_pql("SELECT count(*) FROM t WHERE a = 1 OR b = 2 AND c = 3")
+    f = req.filter
+    assert f.operator == FilterOperator.OR
+    assert f.children[0].operator == FilterOperator.EQUALITY
+    assert f.children[1].operator == FilterOperator.AND
+
+
+def test_parens():
+    req = parse_pql("SELECT count(*) FROM t WHERE (a = 1 OR b = 2) AND c = 3")
+    f = req.filter
+    assert f.operator == FilterOperator.AND
+    assert f.children[0].operator == FilterOperator.OR
+
+
+def test_regexp_like():
+    req = parse_pql("SELECT count(*) FROM t WHERE regexp_like(name, 'foo.*')")
+    assert req.filter.operator == FilterOperator.REGEX
+    assert req.filter.values == ["foo.*"]
+
+
+def test_string_literals_quotes():
+    req = parse_pql("SELECT count(*) FROM t WHERE a = 'it''s' OR b = \"x\"")
+    assert req.filter.children[0].values == ["it's"]
+    assert req.filter.children[1].values == ["x"]
+
+
+def test_mixed_agg_and_column_rejected():
+    with pytest.raises(PqlParseError):
+        parse_pql("SELECT a, sum(b) FROM t")
+
+
+def test_unknown_agg_rejected():
+    with pytest.raises(PqlParseError):
+        parse_pql("SELECT frobnicate(a) FROM t")
+
+
+def test_having():
+    req = parse_pql("SELECT sum(a) FROM t GROUP BY b HAVING sum(a) > 100")
+    assert req.having is not None
+    assert req.having.function == "sum" and req.having.operator == ">" and req.having.value == 100.0
+
+
+def test_optimizer_or_eq_to_in():
+    req = parse_pql("SELECT count(*) FROM t WHERE a = 1 OR a = 2 OR a = 3")
+    optimize_request(req)
+    assert req.filter.operator == FilterOperator.IN
+    assert sorted(req.filter.values) == ["1", "2", "3"]
+
+
+def test_optimizer_flatten():
+    req = parse_pql("SELECT count(*) FROM t WHERE (a = 1 AND (b = 2 AND c = 3))")
+    optimize_request(req)
+    assert req.filter.operator == FilterOperator.AND
+    assert len(req.filter.children) == 3
+
+
+def test_mv_aggregations():
+    req = parse_pql("SELECT sumMV(vals), countMV(vals) FROM t")
+    assert req.aggregations[0].function == "summv"
+    assert req.aggregations[0].is_mv and req.aggregations[0].base_function == "sum"
+
+
+def test_trailing_semicolon_and_case():
+    req = parse_pql("select SUM(x) from T where Y = 'z' group by Z top 3;")
+    assert req.group_by.top_n == 3

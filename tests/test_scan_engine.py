@@ -1,0 +1,147 @@
+"""Sentinel tests for the scan oracle — exact golden values on a tiny
+hand-written dataset (the QueriesSentinelTest analog at oracle level)."""
+import math
+
+from pinot_tpu.common.schema import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.pql import parse_pql
+from pinot_tpu.tools.scan_engine import ScanQueryProcessor
+
+SCHEMA = Schema(
+    "t",
+    dimensions=[
+        FieldSpec("city", DataType.STRING),
+        FieldSpec("tags", DataType.STRING_ARRAY, single_value=False),
+    ],
+    metrics=[
+        FieldSpec("sales", DataType.INT, FieldType.METRIC),
+        FieldSpec("price", DataType.DOUBLE, FieldType.METRIC),
+    ],
+)
+
+ROWS = [
+    {"city": "sf", "tags": ["a", "b"], "sales": 10, "price": 1.5},
+    {"city": "sf", "tags": ["b"], "sales": 20, "price": 2.5},
+    {"city": "ny", "tags": ["a"], "sales": 30, "price": 3.5},
+    {"city": "la", "tags": ["c", "a"], "sales": 40, "price": 4.5},
+    {"city": "ny", "tags": ["b", "c"], "sales": 50, "price": 5.5},
+]
+
+ENGINE = ScanQueryProcessor(SCHEMA, ROWS)
+
+
+def run(pql):
+    return ENGINE.execute(parse_pql(pql))
+
+
+def agg_values(resp):
+    return [a.value for a in resp.aggregation_results]
+
+
+def test_count_star():
+    assert agg_values(run("SELECT count(*) FROM t")) == [5]
+
+
+def test_sum_min_max_avg():
+    resp = run("SELECT sum(sales), min(sales), max(sales), avg(sales), minmaxrange(sales) FROM t")
+    assert agg_values(resp) == [150.0, 10.0, 50.0, 30.0, 40.0]
+
+
+def test_filter_equality():
+    resp = run("SELECT count(*), sum(sales) FROM t WHERE city = 'sf'")
+    assert agg_values(resp) == [2, 30.0]
+    assert resp.num_docs_scanned == 2
+    assert resp.total_docs == 5
+
+
+def test_filter_in_and_range():
+    assert agg_values(run("SELECT count(*) FROM t WHERE city IN ('sf','ny')")) == [4]
+    assert agg_values(run("SELECT count(*) FROM t WHERE sales > 20")) == [3]
+    assert agg_values(run("SELECT count(*) FROM t WHERE sales BETWEEN 20 AND 40")) == [3]
+    assert agg_values(run("SELECT count(*) FROM t WHERE sales >= 20 AND sales < 50")) == [3]
+
+
+def test_filter_not_and_or():
+    assert agg_values(run("SELECT count(*) FROM t WHERE city <> 'sf'")) == [3]
+    assert agg_values(run("SELECT count(*) FROM t WHERE city = 'sf' OR sales = 40")) == [3]
+    assert agg_values(run("SELECT count(*) FROM t WHERE city NOT IN ('sf','la')")) == [2]
+
+
+def test_mv_predicate_any_semantics():
+    # tags contains 'a' in rows 0, 2, 3
+    assert agg_values(run("SELECT count(*) FROM t WHERE tags = 'a'")) == [3]
+    # NOT on MV: no value equals 'a' -> rows 1, 4
+    assert agg_values(run("SELECT count(*) FROM t WHERE tags <> 'a'")) == [2]
+
+
+def test_distinctcount():
+    assert agg_values(run("SELECT distinctcount(city) FROM t")) == [3]
+    assert agg_values(run("SELECT distinctcountmv(tags) FROM t")) == [3]
+
+
+def test_percentile_exact_formula():
+    # sales sorted: [10,20,30,40,50]; p50 idx = int(5*0.5)=2 -> 30
+    assert agg_values(run("SELECT percentile50(sales) FROM t")) == [30.0]
+    # p90 idx = int(4.5)=4 -> 50
+    assert agg_values(run("SELECT percentile90(sales) FROM t")) == [50.0]
+    assert agg_values(run("SELECT percentileest50(sales) FROM t")) == [30.0]
+
+
+def test_group_by_desc_order_and_top():
+    resp = run("SELECT sum(sales) FROM t GROUP BY city TOP 2")
+    gr = resp.aggregation_results[0].group_by_result
+    assert [(g.group, g.value) for g in gr] == [(["ny"], 80.0), (["la"], 40.0)]
+
+
+def test_group_by_min_ascending():
+    resp = run("SELECT min(sales) FROM t GROUP BY city")
+    gr = resp.aggregation_results[0].group_by_result
+    # min sorts ascending (reference quirk: startswith("min"))
+    assert [(g.group[0], g.value) for g in gr] == [("sf", 10.0), ("ny", 30.0), ("la", 40.0)]
+
+
+def test_group_by_mv_explodes():
+    resp = run("SELECT count(*) FROM t GROUP BY tags")
+    gr = {g.group[0]: g.value for g in resp.aggregation_results[0].group_by_result}
+    assert gr == {"a": 3, "b": 3, "c": 2}
+
+
+def test_group_by_multi_column():
+    resp = run("SELECT sum(sales) FROM t GROUP BY city, tags TOP 100")
+    gr = {tuple(g.group): g.value for g in resp.aggregation_results[0].group_by_result}
+    assert gr[("sf", "b")] == 30.0
+    assert gr[("ny", "c")] == 50.0
+
+
+def test_selection_basic():
+    resp = run("SELECT city, sales FROM t LIMIT 3")
+    s = resp.selection_results
+    assert s.columns == ["city", "sales"]
+    assert s.rows == [["sf", 10], ["sf", 20], ["ny", 30]]
+
+
+def test_selection_order_by():
+    resp = run("SELECT city FROM t ORDER BY sales DESC LIMIT 2")
+    assert resp.selection_results.rows == [["ny"], ["la"]]
+
+
+def test_selection_star_order():
+    resp = run("SELECT * FROM t LIMIT 1")
+    assert resp.selection_results.columns == ["city", "tags", "sales", "price"]
+
+
+def test_mv_aggregation():
+    # countMV counts every value: 2+1+1+2+2 = 8
+    assert agg_values(run("SELECT countmv(tags) FROM t")) == [8]
+
+
+def test_empty_result_defaults():
+    resp = run("SELECT count(*), sum(sales), min(sales), max(sales) FROM t WHERE city = 'zz'")
+    vals = agg_values(resp)
+    assert vals[0] == 0 and vals[1] == 0.0
+    assert vals[2] == math.inf and vals[3] == -math.inf
+
+
+def test_hll_close_to_exact():
+    resp = run("SELECT distinctcounthll(sales) FROM t")
+    # tiny cardinality -> linear counting is exact
+    assert agg_values(resp) == [5]
