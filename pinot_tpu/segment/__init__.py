@@ -1,0 +1,14 @@
+from pinot_tpu.segment.immutable import ColumnData, ColumnMetadata, ImmutableSegment, SegmentMetadata
+from pinot_tpu.segment.builder import SegmentBuilder, SegmentGeneratorConfig
+from pinot_tpu.segment.format import write_segment, read_segment
+
+__all__ = [
+    "ColumnData",
+    "ColumnMetadata",
+    "ImmutableSegment",
+    "SegmentMetadata",
+    "SegmentBuilder",
+    "SegmentGeneratorConfig",
+    "write_segment",
+    "read_segment",
+]

@@ -1,0 +1,49 @@
+"""Vectorized fixed-bit packing codecs.
+
+The TPU-native analog of the reference's fixed-bit forward-index
+readers/writers (pinot-core ``io/reader/impl/v1/FixedBitSingleValueReader.java``,
+``io/writer/impl/``): dictIds are stored with ``ceil(log2(cardinality))``
+bits each.  Unlike the Java word-by-word readers, packing/unpacking here
+is whole-array vectorized numpy (bit-slicing), used at segment
+write/load time; on device the forward index lives unpacked as int32
+(HBM trades space for gather speed; the packed form is the *disk* format).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def bits_required(cardinality: int) -> int:
+    """Minimum bits to store dictIds in [0, cardinality)."""
+    if cardinality <= 1:
+        return 1
+    return int(cardinality - 1).bit_length()
+
+
+def pack_bits(values: np.ndarray, nbits: int) -> np.ndarray:
+    """Pack int array into a uint8 byte stream, little-endian bit order."""
+    values = np.asarray(values, dtype=np.uint64)
+    n = values.size
+    if n == 0:
+        return np.zeros(0, dtype=np.uint8)
+    # Expand each value into its bits [n, nbits], then pack.
+    shifts = np.arange(nbits, dtype=np.uint64)
+    bits = ((values[:, None] >> shifts[None, :]) & 1).astype(np.uint8)
+    flat = bits.reshape(-1)
+    pad = (-flat.size) % 8
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, dtype=np.uint8)])
+    return np.packbits(flat.reshape(-1, 8)[:, ::-1], axis=1).reshape(-1)
+
+
+def unpack_bits(packed: np.ndarray, nbits: int, count: int) -> np.ndarray:
+    """Inverse of :func:`pack_bits`; returns int32 array of length count."""
+    if count == 0:
+        return np.zeros(0, dtype=np.int32)
+    packed = np.asarray(packed, dtype=np.uint8)
+    # undo per-byte bit order, then take the first count*nbits bits
+    bits = np.unpackbits(packed).reshape(-1, 8)[:, ::-1].reshape(-1)[: count * nbits]
+    bits = bits.reshape(count, nbits).astype(np.uint64)
+    shifts = np.arange(nbits, dtype=np.uint64)
+    vals = (bits << shifts[None, :]).sum(axis=1)
+    return vals.astype(np.int32)

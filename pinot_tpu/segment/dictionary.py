@@ -1,0 +1,100 @@
+"""Per-column sorted value dictionaries.
+
+Mirrors the reference's ``ImmutableDictionaryReader`` family
+(pinot-core ``segment/index/readers/ImmutableDictionaryReader.java:25``):
+values are stored sorted, ``index_of`` is a binary search, and dictIds
+are therefore *order-preserving* — which is what lets range predicates
+become dictId-space comparisons on device.
+
+Numeric dictionaries are numpy arrays (stageable into HBM); string
+dictionaries stay host-side (only dictIds reach the device, group keys
+are materialized back to strings at reduce time, as the reference does
+at result build).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+
+from pinot_tpu.common.schema import DataType
+
+
+class Dictionary:
+    """Sorted, deduplicated value dictionary for one column."""
+
+    def __init__(self, stored_type: DataType, values: Union[np.ndarray, List[str]]):
+        self.stored_type = stored_type
+        self.is_string = stored_type == DataType.STRING
+        if self.is_string:
+            self.values: Union[np.ndarray, List[str]] = list(values)
+            self._np = np.asarray(self.values, dtype=object)
+        else:
+            self.values = np.asarray(values, dtype=stored_type.to_numpy())
+            self._np = self.values
+
+    @classmethod
+    def build(cls, stored_type: DataType, raw_values: Sequence[Any]) -> "Dictionary":
+        if stored_type == DataType.STRING:
+            uniq = sorted(set(str(v) for v in raw_values))
+            return cls(stored_type, uniq)
+        arr = np.asarray(list(raw_values), dtype=stored_type.to_numpy())
+        return cls(stored_type, np.unique(arr))
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.values)
+
+    def get(self, dict_id: int) -> Any:
+        v = self.values[dict_id]
+        if self.is_string:
+            return v
+        return v.item()
+
+    def index_of(self, value: Any) -> int:
+        """dictId of value, or -1 if absent (binary search,
+        ImmutableDictionaryReader.java:39-55)."""
+        i = self.insertion_index(value)
+        if 0 <= i < len(self.values) and self._eq(self.values[i], value):
+            return int(i)
+        return -1
+
+    def insertion_index(self, value: Any) -> int:
+        """Index of the first element >= value (np.searchsorted 'left')."""
+        if self.is_string:
+            import bisect
+
+            return bisect.bisect_left(self.values, str(value))
+        return int(np.searchsorted(self.values, value, side="left"))
+
+    def _eq(self, a: Any, b: Any) -> bool:
+        if self.is_string:
+            return a == str(b)
+        return bool(a == b)
+
+    def index_array(self, raw: np.ndarray) -> np.ndarray:
+        """Vectorized index_of for building forward indexes (all values
+        must be present)."""
+        if self.is_string:
+            lookup = {v: i for i, v in enumerate(self.values)}
+            return np.fromiter((lookup[v] for v in raw), dtype=np.int32, count=len(raw))
+        idx = np.searchsorted(self.values, raw)
+        return idx.astype(np.int32)
+
+    @property
+    def min_value(self) -> Any:
+        return self.get(0) if len(self.values) else None
+
+    @property
+    def max_value(self) -> Any:
+        return self.get(len(self.values) - 1) if len(self.values) else None
+
+    def numeric_array(self, dtype=np.float64) -> np.ndarray:
+        """Dictionary values as a numeric array for device staging."""
+        if self.is_string:
+            raise TypeError("string dictionary has no numeric array")
+        return np.asarray(self.values, dtype=dtype)

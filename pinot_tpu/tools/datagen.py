@@ -52,7 +52,7 @@ def random_rows(
     return rows
 
 
-def test_schema(with_mv: bool = True) -> Schema:
+def make_test_schema(with_mv: bool = True) -> Schema:
     """A small mixed-type schema exercising every stored type."""
     dims = [
         FieldSpec("dimStr", DataType.STRING, FieldType.DIMENSION),
