@@ -78,15 +78,26 @@ class DataType(str, Enum):
         }[self.stored_type]
 
     def convert(self, value: Any) -> Any:
-        """Coerce a raw ingest value to this type's python representation."""
+        """Coerce a raw ingest value to this type's python representation.
+
+        FLOAT round-trips through float32 (the reference stores Java
+        ``float``), so predicate literals, stored values, and rendered
+        results all agree on the same 32-bit value.
+        """
         t = self.stored_type
         if t == DataType.STRING:
             if isinstance(value, bool):
                 return "true" if value else "false"
             return str(value)
         if t in (DataType.INT, DataType.LONG):
-            return int(value)
-        return float(value)
+            try:
+                return int(value)
+            except ValueError:
+                return int(float(value))
+        v = float(value)
+        if t == DataType.FLOAT:
+            return float(np.float32(v))
+        return v
 
 
 class FieldType(str, Enum):

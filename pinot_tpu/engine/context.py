@@ -1,0 +1,91 @@
+"""Table dictionary context: global (query-level) dictionaries + per-segment
+remaps.
+
+Dictionaries are per-segment in the reference, and cross-segment group-by
+merge happens by *materialized value* in Java HashMaps
+(``MCombineGroupByOperator.java:152``).  That doesn't vectorize.  The
+TPU-native design instead builds a **table-level global dictionary** per
+column (the sorted union of the segments' dictionaries) plus one small
+``remap: int32[segment_card]`` array per (segment, column) translating
+local dictIds to global ids.  Group keys, distinct-count presence vectors
+and percentile histograms are then indexed in the *global* id space —
+identical across segments — so cross-segment (and cross-chip) merge is a
+plain elementwise reduction (``psum``-able over ICI), with group-key
+materialization a single host-side lookup at reduce time.
+
+Contexts are cached per (table, segment-set fingerprint): segments are
+immutable, so remaps never change for a sealed segment.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pinot_tpu.common.schema import DataType
+from pinot_tpu.segment.dictionary import Dictionary
+from pinot_tpu.segment.immutable import ImmutableSegment
+
+
+@dataclass
+class GlobalColumn:
+    """Global dictionary + per-segment remap arrays for one column."""
+
+    name: str
+    stored_type: DataType
+    global_dict: Dictionary
+    # remaps[i][local_dict_id] -> global_dict_id  (int32, len = segment card)
+    remaps: List[np.ndarray]
+
+    @property
+    def global_cardinality(self) -> int:
+        return self.global_dict.cardinality
+
+
+class TableContext:
+    """Global dictionaries for one set of segments (one query's scope)."""
+
+    def __init__(self, segments: Sequence[ImmutableSegment]):
+        self.segments = list(segments)
+        self._columns: Dict[str, GlobalColumn] = {}
+
+    def column(self, name: str) -> GlobalColumn:
+        gc = self._columns.get(name)
+        if gc is None:
+            gc = self._build(name)
+            self._columns[name] = gc
+        return gc
+
+    def _build(self, name: str) -> GlobalColumn:
+        dicts = [seg.column(name).dictionary for seg in self.segments]
+        stored = dicts[0].stored_type
+        if stored == DataType.STRING:
+            union = sorted(set().union(*[set(d.values) for d in dicts]))
+            gdict = Dictionary(stored, union)
+            lookup = {v: i for i, v in enumerate(union)}
+            remaps = [
+                np.fromiter((lookup[v] for v in d.values), dtype=np.int32, count=len(d))
+                for d in dicts
+            ]
+        else:
+            union = np.unique(np.concatenate([np.asarray(d.values) for d in dicts]))
+            gdict = Dictionary(stored, union)
+            remaps = [
+                np.searchsorted(union, np.asarray(d.values)).astype(np.int32) for d in dicts
+            ]
+        return GlobalColumn(name=name, stored_type=stored, global_dict=gdict, remaps=remaps)
+
+
+_context_cache: Dict[Tuple[str, ...], TableContext] = {}
+
+
+def get_table_context(segments: Sequence[ImmutableSegment]) -> TableContext:
+    key = tuple(f"{s.segment_name}:{s.metadata.crc}" for s in segments)
+    ctx = _context_cache.get(key)
+    if ctx is None:
+        ctx = TableContext(segments)
+        if len(_context_cache) > 64:
+            _context_cache.clear()
+        _context_cache[key] = ctx
+    return ctx

@@ -1,0 +1,342 @@
+"""Per-instance query executor: segments + BrokerRequest -> IntermediateResult.
+
+The ``ServerQueryExecutorV1Impl.processQuery`` analog
+(``core/query/executor/ServerQueryExecutorV1Impl.java:88``):
+prune -> stage -> plan -> run compiled kernel -> finalize partials.
+
+Unlike the reference's per-segment operator trees + combine thread pool,
+ALL segments execute in one vmapped XLA program with the cross-segment
+merge fused in (see ``kernel.py``); this host class only prepares inputs
+and converts device outputs to mergeable ``IntermediateResult`` partials.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from pinot_tpu.common.request import BrokerRequest
+from pinot_tpu.common.values import render_value
+from pinot_tpu.engine import config
+from pinot_tpu.engine.context import TableContext, get_table_context
+from pinot_tpu.engine.device import StagedTable, get_staged
+from pinot_tpu.engine.kernel import make_table_kernel
+from pinot_tpu.engine.plan import StaticPlan, build_query_inputs, build_static_plan
+from pinot_tpu.engine.pruner import prune_segments
+from pinot_tpu.engine.results import (
+    AggPartial,
+    AvgPartial,
+    CountPartial,
+    DistinctPartial,
+    HistogramPartial,
+    HllPartial,
+    IntermediateResult,
+    MaxPartial,
+    MinMaxRangePartial,
+    MinPartial,
+    SumPartial,
+)
+from pinot_tpu.segment.immutable import ImmutableSegment
+
+
+class QueryExecutor:
+    """Executes queries over a set of immutable segments on this host's
+    device(s)."""
+
+    def execute(
+        self, segments: Sequence[ImmutableSegment], request: BrokerRequest
+    ) -> IntermediateResult:
+        total_docs = sum(s.num_docs for s in segments)
+        live = prune_segments(segments, request)
+        if not live:
+            return self._empty_result(request, total_docs)
+
+        needed = set(request.referenced_columns())
+        sel_columns: Optional[List[str]] = None
+        if request.is_selection:
+            sel_columns = self._resolve_selection_columns(request, live[0])
+            needed.update(sel_columns)
+
+        ctx = get_table_context(live)
+        staged = get_staged(live, sorted(needed))
+        plan = build_static_plan(request, ctx, staged)
+
+        if not plan.on_device:
+            from pinot_tpu.engine.host_fallback import execute_host
+
+            return execute_host(live, ctx, request, total_docs, sel_columns)
+
+        q_inputs = self._to_device_inputs(build_query_inputs(request, plan, ctx, staged))
+        seg_arrays = self._segment_arrays(plan, staged, needed)
+        kernel = make_table_kernel(plan)
+        outs = kernel(seg_arrays, q_inputs)
+        outs = {k: np.asarray(v) if not isinstance(v, tuple) else tuple(np.asarray(x) for x in v) for k, v in outs.items()}
+
+        return self._finalize(request, plan, ctx, staged, live, outs, total_docs, sel_columns)
+
+    # ------------------------------------------------------------------
+    def _resolve_selection_columns(
+        self, request: BrokerRequest, seg: ImmutableSegment
+    ) -> List[str]:
+        cols = request.selection.columns
+        if not cols or cols == ["*"]:
+            return list(seg.columns.keys())
+        return list(cols)
+
+    def _segment_arrays(
+        self, plan: StaticPlan, staged: StagedTable, needed: set
+    ) -> Dict[str, Any]:
+        arrays: Dict[str, Any] = {"valid": staged.valid}
+        for name in needed:
+            col = staged.column(name)
+            if col.fwd is not None:
+                arrays[f"{name}.fwd"] = col.fwd
+            if col.mv is not None:
+                arrays[f"{name}.mv"] = col.mv
+                arrays[f"{name}.mv_valid"] = col.mv_valid
+            if col.dict_vals is not None:
+                arrays[f"{name}.dict"] = col.dict_vals
+        return arrays
+
+    def _to_device_inputs(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
+        def conv(x):
+            if isinstance(x, np.ndarray):
+                return jnp.asarray(x)
+            if isinstance(x, list):
+                return [conv(v) for v in x]
+            if isinstance(x, dict):
+                return {k: conv(v) for k, v in x.items()}
+            return x
+
+        return conv(inputs)
+
+    def _empty_result(self, request: BrokerRequest, total_docs: int) -> IntermediateResult:
+        res = IntermediateResult(total_docs=total_docs)
+        if request.is_aggregation and not request.is_group_by:
+            from pinot_tpu.engine.results import make_partial
+
+            res.aggregations = [make_partial(a.base_function) for a in request.aggregations]
+        elif request.is_group_by:
+            res.groups = {}
+        else:
+            res.selection_rows = []
+        return res
+
+    # ------------------------------------------------------------------
+    def _finalize(
+        self,
+        request: BrokerRequest,
+        plan: StaticPlan,
+        ctx: TableContext,
+        staged: StagedTable,
+        live: List[ImmutableSegment],
+        outs: Dict[str, Any],
+        total_docs: int,
+        sel_columns: Optional[List[str]],
+    ) -> IntermediateResult:
+        matched = int(outs["num_docs"])
+        res = IntermediateResult(
+            num_docs_scanned=matched,
+            total_docs=total_docs,
+            num_segments_queried=len(live),
+            num_entries_scanned_in_filter=len(plan.leaves) * staged.total_docs,
+            num_entries_scanned_post_filter=matched * max(1, len(plan.aggs)),
+        )
+
+        if plan.group_by is not None:
+            res.groups = self._finalize_groups(request, plan, ctx, outs)
+        elif plan.aggs:
+            res.aggregations = [
+                self._scalar_partial(agg, outs[f"agg_{i}"], ctx)
+                for i, agg in enumerate(plan.aggs)
+            ]
+        if plan.selection is not None:
+            res.selection_rows = self._finalize_selection(
+                request, plan, live, outs, sel_columns
+            )
+            res.selection_columns = sel_columns
+        return res
+
+    def _scalar_partial(self, agg, state, ctx: TableContext) -> AggPartial:
+        base = agg.base
+        if base == "count":
+            return CountPartial(float(state))
+        if base == "sum":
+            return SumPartial(float(state))
+        if base == "min":
+            return MinPartial(float(state))
+        if base == "max":
+            return MaxPartial(float(state))
+        if base == "avg":
+            return AvgPartial(float(state[0]), float(state[1]))
+        if base == "minmaxrange":
+            return MinMaxRangePartial(float(state[0]), float(state[1]))
+        if agg.kind == "presence":
+            gdict = ctx.column(agg.column).global_dict
+            ids = np.nonzero(np.asarray(state))[0]
+            return DistinctPartial({gdict.get(int(i)) for i in ids if i < gdict.cardinality})
+        if agg.kind == "hist":
+            gdict = ctx.column(agg.column).global_dict
+            h = np.asarray(state)
+            ids = np.nonzero(h)[0]
+            counts = {
+                float(gdict.get(int(i))): int(h[i]) for i in ids if i < gdict.cardinality
+            }
+            p = int(agg.base[len("percentileest"):]) if agg.base.startswith("percentileest") else int(agg.base[len("percentile"):])
+            return HistogramPartial(counts, percentile=p)
+        if agg.kind == "hll":
+            return HllPartial(np.asarray(state).astype(np.uint8))
+        raise AssertionError(agg)
+
+    # ------------------------------------------------------------------
+    def _finalize_groups(
+        self, request: BrokerRequest, plan: StaticPlan, ctx: TableContext, outs
+    ) -> Dict[Tuple[str, ...], List[AggPartial]]:
+        gb = plan.group_by
+        presence = np.asarray(outs["gb_presence"]).astype(bool)
+        keys = np.nonzero(presence)[0]
+        if keys.size == 0:
+            return {}
+
+        # Trim candidate groups per aggregation (reference trims to
+        # topN*5 per server, MCombineGroupByOperator.java:216); the
+        # union over aggregations is kept so merges stay consistent.
+        trim = max(gb.top_n * 5, 100)
+        if keys.size > trim:
+            candidates: set = set()
+            for i, agg in enumerate(plan.aggs):
+                order_vals = self._group_order_values(agg, outs[f"gb_{i}"], keys, ctx)
+                asc = agg.func.startswith("min")
+                order = np.argsort(order_vals, kind="stable")
+                chosen = order[:trim] if asc else order[-trim:]
+                candidates.update(keys[chosen].tolist())
+                # keep every group tied with the boundary value — final
+                # ordering breaks ties by rendered key, which the trim
+                # pass cannot see
+                boundary = order_vals[order[trim - 1 if asc else -trim]]
+                candidates.update(keys[order_vals == boundary].tolist())
+            keys = np.asarray(sorted(candidates), dtype=keys.dtype)
+
+        # decompose mixed-radix keys -> per-column global ids
+        gids = []
+        rem = keys.copy()
+        for gcard in reversed(gb.gcards):
+            gids.append(rem % gcard)
+            rem = rem // gcard
+        gids.reverse()
+
+        gdicts = [ctx.column(c).global_dict for c in gb.columns]
+        key_tuples: List[Tuple[str, ...]] = []
+        for row in range(keys.size):
+            key_tuples.append(
+                tuple(
+                    render_value(gdicts[j].stored_type, gdicts[j].get(int(gids[j][row])))
+                    for j in range(len(gb.columns))
+                )
+            )
+
+        groups: Dict[Tuple[str, ...], List[AggPartial]] = {}
+        for row, ktup in enumerate(key_tuples):
+            k = int(keys[row])
+            partials: List[AggPartial] = []
+            for i, agg in enumerate(plan.aggs):
+                partials.append(self._group_partial(agg, outs[f"gb_{i}"], k, ctx))
+            groups[ktup] = partials
+        return groups
+
+    def _group_order_values(self, agg, state, keys: np.ndarray, ctx: TableContext) -> np.ndarray:
+        """Exact finalized per-group values, used for trim ordering."""
+        base = agg.base
+        if base in ("count", "sum", "min", "max"):
+            return np.asarray(state)[keys]
+        if base == "avg":
+            s = np.asarray(state[0])[keys]
+            c = np.asarray(state[1])[keys]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                return np.where(c > 0, s / np.maximum(c, 1), -np.inf)
+        if base == "minmaxrange":
+            return np.asarray(state[1])[keys] - np.asarray(state[0])[keys]
+        if agg.kind == "presence":
+            return np.asarray(state)[keys].sum(axis=1).astype(float)
+        if agg.kind == "hist":
+            # exact percentile from histogram rows, vectorized:
+            # sorted[int(n * p/100)] per group (PercentileUtil.java:50)
+            p = int(base[len("percentileest"):]) if base.startswith("percentileest") else int(base[len("percentile"):])
+            h = np.asarray(state)[keys]  # [K, gcard_pad]
+            cs = np.cumsum(h, axis=1)
+            n = cs[:, -1]
+            idx = np.minimum((n * p / 100.0).astype(np.int64), np.maximum(n - 1, 0))
+            pos = (cs <= idx[:, None]).sum(axis=1)
+            gdict = ctx.column(agg.column).global_dict
+            vals = np.asarray(gdict.values, dtype=np.float64)
+            pos = np.minimum(pos, vals.size - 1)
+            return np.where(n > 0, vals[pos], -np.inf)
+        if agg.kind == "hll":
+            from pinot_tpu.engine import hll as hll_mod
+
+            ests = hll_mod.estimate_from_registers(np.asarray(state)[keys])
+            return np.asarray(ests, dtype=np.float64)
+        raise AssertionError(agg)
+
+    def _group_partial(self, agg, state, key: int, ctx: TableContext) -> AggPartial:
+        base = agg.base
+        if base == "count":
+            return CountPartial(float(np.asarray(state)[key]))
+        if base == "sum":
+            return SumPartial(float(np.asarray(state)[key]))
+        if base == "min":
+            return MinPartial(float(np.asarray(state)[key]))
+        if base == "max":
+            return MaxPartial(float(np.asarray(state)[key]))
+        if base == "avg":
+            return AvgPartial(float(np.asarray(state[0])[key]), float(np.asarray(state[1])[key]))
+        if base == "minmaxrange":
+            return MinMaxRangePartial(float(np.asarray(state[0])[key]), float(np.asarray(state[1])[key]))
+        if agg.kind == "presence":
+            gdict = ctx.column(agg.column).global_dict
+            row = np.asarray(state)[key]
+            ids = np.nonzero(row)[0]
+            return DistinctPartial({gdict.get(int(i)) for i in ids if i < gdict.cardinality})
+        if agg.kind == "hist":
+            gdict = ctx.column(agg.column).global_dict
+            row = np.asarray(state)[key]
+            ids = np.nonzero(row)[0]
+            counts = {float(gdict.get(int(i))): int(row[i]) for i in ids if i < gdict.cardinality}
+            p = int(base[len("percentileest"):]) if base.startswith("percentileest") else int(base[len("percentile"):])
+            return HistogramPartial(counts, percentile=p)
+        if agg.kind == "hll":
+            return HllPartial(np.asarray(state)[key].astype(np.uint8))
+        raise AssertionError(agg)
+
+    # ------------------------------------------------------------------
+    def _finalize_selection(
+        self,
+        request: BrokerRequest,
+        plan: StaticPlan,
+        live: List[ImmutableSegment],
+        outs,
+        sel_columns: List[str],
+    ) -> List[Tuple[list, list]]:
+        sel = request.selection
+        docids = np.asarray(outs["sel_docids"])  # [S, k]
+        valid = np.asarray(outs["sel_valid"])  # [S, k]
+        rows: List[Tuple[list, list]] = []
+        for si, seg in enumerate(live):
+            for j in range(docids.shape[1]):
+                if not valid[si, j]:
+                    continue
+                doc = int(docids[si, j])
+                if doc >= seg.num_docs:
+                    continue
+                full = seg.row(doc)
+                sort_vals = []
+                for s in sel.sorts:
+                    v = full[s.column]
+                    if isinstance(v, list):
+                        v = v[0] if v else None
+                    sort_vals.append(v)
+                rows.append((sort_vals, [full[c] for c in sel_columns]))
+        return rows

@@ -1,0 +1,170 @@
+"""Host fallback path for queries whose dense device state would not fit
+(group-by key spaces beyond ``MAX_GROUP_CAPACITY``, huge value-state
+aggregations, composite sort keys beyond the key dtype).
+
+The reference's analog is the hash-map group-by storage types
+(``DefaultGroupKeyGenerator.java:60-63`` LONG_MAP_BASED/ARRAY_MAP_BASED)
+that kick in when the dense ARRAY_BASED key space overflows.  Here the
+filter still evaluates vectorized (numpy match-table gathers over the
+forward index); only the aggregation of *matched* rows falls back to the
+row-wise accumulators shared with the scan oracle.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.common.request import BrokerRequest, FilterOperator, FilterQueryTree
+from pinot_tpu.common.values import render_value
+from pinot_tpu.engine import config
+from pinot_tpu.engine.context import TableContext
+from pinot_tpu.engine.plan import match_table
+from pinot_tpu.engine.results import IntermediateResult, make_partial
+from pinot_tpu.segment.immutable import ImmutableSegment
+from pinot_tpu.tools.scan_engine import _Accumulator
+
+
+def _segment_mask(seg: ImmutableSegment, tree: Optional[FilterQueryTree]) -> np.ndarray:
+    n = seg.num_docs
+    if tree is None:
+        return np.ones(n, dtype=bool)
+    if tree.is_leaf:
+        col = seg.column(tree.column)
+        d = col.dictionary
+        table = match_table(tree, d, d.cardinality if d.cardinality else 1)
+        negative = tree.operator in (FilterOperator.NOT, FilterOperator.NOT_IN)
+        if col.is_single_value:
+            if negative:
+                table = ~table
+            return table[col.fwd]
+        hits = table[col.mv_values]
+        any_hit = np.zeros(n, dtype=bool)
+        np.logical_or.at(any_hit, np.repeat(np.arange(n), np.diff(col.mv_offsets)), hits)
+        return ~any_hit if negative else any_hit
+    masks = [_segment_mask(seg, c) for c in tree.children]
+    out = masks[0]
+    for m in masks[1:]:
+        out = (out & m) if tree.operator == FilterOperator.AND else (out | m)
+    return out
+
+
+def execute_host(
+    segments: List[ImmutableSegment],
+    ctx: TableContext,
+    request: BrokerRequest,
+    total_docs: int,
+    sel_columns: Optional[List[str]],
+) -> IntermediateResult:
+    res = IntermediateResult(
+        total_docs=total_docs,
+        num_segments_queried=len(segments),
+    )
+    if request.is_group_by:
+        res.groups = {}
+    elif request.is_aggregation:
+        res.aggregations = [make_partial(a.base_function) for a in request.aggregations]
+    else:
+        res.selection_rows = []
+        res.selection_columns = sel_columns
+
+    for seg in segments:
+        mask = _segment_mask(seg, request.filter)
+        matched = np.nonzero(mask)[0]
+        res.num_docs_scanned += int(matched.size)
+
+        if request.is_group_by:
+            gb = request.group_by
+            for doc in matched:
+                row = seg.row(int(doc))
+                for key in _group_keys(seg, row, gb.columns):
+                    accs = res.groups.get(key)
+                    if accs is None:
+                        accs = [_Accumulator(a) for a in request.aggregations]
+                        res.groups[key] = accs
+                    for acc in accs:
+                        acc.add(row)
+        elif request.is_aggregation:
+            for doc in matched:
+                row = seg.row(int(doc))
+                for acc, _a in zip(res.aggregations, request.aggregations):
+                    acc.add(row)
+        else:
+            sel = request.selection
+            k = sel.offset + sel.size
+            take = matched[: k] if not sel.sorts else matched
+            for doc in take:
+                row = seg.row(int(doc))
+                sort_vals = []
+                for s in sel.sorts:
+                    v = row[s.column]
+                    if isinstance(v, list):
+                        v = v[0] if v else None
+                    sort_vals.append(v)
+                res.selection_rows.append((sort_vals, [row[c] for c in sel_columns]))
+            if sel.sorts and len(res.selection_rows) > 4 * k:
+                pass  # bounded enough for fallback; final trim at reduce
+
+    # adapt oracle accumulators -> mergeable partials
+    if request.is_group_by:
+        res.groups = {
+            key: [_to_partial(acc) for acc in accs] for key, accs in res.groups.items()
+        }
+    elif request.is_aggregation:
+        res.aggregations = [_to_partial(acc) for acc in res.aggregations]
+    return res
+
+
+def _group_keys(seg: ImmutableSegment, row, columns) -> List[Tuple[str, ...]]:
+    keys: List[Tuple[str, ...]] = [()]
+    for col in columns:
+        st = seg.column(col).dictionary.stored_type
+        v = row[col]
+        vals = v if isinstance(v, list) else [v]
+        keys = [k + (render_value(st, x),) for k in keys for x in vals]
+    return keys
+
+
+def _to_partial(acc):
+    """Convert a scan-oracle accumulator (or an already-built partial)
+    into a mergeable AggPartial."""
+    from pinot_tpu.engine.results import (
+        AggPartial,
+        AvgPartial,
+        CountPartial,
+        DistinctPartial,
+        HistogramPartial,
+        HllPartial,
+        MaxPartial,
+        MinMaxRangePartial,
+        MinPartial,
+        SumPartial,
+    )
+    from pinot_tpu.engine import hll as hll_mod
+
+    if isinstance(acc, AggPartial):
+        return acc
+    base = acc.base
+    if base == "count":
+        return CountPartial(acc.count)
+    if base == "sum":
+        return SumPartial(acc.sum)
+    if base == "min":
+        return MinPartial(acc.min)
+    if base == "max":
+        return MaxPartial(acc.max)
+    if base == "avg":
+        return AvgPartial(acc.sum, acc.count)
+    if base == "minmaxrange":
+        return MinMaxRangePartial(acc.min, acc.max)
+    if base == "distinctcount":
+        return DistinctPartial(set(acc.distinct))
+    if base in ("distinctcounthll", "fasthll"):
+        return HllPartial(hll_mod.registers_from_values(acc.distinct))
+    if base.startswith("percentile"):
+        p = int(base[len("percentileest"):]) if base.startswith("percentileest") else int(base[len("percentile"):])
+        counts: Dict[float, int] = {}
+        for v in acc.values:
+            counts[v] = counts.get(v, 0) + 1
+        return HistogramPartial(counts, percentile=p)
+    raise ValueError(base)

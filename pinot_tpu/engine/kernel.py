@@ -1,0 +1,412 @@
+"""Kernel builder: StaticPlan -> jit-compiled query kernel.
+
+The reference executes a virtual-call operator tree per segment in
+10k-doc blocks (``AggregationGroupByOperator.java:74-96``,
+``MProjectionOperator.java``).  Here the whole per-segment pipeline —
+filter mask -> projection gather -> aggregate / group-by scatter —
+is ONE traced XLA program over the full (padded) column arrays:
+
+  mask      = boolean combine of match-table gathers       (filter ops)
+  values    = dict_vals[fwd]                                (projection)
+  scalars   = masked reductions                             (aggregation)
+  group-by  = scatter-add/min/max into dense [capacity]
+              holders keyed by global-id mixed-radix keys   (group-by)
+
+The kernel is written for ONE segment and lifted with ``jax.vmap`` over
+the stacked segment axis — the TPU replacement for MCombineOperator's
+thread pools; cross-segment merge is an elementwise reduction over that
+axis (and a `psum` across chips in ``pinot_tpu.parallel``).
+
+Everything is static-shaped: padding rows are masked by ``valid``,
+invalid scatter entries are routed to index=capacity and dropped
+(XLA scatter mode 'drop').
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pinot_tpu.engine import config
+from pinot_tpu.engine.plan import MV_ANY, MV_NONE, SV, StaticAgg, StaticPlan
+
+BIG = jnp.inf
+
+
+def _leaf_mask(plan: StaticPlan, i: int, seg: Dict[str, Any], q: Dict[str, Any]) -> jnp.ndarray:
+    leaf = plan.leaves[i]
+    table = q["match"][i]  # [card_pad] bool
+    if leaf.mode == SV:
+        fwd = seg[f"{leaf.column}.fwd"]  # [n]
+        return table[fwd]
+    mv = seg[f"{leaf.column}.mv"]  # [n, mv]
+    mvv = seg[f"{leaf.column}.mv_valid"]
+    hit = jnp.any(table[mv] & mvv, axis=-1)
+    if leaf.mode == MV_ANY:
+        return hit
+    return ~hit  # MV_NONE
+
+
+def _eval_tree(plan: StaticPlan, node: tuple, seg, q) -> jnp.ndarray:
+    kind = node[0]
+    if kind == "leaf":
+        return _leaf_mask(plan, node[1], seg, q)
+    masks = [_eval_tree(plan, c, seg, q) for c in node[1]]
+    out = masks[0]
+    for m in masks[1:]:
+        out = (out & m) if kind == "and" else (out | m)
+    return out
+
+
+def _row_values(agg: StaticAgg, seg, mask):
+    """Per-row (or per-entry) numeric values + entry mask for an agg column."""
+    fdt = config.float_dtype()
+    if agg.is_mv:
+        mv = seg[f"{agg.column}.mv"]
+        mvv = seg[f"{agg.column}.mv_valid"] & mask[:, None]
+        vals = seg[f"{agg.column}.dict"][mv]
+        return vals, mvv
+    fwd = seg[f"{agg.column}.fwd"]
+    vals = seg[f"{agg.column}.dict"][fwd]
+    return vals, mask
+
+
+def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
+    """Per-segment partial state for one aggregation (no group-by)."""
+    fdt = config.float_dtype()
+    base = agg.base
+    if base == "count":
+        if agg.is_mv:
+            mvv = seg[f"{agg.column}.mv_valid"] & mask[:, None]
+            return jnp.sum(mvv, dtype=fdt)
+        return jnp.sum(mask, dtype=fdt)
+
+    if agg.kind == "scalar" or agg.kind == "pair":
+        vals, m = _row_values(agg, seg, mask)
+        if base == "sum":
+            return jnp.sum(jnp.where(m, vals, 0), dtype=fdt)
+        if base == "min":
+            return jnp.min(jnp.where(m, vals, BIG))
+        if base == "max":
+            return jnp.max(jnp.where(m, vals, -BIG))
+        if base == "avg":
+            return (
+                jnp.sum(jnp.where(m, vals, 0), dtype=fdt),
+                jnp.sum(m, dtype=fdt),
+            )
+        if base == "minmaxrange":
+            return (
+                jnp.min(jnp.where(m, vals, BIG)),
+                jnp.max(jnp.where(m, vals, -BIG)),
+            )
+
+    aux = q["agg_aux"][i]
+    if agg.kind == "presence":
+        remap = aux["remap"]  # [card_pad] int32 -> global ids
+        presence = jnp.zeros(agg.gcard_pad, dtype=jnp.int32)
+        if agg.is_mv:
+            mv = seg[f"{agg.column}.mv"]
+            m = seg[f"{agg.column}.mv_valid"] & mask[:, None]
+            gids = remap[mv]
+            return presence.at[gids].max(m.astype(jnp.int32), mode="drop")
+        gids = remap[seg[f"{agg.column}.fwd"]]
+        return presence.at[gids].max(mask.astype(jnp.int32), mode="drop")
+
+    if agg.kind == "hist":
+        remap = aux["remap"]
+        hist = jnp.zeros(agg.gcard_pad, dtype=fdt)
+        if agg.is_mv:
+            mv = seg[f"{agg.column}.mv"]
+            m = seg[f"{agg.column}.mv_valid"] & mask[:, None]
+            return hist.at[remap[mv]].add(m.astype(fdt), mode="drop")
+        gids = remap[seg[f"{agg.column}.fwd"]]
+        return hist.at[gids].add(mask.astype(fdt), mode="drop")
+
+    if agg.kind == "hll":
+        bucket, rho = aux["bucket"], aux["rho"]
+        regs = jnp.zeros(config.HLL_M, dtype=jnp.int32)
+        if agg.is_mv:
+            mv = seg[f"{agg.column}.mv"]
+            m = seg[f"{agg.column}.mv_valid"] & mask[:, None]
+            return regs.at[bucket[mv]].max(
+                jnp.where(m, rho[mv], 0), mode="drop"
+            )
+        fwd = seg[f"{agg.column}.fwd"]
+        return regs.at[bucket[fwd]].max(
+            jnp.where(mask, rho[fwd], 0), mode="drop"
+        )
+
+    raise AssertionError(agg)
+
+
+def _group_keys(plan: StaticPlan, seg, q, mask):
+    """Mixed-radix global group keys.
+
+    Returns (keys [n, E], kvalid [n, E]) where E is the static MV
+    expansion factor (1 if all group columns are single-value).
+    """
+    gb = plan.group_by
+    kdt = config.key_dtype()
+    n = mask.shape[0]
+    keys = jnp.zeros((n, 1), dtype=kdt)
+    kvalid = mask[:, None]
+    for col, is_mv, gcard, remap in zip(
+        gb.columns, gb.col_is_mv, gb.gcards, q["group_remap"]
+    ):
+        if not is_mv:
+            g = remap[seg[f"{col}.fwd"]].astype(kdt)  # [n]
+            keys = keys * gcard + g[:, None]
+        else:
+            mv = seg[f"{col}.mv"]
+            mvv = seg[f"{col}.mv_valid"]
+            g = remap[mv].astype(kdt)  # [n, mv]
+            E = keys.shape[1]
+            keys = (keys[:, :, None] * gcard + g[:, None, :]).reshape(n, -1)
+            kvalid = (kvalid[:, :, None] & mvv[:, None, :]).reshape(n, -1)
+    return keys, kvalid
+
+
+def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -> Any:
+    fdt = config.float_dtype()
+    base = agg.base
+    idx = jnp.where(kvalid, keys, capacity)  # invalid -> dropped
+    flat_idx = idx.reshape(-1)
+    fvalid = kvalid.reshape(-1)
+
+    def per_entry(row_scalar):
+        """Broadcast a per-row scalar across the expansion axis, flattened."""
+        return jnp.broadcast_to(row_scalar[:, None], idx.shape).reshape(-1)
+
+    if base == "count":
+        if agg.is_mv:
+            mvv = seg[f"{agg.column}.mv_valid"]
+            row_counts = jnp.sum(mvv, axis=-1).astype(fdt)
+            w = per_entry(row_counts)
+        else:
+            w = jnp.ones_like(flat_idx, dtype=fdt)
+        return jnp.zeros(capacity, dtype=fdt).at[flat_idx].add(
+            jnp.where(fvalid, w, 0), mode="drop"
+        )
+
+    if agg.kind in ("scalar", "pair"):
+        vals, m = _row_values(agg, seg, mask)
+        if agg.is_mv:
+            row_sum = jnp.sum(jnp.where(m, vals, 0), axis=-1)
+            row_cnt = jnp.sum(m, axis=-1).astype(fdt)
+            row_min = jnp.min(jnp.where(m, vals, BIG), axis=-1)
+            row_max = jnp.max(jnp.where(m, vals, -BIG), axis=-1)
+        else:
+            row_sum = vals
+            row_cnt = jnp.ones_like(vals, dtype=fdt)
+            row_min = vals
+            row_max = vals
+
+        def scatter_add(row_vals):
+            return jnp.zeros(capacity, dtype=fdt).at[flat_idx].add(
+                jnp.where(fvalid, per_entry(row_vals), 0), mode="drop"
+            )
+
+        def scatter_min(row_vals):
+            return jnp.full(capacity, BIG, dtype=fdt).at[flat_idx].min(
+                jnp.where(fvalid, per_entry(row_vals), BIG), mode="drop"
+            )
+
+        def scatter_max(row_vals):
+            return jnp.full(capacity, -BIG, dtype=fdt).at[flat_idx].max(
+                jnp.where(fvalid, per_entry(row_vals), -BIG), mode="drop"
+            )
+
+        if base == "sum":
+            return scatter_add(row_sum)
+        if base == "min":
+            return scatter_min(row_min)
+        if base == "max":
+            return scatter_max(row_max)
+        if base == "avg":
+            return (scatter_add(row_sum), scatter_add(row_cnt))
+        if base == "minmaxrange":
+            return (scatter_min(row_min), scatter_max(row_max))
+
+    aux = q["agg_aux"][i]
+    if agg.kind in ("presence", "hist"):
+        remap = aux["remap"]
+        if agg.is_mv:
+            mv = seg[f"{agg.column}.mv"]
+            mvv = seg[f"{agg.column}.mv_valid"]
+            gids = remap[mv]  # [n, mv]
+            E = idx.shape[1]
+            pair_k = jnp.broadcast_to(idx[:, :, None], idx.shape + gids.shape[-1:]).reshape(-1)
+            pair_g = jnp.broadcast_to(gids[:, None, :], (gids.shape[0], E, gids.shape[-1])).reshape(-1)
+            pair_v = (kvalid[:, :, None] & mvv[:, None, :]).reshape(-1)
+        else:
+            gids = remap[seg[f"{agg.column}.fwd"]]  # [n]
+            pair_k = flat_idx
+            pair_g = per_entry(gids)
+            pair_v = fvalid
+        if agg.kind == "presence":
+            holder = jnp.zeros((capacity, agg.gcard_pad), dtype=jnp.int32)
+            return holder.at[pair_k, pair_g].max(pair_v.astype(jnp.int32), mode="drop")
+        holder = jnp.zeros((capacity, agg.gcard_pad), dtype=fdt)
+        return holder.at[pair_k, pair_g].add(pair_v.astype(fdt), mode="drop")
+
+    if agg.kind == "hll":
+        bucket, rho = aux["bucket"], aux["rho"]
+        if agg.is_mv:
+            mv = seg[f"{agg.column}.mv"]
+            mvv = seg[f"{agg.column}.mv_valid"]
+            b = bucket[mv]
+            r = rho[mv]
+            E = idx.shape[1]
+            pair_k = jnp.broadcast_to(idx[:, :, None], idx.shape + b.shape[-1:]).reshape(-1)
+            pair_b = jnp.broadcast_to(b[:, None, :], (b.shape[0], E, b.shape[-1])).reshape(-1)
+            pair_r = jnp.broadcast_to(r[:, None, :], (r.shape[0], E, r.shape[-1])).reshape(-1)
+            pair_v = (kvalid[:, :, None] & mvv[:, None, :]).reshape(-1)
+        else:
+            fwd = seg[f"{agg.column}.fwd"]
+            pair_k = flat_idx
+            pair_b = per_entry(bucket[fwd])
+            pair_r = per_entry(rho[fwd])
+            pair_v = fvalid
+        holder = jnp.zeros((capacity, config.HLL_M), dtype=jnp.int32)
+        return holder.at[pair_k, pair_b].max(
+            jnp.where(pair_v, pair_r, 0), mode="drop"
+        )
+
+    raise AssertionError(agg)
+
+
+def make_single_segment_kernel(plan: StaticPlan) -> Callable:
+    def kernel(seg: Dict[str, Any], q: Dict[str, Any]) -> Dict[str, Any]:
+        valid = seg["valid"]
+        if plan.filter_tree is not None:
+            mask = _eval_tree(plan, plan.filter_tree, seg, q) & valid
+        else:
+            mask = valid
+        out: Dict[str, Any] = {
+            "num_docs": jnp.sum(mask, dtype=config.float_dtype())
+        }
+
+        if plan.group_by is not None:
+            keys, kvalid = _group_keys(plan, seg, q, mask)
+            cap = plan.group_by.capacity
+            out["gb_presence"] = (
+                jnp.zeros(cap, dtype=jnp.int32)
+                .at[jnp.where(kvalid, keys, cap).reshape(-1)]
+                .max(kvalid.reshape(-1).astype(jnp.int32), mode="drop")
+            )
+            for i, agg in enumerate(plan.aggs):
+                out[f"gb_{i}"] = _group_state(agg, i, seg, q, mask, keys, kvalid, cap)
+        else:
+            for i, agg in enumerate(plan.aggs):
+                out[f"agg_{i}"] = _agg_state(agg, i, seg, q, mask)
+
+        if plan.selection is not None:
+            out.update(_selection_outputs(plan, seg, q, mask))
+        return out
+
+    return kernel
+
+
+def _selection_outputs(plan: StaticPlan, seg, q, mask) -> Dict[str, Any]:
+    sel = plan.selection
+    n = mask.shape[0]
+    kdt = config.key_dtype()
+    if not sel.sort_columns:
+        # first-k matching docIds, in doc order
+        score = jnp.where(mask, jnp.arange(n, dtype=kdt), n)
+    else:
+        key = jnp.zeros(n, dtype=kdt)
+        for col, asc, gcard, remap in zip(
+            sel.sort_columns, sel.sort_ascending, sel.sort_gcards, q["sel_remap"]
+        ):
+            scol = seg.get(f"{col}.fwd")
+            if scol is None:
+                # MV sort column: order by first value (oracle semantics)
+                scol = seg[f"{col}.mv"][:, 0]
+            g = remap[scol].astype(kdt)
+            if not asc:
+                g = (gcard - 1) - g
+            key = key * gcard + g
+        score = jnp.where(mask, key, jnp.iinfo(kdt).max)
+    neg = -score
+    _, idx = jax.lax.top_k(neg, sel.k)  # k smallest scores
+    sel_valid = mask[idx]
+    return {"sel_docids": idx.astype(jnp.int32), "sel_valid": sel_valid}
+
+
+# ---------------------------------------------------------------------------
+# Cross-segment merge spec + compiled table kernel
+# ---------------------------------------------------------------------------
+
+
+def output_reducers(plan: StaticPlan) -> Dict[str, str]:
+    """Reduce op over the segment axis per output key.
+
+    'none' outputs stay per-segment (selection candidates).
+    These same ops become `psum`/`pmax`-style collectives across chips.
+    """
+    red: Dict[str, str] = {"num_docs": "sum"}
+    if plan.group_by is not None:
+        red["gb_presence"] = "max"
+        for i, agg in enumerate(plan.aggs):
+            red[f"gb_{i}"] = _state_reduce(agg)
+    else:
+        for i, agg in enumerate(plan.aggs):
+            red[f"agg_{i}"] = _state_reduce(agg)
+    if plan.selection is not None:
+        red["sel_docids"] = "none"
+        red["sel_valid"] = "none"
+    return red
+
+
+def _state_reduce(agg: StaticAgg) -> str:
+    base = agg.base
+    if base in ("count", "sum"):
+        return "sum"
+    if base == "min":
+        return "min"
+    if base == "max":
+        return "max"
+    if base == "avg":
+        return "sum_pair"
+    if base == "minmaxrange":
+        return "minmax_pair"
+    if agg.kind == "presence":
+        return "max"
+    if agg.kind == "hist":
+        return "sum"
+    if agg.kind == "hll":
+        return "max"
+    raise AssertionError(agg)
+
+
+def apply_reduce(op: str, value: Any):
+    if op == "sum":
+        return jnp.sum(value, axis=0)
+    if op == "min":
+        return jnp.min(value, axis=0)
+    if op == "max":
+        return jnp.max(value, axis=0)
+    if op == "sum_pair":
+        return (jnp.sum(value[0], axis=0), jnp.sum(value[1], axis=0))
+    if op == "minmax_pair":
+        return (jnp.min(value[0], axis=0), jnp.max(value[1], axis=0))
+    if op == "none":
+        return value
+    raise ValueError(op)
+
+
+@functools.lru_cache(maxsize=256)
+def make_table_kernel(plan: StaticPlan) -> Callable:
+    """vmap the single-segment kernel over the stacked segment axis and
+    merge; jitted once per (plan, shape signature)."""
+    single = make_single_segment_kernel(plan)
+    reducers = output_reducers(plan)
+
+    def table_fn(segs: Dict[str, Any], q: Dict[str, Any]) -> Dict[str, Any]:
+        outs = jax.vmap(single)(segs, q)
+        return {k: apply_reduce(reducers[k], v) for k, v in outs.items()}
+
+    return jax.jit(table_fn)

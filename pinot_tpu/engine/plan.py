@@ -1,0 +1,383 @@
+"""Query planning: BrokerRequest -> (StaticPlan, QueryInputs).
+
+The reference's plan maker (``InstancePlanMakerImplV2.java:40``) builds a
+virtual-call operator tree per segment.  Here planning splits a query
+into:
+
+- **StaticPlan** — a hashable description of the kernel's *structure*:
+  filter tree shape, leaf modes, aggregation list, group-by strides and
+  capacity, selection spec.  It is the jit-cache key: two queries with
+  the same StaticPlan and array shapes share one compiled XLA program.
+
+- **QueryInputs** — per-segment *data* for that structure, all computed
+  host-side in O(cardinality) per column: predicate match tables in
+  dictId space (the PredicateEvaluator analog — an EQ/IN/RANGE/REGEX
+  predicate becomes a ``bool[card]`` table; the device then does ONE
+  gather per leaf, which is the vectorized inverted index), global-id
+  remap tables for group-by/distinct/percentile, HLL (bucket, rho)
+  tables per dictionary entry.
+
+Filter leaf modes:
+  SV      — mask = table[fwd]
+  MV_ANY  — mask = any(table[mv] & mv_valid)         (positive predicates)
+  MV_NONE — mask = ~any(member[mv] & mv_valid)       (NOT / NOT_IN)
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pinot_tpu.common.request import (
+    AggregationInfo,
+    BrokerRequest,
+    FilterOperator,
+    FilterQueryTree,
+    RangeSpec,
+)
+from pinot_tpu.common.schema import DataType
+from pinot_tpu.engine import config
+from pinot_tpu.engine import hll as hll_mod
+from pinot_tpu.engine.context import TableContext
+from pinot_tpu.engine.device import StagedTable
+from pinot_tpu.segment.dictionary import Dictionary
+
+
+# ---------------------------------------------------------------------------
+# Static plan
+# ---------------------------------------------------------------------------
+
+SV, MV_ANY, MV_NONE = "sv", "mv_any", "mv_none"
+
+
+@dataclass(frozen=True)
+class StaticLeaf:
+    column: str
+    mode: str  # SV | MV_ANY | MV_NONE
+
+
+@dataclass(frozen=True)
+class StaticAgg:
+    func: str  # full function name e.g. "sum", "summv"
+    base: str  # base function e.g. "sum"
+    column: str  # "*" for count(*)
+    is_mv: bool
+    # device state kind: scalar | pair | presence | hist | hll
+    kind: str
+    # static size of the value-state axis (presence/hist), 0 otherwise
+    gcard_pad: int = 0
+
+
+@dataclass(frozen=True)
+class StaticGroupBy:
+    columns: Tuple[str, ...]
+    col_is_mv: Tuple[bool, ...]
+    gcards: Tuple[int, ...]  # global cardinalities (strides derive from these)
+    capacity: int  # dense holder size = prod(gcards), device path only
+    top_n: int
+
+
+@dataclass(frozen=True)
+class StaticSelection:
+    columns: Tuple[str, ...]
+    sort_columns: Tuple[str, ...]
+    sort_ascending: Tuple[bool, ...]
+    sort_gcards: Tuple[int, ...]  # global cards = composite-key radices
+    k: int  # per-segment candidates = offset + size
+
+
+@dataclass(frozen=True)
+class StaticPlan:
+    # filter tree encoded as nested tuples: ("leaf", i) | ("and"|"or", (...))
+    filter_tree: Optional[tuple]
+    leaves: Tuple[StaticLeaf, ...]
+    aggs: Tuple[StaticAgg, ...]
+    group_by: Optional[StaticGroupBy]
+    selection: Optional[StaticSelection]
+    on_device: bool  # False -> host (numpy) fallback path
+
+
+def _agg_kind(base: str) -> str:
+    if base in ("count", "sum", "min", "max"):
+        return "scalar"
+    if base in ("avg", "minmaxrange"):
+        return "pair"
+    if base == "distinctcount":
+        return "presence"
+    if base in ("distinctcounthll", "fasthll"):
+        return "hll"
+    if base.startswith("percentile"):
+        return "hist"
+    raise ValueError(f"unknown aggregation {base!r}")
+
+
+def build_static_plan(
+    request: BrokerRequest,
+    ctx: TableContext,
+    staged: StagedTable,
+) -> StaticPlan:
+    # ---- filter -----------------------------------------------------
+    leaves: List[StaticLeaf] = []
+
+    def encode(node: FilterQueryTree) -> tuple:
+        if node.is_leaf:
+            col = staged.column(node.column)
+            if col.single_value:
+                mode = SV
+            elif node.operator in (FilterOperator.NOT, FilterOperator.NOT_IN):
+                mode = MV_NONE
+            else:
+                mode = MV_ANY
+            leaves.append(StaticLeaf(column=node.column, mode=mode))
+            return ("leaf", len(leaves) - 1)
+        op = "and" if node.operator == FilterOperator.AND else "or"
+        return (op, tuple(encode(c) for c in node.children))
+
+    tree = encode(request.filter) if request.filter is not None else None
+
+    on_device = True
+
+    # ---- aggregations ----------------------------------------------
+    aggs: List[StaticAgg] = []
+    for a in request.aggregations:
+        base = a.base_function
+        kind = _agg_kind(base)
+        gcard_pad = 0
+        if kind in ("presence", "hist"):
+            gcol = ctx.column(a.column)
+            gcard_pad = config.pad_card(gcol.global_cardinality)
+            if gcard_pad > config.MAX_VALUE_STATE:
+                on_device = False
+        is_mv = a.is_mv
+        if a.column != "*" and not staged.column(a.column).single_value:
+            is_mv = True
+        aggs.append(
+            StaticAgg(func=a.function, base=base, column=a.column, is_mv=is_mv, kind=kind, gcard_pad=gcard_pad)
+        )
+
+    # ---- group-by ---------------------------------------------------
+    group_by: Optional[StaticGroupBy] = None
+    if request.is_group_by:
+        cols = tuple(request.group_by.columns)
+        col_is_mv = tuple(not staged.column(c).single_value for c in cols)
+        gcards = tuple(ctx.column(c).global_cardinality for c in cols)
+        cap = 1
+        for c in gcards:
+            cap *= max(c, 1)
+        if cap > config.MAX_GROUP_CAPACITY or cap > config.max_key_space():
+            on_device = False
+        # value-state aggs need [capacity, gcard] holders — cap the product
+        for a in aggs:
+            if a.kind in ("presence", "hist", "hll"):
+                state = a.gcard_pad if a.kind != "hll" else config.HLL_M
+                if cap * state > config.MAX_VALUE_STATE * 4:
+                    on_device = False
+        group_by = StaticGroupBy(
+            columns=cols,
+            col_is_mv=col_is_mv,
+            gcards=gcards,
+            capacity=int(cap),
+            top_n=request.group_by.top_n,
+        )
+        # MV group-by expansion blowup guard
+        expansion = 1
+        for c, mv in zip(cols, col_is_mv):
+            if mv:
+                expansion *= staged.column(c).mv_pad
+        if expansion > 64:
+            on_device = False
+
+    # ---- selection --------------------------------------------------
+    selection: Optional[StaticSelection] = None
+    if request.is_selection:
+        sel = request.selection
+        cols = tuple(sel.columns) if sel.columns and sel.columns != ["*"] else ("*",)
+        sort_cols = tuple(s.column for s in sel.sorts)
+        sort_asc = tuple(s.ascending for s in sel.sorts)
+        k = min(sel.offset + sel.size, staged.n_pad)
+        # composite sort key must fit the key dtype
+        sort_gcards = tuple(max(ctx.column(c).global_cardinality, 1) for c in sort_cols)
+        space = 1
+        for g in sort_gcards:
+            space *= g
+        if space > config.max_key_space():
+            on_device = False
+        selection = StaticSelection(
+            columns=cols,
+            sort_columns=sort_cols,
+            sort_ascending=sort_asc,
+            sort_gcards=sort_gcards,
+            k=int(k),
+        )
+
+    return StaticPlan(
+        filter_tree=tree,
+        leaves=tuple(leaves),
+        aggs=tuple(aggs),
+        group_by=group_by,
+        selection=selection,
+        on_device=on_device,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Match tables (host-side predicate evaluation in dictId space)
+# ---------------------------------------------------------------------------
+
+
+def _coerce(literal: str, stored: DataType) -> Any:
+    return stored.convert(literal)
+
+
+def match_table(node: FilterQueryTree, dictionary: Dictionary, card_pad: int) -> np.ndarray:
+    """bool[card_pad] — True at dictIds whose value satisfies the leaf.
+
+    For MV_NONE leaves the table is *membership* of the excluded set
+    (the kernel negates after the any-reduction).
+    """
+    stored = dictionary.stored_type
+    card = dictionary.cardinality
+    table = np.zeros(card_pad, dtype=bool)
+    op = node.operator
+    if op in (FilterOperator.EQUALITY, FilterOperator.IN):
+        for v in node.values:
+            i = dictionary.index_of(_coerce(v, stored))
+            if i >= 0:
+                table[i] = True
+    elif op in (FilterOperator.NOT, FilterOperator.NOT_IN):
+        # SV: complement table; MV: membership table (kernel handles NONE)
+        member = np.zeros(card_pad, dtype=bool)
+        for v in node.values:
+            i = dictionary.index_of(_coerce(v, stored))
+            if i >= 0:
+                member[i] = True
+        table = member  # caller flips for SV below
+    elif op == FilterOperator.RANGE:
+        r = node.range_spec or RangeSpec()
+        lo = 0
+        hi = card
+        if r.lower is not None and r.lower != "*":
+            v = _coerce(r.lower, stored)
+            i = dictionary.insertion_index(v)
+            if r.include_lower:
+                lo = i
+            else:
+                lo = i + 1 if (i < card and dictionary._eq(dictionary.values[i], v)) else i
+        if r.upper is not None and r.upper != "*":
+            v = _coerce(r.upper, stored)
+            i = dictionary.insertion_index(v)
+            if r.include_upper:
+                hi = i + 1 if (i < card and dictionary._eq(dictionary.values[i], v)) else i
+            else:
+                hi = i
+        if hi > lo:
+            table[lo:hi] = True
+    elif op == FilterOperator.REGEX:
+        pattern = re.compile(node.values[0])
+        for i in range(card):
+            if pattern.search(str(dictionary.get(i))) is not None:
+                table[i] = True
+    else:
+        raise ValueError(f"unsupported leaf operator {op}")
+    return table
+
+
+# ---------------------------------------------------------------------------
+# Query inputs (per-segment arrays, stacked [S, ...])
+# ---------------------------------------------------------------------------
+
+
+def build_query_inputs(
+    request: BrokerRequest,
+    plan: StaticPlan,
+    ctx: TableContext,
+    staged: StagedTable,
+) -> Dict[str, Any]:
+    S = staged.num_segments
+    inputs: Dict[str, Any] = {}
+
+    # filter leaf match tables
+    if plan.filter_tree is not None:
+        # walk request filter leaves in the same order encode() visited them
+        flat_leaves: List[FilterQueryTree] = []
+
+        def collect(node: FilterQueryTree) -> None:
+            if node.is_leaf:
+                flat_leaves.append(node)
+            else:
+                for c in node.children:
+                    collect(c)
+
+        collect(request.filter)
+        tables = []
+        for leaf_node, leaf_static in zip(flat_leaves, plan.leaves):
+            col = staged.column(leaf_static.column)
+            per_seg = np.zeros((S, col.card_pad), dtype=bool)
+            for i, seg in enumerate(ctx.segments):
+                t = match_table(leaf_node, seg.column(leaf_static.column).dictionary, col.card_pad)
+                if leaf_static.mode == SV and leaf_node.operator in (
+                    FilterOperator.NOT,
+                    FilterOperator.NOT_IN,
+                ):
+                    # SV complement: true cardinality slots only
+                    c = col.cards[i]
+                    flipped = np.zeros(col.card_pad, dtype=bool)
+                    flipped[:c] = ~t[:c]
+                    t = flipped
+                per_seg[i] = t
+            tables.append(per_seg)
+        inputs["match"] = tables
+
+    # per-agg auxiliary tables
+    agg_aux: List[Dict[str, np.ndarray]] = []
+    for a in plan.aggs:
+        aux: Dict[str, np.ndarray] = {}
+        if a.kind in ("presence", "hist"):
+            aux["remap"] = _stacked_remap(ctx, staged, a.column)
+        elif a.kind == "hll":
+            bucket, rho = _hll_tables(ctx, staged, a.column)
+            aux["bucket"] = bucket
+            aux["rho"] = rho
+        agg_aux.append(aux)
+    inputs["agg_aux"] = agg_aux
+
+    # group-by remaps
+    if plan.group_by is not None and plan.on_device:
+        inputs["group_remap"] = [
+            _stacked_remap(ctx, staged, c) for c in plan.group_by.columns
+        ]
+
+    # selection sort remaps
+    if plan.selection is not None and plan.selection.sort_columns:
+        inputs["sel_remap"] = [
+            _stacked_remap(ctx, staged, c) for c in plan.selection.sort_columns
+        ]
+
+    return inputs
+
+
+def _stacked_remap(ctx: TableContext, staged: StagedTable, column: str) -> np.ndarray:
+    col = staged.column(column)
+    gcol = ctx.column(column)
+    out = np.zeros((staged.num_segments, col.card_pad), dtype=np.int32)
+    for i, remap in enumerate(gcol.remaps):
+        out[i, : remap.size] = remap
+    return out
+
+
+def _hll_tables(ctx: TableContext, staged: StagedTable, column: str):
+    """Per-dictId (bucket, rho) tables: the HLL hash work happens once
+    per dictionary entry on host; the device only scatter-maxes."""
+    col = staged.column(column)
+    S = staged.num_segments
+    bucket = np.zeros((S, col.card_pad), dtype=np.int32)
+    rho = np.zeros((S, col.card_pad), dtype=np.int32)
+    for i, seg in enumerate(ctx.segments):
+        d = seg.column(column).dictionary
+        for j in range(d.cardinality):
+            b, r = hll_mod.bucket_and_rho(hll_mod.value_hash64(d.get(j)))
+            bucket[i, j] = b
+            rho[i, j] = r
+    return bucket, rho

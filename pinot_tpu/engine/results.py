@@ -1,0 +1,252 @@
+"""Mergeable aggregation partials + finalization.
+
+These are the *contents* of the server->broker partial results (the
+DataTable payload analog).  Each aggregation function has a partial
+state that merges associatively — across segments, servers, and chips:
+
+  count/sum         float        merge = +
+  min / max         float        merge = min / max
+  avg               (sum, count) merge = pairwise +      (AvgPair analog)
+  minmaxrange       (min, max)
+  distinctcount     value set    merge = union           (IntOpenHashSet analog)
+  distinctcounthll  uint8[m] HLL registers, merge = elementwise max
+                    (vs the reference's Java-serialized HLL objects,
+                     DataTableCustomSerDe.java:49)
+  percentile*       value->count histogram, merge = counter add
+                    (vs the reference shipping the raw DoubleArrayList —
+                     strictly smaller, and exact)
+
+Group-by partials are {group key tuple -> per-function partial} maps,
+merged key-wise (MCombineGroupByOperator.java:152 semantics) and trimmed
+to top_n at final reduce.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.engine import hll as hll_mod
+
+
+class AggPartial:
+    """Base: merge in place, then finalize to the response value."""
+
+    def merge(self, other: "AggPartial") -> None:
+        raise NotImplementedError
+
+    def finalize(self) -> Any:
+        raise NotImplementedError
+
+
+class CountPartial(AggPartial):
+    def __init__(self, count: float = 0.0) -> None:
+        self.count = float(count)
+
+    def merge(self, other: "CountPartial") -> None:
+        self.count += other.count
+
+    def finalize(self) -> Any:
+        return int(self.count)
+
+
+class SumPartial(AggPartial):
+    def __init__(self, total: float = 0.0) -> None:
+        self.total = float(total)
+
+    def merge(self, other: "SumPartial") -> None:
+        self.total += other.total
+
+    def finalize(self) -> float:
+        return self.total
+
+
+class MinPartial(AggPartial):
+    def __init__(self, value: float = math.inf) -> None:
+        self.value = float(value)
+
+    def merge(self, other: "MinPartial") -> None:
+        self.value = min(self.value, other.value)
+
+    def finalize(self) -> float:
+        return self.value
+
+
+class MaxPartial(AggPartial):
+    def __init__(self, value: float = -math.inf) -> None:
+        self.value = float(value)
+
+    def merge(self, other: "MaxPartial") -> None:
+        self.value = max(self.value, other.value)
+
+    def finalize(self) -> float:
+        return self.value
+
+
+class AvgPartial(AggPartial):
+    def __init__(self, total: float = 0.0, count: float = 0.0) -> None:
+        self.total = float(total)
+        self.count = float(count)
+
+    def merge(self, other: "AvgPartial") -> None:
+        self.total += other.total
+        self.count += other.count
+
+    def finalize(self) -> float:
+        return self.total / self.count if self.count else -math.inf
+
+
+class MinMaxRangePartial(AggPartial):
+    def __init__(self, mn: float = math.inf, mx: float = -math.inf) -> None:
+        self.mn = float(mn)
+        self.mx = float(mx)
+
+    def merge(self, other: "MinMaxRangePartial") -> None:
+        self.mn = min(self.mn, other.mn)
+        self.mx = max(self.mx, other.mx)
+
+    def finalize(self) -> float:
+        return self.mx - self.mn
+
+
+class DistinctPartial(AggPartial):
+    def __init__(self, values: Optional[set] = None) -> None:
+        self.values = values if values is not None else set()
+
+    def merge(self, other: "DistinctPartial") -> None:
+        self.values |= other.values
+
+    def finalize(self) -> int:
+        return len(self.values)
+
+
+class HllPartial(AggPartial):
+    def __init__(self, registers: Optional[np.ndarray] = None) -> None:
+        self.registers = (
+            registers.astype(np.uint8)
+            if registers is not None
+            else np.zeros(hll_mod.M, dtype=np.uint8)
+        )
+
+    def merge(self, other: "HllPartial") -> None:
+        self.registers = np.maximum(self.registers, other.registers)
+
+    def finalize(self) -> int:
+        return int(hll_mod.estimate_from_registers(self.registers))
+
+
+class HistogramPartial(AggPartial):
+    """Exact value histogram for percentiles."""
+
+    def __init__(self, counts: Optional[Dict[float, int]] = None, percentile: int = 50) -> None:
+        self.counts: Dict[float, int] = counts or {}
+        self.percentile = percentile
+
+    def merge(self, other: "HistogramPartial") -> None:
+        for v, c in other.counts.items():
+            self.counts[v] = self.counts.get(v, 0) + c
+
+    def finalize(self) -> float:
+        """Reference formula sorted[int(n * p/100)]
+        (quantile/PercentileUtil.java:50) over the histogram."""
+        if not self.counts:
+            return -math.inf
+        items = sorted(self.counts.items())
+        n = sum(c for _, c in items)
+        idx = min(int(n * self.percentile / 100.0), n - 1)
+        acc = 0
+        for v, c in items:
+            acc += c
+            if acc > idx:
+                return v
+        return items[-1][0]
+
+
+def make_partial(base_function: str) -> AggPartial:
+    if base_function == "count":
+        return CountPartial()
+    if base_function == "sum":
+        return SumPartial()
+    if base_function == "min":
+        return MinPartial()
+    if base_function == "max":
+        return MaxPartial()
+    if base_function == "avg":
+        return AvgPartial()
+    if base_function == "minmaxrange":
+        return MinMaxRangePartial()
+    if base_function == "distinctcount":
+        return DistinctPartial()
+    if base_function in ("distinctcounthll", "fasthll"):
+        return HllPartial()
+    if base_function.startswith("percentileest"):
+        return HistogramPartial(percentile=int(base_function[len("percentileest"):]))
+    if base_function.startswith("percentile"):
+        return HistogramPartial(percentile=int(base_function[len("percentile"):]))
+    raise ValueError(f"unknown aggregation {base_function!r}")
+
+
+GroupKey = Tuple[str, ...]
+
+
+class IntermediateResult:
+    """One executor's (server's) partial answer for a query — the unit
+    that flows broker-ward and merges with peers
+    (BrokerReduceService.reduceOnDataTable analog)."""
+
+    def __init__(
+        self,
+        aggregations: Optional[List[AggPartial]] = None,
+        groups: Optional[Dict[GroupKey, List[AggPartial]]] = None,
+        selection_rows: Optional[List[Tuple[list, list]]] = None,  # (sort_key_values, row)
+        num_docs_scanned: int = 0,
+        total_docs: int = 0,
+        num_segments_queried: int = 0,
+        num_entries_scanned_in_filter: int = 0,
+        num_entries_scanned_post_filter: int = 0,
+        trace: Optional[Dict[str, Any]] = None,
+        selection_columns: Optional[List[str]] = None,
+    ) -> None:
+        self.selection_columns = selection_columns
+        self.aggregations = aggregations
+        self.groups = groups
+        self.selection_rows = selection_rows
+        self.num_docs_scanned = num_docs_scanned
+        self.total_docs = total_docs
+        self.num_segments_queried = num_segments_queried
+        self.num_entries_scanned_in_filter = num_entries_scanned_in_filter
+        self.num_entries_scanned_post_filter = num_entries_scanned_post_filter
+        self.trace = trace or {}
+
+    def merge(self, other: "IntermediateResult") -> None:
+        self.num_docs_scanned += other.num_docs_scanned
+        self.total_docs += other.total_docs
+        self.num_segments_queried += other.num_segments_queried
+        self.num_entries_scanned_in_filter += other.num_entries_scanned_in_filter
+        self.num_entries_scanned_post_filter += other.num_entries_scanned_post_filter
+        self.trace.update(other.trace)
+        if other.aggregations is not None:
+            if self.aggregations is None:
+                self.aggregations = other.aggregations
+            else:
+                for mine, theirs in zip(self.aggregations, other.aggregations):
+                    mine.merge(theirs)
+        if other.groups is not None:
+            if self.groups is None:
+                self.groups = other.groups
+            else:
+                for key, partials in other.groups.items():
+                    existing = self.groups.get(key)
+                    if existing is None:
+                        self.groups[key] = partials
+                    else:
+                        for mine, theirs in zip(existing, partials):
+                            mine.merge(theirs)
+        if other.selection_rows is not None:
+            if self.selection_rows is None:
+                self.selection_rows = other.selection_rows
+            else:
+                self.selection_rows.extend(other.selection_rows)
+        if self.selection_columns is None:
+            self.selection_columns = other.selection_columns

@@ -1,0 +1,121 @@
+"""Random PQL query generator for differential testing.
+
+The analog of the reference's ``QueryGenerator``
+(pinot-integration-tests ``QueryGenerator.java:64``), which generates
+random PQL + equivalent H2 SQL.  Here both engines (TPU + scan oracle)
+speak PQL directly, so only PQL is generated; the oracle plays H2's role.
+"""
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Sequence
+
+from pinot_tpu.common.schema import DataType, FieldType, Schema
+
+Row = Dict[str, Any]
+
+_SV_AGGS = ["count", "sum", "min", "max", "avg", "minmaxrange", "distinctcount", "percentile50", "percentile90"]
+
+
+class QueryGenerator:
+    def __init__(self, schema: Schema, rows: Sequence[Row], table: str = "testTable", seed: int = 0):
+        self.schema = schema
+        self.rows = list(rows)
+        self.table = table
+        self.rng = random.Random(seed)
+        self.sv_dims = [
+            s.name for s in schema.all_fields()
+            if s.single_value and s.field_type != FieldType.METRIC
+        ]
+        self.mv_dims = [s.name for s in schema.all_fields() if not s.single_value]
+        self.metrics = [s.name for s in schema.all_fields() if s.field_type == FieldType.METRIC]
+        self.all_sv = [s.name for s in schema.all_fields() if s.single_value]
+
+    # -- helpers -------------------------------------------------------
+    def _sample_value(self, column: str) -> Any:
+        row = self.rng.choice(self.rows)
+        v = row[column]
+        if isinstance(v, list):
+            v = self.rng.choice(v)
+        return v
+
+    def _literal(self, column: str) -> str:
+        v = self._sample_value(column)
+        if isinstance(v, str):
+            escaped = v.replace("'", "''")
+            return f"'{escaped}'"
+        return str(v)
+
+    def _predicate(self) -> str:
+        col = self.rng.choice(self.all_sv + self.mv_dims)
+        kind = self.rng.randrange(6)
+        if kind == 0:
+            return f"{col} = {self._literal(col)}"
+        if kind == 1:
+            return f"{col} <> {self._literal(col)}"
+        if kind == 2:
+            vals = ", ".join(self._literal(col) for _ in range(self.rng.randint(1, 4)))
+            return f"{col} IN ({vals})"
+        if kind == 3:
+            vals = ", ".join(self._literal(col) for _ in range(self.rng.randint(1, 3)))
+            return f"{col} NOT IN ({vals})"
+        if kind == 4:
+            a, b = self._literal(col), self._literal(col)
+            if a.startswith("'"):
+                lo, hi = sorted([a, b])
+            else:
+                lo, hi = sorted([a, b], key=float)
+            return f"{col} BETWEEN {lo} AND {hi}"
+        op = self.rng.choice(["<", ">", "<=", ">="])
+        return f"{col} {op} {self._literal(col)}"
+
+    def _where(self) -> str:
+        n = self.rng.randrange(4)
+        if n == 0:
+            return ""
+        preds = [self._predicate() for _ in range(n)]
+        joined = preds[0]
+        for p in preds[1:]:
+            joined += f" {self.rng.choice(['AND', 'OR'])} {p}"
+        return f" WHERE {joined}"
+
+    # -- query kinds ---------------------------------------------------
+    def aggregation_query(self) -> str:
+        n = self.rng.randint(1, 3)
+        aggs = []
+        for _ in range(n):
+            f = self.rng.choice(_SV_AGGS)
+            if f == "count" and self.rng.random() < 0.5:
+                aggs.append("count(*)")
+            elif f == "distinctcount":
+                aggs.append(f"distinctcount({self.rng.choice(self.all_sv)})")
+            else:
+                aggs.append(f"{f}({self.rng.choice(self.metrics)})")
+        return f"SELECT {', '.join(aggs)} FROM {self.table}{self._where()}"
+
+    def group_by_query(self) -> str:
+        q = self.aggregation_query()
+        k = self.rng.randint(1, 2)
+        cols = self.rng.sample(self.sv_dims + self.mv_dims, k)
+        top = self.rng.choice([5, 10, 50])
+        return f"{q} GROUP BY {', '.join(cols)} TOP {top}"
+
+    def selection_query(self) -> str:
+        cols = self.rng.sample(self.all_sv, self.rng.randint(1, min(3, len(self.all_sv))))
+        order = ""
+        if self.rng.random() < 0.6:
+            ocols = self.rng.sample(self.all_sv, self.rng.randint(1, 2))
+            parts = [f"{c} {self.rng.choice(['ASC', 'DESC'])}" for c in ocols]
+            order = f" ORDER BY {', '.join(parts)}"
+        limit = self.rng.choice([5, 10, 25])
+        return (
+            f"SELECT {', '.join(cols)} FROM {self.table}{self._where()}{order} LIMIT {limit}"
+        )
+
+    def next_query(self) -> str:
+        r = self.rng.random()
+        if r < 0.4:
+            return self.aggregation_query()
+        if r < 0.8:
+            return self.group_by_query()
+        return self.selection_query()

@@ -56,15 +56,7 @@ Row = Dict[str, Any]
 
 
 def _coerce(literal: str, dt: DataType) -> Any:
-    st = dt.stored_type
-    if st == DataType.STRING:
-        return str(literal)
-    if st in (DataType.INT, DataType.LONG):
-        try:
-            return int(literal)
-        except ValueError:
-            return int(float(literal))
-    return float(literal)
+    return dt.convert(literal)
 
 
 def _values_of(row: Row, column: str) -> List[Any]:
@@ -247,7 +239,29 @@ class ScanQueryProcessor:
 
     def __init__(self, schema: Schema, rows: Sequence[Row]) -> None:
         self.schema = schema
-        self.rows = list(rows)
+        # Normalize rows exactly like the segment builder: type-convert
+        # every value, fill missing with default null values — so the
+        # oracle sees the same stored values the engine does.
+        self.rows = [self._normalize(r) for r in rows]
+
+    def _normalize(self, row: Row) -> Row:
+        out: Row = {}
+        for spec in self.schema.all_fields():
+            v = row.get(spec.name)
+            if v is None:
+                out[spec.name] = (
+                    spec.get_default_null_value()
+                    if spec.single_value
+                    else [spec.get_default_null_value()]
+                )
+            elif spec.single_value:
+                out[spec.name] = spec.stored_type.convert(v)
+            else:
+                vs = v if isinstance(v, (list, tuple)) else [v]
+                out[spec.name] = [spec.stored_type.convert(x) for x in vs] or [
+                    spec.get_default_null_value()
+                ]
+        return out
 
     def execute(self, request: BrokerRequest) -> BrokerResponse:
         matcher = _build_matcher(request.filter, self.schema)
@@ -291,13 +305,9 @@ class ScanQueryProcessor:
         return keys
 
     def _render(self, column: str, v: Any) -> str:
-        spec = self.schema.field(column)
-        st = spec.stored_type
-        if st in (DataType.INT, DataType.LONG):
-            return str(int(v))
-        if st in (DataType.FLOAT, DataType.DOUBLE):
-            return repr(float(v))
-        return str(v)
+        from pinot_tpu.common.values import render_value
+
+        return render_value(self.schema.field(column).stored_type, v)
 
     def _group_by(self, request: BrokerRequest, rows: List[Row]) -> List[AggregationResult]:
         gb = request.group_by

@@ -4,14 +4,29 @@ Multi-chip sharding is validated on a virtual CPU mesh
 (``xla_force_host_platform_device_count=8``) since only one real TPU
 chip is reachable; x64 is enabled so CPU test runs reproduce the
 reference's double-precision aggregation semantics exactly.
+
+The container's sitecustomize force-registers the experimental 'axon'
+TPU backend (tunnel to the real chip) before conftest runs; its PJRT
+client init can block, so the factory is dropped here — tests are
+CPU-only by design.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
+try:  # drop the axon TPU backend factory before any backend init
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name not in ("cpu",):
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
+
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

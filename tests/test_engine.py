@@ -1,0 +1,218 @@
+"""TPU engine tests: sentinel golden values + differential vs the scan
+oracle (the QueriesSentinelTest / H2-differential analogs, SURVEY §4)."""
+import json
+import math
+
+import pytest
+
+from pinot_tpu.common.schema import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.engine.executor import QueryExecutor
+from pinot_tpu.engine.reduce import reduce_to_response
+from pinot_tpu.pql import parse_pql, optimize_request
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+from pinot_tpu.tools.query_gen import QueryGenerator
+from pinot_tpu.tools.scan_engine import ScanQueryProcessor
+
+SCHEMA = Schema(
+    "t",
+    dimensions=[
+        FieldSpec("city", DataType.STRING),
+        FieldSpec("tags", DataType.STRING_ARRAY, single_value=False),
+    ],
+    metrics=[
+        FieldSpec("sales", DataType.INT, FieldType.METRIC),
+        FieldSpec("price", DataType.DOUBLE, FieldType.METRIC),
+    ],
+)
+
+ROWS = [
+    {"city": "sf", "tags": ["a", "b"], "sales": 10, "price": 1.5},
+    {"city": "sf", "tags": ["b"], "sales": 20, "price": 2.5},
+    {"city": "ny", "tags": ["a"], "sales": 30, "price": 3.5},
+    {"city": "la", "tags": ["c", "a"], "sales": 40, "price": 4.5},
+    {"city": "ny", "tags": ["b", "c"], "sales": 50, "price": 5.5},
+]
+
+SEGMENT = build_segment(SCHEMA, ROWS, "t", "s0")
+EXECUTOR = QueryExecutor()
+
+
+def run_engine(pql, segments=None):
+    req = optimize_request(parse_pql(pql))
+    res = EXECUTOR.execute(segments or [SEGMENT], req)
+    return reduce_to_response(req, [res])
+
+
+def agg_values(resp):
+    return [a.value for a in resp.aggregation_results]
+
+
+# ------------------------------------------------------------- sentinels
+def test_count_star():
+    assert agg_values(run_engine("SELECT count(*) FROM t")) == [5]
+
+
+def test_basic_aggs():
+    resp = run_engine(
+        "SELECT sum(sales), min(sales), max(sales), avg(sales), minmaxrange(sales) FROM t"
+    )
+    assert agg_values(resp) == [150.0, 10.0, 50.0, 30.0, 40.0]
+
+
+def test_filters():
+    assert agg_values(run_engine("SELECT count(*) FROM t WHERE city = 'sf'")) == [2]
+    assert agg_values(run_engine("SELECT count(*) FROM t WHERE city IN ('sf','ny')")) == [4]
+    assert agg_values(run_engine("SELECT count(*) FROM t WHERE sales > 20")) == [3]
+    assert agg_values(run_engine("SELECT count(*) FROM t WHERE sales BETWEEN 20 AND 40")) == [3]
+    assert agg_values(run_engine("SELECT count(*) FROM t WHERE city <> 'sf'")) == [3]
+    assert agg_values(run_engine("SELECT count(*) FROM t WHERE city NOT IN ('sf','la')")) == [2]
+    assert agg_values(
+        run_engine("SELECT count(*) FROM t WHERE city = 'sf' OR sales = 40")
+    ) == [3]
+
+
+def test_mv_filters():
+    assert agg_values(run_engine("SELECT count(*) FROM t WHERE tags = 'a'")) == [3]
+    assert agg_values(run_engine("SELECT count(*) FROM t WHERE tags <> 'a'")) == [2]
+
+
+def test_regex_filter():
+    assert agg_values(run_engine("SELECT count(*) FROM t WHERE regexp_like(city, '^s')")) == [2]
+
+
+def test_distinct_and_hll():
+    assert agg_values(run_engine("SELECT distinctcount(city) FROM t")) == [3]
+    assert agg_values(run_engine("SELECT distinctcountmv(tags) FROM t")) == [3]
+    assert agg_values(run_engine("SELECT distinctcounthll(sales) FROM t")) == [5]
+
+
+def test_percentiles():
+    assert agg_values(run_engine("SELECT percentile50(sales) FROM t")) == [30.0]
+    assert agg_values(run_engine("SELECT percentile90(sales) FROM t")) == [50.0]
+
+
+def test_group_by():
+    resp = run_engine("SELECT sum(sales) FROM t GROUP BY city TOP 2")
+    gr = resp.aggregation_results[0].group_by_result
+    assert [(g.group, g.value) for g in gr] == [(["ny"], 80.0), (["la"], 40.0)]
+
+
+def test_group_by_min_asc():
+    resp = run_engine("SELECT min(sales) FROM t GROUP BY city")
+    gr = resp.aggregation_results[0].group_by_result
+    assert [(g.group[0], g.value) for g in gr] == [("sf", 10.0), ("ny", 30.0), ("la", 40.0)]
+
+
+def test_group_by_mv():
+    resp = run_engine("SELECT count(*) FROM t GROUP BY tags")
+    gr = {g.group[0]: g.value for g in resp.aggregation_results[0].group_by_result}
+    assert gr == {"a": 3, "b": 3, "c": 2}
+
+
+def test_group_by_multi():
+    resp = run_engine("SELECT sum(sales) FROM t GROUP BY city, tags TOP 100")
+    gr = {tuple(g.group): g.value for g in resp.aggregation_results[0].group_by_result}
+    assert gr[("sf", "b")] == 30.0
+    assert gr[("ny", "c")] == 50.0
+
+
+def test_mv_aggregation():
+    assert agg_values(run_engine("SELECT countmv(tags) FROM t")) == [8]
+
+
+def test_selection():
+    resp = run_engine("SELECT city, sales FROM t LIMIT 3")
+    assert resp.selection_results.rows == [["sf", 10], ["sf", 20], ["ny", 30]]
+
+
+def test_selection_order_by():
+    resp = run_engine("SELECT city FROM t ORDER BY sales DESC LIMIT 2")
+    assert resp.selection_results.rows == [["ny"], ["la"]]
+
+
+def test_selection_star():
+    resp = run_engine("SELECT * FROM t LIMIT 1")
+    assert resp.selection_results.columns == ["city", "tags", "sales", "price"]
+
+
+def test_empty_filter_result():
+    resp = run_engine("SELECT count(*), sum(sales) FROM t WHERE city = 'zz'")
+    assert agg_values(resp) == [0, 0.0]
+
+
+def test_stats():
+    resp = run_engine("SELECT count(*) FROM t WHERE city = 'sf'")
+    assert resp.num_docs_scanned == 2
+    assert resp.total_docs == 5
+    assert resp.num_segments_queried == 1
+
+
+# ------------------------------------------------- differential vs oracle
+def _norm(resp):
+    return json.dumps(resp.to_json(), sort_keys=True)
+
+
+def _values_close(a, b, tol=1e-6):
+    if isinstance(a, dict) and isinstance(b, dict):
+        return set(a) == set(b) and all(_values_close(a[k], b[k], tol) for k in a)
+    if isinstance(a, list) and isinstance(b, list):
+        return len(a) == len(b) and all(_values_close(x, y, tol) for x, y in zip(a, b))
+    if isinstance(a, str) and isinstance(b, str):
+        try:
+            fa, fb = float(a), float(b)
+            if math.isinf(fa) or math.isinf(fb):
+                return fa == fb
+            return abs(fa - fb) <= tol * max(1.0, abs(fa), abs(fb))
+        except ValueError:
+            return a == b
+    return a == b
+
+
+def _run_differential(num_segments, seed, num_queries=40):
+    schema = make_test_schema()
+    rows = random_rows(schema, 1200, seed=seed, cardinality=15)
+    if num_segments == 1:
+        segments = [build_segment(schema, rows, "testTable", "seg0")]
+    else:
+        chunk = len(rows) // num_segments
+        segments = [
+            build_segment(
+                schema,
+                rows[i * chunk : (i + 1) * chunk if i < num_segments - 1 else len(rows)],
+                "testTable",
+                f"seg{i}",
+            )
+            for i in range(num_segments)
+        ]
+    oracle = ScanQueryProcessor(schema, rows)
+    gen = QueryGenerator(schema, rows, seed=seed)
+    mismatches = []
+    for qi in range(num_queries):
+        pql = gen.next_query()
+        req_e = optimize_request(parse_pql(pql))
+        req_o = optimize_request(parse_pql(pql))
+        got = reduce_to_response(req_e, [EXECUTOR.execute(segments, req_e)])
+        want = oracle.execute(req_o)
+        gj, wj = got.to_json(), want.to_json()
+        for k in ("timeUsedMs", "numEntriesScannedInFilter", "numEntriesScannedPostFilter",
+                  "numSegmentsQueried", "numServersQueried", "numServersResponded"):
+            gj.pop(k, None)
+            wj.pop(k, None)
+        if not _values_close(gj, wj):
+            mismatches.append((pql, gj, wj))
+    assert not mismatches, f"{len(mismatches)} mismatches; first: " + json.dumps(
+        mismatches[0], indent=2, default=str
+    )[:4000]
+
+
+def test_differential_single_segment():
+    _run_differential(1, seed=11)
+
+
+def test_differential_multi_segment():
+    _run_differential(3, seed=23)
+
+
+def test_differential_more_queries():
+    _run_differential(2, seed=47, num_queries=60)
