@@ -73,9 +73,15 @@ def stage_segments(
     segments: Sequence[ImmutableSegment],
     column_names: Sequence[str],
     device=None,
+    pad_segments_to: int = 0,
 ) -> StagedTable:
-    """Stack + pad + transfer the given columns of the segments."""
-    S = len(segments)
+    """Stack + pad + transfer the given columns of the segments.
+
+    ``pad_segments_to`` rounds the segment axis up with all-invalid
+    dummy segments so it divides the mesh's device count (multi-chip
+    ``shard_map`` needs an evenly shardable leading axis).
+    """
+    S = max(len(segments), pad_segments_to)
     n_pad = config.pad_docs(max(seg.num_docs for seg in segments))
 
     put = (lambda x: jax.device_put(x, device)) if device is not None else jnp.asarray
@@ -88,7 +94,7 @@ def stage_segments(
         segment_names=tuple(s.segment_name for s in segments),
         num_segments=S,
         n_pad=n_pad,
-        num_docs=tuple(s.num_docs for s in segments),
+        num_docs=tuple(s.num_docs for s in segments) + (0,) * (S - len(segments)),
         valid=put(valid_np),
     )
 
@@ -147,15 +153,18 @@ _stage_cache: Dict[Tuple, StagedTable] = {}
 
 
 def get_staged(
-    segments: Sequence[ImmutableSegment], column_names: Sequence[str]
+    segments: Sequence[ImmutableSegment],
+    column_names: Sequence[str],
+    pad_segments_to: int = 0,
 ) -> StagedTable:
     key = (
         tuple(f"{s.segment_name}:{s.metadata.crc}" for s in segments),
         tuple(sorted(column_names)),
+        pad_segments_to,
     )
     st = _stage_cache.get(key)
     if st is None:
-        st = stage_segments(segments, sorted(column_names))
+        st = stage_segments(segments, sorted(column_names), pad_segments_to=pad_segments_to)
         if len(_stage_cache) > 32:
             _stage_cache.clear()
         _stage_cache[key] = st

@@ -44,7 +44,17 @@ from pinot_tpu.segment.immutable import ImmutableSegment
 
 class QueryExecutor:
     """Executes queries over a set of immutable segments on this host's
-    device(s)."""
+    device(s).
+
+    With ``mesh`` set, the stacked segment axis is sharded over the
+    device mesh and cross-chip merge rides ICI collectives
+    (``pinot_tpu.parallel.multichip``); without it, the vmapped
+    single-device kernel runs.
+    """
+
+    def __init__(self, mesh=None) -> None:
+        self.mesh = mesh
+        self._sharded_kernels: Dict[Any, Any] = {}
 
     def execute(
         self, segments: Sequence[ImmutableSegment], request: BrokerRequest
@@ -60,8 +70,13 @@ class QueryExecutor:
             sel_columns = self._resolve_selection_columns(request, live[0])
             needed.update(sel_columns)
 
+        pad_to = 0
+        if self.mesh is not None:
+            n = int(self.mesh.devices.size)
+            pad_to = -(-len(live) // n) * n
+
         ctx = get_table_context(live)
-        staged = get_staged(live, sorted(needed))
+        staged = get_staged(live, sorted(needed), pad_segments_to=pad_to)
         plan = build_static_plan(request, ctx, staged)
 
         if not plan.on_device:
@@ -71,11 +86,25 @@ class QueryExecutor:
 
         q_inputs = self._to_device_inputs(build_query_inputs(request, plan, ctx, staged))
         seg_arrays = self._segment_arrays(plan, staged, needed)
-        kernel = make_table_kernel(plan)
+        kernel = self._kernel(plan)
         outs = kernel(seg_arrays, q_inputs)
         outs = {k: np.asarray(v) if not isinstance(v, tuple) else tuple(np.asarray(x) for x in v) for k, v in outs.items()}
 
         return self._finalize(request, plan, ctx, staged, live, outs, total_docs, sel_columns)
+
+    def _kernel(self, plan: StaticPlan):
+        if self.mesh is None:
+            return make_table_kernel(plan)
+        key = plan
+        k = self._sharded_kernels.get(key)
+        if k is None:
+            from pinot_tpu.parallel.multichip import make_sharded_table_kernel
+
+            k = make_sharded_table_kernel(plan, self.mesh)
+            if len(self._sharded_kernels) > 128:
+                self._sharded_kernels.clear()
+            self._sharded_kernels[key] = k
+        return k
 
     # ------------------------------------------------------------------
     def _resolve_selection_columns(

@@ -1,0 +1,7 @@
+from pinot_tpu.parallel.multichip import (
+    default_mesh,
+    make_sharded_table_kernel,
+    run_sharded_query,
+)
+
+__all__ = ["default_mesh", "make_sharded_table_kernel", "run_sharded_query"]

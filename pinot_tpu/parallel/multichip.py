@@ -1,0 +1,111 @@
+"""Multi-chip query execution: shard the segment axis over a device mesh.
+
+The reference scales a query two ways (SURVEY §2.5): segments fan out
+across server threads (``MCombineOperator.java:55-64``) and across
+servers via broker scatter-gather + reduce
+(``BrokerReduceService.java:62``).  On TPU both collapse into ONE SPMD
+program: the stacked segment axis is sharded over a 1-D
+``jax.sharding.Mesh``; each chip vmaps the single-segment kernel over
+its local segments; cross-chip merge is an XLA collective over ICI
+(``psum`` for sums/histograms/group-by holders, ``pmin``/``pmax`` for
+min/max/HLL registers/presence bitmaps).  Aggregation outputs come back
+replicated; selection candidates stay sharded (gathered host-side).
+
+Cross-host/DCN scale-out keeps the broker/server scatter-gather path
+(see ``pinot_tpu.broker``) — the mesh covers the chips a single server
+process owns (its "slice").
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.6: top-level shard_map
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from pinot_tpu.engine.kernel import (
+    apply_reduce,
+    make_single_segment_kernel,
+    output_reducers,
+)
+from pinot_tpu.engine.plan import StaticPlan
+
+SEGMENT_AXIS = "segments"
+
+
+def default_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.asarray(devs), (SEGMENT_AXIS,))
+
+
+def _collective(op: str, value: Any, axis: str):
+    if op == "sum":
+        return jax.lax.psum(value, axis)
+    if op == "min":
+        return jax.lax.pmin(value, axis)
+    if op == "max":
+        return jax.lax.pmax(value, axis)
+    if op == "sum_pair":
+        return (jax.lax.psum(value[0], axis), jax.lax.psum(value[1], axis))
+    if op == "minmax_pair":
+        return (jax.lax.pmin(value[0], axis), jax.lax.pmax(value[1], axis))
+    if op == "none":
+        return value
+    raise ValueError(op)
+
+
+def _out_specs(reducers: Dict[str, str], shard_spec) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, op in reducers.items():
+        spec = shard_spec if op == "none" else P()
+        out[k] = (spec, spec) if op in ("sum_pair", "minmax_pair") else spec
+    return out
+
+
+def make_sharded_table_kernel(plan: StaticPlan, mesh: Mesh) -> Callable:
+    """Compile the query kernel as an SPMD program over the mesh.
+
+    Takes the same (seg_arrays, query_inputs) pytrees as the
+    single-chip table kernel; every leaf's leading axis must equal the
+    (padded) segment count and divide evenly by the mesh size.
+    """
+    single = make_single_segment_kernel(plan)
+    reducers = output_reducers(plan)
+
+    def local_fn(segs: Dict[str, Any], q: Dict[str, Any]) -> Dict[str, Any]:
+        outs = jax.vmap(single)(segs, q)  # this chip's segments
+        merged: Dict[str, Any] = {}
+        for k, v in outs.items():
+            op = reducers[k]
+            if op == "none":
+                merged[k] = v  # stays sharded over the segment axis
+            else:
+                merged[k] = _collective(op, apply_reduce(op, v), SEGMENT_AXIS)
+        return merged
+
+    shard_spec = P(SEGMENT_AXIS)
+
+    def sharded(segs, q):
+        in_specs = (
+            jax.tree_util.tree_map(lambda _: shard_spec, segs),
+            jax.tree_util.tree_map(lambda _: shard_spec, q),
+        )
+        fn = shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=_out_specs(reducers, shard_spec),
+            check_vma=False,
+        )
+        return fn(segs, q)
+
+    return jax.jit(sharded)
+
+
+def run_sharded_query(plan: StaticPlan, mesh: Mesh, seg_arrays, q_inputs):
+    return make_sharded_table_kernel(plan, mesh)(seg_arrays, q_inputs)
