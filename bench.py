@@ -1,0 +1,127 @@
+"""Benchmark: rows scanned/sec on a TPC-H-Q1-shaped query (BASELINE.md).
+
+The reference's stored numbers (contrib/pinot-benchmark, BASELINE.md):
+full-scan SUM queries on 6M-row lineitem run at ~14.2M rows/s in the
+single config (422 ms for Q0).  The north star is rows-scanned/sec/chip
+on a Q1-shaped filtered group-by.
+
+This harness stages synthetic lineitem segments into device memory and
+times the compiled query kernel end-to-end (device compute + result
+readback), steady-state (post-compile), median of N iterations.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_ROWS_PER_SEC = 14_200_000.0  # BASELINE.md: 6,001,215 rows / 0.422 s
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+
+    num_segments = int(os.environ.get("PINOT_TPU_BENCH_SEGMENTS", "4"))
+    rows_per_segment = int(
+        os.environ.get(
+            "PINOT_TPU_BENCH_ROWS_PER_SEGMENT", "2000000" if on_tpu else "250000"
+        )
+    )
+    iters = int(os.environ.get("PINOT_TPU_BENCH_ITERS", "20"))
+    total_rows = num_segments * rows_per_segment
+
+    from pinot_tpu.engine.context import get_table_context
+    from pinot_tpu.engine.device import stage_segments
+    from pinot_tpu.engine.executor import QueryExecutor
+    from pinot_tpu.engine.kernel import make_table_kernel
+    from pinot_tpu.engine.plan import build_query_inputs, build_static_plan
+    from pinot_tpu.pql import optimize_request, parse_pql
+    from pinot_tpu.tools.datagen import synthetic_lineitem_segment
+
+    segments = [
+        synthetic_lineitem_segment(rows_per_segment, seed=11 + i, name=f"li{i}")
+        for i in range(num_segments)
+    ]
+
+    # TPC-H Q1 shape: date-range filter, 2-col group-by, multiple SUMs
+    pql = (
+        "SELECT sum(l_quantity), sum(l_extendedprice), sum(l_discount), count(*) "
+        "FROM lineitem WHERE l_shipdate <= '1998-09-02' "
+        "GROUP BY l_returnflag, l_linestatus TOP 10"
+    )
+    request = optimize_request(parse_pql(pql))
+
+    ctx = get_table_context(segments)
+    needed = sorted(set(request.referenced_columns()))
+    staged = stage_segments(segments, needed)
+    plan = build_static_plan(request, ctx, staged)
+    assert plan.on_device, "bench query must run on device"
+    q_np = build_query_inputs(request, plan, ctx, staged)
+
+    import jax.numpy as jnp
+
+    def conv(x):
+        if isinstance(x, np.ndarray):
+            return jnp.asarray(x)
+        if isinstance(x, list):
+            return [conv(v) for v in x]
+        if isinstance(x, dict):
+            return {k: conv(v) for k, v in x.items()}
+        return x
+
+    q_inputs = conv(q_np)
+    seg_arrays = {"valid": staged.valid}
+    for name in needed:
+        col = staged.column(name)
+        if col.fwd is not None:
+            seg_arrays[f"{name}.fwd"] = col.fwd
+        if col.dict_vals is not None:
+            seg_arrays[f"{name}.dict"] = col.dict_vals
+
+    kernel = make_table_kernel(plan)
+
+    def run_once():
+        outs = kernel(seg_arrays, q_inputs)
+        jax.block_until_ready(outs)
+        return outs
+
+    run_once()  # compile
+    run_once()  # warm
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run_once()
+        times.append(time.perf_counter() - t0)
+    median = sorted(times)[len(times) // 2]
+    rows_per_sec = total_rows / median
+
+    print(
+        json.dumps(
+            {
+                "metric": "tpch_q1_rows_scanned_per_sec_per_chip",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+                "detail": {
+                    "platform": platform,
+                    "total_rows": total_rows,
+                    "num_segments": num_segments,
+                    "median_ms": round(median * 1000, 3),
+                    "iters": iters,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
