@@ -1,0 +1,9 @@
+from pinot_tpu.broker.routing import RoutingTableProvider, balanced_random_routing_tables
+from pinot_tpu.broker.broker import BrokerRequestHandler, BrokerHttpServer
+
+__all__ = [
+    "RoutingTableProvider",
+    "balanced_random_routing_tables",
+    "BrokerRequestHandler",
+    "BrokerHttpServer",
+]

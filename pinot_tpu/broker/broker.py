@@ -1,0 +1,282 @@
+"""Broker: PQL front door — parse, route, scatter-gather, reduce.
+
+The reference flow (``BrokerRequestHandler.java:139``): compile PQL ->
+optimize -> look up routing table -> scatter InstanceRequests ->
+gather DataTables (per-server errors become response exceptions, the
+healthy partials still reduce, :443-460) -> BrokerReduceService ->
+JSON.  Hybrid tables federate into offline+realtime sub-queries split
+at the time boundary (:280-329; see ``pinot_tpu.broker.time_boundary``).
+
+Scatter-gather fans out on a thread pool with a per-request timeout
+(``ScatterGatherImpl.java:80``); replica choice already happened when
+the routing table was built.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from pinot_tpu.common.datatable import (
+    deserialize_result,
+    serialize_instance_request,
+)
+from pinot_tpu.common.request import BrokerRequest, FilterOperator, FilterQueryTree, RangeSpec
+from pinot_tpu.common.response import BrokerResponse, ErrorCode, QueryException
+from pinot_tpu.engine.reduce import reduce_to_response
+from pinot_tpu.engine.results import IntermediateResult
+from pinot_tpu.pql import PqlParseError, optimize_request, parse_pql
+from pinot_tpu.broker.routing import RoutingTableProvider
+from pinot_tpu.broker.time_boundary import TimeBoundaryService
+from pinot_tpu.utils.metrics import BrokerMetrics
+
+logger = logging.getLogger(__name__)
+
+OFFLINE_SUFFIX = "_OFFLINE"
+REALTIME_SUFFIX = "_REALTIME"
+
+
+class BrokerRequestHandler:
+    def __init__(
+        self,
+        transport,
+        server_addresses: Dict[str, Tuple[str, int]],
+        routing: Optional[RoutingTableProvider] = None,
+        time_boundary: Optional[TimeBoundaryService] = None,
+        timeout_ms: float = 15_000.0,
+        name: str = "broker0",
+    ) -> None:
+        self.transport = transport
+        self.server_addresses = dict(server_addresses)
+        self.routing = routing or RoutingTableProvider()
+        self.time_boundary = time_boundary or TimeBoundaryService()
+        self.timeout_ms = timeout_ms
+        self.metrics = BrokerMetrics(name)
+        self._request_id = 0
+        self._id_lock = threading.Lock()
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=16)
+
+    def set_server_address(self, server: str, address: Tuple[str, int]) -> None:
+        self.server_addresses[server] = address
+
+    def _next_id(self) -> int:
+        with self._id_lock:
+            self._request_id += 1
+            return self._request_id
+
+    # ------------------------------------------------------------------
+    def handle_pql(self, pql: str, trace: bool = False) -> BrokerResponse:
+        t0 = time.perf_counter()
+        self.metrics.meter("queries").mark()
+        try:
+            request = optimize_request(parse_pql(pql))
+        except PqlParseError as e:
+            resp = BrokerResponse(
+                exceptions=[QueryException(ErrorCode.PQL_PARSING, str(e))]
+            )
+            resp.time_used_ms = (time.perf_counter() - t0) * 1000
+            return resp
+        request.enable_trace = trace
+        self._trace_flag = trace
+        resp = self.handle_request(request, pql)
+        resp.time_used_ms = (time.perf_counter() - t0) * 1000
+        self.metrics.timer("queryTotal").update(resp.time_used_ms)
+        return resp
+
+    def handle_request(self, request: BrokerRequest, pql: str) -> BrokerResponse:
+        table = request.table_name
+        physical = self._physical_tables(table, pql)
+        if not physical:
+            return BrokerResponse(
+                exceptions=[
+                    QueryException(
+                        ErrorCode.BROKER_RESOURCE_MISSING, f"no routing for table {table}"
+                    )
+                ]
+            )
+
+        parts: List[IntermediateResult] = []
+        exceptions: List[QueryException] = []
+        futures = []
+        for phys_table, sub_pql in physical:
+            routing = self.routing.find_servers(phys_table)
+            if routing is None:
+                exceptions.append(
+                    QueryException(
+                        ErrorCode.BROKER_RESOURCE_MISSING,
+                        f"no routing for table {phys_table}",
+                    )
+                )
+                continue
+            for server, segments in routing.items():
+                futures.append(
+                    (
+                        server,
+                        self._pool.submit(
+                            self._send_one, server, phys_table, sub_pql, segments
+                        ),
+                    )
+                )
+
+        t_sg = time.perf_counter()
+        deadline = t_sg + self.timeout_ms / 1000.0
+        for server, fut in futures:
+            try:
+                remaining = max(0.05, deadline - time.perf_counter())
+                parts.append(fut.result(timeout=remaining))
+            except Exception as e:
+                logger.warning("server %s failed: %s", server, e)
+                exceptions.append(
+                    QueryException(
+                        ErrorCode.BROKER_GATHER, f"server {server}: {type(e).__name__}: {e}"
+                    )
+                )
+        self.metrics.timer("scatterGather").update((time.perf_counter() - t_sg) * 1000)
+
+        t_red = time.perf_counter()
+        for p in parts:
+            for code, msg in p.exceptions:
+                exceptions.append(QueryException(code, msg))
+        resp = reduce_to_response(request, parts, exceptions)
+        self.metrics.timer("reduce").update((time.perf_counter() - t_red) * 1000)
+        resp.num_servers_queried = len(futures)
+        resp.num_servers_responded = len(parts)
+        return resp
+
+    # ------------------------------------------------------------------
+    def _physical_tables(self, table: str, pql: str) -> List[Tuple[str, str]]:
+        """Logical table -> [(physical table, sub-query pql)].
+
+        Hybrid federation (BrokerRequestHandler.java:280-329): a table
+        with both OFFLINE and REALTIME physical tables gets the query
+        duplicated with a time-boundary filter added on each side.
+        """
+        known = set(self.routing.tables())
+        if table in known:
+            return [(table, pql)]
+        offline = table + OFFLINE_SUFFIX
+        realtime = table + REALTIME_SUFFIX
+        if offline in known and realtime in known:
+            boundary = self.time_boundary.get(offline)
+            if boundary is not None:
+                col, value = boundary
+                return [
+                    (offline, self._with_time_filter(pql, col, value, is_offline=True)),
+                    (realtime, self._with_time_filter(pql, col, value, is_offline=False)),
+                ]
+            return [(offline, pql)]
+        if offline in known:
+            return [(offline, pql)]
+        if realtime in known:
+            return [(realtime, pql)]
+        return []
+
+    def _with_time_filter(self, pql: str, col: str, value: int, is_offline: bool) -> str:
+        """Append the hybrid time-boundary predicate to the PQL text
+        (offline: col <= boundary; realtime: col > boundary —
+        HelixExternalViewBasedTimeBoundaryService.java:52-85)."""
+        op = "<=" if is_offline else ">"
+        upper = pql.upper()
+        pred = f"{col} {op} {value}"
+        if " WHERE " in upper:
+            idx = upper.index(" WHERE ") + len(" WHERE ")
+            rest = pql[idx:]
+            # predicate list ends at the next clause keyword
+            end = len(rest)
+            for kw in (" GROUP BY ", " ORDER BY ", " HAVING ", " TOP ", " LIMIT "):
+                j = rest.upper().find(kw)
+                if j != -1:
+                    end = min(end, j)
+            return pql[:idx] + f"({rest[:end]}) AND {pred}" + rest[end:]
+        # insert WHERE after FROM <table>
+        ufrom = upper.index(" FROM ")
+        after = pql[ufrom + len(" FROM ") :]
+        stop = len(after)
+        for kw in (" WHERE ", " GROUP BY ", " ORDER BY ", " HAVING ", " TOP ", " LIMIT "):
+            j = after.upper().find(kw)
+            if j != -1:
+                stop = min(stop, j)
+        return (
+            pql[: ufrom + len(" FROM ")] + after[:stop] + f" WHERE {pred}" + after[stop:]
+        )
+
+    _trace_flag: bool = False
+
+    def _send_one(
+        self, server: str, table: str, pql: str, segments: List[str]
+    ) -> IntermediateResult:
+        address = self.server_addresses[server]
+        payload = serialize_instance_request(
+            self._next_id(), pql, table, segments, self.timeout_ms, trace=self._trace_flag
+        )
+        reply = self.transport.request(address, payload, timeout=self.timeout_ms / 1000.0)
+        return deserialize_result(reply)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front (PinotClientRequestServlet analog)
+# ---------------------------------------------------------------------------
+
+
+class BrokerHttpServer:
+    """HTTP endpoint: GET /query?pql=... and POST /query {"pql": ...}
+    (``PinotClientRequestServlet.java:54/:73``)."""
+
+    def __init__(self, handler: BrokerRequestHandler, host: str = "127.0.0.1", port: int = 0):
+        broker = handler
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _respond(self, payload: Dict[str, Any], status: int = 200) -> None:
+                body = json.dumps(payload).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                if url.path not in ("/query", "/"):
+                    if url.path == "/health":
+                        return self._respond({"status": "ok"})
+                    if url.path == "/metrics":
+                        return self._respond(broker.metrics.snapshot())
+                    return self._respond({"error": "not found"}, 404)
+                qs = parse_qs(url.query)
+                pql = (qs.get("pql") or qs.get("bql") or [""])[0]
+                trace = (qs.get("trace") or ["false"])[0].lower() == "true"
+                resp = broker.handle_pql(pql, trace=trace)
+                self._respond(resp.to_json())
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError as e:
+                    return self._respond(
+                        {"exceptions": [{"errorCode": ErrorCode.JSON_PARSING, "message": str(e)}]}
+                    )
+                pql = body.get("pql") or body.get("bql") or ""
+                resp = broker.handle_pql(pql, trace=bool(body.get("trace")))
+                self._respond(resp.to_json())
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,73 @@
+"""Broker routing: external view -> precomputed routing tables.
+
+The reference listens to Helix ExternalView changes and precomputes N
+routing tables per table — each a full ``{server -> segment set}``
+cover with one random ONLINE replica chosen per segment — then picks a
+random table per query (``HelixExternalViewBasedRouting.java:65``,
+``BalancedRandomRoutingTableBuilder.java``).  Same design here, fed by
+the controller's external view (``pinot_tpu.controller``) or a static
+map.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+# external view shape: {segment_name: {server_name: state}}; state in
+# ONLINE | CONSUMING | OFFLINE | ERROR
+ExternalView = Dict[str, Dict[str, str]]
+RoutingTable = Dict[str, List[str]]  # server -> segments
+
+ONLINE_STATES = ("ONLINE", "CONSUMING")
+
+
+def balanced_random_routing_tables(
+    external_view: ExternalView, num_tables: int = 10, seed: int = 0
+) -> List[RoutingTable]:
+    """Precompute N random replica-balanced covers of all segments."""
+    rng = random.Random(seed)
+    out: List[RoutingTable] = []
+    for _ in range(max(1, num_tables)):
+        table: RoutingTable = {}
+        for segment, replicas in external_view.items():
+            candidates = [s for s, st in replicas.items() if st in ONLINE_STATES]
+            if not candidates:
+                continue  # segment currently unserved -> partial results
+            server = rng.choice(candidates)
+            table.setdefault(server, []).append(segment)
+        out.append(table)
+    return out
+
+
+class RoutingTableProvider:
+    """Per-table routing state, rebuilt on external-view updates (the
+    broker's ExternalView listener analog)."""
+
+    def __init__(self, num_tables: int = 10) -> None:
+        self._routing: Dict[str, List[RoutingTable]] = {}
+        self._lock = threading.Lock()
+        self._num_tables = num_tables
+        self._rng = random.Random(7)
+
+    def update(self, table_name: str, external_view: ExternalView) -> None:
+        tables = balanced_random_routing_tables(
+            external_view, self._num_tables, seed=self._rng.randrange(1 << 30)
+        )
+        with self._lock:
+            self._routing[table_name] = tables
+
+    def remove(self, table_name: str) -> None:
+        with self._lock:
+            self._routing.pop(table_name, None)
+
+    def find_servers(self, table_name: str) -> Optional[RoutingTable]:
+        with self._lock:
+            tables = self._routing.get(table_name)
+            if not tables:
+                return None
+            return self._rng.choice(tables)
+
+    def tables(self) -> List[str]:
+        with self._lock:
+            return list(self._routing.keys())

@@ -1,0 +1,46 @@
+"""Time boundary service for hybrid tables.
+
+Reference: ``HelixExternalViewBasedTimeBoundaryService.java:36`` — for a
+hybrid table the boundary is the max end-time over the OFFLINE table's
+segments; the broker rewrites the offline sub-query to ``time <=
+boundary`` and the realtime one to ``time > boundary`` so rows are
+counted exactly once across the two sides.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+from pinot_tpu.segment.immutable import SegmentMetadata
+
+
+class TimeBoundaryService:
+    def __init__(self) -> None:
+        self._boundaries: Dict[str, Tuple[str, int]] = {}
+        self._lock = threading.Lock()
+
+    def update_from_segments(
+        self, offline_table: str, segment_metas: Iterable[SegmentMetadata]
+    ) -> None:
+        col: Optional[str] = None
+        max_end: Optional[int] = None
+        for meta in segment_metas:
+            if meta.time_column is None or meta.end_time is None:
+                continue
+            col = meta.time_column
+            max_end = meta.end_time if max_end is None else max(max_end, meta.end_time)
+        if col is not None and max_end is not None:
+            with self._lock:
+                self._boundaries[offline_table] = (col, max_end)
+
+    def set(self, offline_table: str, column: str, value: int) -> None:
+        with self._lock:
+            self._boundaries[offline_table] = (column, value)
+
+    def get(self, offline_table: str) -> Optional[Tuple[str, int]]:
+        with self._lock:
+            return self._boundaries.get(offline_table)
+
+    def remove(self, offline_table: str) -> None:
+        with self._lock:
+            self._boundaries.pop(offline_table, None)

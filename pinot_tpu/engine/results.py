@@ -207,8 +207,10 @@ class IntermediateResult:
         num_entries_scanned_post_filter: int = 0,
         trace: Optional[Dict[str, Any]] = None,
         selection_columns: Optional[List[str]] = None,
+        exceptions: Optional[List[Tuple[int, str]]] = None,
     ) -> None:
         self.selection_columns = selection_columns
+        self.exceptions: List[Tuple[int, str]] = exceptions or []
         self.aggregations = aggregations
         self.groups = groups
         self.selection_rows = selection_rows
@@ -220,6 +222,7 @@ class IntermediateResult:
         self.trace = trace or {}
 
     def merge(self, other: "IntermediateResult") -> None:
+        self.exceptions.extend(other.exceptions)
         self.num_docs_scanned += other.num_docs_scanned
         self.total_docs += other.total_docs
         self.num_segments_queried += other.num_segments_queried

@@ -1,0 +1,11 @@
+from pinot_tpu.server.datamanager import InstanceDataManager, TableDataManager, SegmentDataManager
+from pinot_tpu.server.instance import ServerInstance
+from pinot_tpu.server.scheduler import QueryScheduler
+
+__all__ = [
+    "InstanceDataManager",
+    "TableDataManager",
+    "SegmentDataManager",
+    "ServerInstance",
+    "QueryScheduler",
+]

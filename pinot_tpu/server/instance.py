@@ -1,0 +1,89 @@
+"""Server instance: request handling front for one query-serving node.
+
+The reference chain (``ScheduledRequestHandler.java:55``): Netty bytes
+-> Thrift InstanceRequest -> QueryScheduler -> QueryExecutor ->
+serialized DataTable bytes.  Here: framed bytes -> InstanceRequest ->
+scheduler -> TPU QueryExecutor -> DataTable bytes.  Errors come back as
+a DataTable whose ``exceptions`` metadata is set (the broker still
+reduces the healthy servers' partials —
+``BrokerRequestHandler.java:443-460`` semantics).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional, Sequence, Tuple
+
+from pinot_tpu.common.datatable import (
+    deserialize_instance_request,
+    serialize_result,
+)
+from pinot_tpu.common.response import ErrorCode
+from pinot_tpu.engine.executor import QueryExecutor
+from pinot_tpu.engine.results import IntermediateResult
+from pinot_tpu.pql import optimize_request, parse_pql
+from pinot_tpu.segment.immutable import ImmutableSegment
+from pinot_tpu.server.datamanager import InstanceDataManager
+from pinot_tpu.server.scheduler import QueryScheduler
+from pinot_tpu.utils.metrics import ServerMetrics
+from pinot_tpu.utils.trace import TraceContext
+
+logger = logging.getLogger(__name__)
+
+
+class ServerInstance:
+    def __init__(self, name: str = "server0", mesh=None, num_workers: int = 4) -> None:
+        self.name = name
+        self.data_manager = InstanceDataManager()
+        self.executor = QueryExecutor(mesh=mesh)
+        self.scheduler = QueryScheduler(num_workers=num_workers)
+        self.metrics = ServerMetrics(name)
+
+    # -- segment lifecycle -------------------------------------------
+    def add_segment(self, table: str, segment: ImmutableSegment) -> None:
+        self.data_manager.add_segment(table, segment)
+
+    def remove_segment(self, table: str, name: str) -> None:
+        tdm = self.data_manager.table(table)
+        if tdm is not None:
+            tdm.remove_segment(name)
+
+    # -- query path ---------------------------------------------------
+    def handle_request(self, payload: bytes) -> bytes:
+        """Framed request bytes -> framed DataTable bytes."""
+        t_start = time.perf_counter()
+        req = deserialize_instance_request(payload)
+        try:
+            result = self.scheduler.run(
+                lambda: self._process(req), timeout_s=req["timeoutMs"] / 1000.0
+            )
+        except Exception as e:  # scheduler timeout / execution error
+            logger.exception("query %s failed", req.get("requestId"))
+            result = IntermediateResult(
+                exceptions=[(ErrorCode.QUERY_EXECUTION, f"{type(e).__name__}: {e}")]
+            )
+        self.metrics.timer("queryExecution").update((time.perf_counter() - t_start) * 1000)
+        self.metrics.meter("queries").mark()
+        return serialize_result(result)
+
+    def _process(self, req: dict) -> IntermediateResult:
+        request = optimize_request(parse_pql(req["pql"]))
+        request.enable_trace = bool(req.get("trace"))
+        trace = TraceContext(enabled=request.enable_trace, scope=self.name)
+        tdm = self.data_manager.table(req["table"])
+        if tdm is None:
+            return IntermediateResult(
+                exceptions=[
+                    (ErrorCode.SERVER_SCHEDULER_DOWN, f"table {req['table']} not on server {self.name}")
+                ]
+            )
+        names: Optional[Sequence[str]] = req["segments"] or None
+        acquired = tdm.acquire_segments(names)
+        try:
+            with trace.span("planAndExecute"):
+                result = self.executor.execute([a.segment for a in acquired], request)
+        finally:
+            tdm.release_segments(acquired)
+        if trace.enabled:
+            result.trace.update(trace.to_dict())
+        return result

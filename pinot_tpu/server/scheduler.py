@@ -1,0 +1,25 @@
+"""Query scheduler: bounded FCFS pool in front of the executor.
+
+The reference bounds query concurrency with runner/worker pools
+(``QueryScheduler.java:35``, ``FCFSQueryScheduler``).  Device execution
+is serialized per chip anyway, so the pool here mainly bounds host-side
+planning/finalize concurrency and provides the submit/timeout surface.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Any, Callable
+
+
+class QueryScheduler:
+    def __init__(self, num_workers: int = 4) -> None:
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=num_workers)
+
+    def submit(self, fn: Callable[[], Any]) -> concurrent.futures.Future:
+        return self._pool.submit(fn)
+
+    def run(self, fn: Callable[[], Any], timeout_s: float) -> Any:
+        return self.submit(fn).result(timeout=timeout_s)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

@@ -1,0 +1,4 @@
+from pinot_tpu.transport.tcp import TcpServer, TcpTransport
+from pinot_tpu.transport.local import LocalTransport
+
+__all__ = ["TcpServer", "TcpTransport", "LocalTransport"]

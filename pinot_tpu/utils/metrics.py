@@ -1,0 +1,125 @@
+"""Metrics: meters, timers, gauges per role.
+
+The Yammer-metrics analog (pinot-common
+``common/metrics/AbstractMetrics.java`` with ``BrokerMeter``,
+``ServerMeter``, ``ServerQueryPhase`` etc.): typed registries per role,
+timers keep recent samples for percentile queries (the
+``AggregatedHistogram`` role), everything thread-safe and cheap.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, Optional
+
+
+class Meter:
+    def __init__(self) -> None:
+        self.count = 0
+        self._t0 = time.time()
+        self._lock = threading.Lock()
+
+    def mark(self, n: int = 1) -> None:
+        with self._lock:
+            self.count += n
+
+    @property
+    def rate(self) -> float:
+        dt = time.time() - self._t0
+        return self.count / dt if dt > 0 else 0.0
+
+
+class Timer:
+    def __init__(self, window: int = 4096) -> None:
+        self.count = 0
+        self.total_ms = 0.0
+        self._samples: Deque[float] = deque(maxlen=window)
+        self._lock = threading.Lock()
+
+    def update(self, ms: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total_ms += ms
+            self._samples.append(ms)
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            s = sorted(self._samples)
+            idx = min(int(len(s) * p / 100.0), len(s) - 1)
+            return s[idx]
+
+    @property
+    def mean_ms(self) -> float:
+        return self.total_ms / self.count if self.count else 0.0
+
+
+class Gauge:
+    def __init__(self) -> None:
+        self.value: Any = 0
+
+    def set(self, v: Any) -> None:
+        self.value = v
+
+
+class MetricsRegistry:
+    """Per-role metrics registry (AbstractMetrics analog)."""
+
+    def __init__(self, scope: str) -> None:
+        self.scope = scope
+        self._meters: Dict[str, Meter] = {}
+        self._timers: Dict[str, Timer] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._lock = threading.Lock()
+
+    def meter(self, name: str) -> Meter:
+        with self._lock:
+            m = self._meters.get(name)
+            if m is None:
+                m = self._meters[name] = Meter()
+            return m
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            t = self._timers.get(name)
+            if t is None:
+                t = self._timers[name] = Timer()
+            return t
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge()
+            return g
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "scope": self.scope,
+                "meters": {k: {"count": m.count, "rate": round(m.rate, 3)} for k, m in self._meters.items()},
+                "timers": {
+                    k: {
+                        "count": t.count,
+                        "meanMs": round(t.mean_ms, 3),
+                        "p95Ms": round(t.percentile(95), 3),
+                        "p99Ms": round(t.percentile(99), 3),
+                    }
+                    for k, t in self._timers.items()
+                },
+                "gauges": {k: g.value for k, g in self._gauges.items()},
+            }
+
+
+class ServerMetrics(MetricsRegistry):
+    """ServerMeter/ServerTimer/ServerQueryPhase namespace."""
+
+
+class BrokerMetrics(MetricsRegistry):
+    """BrokerMeter/BrokerQueryPhase namespace."""
+
+
+class ControllerMetrics(MetricsRegistry):
+    """ControllerMeter/ControllerGauge namespace."""

@@ -1,0 +1,53 @@
+"""Per-request tracing.
+
+The reference registers a requestId-scoped trace registry and wraps
+worker threads so operators can log step latencies
+(``core/util/trace/TraceContext.java:41``, ``TraceRunnable``); the trace
+rides back in DataTable metadata and is merged per server
+(``BrokerReduceService.java:84-87``).  Here a TraceContext collects
+(span -> ms) under a scope name and attaches to the result's trace dict;
+thread inheritance uses contextvars instead of thread wrappers.
+"""
+from __future__ import annotations
+
+import contextvars
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+_current: contextvars.ContextVar[Optional["TraceContext"]] = contextvars.ContextVar(
+    "pinot_tpu_trace", default=None
+)
+
+
+class TraceContext:
+    def __init__(self, enabled: bool = False, scope: str = "") -> None:
+        self.enabled = enabled
+        self.scope = scope
+        self.spans: List[Tuple[str, float]] = []
+
+    @contextmanager
+    def span(self, name: str):
+        if not self.enabled:
+            yield
+            return
+        token = _current.set(self)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.spans.append((name, (time.perf_counter() - t0) * 1000.0))
+            _current.reset(token)
+
+    def add(self, name: str, ms: float) -> None:
+        if self.enabled:
+            self.spans.append((name, ms))
+
+    def to_dict(self) -> Dict[str, Any]:
+        if not self.enabled:
+            return {}
+        return {self.scope: [{"span": n, "ms": round(ms, 3)} for n, ms in self.spans]}
+
+
+def current_trace() -> Optional[TraceContext]:
+    return _current.get()

@@ -1,0 +1,248 @@
+"""Serving-topology tests: DataTable wire roundtrip, in-process
+broker/server cluster vs oracle, real TCP transport, partial failure.
+
+The in-process multi-node harness mirrors the reference's
+``ClusterTest`` approach (everything in one process, SURVEY §4).
+"""
+import json
+import math
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import BrokerHttpServer, BrokerRequestHandler
+from pinot_tpu.broker.routing import RoutingTableProvider
+from pinot_tpu.common.datatable import deserialize_result, serialize_result
+from pinot_tpu.engine.results import (
+    AvgPartial,
+    CountPartial,
+    DistinctPartial,
+    HistogramPartial,
+    HllPartial,
+    IntermediateResult,
+    MinPartial,
+    SumPartial,
+)
+from pinot_tpu.pql import parse_pql, optimize_request
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.server.instance import ServerInstance
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+from pinot_tpu.tools.scan_engine import ScanQueryProcessor
+from pinot_tpu.transport.local import LocalTransport
+from pinot_tpu.transport.tcp import TcpServer, TcpTransport
+
+TABLE = "testTable"
+
+
+# ------------------------------------------------------------ datatable
+def test_datatable_roundtrip():
+    res = IntermediateResult(
+        aggregations=[
+            CountPartial(5),
+            SumPartial(1.5),
+            MinPartial(-2.0),
+            AvgPartial(10.0, 4.0),
+            DistinctPartial({"a", "b", 3}),
+            HllPartial(np.arange(256, dtype=np.uint8)),
+            HistogramPartial({1.0: 3, 2.5: 7}, percentile=90),
+        ],
+        num_docs_scanned=42,
+        total_docs=100,
+        num_segments_queried=3,
+        trace={"server0": [{"span": "x", "ms": 1.5}]},
+        exceptions=[(200, "boom")],
+    )
+    out = deserialize_result(serialize_result(res))
+    assert out.num_docs_scanned == 42
+    assert out.total_docs == 100
+    assert out.exceptions == [(200, "boom")]
+    assert out.trace == res.trace
+    assert [type(p).__name__ for p in out.aggregations] == [
+        type(p).__name__ for p in res.aggregations
+    ]
+    assert out.aggregations[0].count == 5
+    assert out.aggregations[4].values == {"a", "b", 3}
+    np.testing.assert_array_equal(out.aggregations[5].registers, res.aggregations[5].registers)
+    assert out.aggregations[6].counts == {1.0: 3, 2.5: 7}
+    assert out.aggregations[6].percentile == 90
+
+
+def test_datatable_groups_and_selection():
+    res = IntermediateResult(
+        groups={("a", "1"): [SumPartial(2.0)], ("b", "2"): [SumPartial(3.0)]},
+        num_docs_scanned=2,
+    )
+    out = deserialize_result(serialize_result(res))
+    assert out.groups[("a", "1")][0].total == 2.0
+
+    res2 = IntermediateResult(
+        selection_rows=[([1, "x"], ["x", 1, [1, 2]]), ([2, "y"], ["y", 2, [3]])],
+        selection_columns=["d", "m", "mv"],
+    )
+    out2 = deserialize_result(serialize_result(res2))
+    assert out2.selection_columns == ["d", "m", "mv"]
+    assert out2.selection_rows == [([1, "x"], ["x", 1, [1, 2]]), ([2, "y"], ["y", 2, [3]])]
+
+
+# ----------------------------------------------------------- cluster
+@pytest.fixture(scope="module")
+def cluster():
+    schema = make_test_schema()
+    rows = random_rows(schema, 800, seed=9, cardinality=12)
+    half = len(rows) // 2
+    seg_a1 = build_segment(schema, rows[:200], TABLE, "segA1")
+    seg_a2 = build_segment(schema, rows[200:half], TABLE, "segA2")
+    seg_b1 = build_segment(schema, rows[half:600], TABLE, "segB1")
+    seg_b2 = build_segment(schema, rows[600:], TABLE, "segB2")
+
+    server_a = ServerInstance("serverA")
+    server_a.add_segment(TABLE, seg_a1)
+    server_a.add_segment(TABLE, seg_a2)
+    server_b = ServerInstance("serverB")
+    server_b.add_segment(TABLE, seg_b1)
+    server_b.add_segment(TABLE, seg_b2)
+
+    transport = LocalTransport()
+    transport.register(("serverA", 0), server_a.handle_request)
+    transport.register(("serverB", 0), server_b.handle_request)
+
+    routing = RoutingTableProvider()
+    routing.update(
+        TABLE,
+        {
+            "segA1": {"serverA": "ONLINE"},
+            "segA2": {"serverA": "ONLINE"},
+            "segB1": {"serverB": "ONLINE"},
+            "segB2": {"serverB": "ONLINE"},
+        },
+    )
+    broker = BrokerRequestHandler(
+        transport,
+        {"serverA": ("serverA", 0), "serverB": ("serverB", 0)},
+        routing=routing,
+        timeout_ms=30_000,
+    )
+    oracle = ScanQueryProcessor(schema, rows)
+    return broker, oracle, transport
+
+
+CLUSTER_QUERIES = [
+    "SELECT count(*) FROM testTable",
+    "SELECT sum(metInt), avg(metDouble) FROM testTable WHERE dimInt > 1000",
+    "SELECT sum(metInt) FROM testTable GROUP BY dimStr TOP 5",
+    "SELECT distinctcount(dimLong) FROM testTable",
+    "SELECT percentile90(metInt) FROM testTable",
+    "SELECT min(metFloat) FROM testTable GROUP BY dimStr, dimInt TOP 10",
+    "SELECT dimStr, metInt FROM testTable ORDER BY metInt DESC LIMIT 8",
+    "SELECT distinctcounthll(dimInt) FROM testTable WHERE dimStr <> 'qq'",
+]
+
+
+@pytest.mark.parametrize("pql", CLUSTER_QUERIES)
+def test_cluster_matches_oracle(cluster, pql):
+    broker, oracle, _ = cluster
+    got = broker.handle_pql(pql).to_json()
+    want = oracle.execute(optimize_request(parse_pql(pql))).to_json()
+    for k in ("timeUsedMs", "numEntriesScannedInFilter", "numEntriesScannedPostFilter",
+              "numSegmentsQueried", "numServersQueried", "numServersResponded"):
+        got.pop(k, None)
+        want.pop(k, None)
+    assert got == want
+
+
+def test_cluster_stats(cluster):
+    broker, _, _ = cluster
+    resp = broker.handle_pql("SELECT count(*) FROM testTable")
+    assert resp.num_servers_queried == 2
+    assert resp.num_servers_responded == 2
+    assert resp.total_docs == 800
+
+
+def test_partial_failure(cluster):
+    broker, _, transport = cluster
+    transport.set_down(("serverB", 0))
+    try:
+        resp = broker.handle_pql("SELECT count(*) FROM testTable")
+        # serverA's partial results still reduce; serverB surfaces an exception
+        assert resp.num_servers_responded == 1
+        assert len(resp.exceptions) == 1
+        assert resp.num_docs_scanned == 400
+    finally:
+        transport.set_down(("serverB", 0), down=False)
+
+
+def test_bad_pql_returns_exception(cluster):
+    broker, _, _ = cluster
+    resp = broker.handle_pql("SELEC nope")
+    assert resp.exceptions and resp.exceptions[0].error_code == 150
+
+
+def test_unknown_table(cluster):
+    broker, _, _ = cluster
+    resp = broker.handle_pql("SELECT count(*) FROM nosuchtable")
+    assert resp.exceptions and resp.exceptions[0].error_code == 410
+
+
+def test_trace_rides_back(cluster):
+    broker, _, _ = cluster
+    resp = broker.handle_pql("SELECT count(*) FROM testTable", trace=True)
+    assert resp.trace_info  # per-server span lists
+
+
+# ---------------------------------------------------------------- tcp
+def test_tcp_roundtrip():
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 100, seed=2)
+    seg = build_segment(schema, rows, TABLE, "tcpseg")
+    server = ServerInstance("tcpServer")
+    server.add_segment(TABLE, seg)
+
+    tcp_server = TcpServer(server.handle_request)
+    tcp_server.start()
+    try:
+        transport = TcpTransport()
+        routing = RoutingTableProvider()
+        routing.update(TABLE, {"tcpseg": {"tcpServer": "ONLINE"}})
+        broker = BrokerRequestHandler(
+            transport, {"tcpServer": tcp_server.address}, routing=routing
+        )
+        resp = broker.handle_pql("SELECT count(*) FROM testTable")
+        assert resp.num_docs_scanned == 100
+        oracle = ScanQueryProcessor(schema, rows)
+        want = oracle.execute(parse_pql("SELECT sum(metInt) FROM testTable"))
+        got = broker.handle_pql("SELECT sum(metInt) FROM testTable")
+        assert got.aggregation_results[0].value == want.aggregation_results[0].value
+    finally:
+        tcp_server.stop()
+
+
+def test_http_endpoint():
+    import urllib.request
+
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 50, seed=4)
+    seg = build_segment(schema, rows, TABLE, "httpseg")
+    server = ServerInstance("httpServer")
+    server.add_segment(TABLE, seg)
+    transport = LocalTransport()
+    transport.register(("httpServer", 0), server.handle_request)
+    routing = RoutingTableProvider()
+    routing.update(TABLE, {"httpseg": {"httpServer": "ONLINE"}})
+    broker = BrokerRequestHandler(transport, {"httpServer": ("httpServer", 0)}, routing=routing)
+    http = BrokerHttpServer(broker)
+    http.start()
+    try:
+        url = f"http://127.0.0.1:{http.port}/query"
+        body = json.dumps({"pql": "SELECT count(*) FROM testTable"}).encode()
+        req = urllib.request.Request(url, data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            payload = json.loads(r.read())
+        assert payload["numDocsScanned"] == 50
+        assert payload["aggregationResults"][0]["value"] == "50"
+        # GET variant
+        get_url = url + "?pql=" + urllib.parse.quote("SELECT count(*) FROM testTable")
+        with urllib.request.urlopen(get_url, timeout=10) as r:
+            payload = json.loads(r.read())
+        assert payload["numDocsScanned"] == 50
+    finally:
+        http.stop()
