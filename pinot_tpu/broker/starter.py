@@ -1,0 +1,40 @@
+"""Broker starter: wires routing + time boundary to external-view updates.
+
+The reference's ``HelixBrokerStarter.java:57`` registers ExternalView
+listeners; ``ClusterChangeMediator`` debounces them into routing
+rebuilds and time-boundary refreshes.  Here the controller invokes the
+listener directly on every view change.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from pinot_tpu.broker.broker import BrokerRequestHandler, OFFLINE_SUFFIX
+from pinot_tpu.controller.resource_manager import ClusterResourceManager, InstanceState
+
+
+class BrokerStarter:
+    def __init__(self, broker: BrokerRequestHandler, resources: ClusterResourceManager) -> None:
+        self.broker = broker
+        self.resources = resources
+
+    def start(self) -> None:
+        self.resources.register_instance(InstanceState(self.broker.metrics.scope, role="broker"))
+        self.resources.add_view_listener(self.on_view_change)
+        # seed routing for any pre-existing tables
+        for table in self.resources.tables():
+            self.on_view_change(table, self.resources.get_external_view(table))
+
+    def on_view_change(self, table: str, view: Dict[str, Dict[str, str]]) -> None:
+        if table not in self.resources.tables():
+            self.broker.routing.remove(table)
+            self.broker.time_boundary.remove(table)
+            return
+        self.broker.routing.update(table, view)
+        if table.endswith(OFFLINE_SUFFIX):
+            metas = []
+            for seg in self.resources.segments_of(table):
+                info = self.resources.get_segment_metadata(table, seg)
+                if info and info.get("metadata") is not None:
+                    metas.append(info["metadata"])
+            self.broker.time_boundary.update_from_segments(table, metas)

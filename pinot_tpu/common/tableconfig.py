@@ -1,0 +1,138 @@
+"""Table configuration.
+
+Mirrors the reference's JSON table config (pinot-common
+``common/config/AbstractTableConfig.java:37``): table type
+OFFLINE|REALTIME|HYBRID, replication, retention, indexing config
+(inverted index columns, star-tree), stream (realtime) config, quotas.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class RetentionConfig:
+    retention_time_unit: str = "DAYS"
+    retention_time_value: int = 0  # 0 = keep forever
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "retentionTimeUnit": self.retention_time_unit,
+            "retentionTimeValue": self.retention_time_value,
+        }
+
+
+@dataclass
+class IndexingConfig:
+    inverted_index_columns: List[str] = field(default_factory=list)
+    sorted_column: Optional[str] = None
+    startree_enabled: bool = False
+    startree_dimensions_split_order: List[str] = field(default_factory=list)
+    startree_max_leaf_records: int = 10_000
+    startree_skip_star_node_for_dims: List[str] = field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "invertedIndexColumns": list(self.inverted_index_columns),
+            "sortedColumn": self.sorted_column,
+            "starTreeEnabled": self.startree_enabled,
+            "starTreeDimensionsSplitOrder": list(self.startree_dimensions_split_order),
+            "starTreeMaxLeafRecords": self.startree_max_leaf_records,
+        }
+
+
+@dataclass
+class StreamConfig:
+    """Realtime ingestion config (the kafka.* stream properties analog)."""
+
+    stream_type: str = "file"  # file | kafka (kafka is gated; no client baked in)
+    topic: str = ""
+    decoder: str = "json"
+    rows_per_segment: int = 100_000  # segment flush threshold
+    consume_seconds: float = 3600.0
+
+
+@dataclass
+class QuotaConfig:
+    storage: Optional[str] = None
+    max_queries_per_second: Optional[float] = None
+
+
+@dataclass
+class TableConfig:
+    table_name: str
+    table_type: str = "OFFLINE"  # OFFLINE | REALTIME
+    replication: int = 1
+    retention: RetentionConfig = field(default_factory=RetentionConfig)
+    indexing: IndexingConfig = field(default_factory=IndexingConfig)
+    stream: Optional[StreamConfig] = None
+    quota: QuotaConfig = field(default_factory=QuotaConfig)
+    broker_tenant: str = "DefaultTenant"
+    server_tenant: str = "DefaultTenant"
+
+    @property
+    def physical_name(self) -> str:
+        suffix = "_OFFLINE" if self.table_type == "OFFLINE" else "_REALTIME"
+        if self.table_name.endswith(("_OFFLINE", "_REALTIME")):
+            return self.table_name
+        return self.table_name + suffix
+
+    @property
+    def raw_name(self) -> str:
+        for sfx in ("_OFFLINE", "_REALTIME"):
+            if self.table_name.endswith(sfx):
+                return self.table_name[: -len(sfx)]
+        return self.table_name
+
+    def to_json(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "tableName": self.table_name,
+            "tableType": self.table_type,
+            "segmentsConfig": {
+                "replication": self.replication,
+                **self.retention.to_json(),
+            },
+            "tableIndexConfig": self.indexing.to_json(),
+            "tenants": {"broker": self.broker_tenant, "server": self.server_tenant},
+        }
+        if self.stream is not None:
+            d["streamConfigs"] = {
+                "streamType": self.stream.stream_type,
+                "topic": self.stream.topic,
+                "decoder": self.stream.decoder,
+                "rowsPerSegment": self.stream.rows_per_segment,
+            }
+        return d
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "TableConfig":
+        seg = d.get("segmentsConfig", {})
+        idx = d.get("tableIndexConfig", {})
+        stream = None
+        if "streamConfigs" in d:
+            sc = d["streamConfigs"]
+            stream = StreamConfig(
+                stream_type=sc.get("streamType", "file"),
+                topic=sc.get("topic", ""),
+                decoder=sc.get("decoder", "json"),
+                rows_per_segment=sc.get("rowsPerSegment", 100_000),
+            )
+        return cls(
+            table_name=d["tableName"],
+            table_type=d.get("tableType", "OFFLINE"),
+            replication=seg.get("replication", 1),
+            retention=RetentionConfig(
+                retention_time_unit=seg.get("retentionTimeUnit", "DAYS"),
+                retention_time_value=seg.get("retentionTimeValue", 0),
+            ),
+            indexing=IndexingConfig(
+                inverted_index_columns=idx.get("invertedIndexColumns", []),
+                sorted_column=idx.get("sortedColumn"),
+                startree_enabled=idx.get("starTreeEnabled", False),
+                startree_dimensions_split_order=idx.get("starTreeDimensionsSplitOrder", []),
+                startree_max_leaf_records=idx.get("starTreeMaxLeafRecords", 10_000),
+            ),
+            stream=stream,
+        )

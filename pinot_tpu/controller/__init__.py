@@ -1,0 +1,15 @@
+from pinot_tpu.controller.resource_manager import ClusterResourceManager, InstanceState
+from pinot_tpu.controller.store import SegmentStore
+from pinot_tpu.controller.managers import RetentionManager, ValidationManager, SegmentStatusChecker
+from pinot_tpu.controller.controller import Controller, ControllerHttpServer
+
+__all__ = [
+    "ClusterResourceManager",
+    "InstanceState",
+    "SegmentStore",
+    "RetentionManager",
+    "ValidationManager",
+    "SegmentStatusChecker",
+    "Controller",
+    "ControllerHttpServer",
+]

@@ -1,0 +1,162 @@
+"""Controller: cluster CRUD facade + REST API.
+
+The reference controller (``ControllerStarter.java:47``) exposes REST
+resources for schemas/tables/segments/instances and proxies PQL to a
+broker (``PqlQueryResource.java``); uploads store the segment and write
+ideal state (``PinotSegmentUploadRestletResource.java``).  Same surface
+here over ``ClusterResourceManager`` + ``SegmentStore``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.tableconfig import TableConfig
+from pinot_tpu.controller.managers import (
+    RetentionManager,
+    SegmentStatusChecker,
+    ValidationManager,
+)
+from pinot_tpu.controller.resource_manager import ClusterResourceManager
+from pinot_tpu.controller.store import SegmentStore
+from pinot_tpu.segment.immutable import ImmutableSegment
+
+logger = logging.getLogger(__name__)
+
+
+class Controller:
+    def __init__(self, data_dir: str, start_managers: bool = False) -> None:
+        self.resources = ClusterResourceManager()
+        self.store = SegmentStore(data_dir)
+        self.retention_manager = RetentionManager(self.resources, self.store)
+        self.validation_manager = ValidationManager(self.resources)
+        self.status_checker = SegmentStatusChecker(self.resources)
+        if start_managers:
+            self.retention_manager.start()
+            self.validation_manager.start()
+            self.status_checker.start()
+
+    # -- CRUD -----------------------------------------------------------
+    def add_schema(self, schema: Schema) -> None:
+        self.resources.add_schema(schema)
+
+    def add_table(self, config: TableConfig) -> str:
+        if self.resources.get_schema(config.raw_name) is None:
+            raise ValueError(f"no schema named {config.raw_name!r}; upload the schema first")
+        return self.resources.add_table(config)
+
+    def upload_segment(self, table_physical: str, segment: ImmutableSegment) -> List[str]:
+        """Store the segment durably and drive replicas ONLINE."""
+        path = self.store.save(table_physical, segment)
+        return self.resources.add_segment(
+            table_physical, segment.metadata, {"dir": path}
+        )
+
+    def delete_segment(self, table_physical: str, segment_name: str) -> None:
+        self.resources.delete_segment(table_physical, segment_name)
+        self.store.delete(table_physical, segment_name)
+
+    def delete_table(self, table_physical: str) -> None:
+        self.resources.delete_table(table_physical)
+
+    def stop(self) -> None:
+        self.retention_manager.stop()
+        self.validation_manager.stop()
+        self.status_checker.stop()
+
+
+class ControllerHttpServer:
+    """REST front (restlet resources analog): schemas, tables, segments,
+    ideal/external views, health."""
+
+    def __init__(self, controller: Controller, host: str = "127.0.0.1", port: int = 0):
+        ctrl = controller
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _respond(self, payload: Any, status: int = 200) -> None:
+                body = json.dumps(payload).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_json(self) -> Dict[str, Any]:
+                n = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                parts = [p for p in url.path.split("/") if p]
+                try:
+                    if parts == ["health"]:
+                        return self._respond({"status": "ok"})
+                    if parts == ["tables"]:
+                        return self._respond({"tables": ctrl.resources.tables()})
+                    if len(parts) == 2 and parts[0] == "schemas":
+                        schema = ctrl.resources.get_schema(parts[1])
+                        if schema is None:
+                            return self._respond({"error": "not found"}, 404)
+                        return self._respond(schema.to_json())
+                    if len(parts) == 3 and parts[0] == "tables" and parts[2] == "segments":
+                        return self._respond(
+                            {"segments": ctrl.resources.segments_of(parts[1])}
+                        )
+                    if len(parts) == 3 and parts[0] == "tables" and parts[2] == "idealstate":
+                        return self._respond(ctrl.resources.get_ideal_state(parts[1]))
+                    if len(parts) == 3 and parts[0] == "tables" and parts[2] == "externalview":
+                        return self._respond(ctrl.resources.get_external_view(parts[1]))
+                    return self._respond({"error": "not found"}, 404)
+                except Exception as e:
+                    return self._respond({"error": str(e)}, 500)
+
+            def do_POST(self):
+                url = urlparse(self.path)
+                parts = [p for p in url.path.split("/") if p]
+                try:
+                    if parts == ["schemas"]:
+                        schema = Schema.from_json(self._read_json())
+                        ctrl.add_schema(schema)
+                        return self._respond({"status": "ok", "schema": schema.schema_name})
+                    if parts == ["tables"]:
+                        config = TableConfig.from_json(self._read_json())
+                        physical = ctrl.add_table(config)
+                        return self._respond({"status": "ok", "table": physical})
+                    return self._respond({"error": "not found"}, 404)
+                except Exception as e:
+                    return self._respond({"error": str(e)}, 400)
+
+            def do_DELETE(self):
+                url = urlparse(self.path)
+                parts = [p for p in url.path.split("/") if p]
+                try:
+                    if len(parts) == 2 and parts[0] == "tables":
+                        ctrl.delete_table(parts[1])
+                        return self._respond({"status": "ok"})
+                    if len(parts) == 4 and parts[0] == "tables" and parts[2] == "segments":
+                        ctrl.delete_segment(parts[1], parts[3])
+                        return self._respond({"status": "ok"})
+                    return self._respond({"error": "not found"}, 404)
+                except Exception as e:
+                    return self._respond({"error": str(e)}, 400)
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,139 @@
+"""Controller background managers.
+
+Reference counterparts:
+- RetentionManager (``helix/core/retention/RetentionManager.java:50``):
+  periodically deletes segments whose end time is past the table's
+  retention window.
+- ValidationManager (``validation/ValidationManager.java:64``): compares
+  ideal vs external view, retries ERROR partitions, emits
+  missing-segment metrics (and, for realtime tables, re-creates missing
+  consuming segments — see ``pinot_tpu.realtime``).
+- SegmentStatusChecker (``helix/SegmentStatusChecker.java``): gauges of
+  segments in ERROR / missing replicas.
+
+Managers are explicit ``run_once()`` steps driven by a thread loop (or
+tests calling run_once directly — deterministic, no sleeps).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from pinot_tpu.common.schema import time_unit_to_millis
+from pinot_tpu.controller.resource_manager import ClusterResourceManager, ERROR, ONLINE
+from pinot_tpu.utils.metrics import ControllerMetrics
+
+logger = logging.getLogger(__name__)
+
+
+class _PeriodicManager:
+    def __init__(self, interval_s: float) -> None:
+        self.interval_s = interval_s
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def run_once(self) -> None:
+        raise NotImplementedError
+
+    def start(self) -> None:
+        def loop() -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.run_once()
+                except Exception:
+                    logger.exception("%s run failed", type(self).__name__)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class RetentionManager(_PeriodicManager):
+    def __init__(
+        self,
+        resources: ClusterResourceManager,
+        store,
+        interval_s: float = 3600.0,
+        now_ms=None,
+    ) -> None:
+        super().__init__(interval_s)
+        self.resources = resources
+        self.store = store
+        self._now_ms = now_ms or (lambda: int(time.time() * 1000))
+
+    def run_once(self) -> None:
+        now = self._now_ms()
+        for table in self.resources.tables():
+            config = self.resources.table_configs.get(table)
+            if config is None or config.retention.retention_time_value <= 0:
+                continue
+            window_ms = config.retention.retention_time_value * time_unit_to_millis(
+                config.retention.retention_time_unit
+            )
+            for seg in self.resources.segments_of(table):
+                info = self.resources.get_segment_metadata(table, seg)
+                if not info:
+                    continue
+                meta = info.get("metadata")
+                if meta is None or meta.end_time is None or meta.time_column is None:
+                    continue
+                end_ms = meta.end_time * time_unit_to_millis(meta.time_unit)
+                if end_ms < now - window_ms:
+                    logger.info("retention: deleting %s/%s", table, seg)
+                    self.resources.delete_segment(table, seg)
+                    if self.store is not None:
+                        self.store.delete(table, seg)
+
+
+class ValidationManager(_PeriodicManager):
+    def __init__(self, resources: ClusterResourceManager, interval_s: float = 300.0) -> None:
+        super().__init__(interval_s)
+        self.resources = resources
+        self.metrics = ControllerMetrics("validation")
+        self.realtime_manager = None  # wired by realtime coordinator (stage 7)
+
+    def run_once(self) -> None:
+        for table in self.resources.tables():
+            ideal = self.resources.get_ideal_state(table)
+            view = self.resources.get_external_view(table)
+            missing = 0
+            errors = 0
+            for seg, replicas in ideal.items():
+                actual = view.get(seg, {})
+                for server, target in replicas.items():
+                    got = actual.get(server)
+                    if got == ERROR:
+                        errors += 1
+                        self.resources.reset_segment(table, seg, server)
+                    elif got != target:
+                        missing += 1
+                        self.resources.reset_segment(table, seg, server)
+            self.metrics.gauge(f"{table}.missingReplicas").set(missing)
+            self.metrics.gauge(f"{table}.errorReplicas").set(errors)
+        if self.realtime_manager is not None:
+            self.realtime_manager.ensure_consuming_segments()
+
+
+class SegmentStatusChecker(_PeriodicManager):
+    def __init__(self, resources: ClusterResourceManager, interval_s: float = 300.0) -> None:
+        super().__init__(interval_s)
+        self.resources = resources
+        self.metrics = ControllerMetrics("segmentStatus")
+
+    def run_once(self) -> None:
+        for table in self.resources.tables():
+            ideal = self.resources.get_ideal_state(table)
+            view = self.resources.get_external_view(table)
+            total = len(ideal)
+            online = sum(
+                1
+                for seg, replicas in ideal.items()
+                if any(view.get(seg, {}).get(s) == replicas[s] for s in replicas)
+            )
+            pct = 100.0 if total == 0 else 100.0 * online / total
+            self.metrics.gauge(f"{table}.percentSegmentsAvailable").set(round(pct, 1))
+            self.metrics.gauge(f"{table}.segmentCount").set(total)

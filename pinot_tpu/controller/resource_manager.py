@@ -1,0 +1,268 @@
+"""Cluster resource manager: the ideal-state / external-view brain.
+
+The Helix-semantics core the reference builds on
+(``PinotHelixResourceManager.java:103``,
+``PinotTableIdealStateBuilder.java``), re-implemented as an explicit
+state machine:
+
+- **ideal state** per table: ``{segment -> {server -> target_state}}``
+  — what the controller wants (N replicas per segment, balanced
+  round-robin assignment).
+- **external view** per table: ``{segment -> {server -> actual_state}}``
+  — what participants report after executing transitions.
+- **participants**: registered server callbacks executing
+  OFFLINE->ONLINE / ONLINE->OFFLINE / ->DROPPED transitions (the
+  SegmentOnlineOfflineStateModelFactory analog,
+  ``SegmentOnlineOfflineStateModelFactory.java:85``).
+- **listeners**: broker callbacks receiving external-view updates to
+  rebuild routing (``HelixExternalViewBasedRouting.java:65``).
+
+Everything is synchronous + in-process here; the transport seam is the
+participant/listener callback interface, so a networked deployment
+swaps callbacks for RPC without touching the state logic.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.tableconfig import TableConfig
+from pinot_tpu.segment.immutable import SegmentMetadata
+
+logger = logging.getLogger(__name__)
+
+ONLINE = "ONLINE"
+OFFLINE = "OFFLINE"
+CONSUMING = "CONSUMING"
+DROPPED = "DROPPED"
+ERROR = "ERROR"
+
+
+@dataclass
+class InstanceState:
+    name: str
+    role: str  # "server" | "broker"
+    alive: bool = True
+    tags: Set[str] = field(default_factory=lambda: {"DefaultTenant"})
+
+
+class Participant:
+    """Server-side transition executor registered with the controller."""
+
+    def __init__(
+        self,
+        name: str,
+        on_transition: Callable[[str, str, str, Dict[str, Any]], bool],
+    ) -> None:
+        self.name = name
+        # on_transition(table, segment, target_state, metadata) -> ok
+        self.on_transition = on_transition
+
+
+class ClusterResourceManager:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.schemas: Dict[str, Schema] = {}
+        self.table_configs: Dict[str, TableConfig] = {}
+        self.segment_metadata: Dict[Tuple[str, str], Dict[str, Any]] = {}  # (table, seg) -> zk-like record
+        self.ideal_states: Dict[str, Dict[str, Dict[str, str]]] = {}
+        self.external_views: Dict[str, Dict[str, Dict[str, str]]] = {}
+        self.instances: Dict[str, InstanceState] = {}
+        self._participants: Dict[str, Participant] = {}
+        self._view_listeners: List[Callable[[str, Dict[str, Dict[str, str]]], None]] = []
+        self._assign_rr = 0
+
+    # -- instances ----------------------------------------------------
+    def register_instance(self, state: InstanceState, participant: Optional[Participant] = None) -> None:
+        with self._lock:
+            self.instances[state.name] = state
+            if participant is not None:
+                self._participants[state.name] = participant
+
+    def set_instance_alive(self, name: str, alive: bool) -> None:
+        """Liveness flip (the ZK-session-loss analog): a dead server's
+        partitions drop out of every external view and routing rebuilds."""
+        tables: List[str]
+        with self._lock:
+            inst = self.instances.get(name)
+            if inst is None or inst.alive == alive:
+                return
+            inst.alive = alive
+            tables = list(self.external_views.keys())
+        for table in tables:
+            changed = False
+            with self._lock:
+                view = self.external_views.get(table, {})
+                for seg, replicas in view.items():
+                    if name in replicas:
+                        replicas[name] = OFFLINE if not alive else replicas[name]
+                        changed = True
+            if changed or alive:
+                self._notify_view(table)
+        if alive:
+            self._reconcile_instance(name)
+
+    def _reconcile_instance(self, name: str) -> None:
+        """On instance (re)start: replay its ideal-state transitions."""
+        with self._lock:
+            tables = list(self.ideal_states.keys())
+        for table in tables:
+            with self._lock:
+                ideal = dict(self.ideal_states.get(table, {}))
+            for seg, replicas in ideal.items():
+                if replicas.get(name) in (ONLINE, CONSUMING):
+                    self._execute_transition(table, seg, name, replicas[name])
+            self._notify_view(table)
+
+    # -- listeners ----------------------------------------------------
+    def add_view_listener(self, fn: Callable[[str, Dict[str, Dict[str, str]]], None]) -> None:
+        with self._lock:
+            self._view_listeners.append(fn)
+
+    def _notify_view(self, table: str) -> None:
+        with self._lock:
+            view = {
+                seg: {
+                    srv: st
+                    for srv, st in replicas.items()
+                    if self.instances.get(srv, InstanceState(srv, "server", False)).alive
+                }
+                for seg, replicas in self.external_views.get(table, {}).items()
+            }
+            listeners = list(self._view_listeners)
+        for fn in listeners:
+            try:
+                fn(table, view)
+            except Exception:
+                logger.exception("view listener failed for %s", table)
+
+    # -- schema / table CRUD ------------------------------------------
+    def add_schema(self, schema: Schema) -> None:
+        with self._lock:
+            self.schemas[schema.schema_name] = schema
+
+    def get_schema(self, name: str) -> Optional[Schema]:
+        with self._lock:
+            return self.schemas.get(name)
+
+    def add_table(self, config: TableConfig) -> str:
+        with self._lock:
+            physical = config.physical_name
+            self.table_configs[physical] = config
+            self.ideal_states.setdefault(physical, {})
+            self.external_views.setdefault(physical, {})
+        self._notify_view(physical)
+        return physical
+
+    def delete_table(self, physical: str) -> None:
+        with self._lock:
+            segs = list(self.ideal_states.get(physical, {}).keys())
+        for seg in segs:
+            self.delete_segment(physical, seg)
+        with self._lock:
+            self.table_configs.pop(physical, None)
+            self.ideal_states.pop(physical, None)
+            self.external_views.pop(physical, None)
+        self._notify_view(physical)
+
+    def tables(self) -> List[str]:
+        with self._lock:
+            return list(self.table_configs.keys())
+
+    # -- segment assignment (ideal-state writes) ----------------------
+    def _pick_servers(self, config: TableConfig) -> List[str]:
+        with self._lock:
+            servers = sorted(
+                n
+                for n, inst in self.instances.items()
+                if inst.role == "server" and inst.alive and config.server_tenant in inst.tags
+            )
+        if not servers:
+            raise RuntimeError("no live servers to assign segment")
+        n = min(config.replication, len(servers))
+        # balanced round-robin over the sorted server list
+        picked = [servers[(self._assign_rr + i) % len(servers)] for i in range(n)]
+        self._assign_rr += 1
+        return picked
+
+    def add_segment(
+        self,
+        physical_table: str,
+        metadata: SegmentMetadata,
+        download_info: Dict[str, Any],
+        target_state: str = ONLINE,
+        servers: Optional[Sequence[str]] = None,
+    ) -> List[str]:
+        """Assign a segment to replicas and drive them to target_state
+        (the upload path: PinotSegmentUploadRestletResource ->
+        addNewOfflineSegment -> ideal state -> Helix ONLINE messages)."""
+        with self._lock:
+            config = self.table_configs[physical_table]
+            chosen = list(servers) if servers else self._pick_servers(config)
+            self.ideal_states[physical_table][metadata.segment_name] = {
+                s: target_state for s in chosen
+            }
+            self.segment_metadata[(physical_table, metadata.segment_name)] = {
+                "metadata": metadata,
+                **download_info,
+            }
+        for server in chosen:
+            self._execute_transition(
+                physical_table, metadata.segment_name, server, target_state
+            )
+        self._notify_view(physical_table)
+        return chosen
+
+    def _execute_transition(
+        self, table: str, segment: str, server: str, target: str
+    ) -> None:
+        with self._lock:
+            participant = self._participants.get(server)
+            info = self.segment_metadata.get((table, segment), {})
+            view = self.external_views.setdefault(table, {}).setdefault(segment, {})
+        ok = False
+        if participant is not None:
+            try:
+                ok = participant.on_transition(table, segment, target, info)
+            except Exception:
+                logger.exception("transition %s/%s -> %s on %s failed", table, segment, target, server)
+        with self._lock:
+            view[server] = target if ok else ERROR
+
+    def delete_segment(self, physical_table: str, segment: str) -> None:
+        with self._lock:
+            replicas = self.ideal_states.get(physical_table, {}).pop(segment, {})
+            self.segment_metadata.pop((physical_table, segment), None)
+        for server in replicas:
+            self._execute_transition(physical_table, segment, server, DROPPED)
+        with self._lock:
+            self.external_views.get(physical_table, {}).pop(segment, None)
+        self._notify_view(physical_table)
+
+    def reset_segment(self, physical_table: str, segment: str, server: str) -> None:
+        """ERROR -> OFFLINE -> retarget (the Helix error-reset analog)."""
+        with self._lock:
+            target = self.ideal_states.get(physical_table, {}).get(segment, {}).get(server)
+        if target:
+            self._execute_transition(physical_table, segment, server, target)
+            self._notify_view(physical_table)
+
+    # -- views --------------------------------------------------------
+    def get_ideal_state(self, table: str) -> Dict[str, Dict[str, str]]:
+        with self._lock:
+            return {s: dict(r) for s, r in self.ideal_states.get(table, {}).items()}
+
+    def get_external_view(self, table: str) -> Dict[str, Dict[str, str]]:
+        with self._lock:
+            return {s: dict(r) for s, r in self.external_views.get(table, {}).items()}
+
+    def segments_of(self, table: str) -> List[str]:
+        with self._lock:
+            return list(self.ideal_states.get(table, {}).keys())
+
+    def get_segment_metadata(self, table: str, segment: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self.segment_metadata.get((table, segment))

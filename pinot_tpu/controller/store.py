@@ -1,0 +1,54 @@
+"""Controller segment store: durable copy of every uploaded segment.
+
+The reference controller keeps the uploaded tar under its data dir and
+serves it for server downloads (download URL in the segment's ZK
+metadata; ``SegmentFetcherAndLoader.java:84`` re-downloads on CRC
+mismatch).  Same contract here with a local directory per table.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List, Optional
+
+from pinot_tpu.segment.format import SEGMENT_FILE_NAME, read_segment, write_segment
+from pinot_tpu.segment.immutable import ImmutableSegment
+
+
+class SegmentStore:
+    def __init__(self, base_dir: str) -> None:
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+
+    def segment_dir(self, table: str, segment_name: str) -> str:
+        return os.path.join(self.base_dir, table, segment_name)
+
+    def save(self, table: str, segment: ImmutableSegment) -> str:
+        d = self.segment_dir(table, segment.segment_name)
+        write_segment(segment, d)
+        return d
+
+    def save_file(self, table: str, segment_name: str, src_path: str) -> str:
+        d = self.segment_dir(table, segment_name)
+        os.makedirs(d, exist_ok=True)
+        shutil.copy(src_path, os.path.join(d, SEGMENT_FILE_NAME))
+        return d
+
+    def load(self, table: str, segment_name: str) -> ImmutableSegment:
+        return read_segment(self.segment_dir(table, segment_name))
+
+    def exists(self, table: str, segment_name: str) -> bool:
+        return os.path.exists(
+            os.path.join(self.segment_dir(table, segment_name), SEGMENT_FILE_NAME)
+        )
+
+    def delete(self, table: str, segment_name: str) -> None:
+        d = self.segment_dir(table, segment_name)
+        if os.path.exists(d):
+            shutil.rmtree(d)
+
+    def list_segments(self, table: str) -> List[str]:
+        d = os.path.join(self.base_dir, table)
+        if not os.path.isdir(d):
+            return []
+        return sorted(os.listdir(d))

@@ -1,0 +1,80 @@
+"""Server starter: wires a ServerInstance into the cluster as a
+participant.
+
+The reference's ``HelixServerStarter.java:63`` registers a state-model
+factory whose transitions download + load segments
+(``SegmentFetcherAndLoader.java:84``: compare local CRC vs metadata CRC,
+skip if equal, else fetch/untar/load).  Here the participant callback
+loads from the controller's segment store path (or takes the in-memory
+segment for freshly-committed realtime segments).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from pinot_tpu.controller.resource_manager import (
+    ClusterResourceManager,
+    DROPPED,
+    InstanceState,
+    OFFLINE,
+    ONLINE,
+    CONSUMING,
+    Participant,
+)
+from pinot_tpu.segment.format import read_segment
+from pinot_tpu.server.instance import ServerInstance
+
+logger = logging.getLogger(__name__)
+
+
+class ServerStarter:
+    def __init__(self, server: ServerInstance, resources: ClusterResourceManager) -> None:
+        self.server = server
+        self.resources = resources
+        self._local_crcs: Dict[str, int] = {}  # segment -> crc loaded
+
+    def start(self) -> None:
+        self.resources.register_instance(
+            InstanceState(self.server.name, role="server"),
+            Participant(self.server.name, self.on_transition),
+        )
+
+    def on_transition(
+        self, table: str, segment: str, target: str, info: Dict[str, Any]
+    ) -> bool:
+        if target == ONLINE:
+            return self._load(table, segment, info)
+        if target == CONSUMING:
+            starter = info.get("consuming_starter")
+            if starter is None:
+                return False
+            return bool(starter(self.server, table, segment, info))
+        if target in (OFFLINE, DROPPED):
+            self.server.remove_segment(table, segment)
+            self._local_crcs.pop(segment, None)
+            return True
+        return False
+
+    def _load(self, table: str, segment: str, info: Dict[str, Any]) -> bool:
+        meta = info.get("metadata")
+        crc = meta.crc if meta is not None else None
+        tdm = self.server.data_manager.table(table)
+        actually_loaded = tdm is not None and segment in tdm.segment_names()
+        if actually_loaded and crc is not None and self._local_crcs.get(segment) == crc:
+            return True  # CRC match: already loaded (SegmentFetcherAndLoader.java:84)
+        seg_obj = info.get("segment")  # in-memory handoff (realtime commit)
+        if seg_obj is None:
+            path = info.get("dir")
+            if path is None:
+                logger.error("segment %s/%s has no download info", table, segment)
+                return False
+            try:
+                seg_obj = read_segment(path)
+            except Exception:
+                logger.exception("failed to load %s/%s from %s", table, segment, path)
+                return False
+        self.server.add_segment(table, seg_obj)
+        if crc is not None:
+            self._local_crcs[segment] = crc
+        return True

@@ -1,0 +1,79 @@
+"""In-process cluster harness: controller + N servers + broker in one
+process.
+
+The reference's ``PerfBenchmarkDriver.java:61`` (starts the whole
+cluster in-process, :160-162) and the integration tests' ``ClusterTest``
+use the same trick; this is the standard harness for quickstarts, perf
+runs, and integration tests.
+"""
+from __future__ import annotations
+
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+from pinot_tpu.broker.broker import BrokerHttpServer, BrokerRequestHandler
+from pinot_tpu.broker.starter import BrokerStarter
+from pinot_tpu.common.response import BrokerResponse
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.tableconfig import TableConfig
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.segment.immutable import ImmutableSegment
+from pinot_tpu.server.instance import ServerInstance
+from pinot_tpu.server.starter import ServerStarter
+from pinot_tpu.transport.local import LocalTransport
+
+
+class InProcessCluster:
+    def __init__(
+        self,
+        num_servers: int = 2,
+        data_dir: Optional[str] = None,
+        mesh=None,
+        http: bool = False,
+    ) -> None:
+        self.data_dir = data_dir or tempfile.mkdtemp(prefix="pinot_tpu_cluster_")
+        self.controller = Controller(self.data_dir)
+        self.transport = LocalTransport()
+
+        self.servers: List[ServerInstance] = []
+        self.server_starters: List[ServerStarter] = []
+        addresses: Dict[str, tuple] = {}
+        for i in range(num_servers):
+            server = ServerInstance(f"server{i}", mesh=mesh)
+            starter = ServerStarter(server, self.controller.resources)
+            starter.start()
+            address = (server.name, 0)
+            self.transport.register(address, server.handle_request)
+            addresses[server.name] = address
+            self.servers.append(server)
+            self.server_starters.append(starter)
+
+        self.broker = BrokerRequestHandler(self.transport, addresses, name="broker0")
+        self.broker_starter = BrokerStarter(self.broker, self.controller.resources)
+        self.broker_starter.start()
+
+        self.http: Optional[BrokerHttpServer] = None
+        if http:
+            self.http = BrokerHttpServer(self.broker)
+            self.http.start()
+
+    # -- convenience API ---------------------------------------------
+    def add_offline_table(
+        self, schema: Schema, table_name: Optional[str] = None, **config_kwargs
+    ) -> str:
+        self.controller.add_schema(schema)
+        config = TableConfig(
+            table_name=table_name or schema.schema_name, table_type="OFFLINE", **config_kwargs
+        )
+        return self.controller.add_table(config)
+
+    def upload(self, physical_table: str, segment: ImmutableSegment) -> None:
+        self.controller.upload_segment(physical_table, segment)
+
+    def query(self, pql: str, trace: bool = False) -> BrokerResponse:
+        return self.broker.handle_pql(pql, trace=trace)
+
+    def stop(self) -> None:
+        if self.http is not None:
+            self.http.stop()
+        self.controller.stop()

@@ -1,0 +1,186 @@
+"""Control-plane tests: ideal/external state, segment lifecycle,
+replication + failover, retention/validation managers, REST API."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from pinot_tpu.common.schema import DataType, FieldSpec, FieldType, Schema, TimeFieldSpec
+from pinot_tpu.common.tableconfig import RetentionConfig, TableConfig
+from pinot_tpu.controller.controller import Controller, ControllerHttpServer
+from pinot_tpu.pql import parse_pql
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.tools.cluster_harness import InProcessCluster
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+from pinot_tpu.tools.scan_engine import ScanQueryProcessor
+
+
+def make_cluster(num_servers=2, replication=1, tmp=None):
+    cluster = InProcessCluster(num_servers=num_servers, data_dir=tmp)
+    schema = make_test_schema(with_mv=False)
+    physical = cluster.add_offline_table(schema, replication=replication)
+    return cluster, schema, physical
+
+
+def test_upload_and_query(tmp_path):
+    cluster, schema, physical = make_cluster(tmp=str(tmp_path))
+    rows = random_rows(schema, 300, seed=1)
+    seg1 = build_segment(schema, rows[:150], physical, "s1")
+    seg2 = build_segment(schema, rows[150:], physical, "s2")
+    cluster.upload(physical, seg1)
+    cluster.upload(physical, seg2)
+
+    resp = cluster.query("SELECT count(*) FROM testTable")
+    assert resp.num_docs_scanned == 300
+    # logical name resolves to the _OFFLINE physical table
+    oracle = ScanQueryProcessor(schema, rows)
+    want = oracle.execute(parse_pql("SELECT sum(metInt) FROM testTable"))
+    got = cluster.query("SELECT sum(metInt) FROM testTable")
+    assert got.aggregation_results[0].value == want.aggregation_results[0].value
+
+    # ideal state == external view, one replica each
+    ideal = cluster.controller.resources.get_ideal_state(physical)
+    view = cluster.controller.resources.get_external_view(physical)
+    assert set(ideal) == {"s1", "s2"}
+    assert ideal == view
+
+
+def test_balanced_assignment(tmp_path):
+    cluster, schema, physical = make_cluster(num_servers=2, tmp=str(tmp_path))
+    rows = random_rows(schema, 100, seed=2)
+    for i in range(4):
+        cluster.upload(physical, build_segment(schema, rows, physical, f"seg{i}"))
+    ideal = cluster.controller.resources.get_ideal_state(physical)
+    counts = {}
+    for seg, replicas in ideal.items():
+        for server in replicas:
+            counts[server] = counts.get(server, 0) + 1
+    assert counts == {"server0": 2, "server1": 2}  # round-robin balance
+
+
+def test_replication_and_failover(tmp_path):
+    cluster, schema, physical = make_cluster(num_servers=2, replication=2, tmp=str(tmp_path))
+    rows = random_rows(schema, 200, seed=3)
+    cluster.upload(physical, build_segment(schema, rows, physical, "rseg"))
+    ideal = cluster.controller.resources.get_ideal_state(physical)
+    assert len(ideal["rseg"]) == 2  # two replicas
+
+    assert cluster.query("SELECT count(*) FROM testTable").num_docs_scanned == 200
+
+    # kill server0: routing must fail over to the surviving replica
+    cluster.controller.resources.set_instance_alive("server0", False)
+    resp = cluster.query("SELECT count(*) FROM testTable")
+    assert resp.num_docs_scanned == 200
+    assert not resp.exceptions
+
+    # restart: reconcile reloads and both replicas serve again
+    cluster.controller.resources.set_instance_alive("server0", True)
+    assert cluster.query("SELECT count(*) FROM testTable").num_docs_scanned == 200
+
+
+def test_delete_segment_and_table(tmp_path):
+    cluster, schema, physical = make_cluster(tmp=str(tmp_path))
+    rows = random_rows(schema, 80, seed=4)
+    cluster.upload(physical, build_segment(schema, rows, physical, "d1"))
+    cluster.upload(physical, build_segment(schema, rows, physical, "d2"))
+    assert cluster.query("SELECT count(*) FROM testTable").num_docs_scanned == 160
+
+    cluster.controller.delete_segment(physical, "d1")
+    assert cluster.query("SELECT count(*) FROM testTable").num_docs_scanned == 80
+    assert not cluster.controller.store.exists(physical, "d1")
+
+    cluster.controller.delete_table(physical)
+    resp = cluster.query("SELECT count(*) FROM testTable")
+    assert resp.exceptions  # routing gone
+
+
+def test_retention_manager(tmp_path):
+    cluster = InProcessCluster(num_servers=1, data_dir=str(tmp_path))
+    schema = Schema(
+        "rt",
+        metrics=[FieldSpec("m", DataType.INT, FieldType.METRIC)],
+        time_field=TimeFieldSpec("days", DataType.INT, time_unit="DAYS"),
+    )
+    cluster.controller.add_schema(schema)
+    physical = cluster.controller.add_table(
+        TableConfig(
+            table_name="rt",
+            retention=RetentionConfig(retention_time_unit="DAYS", retention_time_value=30),
+        )
+    )
+    now_days = int(time.time() // 86400)
+    old = build_segment(schema, [{"m": 1, "days": now_days - 100}], physical, "old")
+    fresh = build_segment(schema, [{"m": 2, "days": now_days}], physical, "fresh")
+    cluster.upload(physical, old)
+    cluster.upload(physical, fresh)
+    assert cluster.query("SELECT count(*) FROM rt").num_docs_scanned == 2
+
+    cluster.controller.retention_manager.run_once()
+    assert cluster.controller.resources.segments_of(physical) == ["fresh"]
+    assert cluster.query("SELECT count(*) FROM rt").num_docs_scanned == 1
+
+
+def test_validation_manager_repairs(tmp_path):
+    cluster, schema, physical = make_cluster(num_servers=1, tmp=str(tmp_path))
+    rows = random_rows(schema, 50, seed=6)
+    cluster.upload(physical, build_segment(schema, rows, physical, "v1"))
+
+    # simulate a server that lost the segment (e.g. restart without disk)
+    cluster.servers[0].remove_segment(physical, "v1")
+    view = cluster.controller.resources.external_views[physical]
+    view["v1"]["server0"] = "OFFLINE"
+    cluster.controller.validation_manager.run_once()
+    assert cluster.controller.resources.get_external_view(physical)["v1"]["server0"] == "ONLINE"
+    assert cluster.query("SELECT count(*) FROM testTable").num_docs_scanned == 50
+
+
+def test_status_checker(tmp_path):
+    cluster, schema, physical = make_cluster(num_servers=1, tmp=str(tmp_path))
+    rows = random_rows(schema, 10, seed=7)
+    cluster.upload(physical, build_segment(schema, rows, physical, "sc1"))
+    cluster.controller.status_checker.run_once()
+    snap = cluster.controller.status_checker.metrics.snapshot()
+    assert snap["gauges"][f"{physical}.percentSegmentsAvailable"] == 100.0
+    assert snap["gauges"][f"{physical}.segmentCount"] == 1
+
+
+def test_schema_required_before_table(tmp_path):
+    controller = Controller(str(tmp_path))
+    with pytest.raises(ValueError):
+        controller.add_table(TableConfig(table_name="nope"))
+
+
+def test_controller_http(tmp_path):
+    controller = Controller(str(tmp_path))
+    http = ControllerHttpServer(controller)
+    http.start()
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        schema = make_test_schema(with_mv=False)
+        req = urllib.request.Request(
+            base + "/schemas",
+            data=json.dumps(schema.to_json()).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read())["status"] == "ok"
+
+        req = urllib.request.Request(
+            base + "/tables",
+            data=json.dumps(TableConfig("testTable").to_json()).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read())["table"] == "testTable_OFFLINE"
+
+        with urllib.request.urlopen(base + "/tables", timeout=5) as r:
+            assert json.loads(r.read())["tables"] == ["testTable_OFFLINE"]
+
+        with urllib.request.urlopen(base + "/schemas/testTable", timeout=5) as r:
+            assert json.loads(r.read())["schemaName"] == "testTable"
+
+        with urllib.request.urlopen(base + "/tables/testTable_OFFLINE/segments", timeout=5) as r:
+            assert json.loads(r.read())["segments"] == []
+    finally:
+        http.stop()
