@@ -36,6 +36,12 @@ class Controller:
         self.retention_manager = RetentionManager(self.resources, self.store)
         self.validation_manager = ValidationManager(self.resources)
         self.status_checker = SegmentStatusChecker(self.resources)
+
+        from pinot_tpu.realtime.llc import RealtimeSegmentManager
+
+        self.realtime_manager = RealtimeSegmentManager(self.resources, self.store)
+        self.validation_manager.realtime_manager = self.realtime_manager
+
         if start_managers:
             self.retention_manager.start()
             self.validation_manager.start()
@@ -49,6 +55,14 @@ class Controller:
         if self.resources.get_schema(config.raw_name) is None:
             raise ValueError(f"no schema named {config.raw_name!r}; upload the schema first")
         return self.resources.add_table(config)
+
+    def add_realtime_table(self, config: TableConfig, stream) -> str:
+        """Create a REALTIME table and open its first CONSUMING segments
+        (PinotLLCRealtimeSegmentManager analog)."""
+        schema = self.resources.get_schema(config.raw_name)
+        if schema is None:
+            raise ValueError(f"no schema named {config.raw_name!r}; upload the schema first")
+        return self.realtime_manager.setup_table(config, schema, stream)
 
     def upload_segment(self, table_physical: str, segment: ImmutableSegment) -> List[str]:
         """Store the segment durably and drive replicas ONLINE."""

@@ -1,0 +1,13 @@
+from pinot_tpu.realtime.mutable import MutableSegment
+from pinot_tpu.realtime.stream import (
+    FileBasedStreamProvider,
+    MemoryStreamProvider,
+    StreamProvider,
+)
+
+__all__ = [
+    "MutableSegment",
+    "StreamProvider",
+    "FileBasedStreamProvider",
+    "MemoryStreamProvider",
+]

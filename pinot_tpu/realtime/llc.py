@@ -1,0 +1,375 @@
+"""LLC (low-level consumer) realtime coordination.
+
+Mirrors the reference's three-way dance (SURVEY §3.4):
+
+- server: ``LLRealtimeSegmentDataManager.java:68`` — one consumer per
+  stream partition appends into a mutable segment until a row/time
+  threshold, then reports ``segmentConsumed(offset)`` to the controller.
+- controller: ``SegmentCompletionManager.java:45-54`` — an FSM per
+  consuming segment (HOLDING -> COMMITTER_DECIDED -> COMMITTER_UPLOADING
+  -> COMMITTED) picks the max-offset replica as committer and answers
+  each replica HOLD / CATCH_UP / COMMIT / KEEP / DISCARD / NOT_LEADER
+  (``SegmentCompletionProtocol.java:63-105``).
+- commit: the committer converts mutable -> immutable columnar, uploads;
+  the controller persists metadata (exact start/end offsets — the
+  checkpoint), flips replicas CONSUMING -> ONLINE (laggards download the
+  committed copy), and opens the next CONSUMING segment at the end
+  offset.  Restart resumes from the last committed end offset
+  (``ValidationManager`` repairs missing consuming segments).
+
+Segment naming: ``{table}__{partition}__{seq}`` (LLCSegmentName analog).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.tableconfig import StreamConfig, TableConfig
+from pinot_tpu.controller.resource_manager import (
+    CONSUMING,
+    ClusterResourceManager,
+    ONLINE,
+)
+from pinot_tpu.realtime.mutable import MutableSegment
+from pinot_tpu.realtime.stream import StreamProvider
+
+logger = logging.getLogger(__name__)
+
+MAX_HOLD_TIME_MS = 3000  # SegmentCompletionProtocol.java:50
+
+# FSM states (SegmentCompletionManager.java:48-54)
+HOLDING = "HOLDING"
+COMMITTER_DECIDED = "COMMITTER_DECIDED"
+COMMITTER_UPLOADING = "COMMITTER_UPLOADING"
+COMMITTED = "COMMITTED"
+
+# responses (SegmentCompletionProtocol.java:63-105)
+RESP_HOLD = "HOLD"
+RESP_CATCH_UP = "CATCH_UP"
+RESP_DISCARD = "DISCARD"
+RESP_KEEP = "KEEP"
+RESP_COMMIT = "COMMIT"
+RESP_NOT_LEADER = "NOT_LEADER"
+
+
+def make_segment_name(table: str, partition: int, seq: int) -> str:
+    return f"{table}__{partition}__{seq}"
+
+
+def parse_segment_name(name: str) -> Tuple[str, int, int]:
+    table, partition, seq = name.rsplit("__", 2)
+    return table, int(partition), int(seq)
+
+
+class _SegmentFsm:
+    def __init__(self, num_replicas: int) -> None:
+        self.state = HOLDING
+        self.num_replicas = num_replicas
+        self.offsets: Dict[str, int] = {}
+        self.committer: Optional[str] = None
+        self.target_offset: Optional[int] = None
+        self.final_offset: Optional[int] = None
+        self.first_report_ms: Optional[float] = None
+
+
+class SegmentCompletionManager:
+    """Controller-side commit FSM (SegmentCompletionManager.java:45)."""
+
+    def __init__(self, realtime_manager: "RealtimeSegmentManager") -> None:
+        self.rm = realtime_manager
+        self._fsm: Dict[str, _SegmentFsm] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, segment: str) -> _SegmentFsm:
+        fsm = self._fsm.get(segment)
+        if fsm is None:
+            replicas = self.rm.resources.get_ideal_state(
+                self.rm.physical_table_of(segment)
+            ).get(segment, {})
+            fsm = _SegmentFsm(max(len(replicas), 1))
+            self._fsm[segment] = fsm
+        return fsm
+
+    def segment_consumed(self, segment: str, server: str, offset: int) -> Tuple[str, Optional[int]]:
+        """A replica hit its threshold at ``offset``. Returns
+        (response, target_offset)."""
+        with self._lock:
+            fsm = self._get(segment)
+            now = time.time() * 1000
+
+            if fsm.state == COMMITTED:
+                if offset == fsm.final_offset:
+                    return RESP_KEEP, fsm.final_offset
+                return RESP_DISCARD, fsm.final_offset
+
+            fsm.offsets[server] = offset
+            if fsm.first_report_ms is None:
+                fsm.first_report_ms = now
+
+            if fsm.state == HOLDING:
+                all_reported = len(fsm.offsets) >= fsm.num_replicas
+                hold_expired = now - fsm.first_report_ms > MAX_HOLD_TIME_MS
+                if not (all_reported or hold_expired):
+                    return RESP_HOLD, None
+                # decide committer: max offset wins (ties -> name order)
+                fsm.committer = max(fsm.offsets, key=lambda s: (fsm.offsets[s], s))
+                fsm.target_offset = fsm.offsets[fsm.committer]
+                fsm.state = COMMITTER_DECIDED
+
+            if fsm.state in (COMMITTER_DECIDED, COMMITTER_UPLOADING):
+                assert fsm.target_offset is not None
+                if offset < fsm.target_offset:
+                    return RESP_CATCH_UP, fsm.target_offset
+                if server == fsm.committer and fsm.state == COMMITTER_DECIDED:
+                    fsm.state = COMMITTER_UPLOADING
+                    return RESP_COMMIT, fsm.target_offset
+                return RESP_HOLD, fsm.target_offset
+        return RESP_HOLD, None
+
+    def segment_commit(self, segment: str, server: str, committed) -> str:
+        """Committer uploads its converted segment (segmentCommit)."""
+        with self._lock:
+            fsm = self._get(segment)
+            if fsm.committer != server or fsm.state != COMMITTER_UPLOADING:
+                return RESP_NOT_LEADER
+            fsm.state = COMMITTED
+            fsm.final_offset = fsm.target_offset
+        self.rm.on_segment_committed(segment, committed)
+        return RESP_KEEP
+
+
+class RealtimeSegmentManager:
+    """Controller-side realtime coordinator
+    (PinotLLCRealtimeSegmentManager analog): creates CONSUMING segments,
+    persists commit metadata, opens the next sequence."""
+
+    def __init__(self, resources: ClusterResourceManager, store) -> None:
+        self.resources = resources
+        self.store = store
+        self.completion = SegmentCompletionManager(self)
+        self._tables: Dict[str, Dict[str, Any]] = {}  # physical -> {schema, stream, config}
+        self._consumers: Dict[Tuple[str, str], "RealtimeSegmentDataManager"] = {}
+        self._lock = threading.Lock()
+
+    # -- setup ---------------------------------------------------------
+    def setup_table(
+        self, config: TableConfig, schema: Schema, stream: StreamProvider
+    ) -> str:
+        physical = self.resources.add_table(config)
+        with self._lock:
+            self._tables[physical] = {
+                "schema": schema,
+                "stream": stream,
+                "config": config,
+            }
+        for partition in range(stream.partition_count()):
+            self._create_consuming_segment(physical, partition, seq=0, start_offset=0)
+        return physical
+
+    def physical_table_of(self, segment: str) -> str:
+        return parse_segment_name(segment)[0]
+
+    def _create_consuming_segment(
+        self, physical: str, partition: int, seq: int, start_offset: int
+    ) -> str:
+        name = make_segment_name(physical, partition, seq)
+        from pinot_tpu.segment.immutable import SegmentMetadata
+
+        meta = SegmentMetadata(
+            segment_name=name,
+            table_name=physical,
+            num_docs=0,
+            custom={
+                "partition": partition,
+                "seq": seq,
+                "startOffset": start_offset,
+                "status": "IN_PROGRESS",
+            },
+        )
+        self.resources.add_segment(
+            physical,
+            meta,
+            {
+                "consuming_starter": self._start_consumer,
+                "partition": partition,
+                "startOffset": start_offset,
+            },
+            target_state=CONSUMING,
+        )
+        return name
+
+    # -- server-side consumer creation (via ServerStarter CONSUMING) --
+    def _start_consumer(self, server_instance, table: str, segment: str, info: Dict[str, Any]) -> bool:
+        with self._lock:
+            tinfo = self._tables.get(table)
+            if (segment, server_instance.name) in self._consumers:
+                return True  # already consuming; don't reset the offset
+        if tinfo is None:
+            return False
+        dm = RealtimeSegmentDataManager(
+            server=server_instance,
+            manager=self,
+            table=table,
+            segment_name=segment,
+            schema=tinfo["schema"],
+            stream=tinfo["stream"],
+            partition=int(info["partition"]),
+            start_offset=int(info["startOffset"]),
+            rows_per_segment=tinfo["config"].stream.rows_per_segment
+            if tinfo["config"].stream
+            else 100_000,
+        )
+        with self._lock:
+            self._consumers[(segment, server_instance.name)] = dm
+        server_instance.add_segment(table, dm.mutable)
+        return True
+
+    def consumers_of(self, segment: str) -> List["RealtimeSegmentDataManager"]:
+        with self._lock:
+            return [dm for (seg, _), dm in self._consumers.items() if seg == segment]
+
+    # -- commit --------------------------------------------------------
+    def on_segment_committed(self, segment: str, committed) -> None:
+        physical, partition, seq = parse_segment_name(segment)
+        path = self.store.save(physical, committed)
+        end_offset = committed.metadata.custom.get("endOffset", 0)
+        # persist metadata (the ZK offset checkpoint) + flip replicas ONLINE
+        with self.resources._lock:
+            self.resources.segment_metadata[(physical, segment)] = {
+                "metadata": committed.metadata,
+                "dir": path,
+                "segment": committed,
+            }
+            replicas = self.resources.ideal_states[physical].get(segment, {})
+            for server in replicas:
+                replicas[server] = ONLINE
+        for server in list(replicas):
+            self.resources._execute_transition(physical, segment, server, ONLINE)
+        self.resources._notify_view(physical)
+        # retire consumers for this segment
+        with self._lock:
+            for key in [k for k in self._consumers if k[0] == segment]:
+                self._consumers[key].stop()
+                del self._consumers[key]
+        # open the next consuming segment at the committed end offset
+        self._create_consuming_segment(physical, partition, seq + 1, int(end_offset))
+
+    # -- validation hook ----------------------------------------------
+    def ensure_consuming_segments(self) -> None:
+        """Re-create missing CONSUMING segments
+        (ValidationManager.java:64 LLC repair)."""
+        with self._lock:
+            tables = list(self._tables.keys())
+        for physical in tables:
+            ideal = self.resources.get_ideal_state(physical)
+            with self._lock:
+                stream = self._tables[physical]["stream"]
+            for partition in range(stream.partition_count()):
+                has_consuming = False
+                max_seq, max_end = -1, 0
+                for seg, replicas in ideal.items():
+                    try:
+                        _, p, seq = parse_segment_name(seg)
+                    except ValueError:
+                        continue
+                    if p != partition:
+                        continue
+                    if any(st == CONSUMING for st in replicas.values()):
+                        has_consuming = True
+                    info = self.resources.get_segment_metadata(physical, seg)
+                    if info and info.get("metadata") is not None and seq > max_seq:
+                        max_seq = seq
+                        max_end = int(info["metadata"].custom.get("endOffset", 0))
+                if not has_consuming:
+                    logger.info(
+                        "validation: recreating consuming segment %s p%d seq%d @%d",
+                        physical, partition, max_seq + 1, max_end,
+                    )
+                    self._create_consuming_segment(
+                        physical, partition, max_seq + 1, max_end
+                    )
+
+
+class RealtimeSegmentDataManager:
+    """Server-side per-partition consumer
+    (LLRealtimeSegmentDataManager.java:68)."""
+
+    def __init__(
+        self,
+        server,
+        manager: RealtimeSegmentManager,
+        table: str,
+        segment_name: str,
+        schema: Schema,
+        stream: StreamProvider,
+        partition: int,
+        start_offset: int,
+        rows_per_segment: int,
+    ) -> None:
+        self.server = server
+        self.manager = manager
+        self.table = table
+        self.segment_name = segment_name
+        self.stream = stream
+        self.partition = partition
+        self.offset = start_offset
+        self.rows_per_segment = rows_per_segment
+        self.mutable = MutableSegment(schema, segment_name, table)
+        self.mutable.start_offset = start_offset
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    # -- consumption ---------------------------------------------------
+    def consume_step(self, max_rows: int = 1000) -> int:
+        """Fetch + index one batch; returns rows consumed."""
+        if self._stopped:
+            return 0
+        budget = self.rows_per_segment - self.mutable.num_docs
+        if budget <= 0:
+            return 0
+        rows, next_offset = self.stream.fetch(
+            self.partition, self.offset, min(max_rows, budget)
+        )
+        for row in rows:
+            self.mutable.index(row)
+        self.offset = next_offset
+        self.mutable.end_offset = next_offset
+        return len(rows)
+
+    @property
+    def threshold_reached(self) -> bool:
+        return self.mutable.num_docs >= self.rows_per_segment
+
+    def try_commit(self) -> str:
+        """Run the completion protocol once
+        (segmentConsumed -> maybe segmentCommit)."""
+        if self._stopped:
+            return RESP_DISCARD
+        completion = self.manager.completion
+        resp, target = completion.segment_consumed(
+            self.segment_name, self.server.name, self.offset
+        )
+        if resp == RESP_CATCH_UP and target is not None:
+            while self.offset < target and not self._stopped:
+                got_rows, next_offset = self.stream.fetch(
+                    self.partition, self.offset, target - self.offset
+                )
+                if not got_rows:
+                    break
+                for row in got_rows:
+                    self.mutable.index(row)
+                self.offset = next_offset
+                self.mutable.end_offset = next_offset
+            return resp
+        if resp == RESP_COMMIT:
+            committed = self.mutable.to_committed_segment()
+            return completion.segment_commit(
+                self.segment_name, self.server.name, committed
+            )
+        return resp

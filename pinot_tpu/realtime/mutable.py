@@ -1,0 +1,196 @@
+"""Mutable (consuming) segment: append rows, query at a row watermark.
+
+The reference's ``RealtimeSegmentImpl.java:62`` keeps mutable
+dictionaries (arrival-order ids), growable forward indexes and realtime
+inverted indexes, and serves queries in place; at commit a converter
+produces an immutable columnar segment
+(``realtime/converter/RealtimeSegmentConverter.java``).
+
+TPU-first adaptation (SURVEY §7 hard part 4 — mutability vs immutable
+device arrays): ingestion appends into host-side growable numpy arrays
+with arrival-order dictIds; queries snapshot the segment at the current
+row watermark by converting to a sorted-dictionary ``ImmutableSegment``
+(vectorized O(n) remap), cached until the watermark moves.  The
+snapshot then goes through the normal device staging path, so the query
+kernels never special-case realtime — consistency comes from the
+watermark, not locks.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.common.schema import DataType, FieldSpec, Schema
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.segment.dictionary import Dictionary
+from pinot_tpu.segment.immutable import (
+    ColumnData,
+    ColumnMetadata,
+    ImmutableSegment,
+    SegmentMetadata,
+)
+
+Row = Dict[str, Any]
+
+
+class _MutableColumn:
+    """Arrival-order dictionary + growable dictId arrays
+    (core/realtime/impl/dictionary + fwdindex analogs)."""
+
+    def __init__(self, spec: FieldSpec) -> None:
+        self.spec = spec
+        self.value_to_id: Dict[Any, int] = {}
+        self.id_to_value: List[Any] = []
+        self.single = spec.single_value
+        if self.single:
+            self.ids = np.zeros(1024, dtype=np.int32)
+        else:
+            self.flat_ids: List[int] = []
+            self.offsets: List[int] = [0]
+        self.max_mv = 0
+
+    def _id_of(self, value: Any) -> int:
+        i = self.value_to_id.get(value)
+        if i is None:
+            i = len(self.id_to_value)
+            self.value_to_id[value] = i
+            self.id_to_value.append(value)
+        return i
+
+    def append(self, value: Any, row_idx: int) -> None:
+        st = self.spec.stored_type
+        if self.single:
+            if row_idx >= self.ids.size:
+                self.ids = np.concatenate([self.ids, np.zeros(self.ids.size, dtype=np.int32)])
+            self.ids[row_idx] = self._id_of(st.convert(value))
+        else:
+            vs = value if isinstance(value, (list, tuple)) else [value]
+            vs = [st.convert(x) for x in vs] or [self.spec.get_default_null_value()]
+            for v in vs:
+                self.flat_ids.append(self._id_of(v))
+            self.offsets.append(len(self.flat_ids))
+            self.max_mv = max(self.max_mv, len(vs))
+
+
+class MutableSegment:
+    def __init__(self, schema: Schema, segment_name: str, table_name: str) -> None:
+        self.schema = schema
+        self.segment_name = segment_name
+        self.table_name = table_name
+        self._columns = {spec.name: _MutableColumn(spec) for spec in schema.all_fields()}
+        self._num_docs = 0
+        self._lock = threading.Lock()
+        self._snapshot: Optional[ImmutableSegment] = None
+        self._snapshot_watermark = -1
+        self.start_offset: int = 0
+        self.end_offset: int = 0
+
+    @property
+    def num_docs(self) -> int:
+        return self._num_docs
+
+    def index(self, row: Row) -> None:
+        """Append one row (RealtimeSegmentImpl.index :185); visible to
+        queries at the next snapshot."""
+        with self._lock:
+            idx = self._num_docs
+            for spec in self.schema.all_fields():
+                v = row.get(spec.name)
+                if v is None:
+                    v = (
+                        spec.get_default_null_value()
+                        if spec.single_value
+                        else [spec.get_default_null_value()]
+                    )
+                self._columns[spec.name].append(v, idx)
+            self._num_docs = idx + 1
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> ImmutableSegment:
+        """Immutable view at the current watermark; cached until more
+        rows arrive (chunk-watermark consistency)."""
+        with self._lock:
+            n = self._num_docs
+            if self._snapshot is not None and self._snapshot_watermark == n:
+                return self._snapshot
+            snap = self._convert(n)
+            self._snapshot = snap
+            self._snapshot_watermark = n
+            return snap
+
+    def _convert(self, n: int) -> ImmutableSegment:
+        columns: Dict[str, ColumnData] = {}
+        for spec in self.schema.all_fields():
+            mc = self._columns[spec.name]
+            st = spec.stored_type
+            if st == DataType.STRING:
+                order = np.argsort(np.asarray(mc.id_to_value, dtype=object)) if mc.id_to_value else np.zeros(0, np.int64)
+                sorted_vals = [mc.id_to_value[i] for i in order]
+                d = Dictionary(st, sorted_vals)
+            else:
+                arr = np.asarray(mc.id_to_value, dtype=st.to_numpy()) if mc.id_to_value else np.zeros(0, st.to_numpy())
+                order = np.argsort(arr, kind="stable")
+                d = Dictionary(st, arr[order])
+            # remap arrival-order ids -> sorted dictIds
+            remap = np.empty(max(len(mc.id_to_value), 1), dtype=np.int32)
+            remap[order] = np.arange(order.size, dtype=np.int32)
+
+            meta = ColumnMetadata(
+                name=spec.name,
+                data_type=spec.data_type,
+                field_type=spec.field_type,
+                single_value=spec.single_value,
+                cardinality=d.cardinality,
+                total_docs=n,
+                is_sorted=False,
+                max_num_multi_values=mc.max_mv,
+                total_number_of_entries=n if spec.single_value else len(mc.flat_ids),
+                min_value=d.min_value if len(d) else None,
+                max_value=d.max_value if len(d) else None,
+            )
+            if spec.single_value:
+                fwd = remap[mc.ids[:n]]
+                columns[spec.name] = ColumnData(metadata=meta, dictionary=d, fwd=fwd)
+            else:
+                offsets = np.asarray(mc.offsets[: n + 1], dtype=np.int32)
+                flat = np.asarray(mc.flat_ids[: offsets[-1]], dtype=np.int32)
+                columns[spec.name] = ColumnData(
+                    metadata=meta,
+                    dictionary=d,
+                    mv_values=remap[flat] if flat.size else flat,
+                    mv_offsets=offsets,
+                )
+
+        smeta = SegmentMetadata(
+            segment_name=self.segment_name,
+            table_name=self.table_name,
+            num_docs=n,
+            columns={c.metadata.name: c.metadata for c in columns.values()},
+            time_column=self.schema.time_column_name,
+            time_unit=self.schema.time_field.time_unit if self.schema.time_field else "DAYS",
+            creation_time_ms=int(time.time() * 1000),
+            custom={"realtime": True, "startOffset": self.start_offset, "endOffset": self.end_offset},
+        )
+        tcol = self.schema.time_column_name
+        if tcol and n > 0 and not columns[tcol].dictionary.is_string:
+            smeta.start_time = int(columns[tcol].dictionary.min_value)
+            smeta.end_time = int(columns[tcol].dictionary.max_value)
+        seg = ImmutableSegment(metadata=smeta, columns=columns)
+        # watermark-scoped identity so staging/context caches key correctly
+        smeta.crc = (hash((self.segment_name, n)) & 0x7FFFFFFF) or 1
+        return seg
+
+    def to_committed_segment(self, final_name: Optional[str] = None) -> ImmutableSegment:
+        """Final conversion at commit (RealtimeSegmentConverter analog):
+        a full CRC'd immutable segment ready for the store."""
+        snap = self.snapshot()
+        if final_name and final_name != self.segment_name:
+            snap.metadata.segment_name = final_name
+        snap.metadata.custom.update(
+            {"startOffset": self.start_offset, "endOffset": self.end_offset}
+        )
+        snap.metadata.crc = snap.compute_crc()
+        return snap

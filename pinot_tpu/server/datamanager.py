@@ -17,7 +17,9 @@ from pinot_tpu.segment.immutable import ImmutableSegment
 
 
 class SegmentDataManager:
-    def __init__(self, segment: ImmutableSegment) -> None:
+    def __init__(self, segment) -> None:
+        # ImmutableSegment, or a MutableSegment (consuming) whose
+        # .snapshot() yields the queryable view at the row watermark
         self.segment = segment
         self._refcount = 1  # owner reference
         self._lock = threading.Lock()
@@ -25,6 +27,10 @@ class SegmentDataManager:
     @property
     def name(self) -> str:
         return self.segment.segment_name
+
+    def query_view(self) -> ImmutableSegment:
+        snap = getattr(self.segment, "snapshot", None)
+        return snap() if callable(snap) else self.segment
 
     def acquire(self) -> bool:
         with self._lock:
@@ -47,10 +53,11 @@ class TableDataManager:
         self._segments: Dict[str, SegmentDataManager] = {}
         self._lock = threading.Lock()
 
-    def add_segment(self, segment: ImmutableSegment) -> None:
+    def add_segment(self, segment) -> None:
+        name = segment.segment_name if hasattr(segment, "segment_name") else segment.metadata.segment_name
         with self._lock:
-            old = self._segments.get(segment.segment_name)
-            self._segments[segment.segment_name] = SegmentDataManager(segment)
+            old = self._segments.get(name)
+            self._segments[name] = SegmentDataManager(segment)
         if old is not None:
             old.release()  # drop owner ref of the replaced segment
 

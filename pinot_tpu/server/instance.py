@@ -81,7 +81,7 @@ class ServerInstance:
         acquired = tdm.acquire_segments(names)
         try:
             with trace.span("planAndExecute"):
-                result = self.executor.execute([a.segment for a in acquired], request)
+                result = self.executor.execute([a.query_view() for a in acquired], request)
         finally:
             tdm.release_segments(acquired)
         if trace.enabled:

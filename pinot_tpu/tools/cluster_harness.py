@@ -67,6 +67,25 @@ class InProcessCluster:
         )
         return self.controller.add_table(config)
 
+    def add_realtime_table(
+        self,
+        schema: Schema,
+        stream,
+        table_name: Optional[str] = None,
+        rows_per_segment: int = 1000,
+        replication: int = 1,
+    ) -> str:
+        from pinot_tpu.common.tableconfig import StreamConfig
+
+        self.controller.add_schema(schema)
+        config = TableConfig(
+            table_name=table_name or schema.schema_name,
+            table_type="REALTIME",
+            replication=replication,
+            stream=StreamConfig(stream_type="memory", rows_per_segment=rows_per_segment),
+        )
+        return self.controller.add_realtime_table(config, stream)
+
     def upload(self, physical_table: str, segment: ImmutableSegment) -> None:
         self.controller.upload_segment(physical_table, segment)
 
