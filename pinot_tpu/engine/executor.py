@@ -64,6 +64,31 @@ class QueryExecutor:
         if not live:
             return self._empty_result(request, total_docs)
 
+        # star-tree routing: eligible segments answer from their
+        # pre-aggregated cube (startree/operator.py); the rest take the
+        # normal device path, partials merge below
+        from pinot_tpu.startree.operator import execute_star_tree, is_fit_for_star_tree
+
+        star = [s for s in live if is_fit_for_star_tree(request, s)]
+        if star:
+            normal = [s for s in live if s not in star]
+            parts = [execute_star_tree(s, request) for s in star]
+            if normal:
+                parts.append(self._execute_engine(normal, request))
+            merged = parts[0]
+            for p in parts[1:]:
+                merged.merge(p)
+            merged.total_docs = total_docs
+            return merged
+
+        result = self._execute_engine(live, request)
+        result.total_docs = total_docs
+        return result
+
+    def _execute_engine(
+        self, live: List[ImmutableSegment], request: BrokerRequest
+    ) -> IntermediateResult:
+        total_docs = sum(s.num_docs for s in live)
         needed = set(request.referenced_columns())
         sel_columns: Optional[List[str]] = None
         if request.is_selection:

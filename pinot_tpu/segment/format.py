@@ -79,10 +79,29 @@ def write_segment(segment: ImmutableSegment, directory: str) -> str:
             off = np.ascontiguousarray(col.mv_offsets, dtype=np.int32)
             add(f"{name}.mvoff", off.tobytes(), "raw", dtype="int32", count=int(off.size))
 
+    star_tree = getattr(segment, "star_tree", None)
+    star_header = None
+    if star_tree is not None:
+        add("__startree__.dims", np.ascontiguousarray(star_tree.dims).tobytes(), "raw",
+            dtype=str(star_tree.dims.dtype), count=int(star_tree.dims.size))
+        add("__startree__.sums", np.ascontiguousarray(star_tree.sums).tobytes(), "raw",
+            dtype=str(star_tree.sums.dtype), count=int(star_tree.sums.size))
+        add("__startree__.counts", np.ascontiguousarray(star_tree.counts).tobytes(), "raw",
+            dtype=str(star_tree.counts.dtype), count=int(star_tree.counts.size))
+        star_header = {
+            "splitOrder": star_tree.split_order,
+            "metricColumns": star_tree.metric_columns,
+            "maxLeafRecords": star_tree.max_leaf_records,
+            "numRecords": star_tree.num_records,
+            "root": star_tree.root.to_json(),
+        }
+
     header = {
         "metadata": segment.metadata.to_json(),
         "indexMap": index_map,
     }
+    if star_header is not None:
+        header["starTree"] = star_header
     hdr = json.dumps(header).encode("utf-8")
     path = os.path.join(directory, SEGMENT_FILE_NAME)
     with open(path, "wb") as f:
@@ -136,4 +155,22 @@ def read_segment(directory: str) -> ImmutableSegment:
             col.mv_values = load(f"{name}.mv")
             col.mv_offsets = load(f"{name}.mvoff")
         columns[name] = col
-    return ImmutableSegment(metadata=metadata, columns=columns)
+    segment = ImmutableSegment(metadata=metadata, columns=columns)
+
+    st = header.get("starTree")
+    if st is not None:
+        from pinot_tpu.startree.index import StarTreeIndex, StarTreeNode
+
+        n_rec = st["numRecords"]
+        k = len(st["splitOrder"])
+        m = len(st["metricColumns"])
+        segment.star_tree = StarTreeIndex(
+            split_order=list(st["splitOrder"]),
+            metric_columns=list(st["metricColumns"]),
+            dims=load("__startree__.dims").reshape(n_rec, k),
+            sums=load("__startree__.sums").reshape(n_rec, m),
+            counts=load("__startree__.counts"),
+            root=StarTreeNode.from_json(st["root"]),
+            max_leaf_records=st["maxLeafRecords"],
+        )
+    return segment

@@ -1,0 +1,12 @@
+from pinot_tpu.startree.builder import StarTreeBuilderConfig, build_star_tree
+from pinot_tpu.startree.index import StarTreeIndex, STAR
+from pinot_tpu.startree.operator import is_fit_for_star_tree, execute_star_tree
+
+__all__ = [
+    "StarTreeBuilderConfig",
+    "build_star_tree",
+    "StarTreeIndex",
+    "STAR",
+    "is_fit_for_star_tree",
+    "execute_star_tree",
+]
