@@ -1,0 +1,3 @@
+from pinot_tpu.api.client import Connection, ConnectionFactory, ResultSetGroup
+
+__all__ = ["Connection", "ConnectionFactory", "ResultSetGroup"]

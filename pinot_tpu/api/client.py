@@ -1,0 +1,173 @@
+"""Client library: connections, result sets, prepared statements.
+
+The pinot-api equivalent (``pinot-api/.../client/Connection.java``,
+``ConnectionFactory.java``, ``ResultSetGroup``): connect to one or more
+brokers (static list, or dynamically from a controller's table list —
+the ExternalViewReader analog), round-robin broker selection per query,
+typed accessors over the JSON response.
+"""
+from __future__ import annotations
+
+import json
+import random
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class PinotClientError(Exception):
+    pass
+
+
+class ResultSet:
+    """One aggregation / group-by / selection result block."""
+
+    def __init__(self, block: Dict[str, Any], kind: str) -> None:
+        self._block = block
+        self.kind = kind  # "aggregation" | "groupby" | "selection"
+
+    # -- selection / tabular ------------------------------------------
+    def get_column_names(self) -> List[str]:
+        if self.kind == "selection":
+            return list(self._block.get("columns", []))
+        if self.kind == "groupby":
+            return list(self._block.get("groupByColumns", [])) + [self._block.get("function", "value")]
+        return [self._block.get("function", "value")]
+
+    def get_row_count(self) -> int:
+        if self.kind == "selection":
+            return len(self._block.get("results", []))
+        if self.kind == "groupby":
+            return len(self._block.get("groupByResult", []))
+        return 1
+
+    def get_column_count(self) -> int:
+        return len(self.get_column_names())
+
+    def get(self, row: int, col: int = 0) -> Any:
+        if self.kind == "selection":
+            return self._block["results"][row][col]
+        if self.kind == "groupby":
+            entry = self._block["groupByResult"][row]
+            groups = entry["group"]
+            if col < len(groups):
+                return groups[col]
+            return entry["value"]
+        return self._block.get("value")
+
+    def get_string(self, row: int, col: int = 0) -> str:
+        return str(self.get(row, col))
+
+    def get_int(self, row: int, col: int = 0) -> int:
+        return int(float(self.get(row, col)))
+
+    def get_double(self, row: int, col: int = 0) -> float:
+        return float(self.get(row, col))
+
+    # group-by helpers (reference ResultSet.getGroupKeyString)
+    def get_group_key(self, row: int) -> List[str]:
+        if self.kind != "groupby":
+            raise PinotClientError("not a group-by result")
+        return list(self._block["groupByResult"][row]["group"])
+
+
+class ResultSetGroup:
+    def __init__(self, response: Dict[str, Any]) -> None:
+        self.response = response
+        self._sets: List[ResultSet] = []
+        if "selectionResults" in response:
+            self._sets.append(ResultSet(response["selectionResults"], "selection"))
+        for block in response.get("aggregationResults", []):
+            kind = "groupby" if "groupByResult" in block else "aggregation"
+            self._sets.append(ResultSet(block, kind))
+
+    @property
+    def result_set_count(self) -> int:
+        return len(self._sets)
+
+    def get_result_set(self, index: int) -> ResultSet:
+        return self._sets[index]
+
+    @property
+    def exceptions(self) -> List[Dict[str, Any]]:
+        return self.response.get("exceptions", [])
+
+    @property
+    def execution_stats(self) -> Dict[str, Any]:
+        return {
+            k: self.response.get(k)
+            for k in ("numDocsScanned", "totalDocs", "timeUsedMs", "numServersQueried", "numServersResponded")
+        }
+
+
+class Connection:
+    def __init__(self, broker_urls: Sequence[str], timeout_s: float = 60.0) -> None:
+        if not broker_urls:
+            raise PinotClientError("no brokers")
+        self.broker_urls = [u.rstrip("/") for u in broker_urls]
+        self.timeout_s = timeout_s
+        self._rng = random.Random()
+
+    def execute(self, pql: str, trace: bool = False) -> ResultSetGroup:
+        url = self._rng.choice(self.broker_urls) + "/query"
+        body = json.dumps({"pql": pql, "trace": trace}).encode("utf-8")
+        req = urllib.request.Request(url, data=body, headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                payload = json.loads(r.read())
+        except OSError as e:
+            raise PinotClientError(f"broker {url}: {e}") from e
+        return ResultSetGroup(payload)
+
+    def prepare_statement(self, pql_template: str) -> "PreparedStatement":
+        return PreparedStatement(self, pql_template)
+
+
+class PreparedStatement:
+    """``?``-placeholder statement (reference PreparedStatement)."""
+
+    def __init__(self, connection: Connection, template: str) -> None:
+        self.connection = connection
+        self.template = template
+        self._values: Dict[int, str] = {}
+
+    def set_string(self, index: int, value: str) -> None:
+        escaped = value.replace("'", "''")
+        self._values[index] = f"'{escaped}'"
+
+    def set_int(self, index: int, value: int) -> None:
+        self._values[index] = str(int(value))
+
+    def set_double(self, index: int, value: float) -> None:
+        self._values[index] = repr(float(value))
+
+    def execute(self) -> ResultSetGroup:
+        parts = self.template.split("?")
+        if len(parts) - 1 != len(self._values):
+            raise PinotClientError("not all placeholders bound")
+        out = parts[0]
+        for i in range(1, len(parts)):
+            out += self._values[i - 1] + parts[i]
+        return self.connection.execute(out)
+
+
+class ConnectionFactory:
+    """``fromHostList`` / ``fromController`` (DynamicBrokerSelector analog:
+    the controller's broker list plays ZK's role)."""
+
+    @staticmethod
+    def from_host_list(broker_urls: Sequence[str]) -> Connection:
+        return Connection(broker_urls)
+
+    @staticmethod
+    def from_controller(controller_url: str) -> Connection:
+        url = controller_url.rstrip("/") + "/brokers"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                payload = json.loads(r.read())
+            brokers = payload.get("brokers", [])
+        except OSError as e:
+            raise PinotClientError(f"controller {controller_url}: {e}") from e
+        if not brokers:
+            raise PinotClientError("controller reports no brokers")
+        return Connection(brokers)

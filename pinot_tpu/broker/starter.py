@@ -14,12 +14,20 @@ from pinot_tpu.controller.resource_manager import ClusterResourceManager, Instan
 
 
 class BrokerStarter:
-    def __init__(self, broker: BrokerRequestHandler, resources: ClusterResourceManager) -> None:
+    def __init__(
+        self,
+        broker: BrokerRequestHandler,
+        resources: ClusterResourceManager,
+        url: str = None,
+    ) -> None:
         self.broker = broker
         self.resources = resources
+        self.url = url
 
     def start(self) -> None:
-        self.resources.register_instance(InstanceState(self.broker.metrics.scope, role="broker"))
+        self.resources.register_instance(
+            InstanceState(self.broker.metrics.scope, role="broker", url=self.url)
+        )
         self.resources.add_view_listener(self.on_view_change)
         # seed routing for any pre-existing tables
         for table in self.resources.tables():

@@ -71,6 +71,21 @@ class Controller:
             table_physical, segment.metadata, {"dir": path}
         )
 
+    def upload_segment_bytes(self, table_physical: str, data: bytes) -> List[str]:
+        """HTTP upload path: raw segment-file bytes -> store + assign."""
+        import io
+        import os
+        import tempfile
+
+        from pinot_tpu.segment.format import SEGMENT_FILE_NAME, read_segment
+
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, SEGMENT_FILE_NAME)
+            with open(path, "wb") as f:
+                f.write(data)
+            segment = read_segment(td)
+        return self.upload_segment(table_physical, segment)
+
     def delete_segment(self, table_physical: str, segment_name: str) -> None:
         self.resources.delete_segment(table_physical, segment_name)
         self.store.delete(table_physical, segment_name)
@@ -113,6 +128,16 @@ class ControllerHttpServer:
                 try:
                     if parts == ["health"]:
                         return self._respond({"status": "ok"})
+                    if parts == ["brokers"]:
+                        return self._respond(
+                            {
+                                "brokers": [
+                                    i.url
+                                    for i in ctrl.resources.instances.values()
+                                    if i.role == "broker" and i.alive and i.url
+                                ]
+                            }
+                        )
                     if parts == ["tables"]:
                         return self._respond({"tables": ctrl.resources.tables()})
                     if len(parts) == 2 and parts[0] == "schemas":
@@ -144,6 +169,13 @@ class ControllerHttpServer:
                         config = TableConfig.from_json(self._read_json())
                         physical = ctrl.add_table(config)
                         return self._respond({"status": "ok", "table": physical})
+                    if len(parts) == 2 and parts[0] == "segments":
+                        # binary segment upload: POST /segments/{table}
+                        # (PinotSegmentUploadRestletResource analog)
+                        n = int(self.headers.get("Content-Length", "0"))
+                        body = self.rfile.read(n)
+                        servers = ctrl.upload_segment_bytes(parts[1], body)
+                        return self._respond({"status": "ok", "servers": servers})
                     return self._respond({"error": "not found"}, 404)
                 except Exception as e:
                     return self._respond({"error": str(e)}, 400)

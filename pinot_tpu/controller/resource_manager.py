@@ -47,6 +47,7 @@ class InstanceState:
     role: str  # "server" | "broker"
     alive: bool = True
     tags: Set[str] = field(default_factory=lambda: {"DefaultTenant"})
+    url: Optional[str] = None  # broker HTTP url (client discovery)
 
 
 class Participant:

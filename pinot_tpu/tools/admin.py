@@ -1,0 +1,228 @@
+"""Admin CLI — the ``PinotAdministrator`` analog (pinot-tools, 30+
+commands).  Usage: ``python -m pinot_tpu.tools.admin <command> [args]``.
+
+Commands:
+  Quickstart            offline baseballStats demo (Quickstart.java:33)
+  RealtimeQuickstart    streaming meetupRsvp demo
+  StartCluster          in-process cluster with HTTP broker+controller
+  CreateSegment         build a segment from CSV/JSONL + schema JSON
+  UploadSegment         POST a segment file to a controller
+  AddSchema / AddTable  controller CRUD
+  PostQuery             run PQL against a broker
+  QueryRunner           perf modes singleThread/multiThreads/targetQPS
+  ShowSegment           print a segment file's metadata
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def _post(url: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def cmd_quickstart(args) -> None:
+    from pinot_tpu.tools.quickstart import run_offline_quickstart
+
+    cluster = run_offline_quickstart(
+        num_rows=args.rows, startree=args.startree, http=not args.no_http
+    )
+    if not args.no_http:
+        print("Ctrl-C to exit.")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            cluster.stop()
+
+
+def cmd_realtime_quickstart(args) -> None:
+    from pinot_tpu.tools.quickstart import run_realtime_quickstart
+
+    cluster = run_realtime_quickstart(num_events=args.events, http=not args.no_http)
+    if not args.no_http:
+        print("Ctrl-C to exit.")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            cluster.stop()
+
+
+def cmd_start_cluster(args) -> None:
+    from pinot_tpu.broker.broker import BrokerHttpServer
+    from pinot_tpu.controller.controller import ControllerHttpServer
+    from pinot_tpu.tools.cluster_harness import InProcessCluster
+
+    cluster = InProcessCluster(num_servers=args.servers, data_dir=args.data_dir)
+    broker_http = BrokerHttpServer(cluster.broker, port=args.broker_port)
+    broker_http.start()
+    cluster.broker_starter.url = f"http://127.0.0.1:{broker_http.port}"
+    controller_http = ControllerHttpServer(cluster.controller, port=args.controller_port)
+    controller_http.start()
+    # register broker url for client discovery
+    inst = cluster.controller.resources.instances.get("broker0")
+    if inst is not None:
+        inst.url = f"http://127.0.0.1:{broker_http.port}"
+    print(f"controller: http://127.0.0.1:{controller_http.port}")
+    print(f"broker:     http://127.0.0.1:{broker_http.port}/query")
+    print("Ctrl-C to exit.")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        broker_http.stop()
+        controller_http.stop()
+        cluster.stop()
+
+
+def cmd_create_segment(args) -> None:
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.segment.format import write_segment
+    from pinot_tpu.segment.readers import read_csv, read_jsonl
+    from pinot_tpu.startree.builder import StarTreeBuilderConfig
+
+    with open(args.schema_file) as f:
+        schema = Schema.from_json(json.load(f))
+    if args.data_file.endswith(".csv"):
+        rows = read_csv(args.data_file, schema)
+    else:
+        rows = read_jsonl(args.data_file, schema)
+    cfg = StarTreeBuilderConfig() if args.startree else None
+    seg = build_segment(
+        schema, rows, args.table, args.segment_name, startree_config=cfg
+    )
+    path = write_segment(seg, args.out_dir)
+    print(f"built segment {seg.segment_name}: {seg.num_docs} docs -> {path}")
+
+
+def cmd_upload_segment(args) -> None:
+    with open(args.segment_file, "rb") as f:
+        data = f.read()
+    url = args.controller.rstrip("/") + f"/segments/{args.table}"
+    req = urllib.request.Request(url, data=data, headers={"Content-Type": "application/octet-stream"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        print(json.loads(r.read()))
+
+
+def cmd_add_schema(args) -> None:
+    with open(args.schema_file) as f:
+        payload = json.load(f)
+    print(_post(args.controller.rstrip("/") + "/schemas", payload))
+
+
+def cmd_add_table(args) -> None:
+    with open(args.config_file) as f:
+        payload = json.load(f)
+    print(_post(args.controller.rstrip("/") + "/tables", payload))
+
+
+def cmd_post_query(args) -> None:
+    out = _post(args.broker.rstrip("/") + "/query", {"pql": args.query, "trace": args.trace})
+    print(json.dumps(out, indent=2))
+
+
+def cmd_query_runner(args) -> None:
+    from pinot_tpu.tools.query_runner import QueryRunner, http_query_fn
+
+    with open(args.query_file) as f:
+        queries = [q.strip() for q in f if q.strip()]
+    runner = QueryRunner(http_query_fn(args.broker))
+    if args.mode == "singleThread":
+        report = runner.single_thread(queries, rounds=args.rounds)
+    elif args.mode == "multiThreads":
+        report = runner.multi_threads(queries, num_threads=args.threads, rounds=args.rounds)
+    else:
+        report = runner.target_qps(queries, qps=args.qps, duration_s=args.duration)
+    print(json.dumps(report.to_json(), indent=2))
+
+
+def cmd_show_segment(args) -> None:
+    from pinot_tpu.segment.format import read_segment
+
+    seg = read_segment(args.segment_dir)
+    print(json.dumps(seg.metadata.to_json(), indent=2, default=str))
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="pinot_tpu-admin", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    q = sub.add_parser("Quickstart")
+    q.add_argument("-rows", type=int, default=10_000)
+    q.add_argument("-startree", action="store_true")
+    q.add_argument("-no-http", action="store_true")
+    q.set_defaults(fn=cmd_quickstart)
+
+    rq = sub.add_parser("RealtimeQuickstart")
+    rq.add_argument("-events", type=int, default=2000)
+    rq.add_argument("-no-http", action="store_true")
+    rq.set_defaults(fn=cmd_realtime_quickstart)
+
+    sc = sub.add_parser("StartCluster")
+    sc.add_argument("-servers", type=int, default=2)
+    sc.add_argument("-data-dir", default=None)
+    sc.add_argument("-broker-port", type=int, default=8099)
+    sc.add_argument("-controller-port", type=int, default=9000)
+    sc.set_defaults(fn=cmd_start_cluster)
+
+    cs = sub.add_parser("CreateSegment")
+    cs.add_argument("-schema-file", required=True, dest="schema_file")
+    cs.add_argument("-data-file", required=True, dest="data_file")
+    cs.add_argument("-table", required=True)
+    cs.add_argument("-segment-name", required=True, dest="segment_name")
+    cs.add_argument("-out-dir", required=True, dest="out_dir")
+    cs.add_argument("-startree", action="store_true")
+    cs.set_defaults(fn=cmd_create_segment)
+
+    us = sub.add_parser("UploadSegment")
+    us.add_argument("-controller", default="http://127.0.0.1:9000")
+    us.add_argument("-table", required=True)
+    us.add_argument("-segment-file", required=True, dest="segment_file")
+    us.set_defaults(fn=cmd_upload_segment)
+
+    asch = sub.add_parser("AddSchema")
+    asch.add_argument("-controller", default="http://127.0.0.1:9000")
+    asch.add_argument("-schema-file", required=True, dest="schema_file")
+    asch.set_defaults(fn=cmd_add_schema)
+
+    at = sub.add_parser("AddTable")
+    at.add_argument("-controller", default="http://127.0.0.1:9000")
+    at.add_argument("-config-file", required=True, dest="config_file")
+    at.set_defaults(fn=cmd_add_table)
+
+    pq = sub.add_parser("PostQuery")
+    pq.add_argument("-broker", default="http://127.0.0.1:8099")
+    pq.add_argument("-query", required=True)
+    pq.add_argument("-trace", action="store_true")
+    pq.set_defaults(fn=cmd_post_query)
+
+    qr = sub.add_parser("QueryRunner")
+    qr.add_argument("-broker", default="http://127.0.0.1:8099")
+    qr.add_argument("-query-file", required=True, dest="query_file")
+    qr.add_argument("-mode", choices=["singleThread", "multiThreads", "targetQPS"], default="singleThread")
+    qr.add_argument("-rounds", type=int, default=1)
+    qr.add_argument("-threads", type=int, default=4)
+    qr.add_argument("-qps", type=float, default=10.0)
+    qr.add_argument("-duration", type=float, default=10.0)
+    qr.set_defaults(fn=cmd_query_runner)
+
+    ss = sub.add_parser("ShowSegment")
+    ss.add_argument("-segment-dir", required=True, dest="segment_dir")
+    ss.set_defaults(fn=cmd_show_segment)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

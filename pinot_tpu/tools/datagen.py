@@ -72,6 +72,57 @@ def make_test_schema(with_mv: bool = True) -> Schema:
 
 
 # ---------------------------------------------------------------------------
+# baseballStats-shaped quickstart data (Quickstart.java:33 /
+# sample_data/baseball.schema — synthetic; shape- and type-faithful)
+# ---------------------------------------------------------------------------
+
+_TEAMS = ["BOS", "NYA", "CHA", "SFN", "LAN", "SLN", "ATL", "SEA", "OAK", "TEX"]
+_LEAGUES = ["AL", "NL"]
+_FIRST = ["hank", "babe", "ty", "willie", "ted", "lou", "joe", "mickey", "stan", "cal"]
+_LAST = ["aaron", "ruth", "cobb", "mays", "williams", "gehrig", "dimaggio", "mantle", "musial", "ripken"]
+
+
+def baseball_schema() -> Schema:
+    return Schema(
+        "baseballStats",
+        dimensions=[
+            FieldSpec("playerName", DataType.STRING),
+            FieldSpec("teamID", DataType.STRING),
+            FieldSpec("league", DataType.STRING),
+            FieldSpec("yearID", DataType.INT),
+        ],
+        metrics=[
+            FieldSpec("runs", DataType.INT, FieldType.METRIC),
+            FieldSpec("hits", DataType.INT, FieldType.METRIC),
+            FieldSpec("homeRuns", DataType.INT, FieldType.METRIC),
+            FieldSpec("atBats", DataType.INT, FieldType.METRIC),
+        ],
+    )
+
+
+def baseball_rows(num_rows: int = 10_000, seed: int = 42) -> List[Row]:
+    rng = random.Random(seed)
+    players = [f"{f} {l}" for f in _FIRST for l in _LAST]
+    rows: List[Row] = []
+    for _ in range(num_rows):
+        at_bats = rng.randint(50, 650)
+        hits = rng.randint(0, at_bats // 2)
+        rows.append(
+            {
+                "playerName": rng.choice(players),
+                "teamID": rng.choice(_TEAMS),
+                "league": rng.choice(_LEAGUES),
+                "yearID": rng.randint(1980, 2015),
+                "runs": rng.randint(0, 140),
+                "hits": hits,
+                "homeRuns": rng.randint(0, 60),
+                "atBats": at_bats,
+            }
+        )
+    return rows
+
+
+# ---------------------------------------------------------------------------
 # TPC-H lineitem-shaped generator (contrib/pinot-benchmark workload shape)
 # ---------------------------------------------------------------------------
 

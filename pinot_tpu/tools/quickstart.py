@@ -1,0 +1,126 @@
+"""Quickstarts: offline (baseballStats), realtime (meetupRsvp-shaped
+stream), hybrid — the ``Quickstart.java:33`` / ``RealtimeQuickStart.java``
+/ ``HybridQuickstart.java`` analogs: stand up an in-process cluster,
+load data, run sample queries, optionally keep an HTTP broker running.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional
+
+from pinot_tpu.common.schema import DataType, FieldSpec, FieldType, Schema, TimeFieldSpec
+from pinot_tpu.realtime.stream import MemoryStreamProvider
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.startree.builder import StarTreeBuilderConfig
+from pinot_tpu.tools.cluster_harness import InProcessCluster
+from pinot_tpu.tools.datagen import baseball_rows, baseball_schema
+
+OFFLINE_SAMPLE_QUERIES = [
+    "SELECT count(*) FROM baseballStats",
+    "SELECT sum(runs) FROM baseballStats GROUP BY playerName TOP 5",
+    "SELECT sum(hits), sum(homeRuns) FROM baseballStats WHERE teamID = 'BOS'",
+    "SELECT avg(runs) FROM baseballStats GROUP BY league",
+    "SELECT playerName, runs FROM baseballStats ORDER BY runs DESC LIMIT 5",
+]
+
+
+def run_offline_quickstart(
+    num_rows: int = 10_000,
+    num_segments: int = 4,
+    startree: bool = False,
+    http: bool = False,
+    verbose: bool = True,
+) -> InProcessCluster:
+    """baseballStats offline quickstart: CSV-shaped data -> segments ->
+    cluster -> PQL over HTTP (the minimum end-to-end slice, SURVEY §7)."""
+    schema = baseball_schema()
+    rows = baseball_rows(num_rows)
+    cluster = InProcessCluster(num_servers=2, http=http)
+    physical = cluster.add_offline_table(schema)
+
+    chunk = max(1, len(rows) // num_segments)
+    cfg = StarTreeBuilderConfig(max_leaf_records=100) if startree else None
+    for i in range(num_segments):
+        part = rows[i * chunk : (i + 1) * chunk if i < num_segments - 1 else len(rows)]
+        seg = build_segment(
+            schema, part, physical, f"baseballStats_{i}", startree_config=cfg
+        )
+        cluster.upload(physical, seg)
+
+    if verbose:
+        for pql in OFFLINE_SAMPLE_QUERIES:
+            resp = cluster.query(pql)
+            print(f"\n>>> {pql}")
+            print(json.dumps(resp.to_json(), indent=2)[:1200])
+        if http:
+            print(f"\nbroker listening on http://127.0.0.1:{cluster.http.port}/query")
+    return cluster
+
+
+def meetup_schema() -> Schema:
+    return Schema(
+        "meetupRsvp",
+        dimensions=[
+            FieldSpec("venue_name", DataType.STRING),
+            FieldSpec("event_name", DataType.STRING),
+            FieldSpec("group_city", DataType.STRING),
+        ],
+        metrics=[FieldSpec("rsvp_count", DataType.INT, FieldType.METRIC)],
+        time_field=TimeFieldSpec("mtime", DataType.LONG, time_unit="MILLISECONDS"),
+    )
+
+
+def run_realtime_quickstart(
+    num_events: int = 2000, http: bool = False, verbose: bool = True
+) -> InProcessCluster:
+    """meetupRsvp realtime quickstart: stream -> consuming segment ->
+    live windowed count queries (RealtimeQuickStart.java analog)."""
+    import random
+
+    rng = random.Random(1)
+    schema = meetup_schema()
+    cluster = InProcessCluster(num_servers=1, http=http)
+    stream = MemoryStreamProvider(num_partitions=1)
+    physical = cluster.add_realtime_table(schema, stream, rows_per_segment=500)
+
+    cities = ["sf", "nyc", "seattle", "austin", "chicago"]
+    now = int(time.time() * 1000)
+    for i in range(num_events):
+        stream.produce(
+            {
+                "venue_name": f"venue{rng.randrange(20)}",
+                "event_name": f"event{rng.randrange(8)}",
+                "group_city": rng.choice(cities),
+                "rsvp_count": rng.randint(1, 5),
+                "mtime": now + i,
+            }
+        )
+
+    # drive consumption + commits (a background loop in a deployment)
+    from pinot_tpu.realtime.llc import make_segment_name
+
+    seq = 0
+    while True:
+        seg = make_segment_name(physical, 0, seq)
+        dms = cluster.controller.realtime_manager.consumers_of(seg)
+        if not dms:
+            break
+        dm = dms[0]
+        consumed = dm.consume_step(max_rows=10_000)
+        if dm.threshold_reached:
+            dm.try_commit()
+            seq += 1
+        elif consumed == 0:
+            break
+
+    if verbose:
+        for pql in [
+            "SELECT count(*) FROM meetupRsvp",
+            "SELECT sum(rsvp_count) FROM meetupRsvp GROUP BY group_city",
+            "SELECT count(*) FROM meetupRsvp GROUP BY event_name TOP 3",
+        ]:
+            resp = cluster.query(pql)
+            print(f"\n>>> {pql}")
+            print(json.dumps(resp.to_json(), indent=2)[:900])
+    return cluster

@@ -1,0 +1,137 @@
+"""Tools tests: quickstarts, client library, query runner, admin
+CreateSegment/ShowSegment, controller segment upload over HTTP."""
+import json
+import urllib.request
+
+import pytest
+
+from pinot_tpu.api.client import Connection, ConnectionFactory, PinotClientError
+from pinot_tpu.broker.broker import BrokerHttpServer
+from pinot_tpu.controller.controller import ControllerHttpServer
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.segment.format import SEGMENT_FILE_NAME, write_segment
+from pinot_tpu.tools.datagen import baseball_rows, baseball_schema, make_test_schema, random_rows
+from pinot_tpu.tools.query_runner import QueryRunner
+from pinot_tpu.tools.quickstart import run_offline_quickstart, run_realtime_quickstart
+
+
+def test_offline_quickstart():
+    cluster = run_offline_quickstart(num_rows=2000, num_segments=3, verbose=False)
+    resp = cluster.query("SELECT count(*) FROM baseballStats")
+    assert resp.num_docs_scanned == 2000
+    resp = cluster.query("SELECT sum(runs) FROM baseballStats GROUP BY playerName TOP 5")
+    assert len(resp.aggregation_results[0].group_by_result) == 5
+    cluster.stop()
+
+
+def test_offline_quickstart_startree():
+    cluster = run_offline_quickstart(num_rows=2000, num_segments=2, startree=True, verbose=False)
+    resp = cluster.query("SELECT sum(runs), count(*) FROM baseballStats")
+    assert int(resp.aggregation_results[1].value) == 2000
+    # star-tree answers from pre-agg rows, far fewer than 2000
+    assert resp.num_docs_scanned < 1000
+    cluster.stop()
+
+
+def test_realtime_quickstart():
+    cluster = run_realtime_quickstart(num_events=1200, verbose=False)
+    resp = cluster.query("SELECT count(*) FROM meetupRsvp")
+    assert resp.num_docs_scanned == 1200
+    cluster.stop()
+
+
+def test_client_library():
+    cluster = run_offline_quickstart(num_rows=500, num_segments=1, http=True, verbose=False)
+    try:
+        conn = ConnectionFactory.from_host_list([f"http://127.0.0.1:{cluster.http.port}"])
+        rg = conn.execute("SELECT count(*) FROM baseballStats")
+        rs = rg.get_result_set(0)
+        assert rs.get_int(0) == 500
+        assert rg.execution_stats["numDocsScanned"] == 500
+
+        rg = conn.execute("SELECT sum(runs) FROM baseballStats GROUP BY teamID TOP 3")
+        rs = rg.get_result_set(0)
+        assert rs.kind == "groupby"
+        assert rs.get_row_count() == 3
+        assert len(rs.get_group_key(0)) == 1
+
+        rg = conn.execute("SELECT playerName, runs FROM baseballStats LIMIT 4")
+        rs = rg.get_result_set(0)
+        assert rs.kind == "selection"
+        assert rs.get_row_count() == 4
+        assert rs.get_column_names() == ["playerName", "runs"]
+
+        stmt = conn.prepare_statement("SELECT count(*) FROM baseballStats WHERE teamID = ?")
+        stmt.set_string(0, "BOS")
+        rg2 = stmt.execute()
+        assert rg2.get_result_set(0).get_int(0) > 0
+    finally:
+        cluster.stop()
+
+
+def test_query_runner_modes():
+    calls = []
+
+    def fake_query(pql):
+        calls.append(pql)
+
+    runner = QueryRunner(fake_query)
+    rep = runner.single_thread(["q1", "q2"], rounds=3)
+    assert rep.num_queries == 6 and rep.qps > 0
+    rep = runner.multi_threads(["q1", "q2", "q3"], num_threads=2, rounds=2)
+    assert rep.num_queries == 6
+    assert rep.to_json()["p99Ms"] >= 0
+
+
+def test_admin_create_and_show_segment(tmp_path, capsys):
+    from pinot_tpu.tools.admin import main
+
+    schema = make_test_schema(with_mv=False)
+    schema_file = tmp_path / "schema.json"
+    schema_file.write_text(json.dumps(schema.to_json()))
+    data_file = tmp_path / "data.jsonl"
+    rows = random_rows(schema, 50, seed=1)
+    data_file.write_text("\n".join(json.dumps(r) for r in rows))
+
+    out_dir = tmp_path / "seg_out"
+    main([
+        "CreateSegment",
+        "-schema-file", str(schema_file),
+        "-data-file", str(data_file),
+        "-table", "t",
+        "-segment-name", "cli_seg",
+        "-out-dir", str(out_dir),
+    ])
+    captured = capsys.readouterr()
+    assert "50 docs" in captured.out
+
+    main(["ShowSegment", "-segment-dir", str(out_dir)])
+    captured = capsys.readouterr()
+    assert '"segmentName": "cli_seg"' in captured.out
+
+
+def test_http_segment_upload(tmp_path):
+    from pinot_tpu.tools.cluster_harness import InProcessCluster
+
+    cluster = InProcessCluster(num_servers=1, data_dir=str(tmp_path / "ctrl"))
+    schema = make_test_schema(with_mv=False)
+    physical = cluster.add_offline_table(schema)
+    http = ControllerHttpServer(cluster.controller)
+    http.start()
+    try:
+        seg = build_segment(schema, random_rows(schema, 120, seed=3), physical, "up1")
+        seg_dir = tmp_path / "up1"
+        write_segment(seg, str(seg_dir))
+        data = (seg_dir / SEGMENT_FILE_NAME).read_bytes()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/segments/{physical}",
+            data=data,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["status"] == "ok" and out["servers"]
+        assert cluster.query("SELECT count(*) FROM testTable").num_docs_scanned == 120
+    finally:
+        http.stop()
+        cluster.stop()
