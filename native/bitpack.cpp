@@ -1,0 +1,53 @@
+// Native fixed-bit codec for dictId forward indexes.
+//
+// The reference packs dictIds with minimal bits in Java word-at-a-time
+// readers/writers (pinot-core io/reader/impl/v1/FixedBitSingleValueReader.java,
+// io/writer/impl/FixedBitSingleValueWriter.java). This is the native
+// equivalent used at segment write/load time: LSB-first bit stream,
+// bit i of the stream lives at (bytes[i>>3] >> (i&7)) & 1 — matching
+// pinot_tpu/segment/bitpack.py's numpy fallback format exactly.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// values[n] with values < 2^nbits  ->  out[ceil(n*nbits/8)] (zeroed by caller)
+void pinot_pack_bits(const int32_t* values, int64_t n, int nbits, uint8_t* out) {
+    uint64_t acc = 0;   // bit accumulator
+    int filled = 0;     // bits currently in acc
+    int64_t out_pos = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        acc |= (static_cast<uint64_t>(static_cast<uint32_t>(values[i])) &
+                ((nbits == 64) ? ~0ULL : ((1ULL << nbits) - 1))) << filled;
+        filled += nbits;
+        while (filled >= 8) {
+            out[out_pos++] = static_cast<uint8_t>(acc & 0xFF);
+            acc >>= 8;
+            filled -= 8;
+        }
+    }
+    if (filled > 0) {
+        out[out_pos++] = static_cast<uint8_t>(acc & 0xFF);
+    }
+}
+
+// packed bytes -> out[n] int32
+void pinot_unpack_bits(const uint8_t* packed, int64_t n, int nbits, int32_t* out) {
+    uint64_t acc = 0;
+    int filled = 0;
+    int64_t in_pos = 0;
+    const uint64_t mask = (nbits == 64) ? ~0ULL : ((1ULL << nbits) - 1);
+    for (int64_t i = 0; i < n; ++i) {
+        while (filled < nbits) {
+            acc |= static_cast<uint64_t>(packed[in_pos++]) << filled;
+            filled += 8;
+        }
+        out[i] = static_cast<int32_t>(acc & mask);
+        acc >>= nbits;
+        filled -= nbits;
+    }
+}
+
+}  // extern "C"

@@ -21,11 +21,20 @@ def bits_required(cardinality: int) -> int:
 
 
 def pack_bits(values: np.ndarray, nbits: int) -> np.ndarray:
-    """Pack int array into a uint8 byte stream, little-endian bit order."""
-    values = np.asarray(values, dtype=np.uint64)
-    n = values.size
+    """Pack int array into a uint8 byte stream, little-endian bit order.
+
+    Uses the native C++ codec (``segment/native.py``) when available;
+    the numpy bit-slicing below is the always-available fallback."""
+    n = np.asarray(values).size
     if n == 0:
         return np.zeros(0, dtype=np.uint8)
+    if n >= 4096:
+        from pinot_tpu.segment import native
+
+        out = native.pack_bits(np.asarray(values), nbits)
+        if out is not None:
+            return out
+    values = np.asarray(values, dtype=np.uint64)
     # Expand each value into its bits [n, nbits], then pack.
     shifts = np.arange(nbits, dtype=np.uint64)
     bits = ((values[:, None] >> shifts[None, :]) & 1).astype(np.uint8)
@@ -40,6 +49,12 @@ def unpack_bits(packed: np.ndarray, nbits: int, count: int) -> np.ndarray:
     """Inverse of :func:`pack_bits`; returns int32 array of length count."""
     if count == 0:
         return np.zeros(0, dtype=np.int32)
+    if count >= 4096:
+        from pinot_tpu.segment import native
+
+        out = native.unpack_bits(np.asarray(packed), nbits, count)
+        if out is not None:
+            return out
     packed = np.asarray(packed, dtype=np.uint8)
     # undo per-byte bit order, then take the first count*nbits bits
     bits = np.unpackbits(packed).reshape(-1, 8)[:, ::-1].reshape(-1)[: count * nbits]

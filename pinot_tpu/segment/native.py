@@ -1,0 +1,97 @@
+"""ctypes bindings for the native codec (native/bitpack.cpp).
+
+Loads ``native/libpinotnative.so`` (building it with make on first use
+if a compiler is available); every entry point has a numpy fallback in
+``bitpack.py``, so the package works without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libpinotnative.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception as e:  # no toolchain / build failure -> fallback
+                logger.info("native codec build skipped: %s", e)
+        if os.path.exists(_LIB_PATH):
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+                lib.pinot_pack_bits.argtypes = [
+                    ctypes.POINTER(ctypes.c_int32),
+                    ctypes.c_int64,
+                    ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_uint8),
+                ]
+                lib.pinot_unpack_bits.argtypes = [
+                    ctypes.POINTER(ctypes.c_uint8),
+                    ctypes.c_int64,
+                    ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_int32),
+                ]
+                _lib = lib
+            except OSError as e:
+                logger.info("native codec load failed: %s", e)
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def pack_bits(values: np.ndarray, nbits: int) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, dtype=np.int32)
+    n = values.size
+    out = np.zeros((n * nbits + 7) // 8, dtype=np.uint8)
+    lib.pinot_pack_bits(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n,
+        nbits,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out
+
+
+def unpack_bits(packed: np.ndarray, nbits: int, count: int) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    packed = np.ascontiguousarray(packed, dtype=np.uint8)
+    out = np.empty(count, dtype=np.int32)
+    lib.pinot_unpack_bits(
+        packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        count,
+        nbits,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out

@@ -1,0 +1,45 @@
+"""Standalone server process: load segments from disk, serve TCP queries.
+
+Used by the chaos test (ChaosMonkeyIntegrationTest.java:41 analog —
+real OS processes killed with POSIX signals) and by manual multi-process
+deployments.
+
+Usage: python -m pinot_tpu.tools.run_server --name s0 --port 0 \
+          --table myTable_OFFLINE --segments /path/seg1 /path/seg2
+Prints "READY <port>" on stdout once serving.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--name", default="server0")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--table", required=True)
+    p.add_argument("--segments", nargs="*", default=[])
+    args = p.parse_args(argv)
+
+    from pinot_tpu.segment.format import read_segment
+    from pinot_tpu.server.instance import ServerInstance
+    from pinot_tpu.transport.tcp import TcpServer
+
+    server = ServerInstance(args.name)
+    for seg_dir in args.segments:
+        server.add_segment(args.table, read_segment(seg_dir))
+
+    tcp = TcpServer(server.handle_request, port=args.port)
+    tcp.start()
+    print(f"READY {tcp.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        tcp.stop()
+
+
+if __name__ == "__main__":
+    main()

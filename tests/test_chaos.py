@@ -1,0 +1,108 @@
+"""Chaos test: real OS server processes, killed with POSIX signals.
+
+The ChaosMonkeyIntegrationTest analog (``ChaosMonkeyIntegrationTest.java:41``,
+kill via signals :76, consistency assertion :206): queries must degrade
+to partial results with exceptions while a server is dead, and recover
+fully once it restarts.
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from pinot_tpu.broker.broker import BrokerRequestHandler
+from pinot_tpu.broker.routing import RoutingTableProvider
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.segment.format import write_segment
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+from pinot_tpu.transport.tcp import TcpTransport
+
+TABLE = "chaosTable_OFFLINE"
+
+
+def _spawn_server(name, table, seg_dirs, repo_root):
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # no TPU tunnel in child processes
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "pinot_tpu.tools.run_server",
+            "--name", name,
+            "--table", table,
+            "--segments", *seg_dirs,
+        ],
+        cwd=repo_root,
+        env=env,
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("READY"):
+            return proc, int(line.split()[1])
+    proc.kill()
+    raise RuntimeError(f"server {name} did not become ready")
+
+
+@pytest.mark.slow
+def test_kill_and_restart_server(tmp_path):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 400, seed=13)
+
+    seg_dirs = {}
+    for i, name in enumerate(["c0", "c1"]):
+        seg = build_segment(schema, rows[i * 200 : (i + 1) * 200], TABLE, name)
+        d = str(tmp_path / name)
+        write_segment(seg, d)
+        seg_dirs[name] = d
+
+    procs = {}
+    ports = {}
+    try:
+        procs["sA"], ports["sA"] = _spawn_server("sA", TABLE, [seg_dirs["c0"]], repo_root)
+        procs["sB"], ports["sB"] = _spawn_server("sB", TABLE, [seg_dirs["c1"]], repo_root)
+
+        routing = RoutingTableProvider()
+        routing.update(TABLE, {"c0": {"sA": "ONLINE"}, "c1": {"sB": "ONLINE"}})
+        broker = BrokerRequestHandler(
+            TcpTransport(),
+            {"sA": ("127.0.0.1", ports["sA"]), "sB": ("127.0.0.1", ports["sB"])},
+            routing=routing,
+            timeout_ms=30_000,
+        )
+
+        resp = broker.handle_pql("SELECT count(*) FROM chaosTable")
+        assert resp.num_docs_scanned == 400
+        assert not resp.exceptions
+
+        # SIGKILL one server: partial results + an exception, no hang
+        procs["sB"].send_signal(signal.SIGKILL)
+        procs["sB"].wait(timeout=10)
+        broker2 = BrokerRequestHandler(  # fresh transport (no pooled dead socket)
+            TcpTransport(),
+            {"sA": ("127.0.0.1", ports["sA"]), "sB": ("127.0.0.1", ports["sB"])},
+            routing=routing,
+            timeout_ms=8_000,
+        )
+        resp = broker2.handle_pql("SELECT count(*) FROM chaosTable")
+        assert resp.num_docs_scanned == 200
+        assert len(resp.exceptions) == 1
+        assert resp.num_servers_responded == 1
+
+        # restart on a fresh port; routing repoints; full recovery
+        procs["sB2"], new_port = _spawn_server("sB", TABLE, [seg_dirs["c1"]], repo_root)
+        broker2.set_server_address("sB", ("127.0.0.1", new_port))
+        resp = broker2.handle_pql("SELECT count(*) FROM chaosTable")
+        assert resp.num_docs_scanned == 400
+        assert not resp.exceptions
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
