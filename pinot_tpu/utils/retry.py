@@ -1,0 +1,55 @@
+"""Retry policies (pinot-common ``common/utils/retry/`` analog:
+fixed-delay, exponential-backoff, no-delay)."""
+from __future__ import annotations
+
+import time
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class RetryError(Exception):
+    pass
+
+
+class RetryPolicy:
+    def __init__(self, max_attempts: int) -> None:
+        self.max_attempts = max_attempts
+
+    def delay_s(self, attempt: int) -> float:
+        raise NotImplementedError
+
+    def attempt(self, fn: Callable[[], T]) -> T:
+        last: Exception | None = None
+        for i in range(self.max_attempts):
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 - policy retries anything
+                last = e
+                if i + 1 < self.max_attempts:
+                    time.sleep(self.delay_s(i))
+        raise RetryError(f"failed after {self.max_attempts} attempts: {last}") from last
+
+
+class NoDelayRetryPolicy(RetryPolicy):
+    def delay_s(self, attempt: int) -> float:
+        return 0.0
+
+
+class FixedDelayRetryPolicy(RetryPolicy):
+    def __init__(self, max_attempts: int, delay_s: float) -> None:
+        super().__init__(max_attempts)
+        self._delay = delay_s
+
+    def delay_s(self, attempt: int) -> float:
+        return self._delay
+
+
+class ExponentialBackoffRetryPolicy(RetryPolicy):
+    def __init__(self, max_attempts: int, initial_delay_s: float, factor: float = 2.0) -> None:
+        super().__init__(max_attempts)
+        self.initial = initial_delay_s
+        self.factor = factor
+
+    def delay_s(self, attempt: int) -> float:
+        return self.initial * (self.factor**attempt)
