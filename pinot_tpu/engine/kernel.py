@@ -34,6 +34,45 @@ from pinot_tpu.engine.plan import MV_ANY, MV_NONE, SV, StaticAgg, StaticPlan
 
 BIG = jnp.inf
 
+# Group-by scatter-adds lower poorly on TPU (serialized scatter); for
+# small key spaces a chunked one-hot matmul rides the MXU instead:
+#   acc[K] += w[chunk] @ onehot(keys[chunk], K)
+# Enabled on non-CPU backends (or forced via env for tests).
+MATMUL_GROUP_CAP = 512
+_MATMUL_CHUNK = 1 << 15
+
+
+def _use_matmul_groupby() -> bool:
+    import os
+
+    force = os.environ.get("PINOT_TPU_GROUPBY_MATMUL")
+    if force is not None:
+        return force == "1"
+    return jax.default_backend() != "cpu"
+
+
+def _segment_add_matmul(flat_idx, w, capacity: int):
+    """sum w into capacity buckets via chunked one-hot matmuls.
+    Out-of-range indices (== capacity) contribute to a dropped bucket."""
+    fdt = config.float_dtype()
+    n = flat_idx.shape[0]
+    chunk = min(_MATMUL_CHUNK, n)
+    pad = (-n) % chunk
+    if pad:
+        flat_idx = jnp.concatenate([flat_idx, jnp.full(pad, capacity, flat_idx.dtype)])
+        w = jnp.concatenate([w, jnp.zeros(pad, w.dtype)])
+    nb = flat_idx.shape[0] // chunk
+    idx_r = flat_idx.reshape(nb, chunk)
+    w_r = w.reshape(nb, chunk).astype(fdt)
+
+    def body(acc, args):
+        i_c, w_c = args
+        onehot = jax.nn.one_hot(i_c, capacity, dtype=fdt)  # [chunk, K]
+        return acc + w_c @ onehot, None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros(capacity, dtype=fdt), (idx_r, w_r))
+    return acc
+
 
 def _leaf_mask(plan: StaticPlan, i: int, seg: Dict[str, Any], q: Dict[str, Any]) -> jnp.ndarray:
     leaf = plan.leaves[i]
@@ -179,6 +218,14 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
         """Broadcast a per-row scalar across the expansion axis, flattened."""
         return jnp.broadcast_to(row_scalar[:, None], idx.shape).reshape(-1)
 
+    use_matmul = capacity <= MATMUL_GROUP_CAP and _use_matmul_groupby()
+
+    def group_add(weights):
+        w = jnp.where(fvalid, weights, 0)
+        if use_matmul:
+            return _segment_add_matmul(flat_idx, w, capacity)
+        return jnp.zeros(capacity, dtype=fdt).at[flat_idx].add(w, mode="drop")
+
     if base == "count":
         if agg.is_mv:
             mvv = seg[f"{agg.column}.mv_valid"]
@@ -186,9 +233,7 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
             w = per_entry(row_counts)
         else:
             w = jnp.ones_like(flat_idx, dtype=fdt)
-        return jnp.zeros(capacity, dtype=fdt).at[flat_idx].add(
-            jnp.where(fvalid, w, 0), mode="drop"
-        )
+        return group_add(w)
 
     if agg.kind in ("scalar", "pair"):
         vals, m = _row_values(agg, seg, mask)
@@ -204,9 +249,7 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
             row_max = vals
 
         def scatter_add(row_vals):
-            return jnp.zeros(capacity, dtype=fdt).at[flat_idx].add(
-                jnp.where(fvalid, per_entry(row_vals), 0), mode="drop"
-            )
+            return group_add(per_entry(row_vals))
 
         def scatter_min(row_vals):
             return jnp.full(capacity, BIG, dtype=fdt).at[flat_idx].min(
