@@ -88,11 +88,15 @@ def write_segment(segment: ImmutableSegment, directory: str) -> str:
             dtype=str(star_tree.sums.dtype), count=int(star_tree.sums.size))
         add("__startree__.counts", np.ascontiguousarray(star_tree.counts).tobytes(), "raw",
             dtype=str(star_tree.counts.dtype), count=int(star_tree.counts.size))
+        for hcol, regs in star_tree.hll_registers.items():
+            add(f"__startree__.hll.{hcol}", np.ascontiguousarray(regs).tobytes(), "raw",
+                dtype=str(regs.dtype), count=int(regs.size))
         star_header = {
             "splitOrder": star_tree.split_order,
             "metricColumns": star_tree.metric_columns,
             "maxLeafRecords": star_tree.max_leaf_records,
             "numRecords": star_tree.num_records,
+            "hllColumns": list(star_tree.hll_columns),
             "root": star_tree.root.to_json(),
         }
 
@@ -164,6 +168,7 @@ def read_segment(directory: str) -> ImmutableSegment:
         n_rec = st["numRecords"]
         k = len(st["splitOrder"])
         m = len(st["metricColumns"])
+        hll_cols = list(st.get("hllColumns", []))
         segment.star_tree = StarTreeIndex(
             split_order=list(st["splitOrder"]),
             metric_columns=list(st["metricColumns"]),
@@ -172,5 +177,9 @@ def read_segment(directory: str) -> ImmutableSegment:
             counts=load("__startree__.counts"),
             root=StarTreeNode.from_json(st["root"]),
             max_leaf_records=st["maxLeafRecords"],
+            hll_columns=hll_cols,
+            hll_registers={
+                c: load(f"__startree__.hll.{c}").reshape(n_rec, -1) for c in hll_cols
+            },
         )
     return segment

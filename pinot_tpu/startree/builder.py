@@ -12,6 +12,12 @@ Implementation is vectorized numpy throughout: grouping is
 lexicographic sort + run detection (``np.unique(axis=0)``), and star
 records are generated level-wise by masking the starred column and
 re-aggregating — no per-record recursion.
+
+HLL pre-aggregation (``config.hll_columns`` — the HllConfig
+derived-column capability): each cube row carries a uint8[256] register
+array sketching the configured column's values folded into it; rows
+merge with elementwise max, so ``distinctcounthll``/``fasthll`` answer
+from the cube too.
 """
 from __future__ import annotations
 
@@ -21,57 +27,75 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from pinot_tpu.common.schema import FieldType, Schema
+from pinot_tpu.engine import hll as hll_mod
 from pinot_tpu.segment.immutable import ImmutableSegment
 from pinot_tpu.startree.index import STAR, StarTreeIndex, StarTreeNode
+
+Regs = Dict[str, np.ndarray]  # column -> uint8 [n, 256]
 
 
 @dataclass
 class StarTreeBuilderConfig:
-    """StarTreeBuilderConfig analog (split order, leaf cap, skips)."""
+    """StarTreeBuilderConfig analog (split order, leaf cap, skips,
+    HLL columns)."""
 
     split_order: Optional[List[str]] = None
     max_leaf_records: int = 10_000
     skip_star_for_dims: List[str] = field(default_factory=list)
+    hll_columns: List[str] = field(default_factory=list)
 
 
-def _aggregate(dims: np.ndarray, sums: np.ndarray, counts: np.ndarray):
-    """Group rows by all dim columns; sum metrics and counts."""
+def _aggregate(
+    dims: np.ndarray, sums: np.ndarray, counts: np.ndarray, regs: Optional[Regs]
+):
+    """Group rows by all dim columns; sum metrics/counts, max registers."""
     if dims.shape[0] == 0:
-        return dims, sums, counts
+        return dims, sums, counts, regs
     uniq, inverse = np.unique(dims, axis=0, return_inverse=True)
     m = sums.shape[1]
     agg_sums = np.zeros((uniq.shape[0], m), dtype=np.float64)
     for j in range(m):
         agg_sums[:, j] = np.bincount(inverse, weights=sums[:, j], minlength=uniq.shape[0])
     agg_counts = np.bincount(inverse, weights=counts, minlength=uniq.shape[0]).astype(np.int64)
-    return uniq.astype(np.int32), agg_sums, agg_counts
+    agg_regs: Optional[Regs] = None
+    if regs is not None:
+        agg_regs = {}
+        for col, r in regs.items():
+            out = np.zeros((uniq.shape[0], r.shape[1]), dtype=np.uint8)
+            np.maximum.at(out, inverse, r)
+            agg_regs[col] = out
+    return uniq.astype(np.int32), agg_sums, agg_counts, agg_regs
 
 
-def _sort_lex(dims: np.ndarray, sums: np.ndarray, counts: np.ndarray, from_level: int):
-    """Sort rows lexicographically by dims[:, from_level:]."""
+def _sort_lex(dims, sums, counts, regs: Optional[Regs], from_level: int):
     if dims.shape[0] == 0:
-        return dims, sums, counts
+        return dims, sums, counts, regs
     keys = tuple(dims[:, j] for j in range(dims.shape[1] - 1, from_level - 1, -1))
     order = np.lexsort(keys) if keys else np.arange(dims.shape[0])
-    return dims[order], sums[order], counts[order]
+    regs_o = {c: r[order] for c, r in regs.items()} if regs is not None else None
+    return dims[order], sums[order], counts[order], regs_o
 
 
 class _Accum:
     """Append-only global record arrays."""
 
-    def __init__(self, k: int, m: int) -> None:
+    def __init__(self, k: int, m: int, hll_cols: Sequence[str]) -> None:
         self.dims: List[np.ndarray] = []
         self.sums: List[np.ndarray] = []
         self.counts: List[np.ndarray] = []
+        self.regs: Dict[str, List[np.ndarray]] = {c: [] for c in hll_cols}
         self.size = 0
         self.k = k
         self.m = m
 
-    def append(self, dims, sums, counts) -> Tuple[int, int]:
+    def append(self, dims, sums, counts, regs: Optional[Regs]) -> Tuple[int, int]:
         start = self.size
         self.dims.append(dims)
         self.sums.append(sums)
         self.counts.append(counts)
+        if regs is not None:
+            for c, r in regs.items():
+                self.regs[c].append(r)
         self.size += dims.shape[0]
         return start, self.size
 
@@ -81,11 +105,13 @@ class _Accum:
                 np.zeros((0, self.k), np.int32),
                 np.zeros((0, self.m), np.float64),
                 np.zeros(0, np.int64),
+                {c: np.zeros((0, hll_mod.M), np.uint8) for c in self.regs},
             )
         return (
             np.concatenate(self.dims),
             np.concatenate(self.sums),
             np.concatenate(self.counts),
+            {c: np.concatenate(blocks) for c, blocks in self.regs.items()},
         )
 
 
@@ -116,29 +142,66 @@ def build_star_tree(
             dim_cols,
             key=lambda c: -segment.column(c).metadata.cardinality,
         )
+    # HLL columns must not be split dims (they're the counted column)
+    split_order = [c for c in split_order if c not in config.hll_columns]
     k, m = len(split_order), len(metric_cols)
 
-    # base records: raw docs in dictId space, aggregated by all dims
+    # base records: raw docs in dictId space
     n = segment.num_docs
-    dims = np.stack([segment.column(c).fwd for c in split_order], axis=1).astype(np.int32) if k else np.zeros((n, 0), np.int32)
-    sums = np.stack(
-        [
-            np.asarray(segment.column(c).dictionary.values, dtype=np.float64)[
-                segment.column(c).fwd
-            ]
-            for c in metric_cols
-        ],
-        axis=1,
-    ) if m else np.zeros((n, 0), np.float64)
+    dims = (
+        np.stack([segment.column(c).fwd for c in split_order], axis=1).astype(np.int32)
+        if k
+        else np.zeros((n, 0), np.int32)
+    )
+    sums = (
+        np.stack(
+            [
+                np.asarray(segment.column(c).dictionary.values, dtype=np.float64)[
+                    segment.column(c).fwd
+                ]
+                for c in metric_cols
+            ],
+            axis=1,
+        )
+        if m
+        else np.zeros((n, 0), np.float64)
+    )
     counts = np.ones(n, dtype=np.int64)
 
-    dims, sums, counts = _aggregate(dims, sums, counts)
-    dims, sums, counts = _sort_lex(dims, sums, counts, 0)
+    # aggregate raw docs by all split dims; fold HLL registers in the
+    # same pass via per-dictId (bucket, rho) tables
+    uniq, inverse = (
+        np.unique(dims, axis=0, return_inverse=True)
+        if n
+        else (np.zeros((0, k), np.int32), np.zeros(0, np.int64))
+    )
+    agg_sums = np.zeros((uniq.shape[0], m), dtype=np.float64)
+    for j in range(m):
+        agg_sums[:, j] = np.bincount(inverse, weights=sums[:, j], minlength=uniq.shape[0])
+    agg_counts = np.bincount(inverse, weights=counts, minlength=uniq.shape[0]).astype(np.int64)
 
-    acc = _Accum(k, m)
+    regs: Optional[Regs] = None
+    if config.hll_columns:
+        regs = {}
+        for hcol in config.hll_columns:
+            d = segment.column(hcol).dictionary
+            bucket = np.zeros(d.cardinality, dtype=np.int64)
+            rho = np.zeros(d.cardinality, dtype=np.uint8)
+            for i in range(d.cardinality):
+                b, r = hll_mod.bucket_and_rho(hll_mod.value_hash64(d.get(i)))
+                bucket[i], rho[i] = b, r
+            fwd = segment.column(hcol).fwd
+            out = np.zeros((uniq.shape[0], hll_mod.M), dtype=np.uint8)
+            np.maximum.at(out, (inverse, bucket[fwd]), rho[fwd])
+            regs[hcol] = out
+
+    dims, sums, counts = uniq.astype(np.int32), agg_sums, agg_counts
+    dims, sums, counts, regs = _sort_lex(dims, sums, counts, regs, 0)
+
+    acc = _Accum(k, m, config.hll_columns)
     skip = set(config.skip_star_for_dims)
 
-    def split_node(dims_b, sums_b, counts_b, level: int, gstart: int) -> StarTreeNode:
+    def split_node(dims_b, sums_b, counts_b, regs_b, level: int, gstart: int) -> StarTreeNode:
         """Node over rows [gstart, gstart+len) of the flat table.
         Children reference subranges of the SAME block (records are
         stored once); only star children append new aggregated blocks."""
@@ -150,22 +213,23 @@ def build_star_tree(
         run_starts = np.concatenate([[0], boundaries])
         run_ends = np.concatenate([boundaries, [col.size]])
         for rs, re_ in zip(run_starts, run_ends):
+            rregs = {c: r[rs:re_] for c, r in regs_b.items()} if regs_b is not None else None
             node.children[int(col[rs])] = split_node(
-                dims_b[rs:re_], sums_b[rs:re_], counts_b[rs:re_], level + 1, gstart + rs
+                dims_b[rs:re_], sums_b[rs:re_], counts_b[rs:re_], rregs, level + 1, gstart + int(rs)
             )
         if split_order[level] not in skip:
             star_dims = dims_b.copy()
             star_dims[:, level] = STAR
-            sd, ss, sc = _aggregate(star_dims, sums_b, counts_b)
-            sd, ss, sc = _sort_lex(sd, ss, sc, level + 1)
-            sstart, _ = acc.append(sd, ss, sc)
-            node.star_child = split_node(sd, ss, sc, level + 1, sstart)
+            sd, ss, sc, sr = _aggregate(star_dims, sums_b, counts_b, regs_b)
+            sd, ss, sc, sr = _sort_lex(sd, ss, sc, sr, level + 1)
+            sstart, _ = acc.append(sd, ss, sc, sr)
+            node.star_child = split_node(sd, ss, sc, sr, level + 1, sstart)
         return node
 
-    base_start, _ = acc.append(dims, sums, counts)
-    root = split_node(dims, sums, counts, 0, base_start)
+    base_start, _ = acc.append(dims, sums, counts, regs)
+    root = split_node(dims, sums, counts, regs, 0, base_start)
 
-    flat_dims, flat_sums, flat_counts = acc.finalize()
+    flat_dims, flat_sums, flat_counts, flat_regs = acc.finalize()
     segment.star_tree = StarTreeIndex(
         split_order=split_order,
         metric_columns=metric_cols,
@@ -174,10 +238,13 @@ def build_star_tree(
         counts=flat_counts,
         root=root,
         max_leaf_records=config.max_leaf_records,
+        hll_columns=list(config.hll_columns),
+        hll_registers=flat_regs if config.hll_columns else {},
     )
     segment.metadata.custom["starTree"] = {
         "splitOrder": split_order,
         "maxLeafRecords": config.max_leaf_records,
         "numRecords": int(flat_dims.shape[0]),
+        "hllColumns": list(config.hll_columns),
     }
     return segment

@@ -64,6 +64,11 @@ class StarTreeIndex:
     counts: np.ndarray  # int64 [n_agg]
     root: StarTreeNode
     max_leaf_records: int
+    # HLL pre-aggregation (the derived-HLL-column capability,
+    # HllConfig/HllUtil analogs): per configured column, uint8 register
+    # arrays [n_agg, 256] merged with elementwise max.
+    hll_columns: List[str] = field(default_factory=list)
+    hll_registers: Dict[str, np.ndarray] = field(default_factory=dict)
 
     @property
     def num_records(self) -> int:

@@ -25,6 +25,7 @@ from pinot_tpu.common.values import render_value
 from pinot_tpu.engine.results import (
     AvgPartial,
     CountPartial,
+    HllPartial,
     IntermediateResult,
     SumPartial,
 )
@@ -58,9 +59,15 @@ def is_fit_for_star_tree(request: BrokerRequest, segment: ImmutableSegment) -> b
     if tree is None or not request.is_aggregation:
         return False
     for agg in request.aggregations:
-        if agg.is_mv or agg.base_function not in _FIT_AGGS:
+        if agg.is_mv:
             return False
-        if agg.column != "*" and agg.column not in tree.metric_columns:
+        base = agg.base_function
+        if base in ("distinctcounthll", "fasthll"):
+            if agg.column not in tree.hll_columns:
+                return False
+        elif base not in _FIT_AGGS:
+            return False
+        elif agg.column != "*" and agg.column not in tree.metric_columns:
             return False
     leaves = _conjunctive_eq_leaves(request.filter)
     if leaves is None:
@@ -142,6 +149,10 @@ def execute_star_tree(segment: ImmutableSegment, request: BrokerRequest) -> Inte
         base = agg.base_function
         if base == "count":
             return CountPartial(float(counts[sel].sum()))
+        if base in ("distinctcounthll", "fasthll"):
+            regs = tree.hll_registers[agg.column][rows[sel]]
+            merged = regs.max(axis=0) if regs.shape[0] else np.zeros(regs.shape[1], np.uint8)
+            return HllPartial(merged)
         mi = tree.metric_columns.index(agg.column)
         s = float(tree.sums[rows[sel], mi].sum())
         if base == "sum":

@@ -179,3 +179,48 @@ def test_builder_config_skip_star(data):
     )
     lvl = seg.star_tree.split_order.index("d1")
     assert not np.any(seg.star_tree.dims[:, lvl] == STAR)
+
+
+def test_hll_in_star_tree(tmp_path):
+    """distinctcounthll answered from the cube's pre-merged registers
+    (the HllConfig derived-column capability)."""
+    schema = Schema(
+        "sth",
+        dimensions=[
+            FieldSpec("dim", DataType.STRING),
+            FieldSpec("member", DataType.INT),  # high-card counted column
+        ],
+        metrics=[FieldSpec("m", DataType.INT, FieldType.METRIC)],
+    )
+    rows = random_rows(schema, 3000, seed=5, cardinality=400)
+    seg = build_segment(schema, rows, "sth", "hllseg")
+    build_star_tree(
+        seg,
+        schema,
+        StarTreeBuilderConfig(max_leaf_records=5, hll_columns=["member"]),
+    )
+    oracle = ScanQueryProcessor(schema, rows)
+    ex = QueryExecutor()
+
+    for pql in [
+        "SELECT distinctcounthll(member) FROM sth",
+        f"SELECT fasthll(member) FROM sth WHERE dim = '{rows[0]['dim']}'",
+        "SELECT distinctcounthll(member), count(*) FROM sth GROUP BY dim TOP 100",
+    ]:
+        req = optimize_request(parse_pql(pql))
+        assert is_fit_for_star_tree(req, seg), pql
+        got = reduce_to_response(req, [execute_star_tree(seg, req)]).to_json()
+        want = oracle.execute(optimize_request(parse_pql(pql))).to_json()
+        assert got["aggregationResults"] == want["aggregationResults"], pql
+
+    # full-table HLL comes from few pre-agg rows, not 3000 docs
+    req = parse_pql("SELECT distinctcounthll(member) FROM sth")
+    assert execute_star_tree(seg, req).num_docs_scanned < 100
+
+    # persists + reloads
+    write_segment(seg, str(tmp_path / "hllseg"))
+    loaded = read_segment(str(tmp_path / "hllseg"))
+    req = parse_pql("SELECT distinctcounthll(member) FROM sth")
+    a = reduce_to_response(req, [execute_star_tree(loaded, req)]).to_json()
+    b = oracle.execute(parse_pql("SELECT distinctcounthll(member) FROM sth")).to_json()
+    assert a["aggregationResults"] == b["aggregationResults"]
