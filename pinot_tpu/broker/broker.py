@@ -57,6 +57,9 @@ class BrokerRequestHandler:
         self.time_boundary = time_boundary or TimeBoundaryService()
         self.timeout_ms = timeout_ms
         self.metrics = BrokerMetrics(name)
+        from pinot_tpu.broker.quota import QueryQuotaManager
+
+        self.quota = QueryQuotaManager()
         self._request_id = 0
         self._id_lock = threading.Lock()
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=16)
@@ -90,6 +93,16 @@ class BrokerRequestHandler:
 
     def handle_request(self, request: BrokerRequest, pql: str) -> BrokerResponse:
         table = request.table_name
+        if not self.quota.allow(table):
+            self.metrics.meter("queriesDropped").mark()
+            return BrokerResponse(
+                exceptions=[
+                    QueryException(
+                        ErrorCode.TOO_MANY_REQUESTS,
+                        f"query rate on table {table} exceeds the configured quota",
+                    )
+                ]
+            )
         physical = self._physical_tables(table, pql)
         if not physical:
             return BrokerResponse(

@@ -1,0 +1,45 @@
+"""Per-table query rate limiting (QuotaConfig.maxQueriesPerSecond
+enforcement — the reference stores the quota in table config
+(``common/config/QuotaConfig``) and brokers enforce it)."""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class _TokenBucket:
+    def __init__(self, qps: float) -> None:
+        self.qps = qps
+        self.capacity = max(qps, 1.0)
+        self.tokens = self.capacity
+        self.last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def try_acquire(self) -> bool:
+        with self._lock:
+            now = time.monotonic()
+            self.tokens = min(self.capacity, self.tokens + (now - self.last) * self.qps)
+            self.last = now
+            if self.tokens >= 1.0:
+                self.tokens -= 1.0
+                return True
+            return False
+
+
+class QueryQuotaManager:
+    def __init__(self) -> None:
+        self._buckets: Dict[str, _TokenBucket] = {}
+        self._lock = threading.Lock()
+
+    def set_quota(self, table: str, qps: Optional[float]) -> None:
+        with self._lock:
+            if qps and qps > 0:
+                self._buckets[table] = _TokenBucket(qps)
+            else:
+                self._buckets.pop(table, None)
+
+    def allow(self, table: str) -> bool:
+        with self._lock:
+            bucket = self._buckets.get(table)
+        return bucket.try_acquire() if bucket is not None else True

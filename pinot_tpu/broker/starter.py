@@ -39,6 +39,11 @@ class BrokerStarter:
             self.broker.time_boundary.remove(table)
             return
         self.broker.routing.update(table, view)
+        config = self.resources.table_configs.get(table)
+        if config is not None:
+            self.broker.quota.set_quota(
+                config.raw_name, config.quota.max_queries_per_second
+            )
         if table.endswith(OFFLINE_SUFFIX):
             metas = []
             for seg in self.resources.segments_of(table):

@@ -1,0 +1,99 @@
+"""Per-process configuration.
+
+The reference layers config (SURVEY §5): per-process ``.properties``
+files via Commons Configuration (``ServerConf.java``,
+``ControllerConf.java:28``, ``DefaultHelixBrokerConfig``), with keys
+centralized in ``CommonConstants.java:26``; cluster state (table
+configs, schemas) lives in ZK as JSON; per-segment metadata.properties;
+per-query flags in the request.
+
+Here: typed dataclasses with the same key namespace, loadable from
+java-properties-style files or dicts.  Cluster state JSON lives with the
+controller (``tableconfig.py`` / ``schema.py``); per-segment metadata in
+the segment header; per-query flags on BrokerRequest.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, fields
+from typing import Any, Dict, Type, TypeVar
+
+T = TypeVar("T", bound="BaseConf")
+
+
+def parse_properties(text: str) -> Dict[str, str]:
+    """Parse java-properties-style ``key=value`` lines (# comments)."""
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        key, _, value = line.partition("=")
+        out[key.strip()] = value.strip()
+    return out
+
+
+class BaseConf:
+    PREFIX = ""
+
+    @classmethod
+    def from_dict(cls: Type[T], props: Dict[str, Any]) -> T:
+        kwargs: Dict[str, Any] = {}
+        for f in fields(cls):  # type: ignore[arg-type]
+            key = f"{cls.PREFIX}{f.name.replace('_', '.')}"
+            if key in props:
+                raw = props[key]
+                if f.type in ("int", int):
+                    kwargs[f.name] = int(raw)
+                elif f.type in ("float", float):
+                    kwargs[f.name] = float(raw)
+                elif f.type in ("bool", bool):
+                    kwargs[f.name] = str(raw).lower() in ("1", "true", "yes")
+                else:
+                    kwargs[f.name] = raw
+        return cls(**kwargs)  # type: ignore[call-arg]
+
+    @classmethod
+    def from_properties_file(cls: Type[T], path: str) -> T:
+        with open(path) as f:
+            return cls.from_dict(parse_properties(f.read()))
+
+
+@dataclass
+class ServerConf(BaseConf):
+    """pinot.server.* (ServerConf.java keys)."""
+
+    PREFIX = "pinot.server."
+
+    instance_id: str = "server0"
+    netty_port: int = 8098
+    query_executor_timeout_ms: int = 15_000  # ServerQueryExecutorV1Impl.java:58
+    query_worker_threads: int = 4
+    instance_data_dir: str = "/tmp/pinot_tpu/server/index"
+    instance_segment_tar_dir: str = "/tmp/pinot_tpu/server/tar"
+
+
+@dataclass
+class BrokerConf(BaseConf):
+    """pinot.broker.* (DefaultHelixBrokerConfig keys)."""
+
+    PREFIX = "pinot.broker."
+
+    instance_id: str = "broker0"
+    client_query_port: int = 8099
+    timeout_ms: int = 15_000
+    routing_table_count: int = 10
+    max_query_qps: float = 0.0  # 0 = unlimited (QuotaConfig enforcement)
+
+
+@dataclass
+class ControllerConf(BaseConf):
+    """controller.* (ControllerConf.java:28 keys)."""
+
+    PREFIX = "controller."
+
+    host: str = "127.0.0.1"
+    port: int = 9000
+    data_dir: str = "/tmp/pinot_tpu/controller/data"
+    retention_frequency_seconds: int = 3600
+    validation_frequency_seconds: int = 300
+    status_check_frequency_seconds: int = 300

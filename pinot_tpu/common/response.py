@@ -91,6 +91,7 @@ class ErrorCode:
     BROKER_TIMEOUT = 350
     BROKER_RESOURCE_MISSING = 410
     BROKER_INSTANCE_MISSING = 420
+    TOO_MANY_REQUESTS = 429
     INTERNAL = 450
     UNKNOWN = 1000
 

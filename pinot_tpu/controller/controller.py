@@ -99,6 +99,36 @@ class Controller:
         self.status_checker.stop()
 
 
+def _render_dashboard(ctrl: Controller) -> str:
+    """Ops status page (the pinot-dashboard Flask UI analog): instances,
+    tables, per-segment ideal vs external state."""
+    rows = []
+    rows.append("<h1>pinot_tpu cluster</h1>")
+    rows.append("<h2>Instances</h2><table border=1 cellpadding=4><tr><th>name</th><th>role</th><th>alive</th><th>url</th></tr>")
+    for inst in ctrl.resources.instances.values():
+        rows.append(
+            f"<tr><td>{inst.name}</td><td>{inst.role}</td><td>{inst.alive}</td><td>{inst.url or ''}</td></tr>"
+        )
+    rows.append("</table>")
+    for table in ctrl.resources.tables():
+        ideal = ctrl.resources.get_ideal_state(table)
+        view = ctrl.resources.get_external_view(table)
+        rows.append(f"<h2>{table}</h2>")
+        rows.append(
+            "<table border=1 cellpadding=4><tr><th>segment</th><th>ideal</th><th>external</th><th>docs</th></tr>"
+        )
+        for seg in sorted(ideal):
+            info = ctrl.resources.get_segment_metadata(table, seg) or {}
+            meta = info.get("metadata")
+            docs = meta.num_docs if meta is not None else ""
+            mark = "" if ideal[seg] == view.get(seg, {}) else " style='background:#fdd'"
+            rows.append(
+                f"<tr{mark}><td>{seg}</td><td>{ideal[seg]}</td><td>{view.get(seg, {})}</td><td>{docs}</td></tr>"
+            )
+        rows.append("</table>")
+    return "<html><body style='font-family:monospace'>" + "\n".join(rows) + "</body></html>"
+
+
 class ControllerHttpServer:
     """REST front (restlet resources analog): schemas, tables, segments,
     ideal/external views, health."""
@@ -122,10 +152,20 @@ class ControllerHttpServer:
                 n = int(self.headers.get("Content-Length", "0"))
                 return json.loads(self.rfile.read(n) or b"{}")
 
+            def _respond_html(self, html: str) -> None:
+                body = html.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 url = urlparse(self.path)
                 parts = [p for p in url.path.split("/") if p]
                 try:
+                    if not parts or parts == ["dashboard"]:
+                        return self._respond_html(_render_dashboard(ctrl))
                     if parts == ["health"]:
                         return self._respond({"status": "ok"})
                     if parts == ["brokers"]:

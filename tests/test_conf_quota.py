@@ -1,0 +1,64 @@
+"""Config system + query quota tests."""
+import time
+
+from pinot_tpu.common.conf import BrokerConf, ControllerConf, ServerConf, parse_properties
+from pinot_tpu.common.tableconfig import QuotaConfig
+from pinot_tpu.broker.quota import QueryQuotaManager
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.tools.cluster_harness import InProcessCluster
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+
+
+def test_parse_properties():
+    props = parse_properties(
+        """
+        # comment
+        pinot.server.netty.port=9999
+        pinot.server.query.executor.timeout.ms = 5000
+        controller.port=9001
+        """
+    )
+    assert props["pinot.server.netty.port"] == "9999"
+    assert props["pinot.server.query.executor.timeout.ms"] == "5000"
+
+
+def test_conf_from_dict():
+    conf = ServerConf.from_dict(
+        {"pinot.server.netty.port": "9999", "pinot.server.query.executor.timeout.ms": "5000"}
+    )
+    assert conf.netty_port == 9999
+    assert conf.query_executor_timeout_ms == 5000
+    assert conf.instance_id == "server0"  # default preserved
+
+    b = BrokerConf.from_dict({"pinot.broker.timeout.ms": "2000"})
+    assert b.timeout_ms == 2000
+    c = ControllerConf.from_dict({"controller.port": "9001"})
+    assert c.port == 9001
+
+
+def test_token_bucket_quota():
+    qm = QueryQuotaManager()
+    qm.set_quota("t", 2.0)  # 2 qps, burst 2
+    assert qm.allow("t")
+    assert qm.allow("t")
+    assert not qm.allow("t")  # bucket drained
+    assert qm.allow("other")  # unlimited table unaffected
+    qm.set_quota("t", None)
+    assert qm.allow("t")
+
+
+def test_quota_enforced_end_to_end(tmp_path):
+    cluster = InProcessCluster(num_servers=1, data_dir=str(tmp_path))
+    schema = make_test_schema(with_mv=False)
+    physical = cluster.add_offline_table(schema)
+    # set a tiny quota on the table config and re-notify brokers
+    cluster.controller.resources.table_configs[physical].quota = QuotaConfig(
+        max_queries_per_second=1.0
+    )
+    cluster.upload(physical, build_segment(schema, random_rows(schema, 10, seed=1), physical, "q1"))
+
+    ok = cluster.query("SELECT count(*) FROM testTable")
+    assert not ok.exceptions
+    # immediately again: bucket (capacity 1) is empty
+    limited = cluster.query("SELECT count(*) FROM testTable")
+    assert limited.exceptions and limited.exceptions[0].error_code == 429

@@ -184,3 +184,19 @@ def test_controller_http(tmp_path):
             assert json.loads(r.read())["segments"] == []
     finally:
         http.stop()
+
+
+def test_dashboard_page(tmp_path):
+    cluster, schema, physical = make_cluster(tmp=str(tmp_path))
+    rows = random_rows(schema, 30, seed=8)
+    cluster.upload(physical, build_segment(schema, rows, physical, "dash1"))
+    http = ControllerHttpServer(cluster.controller)
+    http.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{http.port}/", timeout=5) as r:
+            html = r.read().decode()
+        assert "pinot_tpu cluster" in html
+        assert "dash1" in html
+        assert "server0" in html
+    finally:
+        http.stop()
