@@ -200,3 +200,19 @@ def test_dashboard_page(tmp_path):
         assert "server0" in html
     finally:
         http.stop()
+
+
+def test_upload_refresh_replaces_segment(tmp_path):
+    """Re-uploading a segment with the same name refreshes data
+    (UploadRefreshDeleteIntegrationTest analog; CRC changes force reload)."""
+    cluster, schema, physical = make_cluster(num_servers=1, tmp=str(tmp_path))
+    rows_v1 = random_rows(schema, 60, seed=10)
+    cluster.upload(physical, build_segment(schema, rows_v1, physical, "refresh_me"))
+    assert cluster.query("SELECT count(*) FROM testTable").num_docs_scanned == 60
+
+    rows_v2 = random_rows(schema, 90, seed=11)
+    cluster.upload(physical, build_segment(schema, rows_v2, physical, "refresh_me"))
+    assert cluster.query("SELECT count(*) FROM testTable").num_docs_scanned == 90
+
+    cluster.controller.delete_segment(physical, "refresh_me")
+    assert cluster.query("SELECT count(*) FROM testTable").num_docs_scanned == 0
