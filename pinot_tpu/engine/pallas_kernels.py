@@ -1,0 +1,165 @@
+"""Pallas TPU kernels — fused hot-path experiments.
+
+The default engine path is plain XLA (gathers + masked reductions +
+one-hot matmul group-by), which XLA fuses well.  This module provides a
+hand-fused Pallas version of the hottest query shape — filtered
+multi-SUM group-by (TPC-H Q1) — keeping each row block's entire
+pipeline (match-table gather -> mask -> dictionary gather -> one-hot
+matmul accumulate) inside VMEM, one HBM read per forward-index element.
+
+Status: flag-gated (``PINOT_TPU_USE_PALLAS=1``), validated in
+interpret mode on CPU; intended for real-chip validation when TPU
+hardware is attached (dynamic VMEM gathers require a recent Mosaic).
+
+Layout: rows are processed in (8, 128)-aligned blocks; dictionary
+tables (match tables, value arrays, remaps) are small and live whole in
+VMEM; group sums accumulate into a [K_pad] VMEM scratch across grid
+steps and are written out on the last step.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pinot_tpu.engine import config
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    PALLAS_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    PALLAS_AVAILABLE = False
+
+BLOCK_ROWS = 8  # sublanes
+BLOCK_COLS = 128  # lanes
+BLOCK = BLOCK_ROWS * BLOCK_COLS
+
+
+def _pad_rows(n: int) -> int:
+    return -(-n // BLOCK) * BLOCK
+
+
+def use_pallas() -> bool:
+    import os
+
+    return PALLAS_AVAILABLE and os.environ.get("PINOT_TPU_USE_PALLAS") == "1"
+
+
+def fused_filtered_groupby_sums(
+    filter_fwd: jnp.ndarray,  # int32 [n]
+    match: jnp.ndarray,  # bool  [card_f]
+    valid: jnp.ndarray,  # bool  [n]
+    group_keys: jnp.ndarray,  # int32 [n] precombined mixed-radix keys
+    value_fwds: Sequence[jnp.ndarray],  # each int32 [n]
+    value_dicts: Sequence[jnp.ndarray],  # each float [card_v]
+    capacity: int,
+    interpret: bool = False,
+):
+    """Returns (num_docs, count[K], [sums[K] per value column]).
+
+    One fused pass: mask = match[filter_fwd] & valid; per value column
+    v = dict[v_fwd]; scatter via one-hot matmul into K buckets.
+    """
+    fdt = jnp.float32 if not config.x64_enabled() else jnp.float64
+    n = filter_fwd.shape[0]
+    n_pad = _pad_rows(n)
+    k_pad = max(128, -(-capacity // 128) * 128)
+    nv = len(value_fwds)
+
+    def pad1(x, fill=0):
+        return jnp.pad(x, (0, n_pad - n), constant_values=fill)
+
+    f2 = pad1(filter_fwd).reshape(-1, BLOCK_COLS)
+    valid2 = pad1(valid, False).reshape(-1, BLOCK_COLS)
+    keys2 = pad1(group_keys).reshape(-1, BLOCK_COLS)
+    vals2 = [pad1(v).reshape(-1, BLOCK_COLS) for v in value_fwds]
+    match_i = match.astype(fdt)
+    dicts = [d.astype(fdt) for d in value_dicts]
+
+    num_blocks = n_pad // BLOCK
+    grid = (num_blocks,)
+
+    def kernel(*refs):
+        # refs: f_ref, valid_ref, keys_ref, v_refs..., match_ref, d_refs...,
+        #       out_docs, out_count, out_sums, acc_scratch
+        f_ref = refs[0]
+        valid_ref = refs[1]
+        keys_ref = refs[2]
+        v_refs = refs[3 : 3 + nv]
+        match_ref = refs[3 + nv]
+        d_refs = refs[4 + nv : 4 + 2 * nv]
+        out_docs = refs[4 + 2 * nv]
+        out_count = refs[5 + 2 * nv]
+        out_sums = refs[6 + 2 * nv]
+        acc = refs[7 + 2 * nv]  # VMEM scratch [nv + 2, k_pad]
+
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            acc[:, :] = jnp.zeros((nv + 2, k_pad), dtype=fdt)
+
+        fidx = f_ref[:, :]  # [8, 128] int32
+        mask = (match_ref[fidx] > 0) & valid_ref[:, :]
+        maskf = mask.astype(fdt)
+
+        keys = keys_ref[:, :]
+        flat_keys = keys.reshape(-1)
+        flat_mask = maskf.reshape(-1)
+        onehot = (
+            flat_keys[:, None]
+            == jax.lax.broadcasted_iota(jnp.int32, (1, k_pad), 1)
+        ).astype(fdt)  # [BLOCK, k_pad]
+        onehot = onehot * flat_mask[:, None]
+
+        # docs + count rows
+        acc[0, :] = acc[0, :] + jnp.zeros(k_pad, fdt).at[0].add(jnp.sum(maskf))
+        acc[1, :] = acc[1, :] + jnp.sum(onehot, axis=0)
+        for i in range(nv):
+            vals = d_refs[i][v_refs[i][:, :]].reshape(-1)  # gather + flatten
+            acc[2 + i, :] = acc[2 + i, :] + jnp.dot(
+                vals, onehot, preferred_element_type=fdt
+            )
+
+        @pl.when(step == num_blocks - 1)
+        def _emit():
+            out_docs[0, 0] = acc[0, 0]
+            out_count[0, :] = acc[1, :]
+            out_sums[:, :] = acc[2:, :]
+
+    row_spec = pl.BlockSpec(
+        (BLOCK_ROWS, BLOCK_COLS), lambda b: (b, 0), memory_space=pltpu.VMEM
+    )
+    table_spec = pl.BlockSpec(memory_space=pltpu.VMEM)
+
+    out_docs, out_count, out_sums = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[row_spec, row_spec, row_spec]
+        + [row_spec] * nv
+        + [table_spec]
+        + [table_spec] * nv,
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda b: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, k_pad), lambda b: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((nv, k_pad), lambda b: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), fdt),
+            jax.ShapeDtypeStruct((1, k_pad), fdt),
+            jax.ShapeDtypeStruct((nv, k_pad), fdt),
+        ],
+        scratch_shapes=[pltpu.VMEM((nv + 2, k_pad), fdt)],
+        interpret=interpret,
+    )(f2, valid2, keys2, *vals2, match_i, *dicts)
+
+    return (
+        out_docs[0, 0],
+        out_count[0, :capacity],
+        [out_sums[i, :capacity] for i in range(nv)],
+    )

@@ -19,14 +19,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax
 
-try:  # drop the axon TPU backend factory before any backend init
-    from jax._src import xla_bridge as _xb
-
-    for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name not in ("cpu",):
-            _xb._backend_factories.pop(_name, None)
-except Exception:
-    pass
-
+# The sitecustomize force-sets JAX_PLATFORMS=axon before conftest runs;
+# updating the config (not just the env) keeps backend init CPU-only so
+# the axon PJRT client (TPU tunnel) is never dialed. The axon factory
+# stays *registered* — pallas and mlir need the platform names known.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
