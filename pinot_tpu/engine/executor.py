@@ -52,9 +52,18 @@ class QueryExecutor:
     single-device kernel runs.
     """
 
-    def __init__(self, mesh=None) -> None:
+    def __init__(self, mesh=None, metrics=None) -> None:
         self.mesh = mesh
+        self.metrics = metrics  # optional MetricsRegistry: per-phase timers
         self._sharded_kernels: Dict[Any, Any] = {}
+
+    def _phase(self, name: str, t0: float) -> float:
+        """Record a ServerQueryPhase-style timer (SURVEY §5: pruning /
+        planBuild / planExec phases); returns a fresh t0."""
+        now = time.perf_counter()
+        if self.metrics is not None:
+            self.metrics.timer(f"phase.{name}").update((now - t0) * 1000)
+        return now
 
     def execute(
         self, segments: Sequence[ImmutableSegment], request: BrokerRequest
@@ -88,6 +97,7 @@ class QueryExecutor:
     def _execute_engine(
         self, live: List[ImmutableSegment], request: BrokerRequest
     ) -> IntermediateResult:
+        t0 = time.perf_counter()
         total_docs = sum(s.num_docs for s in live)
         needed = set(request.referenced_columns())
         sel_columns: Optional[List[str]] = None
@@ -102,6 +112,7 @@ class QueryExecutor:
 
         ctx = get_table_context(live)
         staged = get_staged(live, sorted(needed), pad_segments_to=pad_to)
+        t0 = self._phase("staging", t0)
         plan = build_static_plan(request, ctx, staged)
 
         if not plan.on_device:
@@ -111,11 +122,15 @@ class QueryExecutor:
 
         q_inputs = self._to_device_inputs(build_query_inputs(request, plan, ctx, staged))
         seg_arrays = self._segment_arrays(plan, staged, needed)
+        t0 = self._phase("planBuild", t0)
         kernel = self._kernel(plan)
         outs = kernel(seg_arrays, q_inputs)
         outs = {k: np.asarray(v) if not isinstance(v, tuple) else tuple(np.asarray(x) for x in v) for k, v in outs.items()}
+        t0 = self._phase("planExec", t0)
 
-        return self._finalize(request, plan, ctx, staged, live, outs, total_docs, sel_columns)
+        result = self._finalize(request, plan, ctx, staged, live, outs, total_docs, sel_columns)
+        self._phase("finalize", t0)
+        return result
 
     def _kernel(self, plan: StaticPlan):
         if self.mesh is None:

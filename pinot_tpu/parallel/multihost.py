@@ -1,0 +1,73 @@
+"""Multi-host (DCN + ICI) execution topology.
+
+The reference scales across machines with Netty/TCP scatter-gather +
+Helix (SURVEY §5 "Distributed communication backend").  The TPU-native
+layering here is:
+
+  1. Within one server process's chip slice: 1-D ``segments`` mesh,
+     collectives over **ICI** (``multichip.py``).
+  2. Across hosts of ONE pod slice: jax's distributed runtime — a 2-D
+     ``(hosts, chips)`` mesh where the segment axis spans both; XLA
+     routes the reductions over ICI within a host and **DCN** across
+     hosts.  ``initialize_distributed`` + ``make_multihost_mesh`` set
+     this up; the same shard_map kernel runs unchanged because it only
+     names the flattened ``segments`` axis.
+  3. Across pods / regions: stays the broker scatter-gather path (TCP,
+     ``pinot_tpu.broker``) — partial aggregates are small and
+     latency-tolerant, which is exactly what the reference's
+     DataTable-over-TCP layer is for.
+
+Only (1) is executable in this environment (one chip / virtual CPU
+devices); (2) is validated structurally — ``make_multihost_mesh``
+produces the 2-D mesh and the kernels accept it by flattening to the
+segment axis — and with a real multi-host slice it activates via
+``jax.distributed.initialize``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from pinot_tpu.parallel.multichip import SEGMENT_AXIS
+
+HOST_AXIS = "hosts"
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up jax's distributed runtime (multi-host).  No-op when
+    single-process (the common case in this environment)."""
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_multihost_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """2-D (hosts, chips-per-host) mesh; reductions cross DCN on the
+    host axis and ICI on the chip axis."""
+    devs = list(devices) if devices is not None else jax.devices()
+    by_process: dict = {}
+    for d in devs:
+        by_process.setdefault(d.process_index, []).append(d)
+    num_hosts = len(by_process)
+    per_host = min(len(v) for v in by_process.values())
+    grid = np.array(
+        [sorted(v, key=lambda d: d.id)[:per_host] for _, v in sorted(by_process.items())]
+    )
+    return Mesh(grid, (HOST_AXIS, SEGMENT_AXIS))
+
+
+def flatten_to_segment_mesh(mesh: Mesh) -> Mesh:
+    """Collapse a (hosts, chips) mesh into the 1-D segments mesh the
+    query kernels shard over (XLA still routes per-link appropriately)."""
+    return Mesh(mesh.devices.reshape(-1), (SEGMENT_AXIS,))

@@ -35,9 +35,9 @@ class ServerInstance:
     def __init__(self, name: str = "server0", mesh=None, num_workers: int = 4) -> None:
         self.name = name
         self.data_manager = InstanceDataManager()
-        self.executor = QueryExecutor(mesh=mesh)
-        self.scheduler = QueryScheduler(num_workers=num_workers)
         self.metrics = ServerMetrics(name)
+        self.executor = QueryExecutor(mesh=mesh, metrics=self.metrics)
+        self.scheduler = QueryScheduler(num_workers=num_workers)
 
     # -- segment lifecycle -------------------------------------------
     def add_segment(self, table: str, segment: ImmutableSegment) -> None:

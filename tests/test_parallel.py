@@ -79,3 +79,39 @@ def test_sharded_matches_single_device(setup, pql):
     a = reduce_to_response(req_a, [QueryExecutor(mesh=mesh).execute(segments, req_a)])
     b = reduce_to_response(req_b, [QueryExecutor().execute(segments, req_b)])
     assert a.to_json() == b.to_json()
+
+
+def test_multihost_mesh_shapes(setup):
+    """2-D (hosts, chips) mesh construction + flattening (structural
+    validation of the DCN/ICI layering; single-process here)."""
+    from pinot_tpu.parallel.multihost import flatten_to_segment_mesh, make_multihost_mesh
+
+    mesh = make_multihost_mesh()
+    assert mesh.devices.size == 8
+    assert mesh.axis_names[-1] == "segments"
+    flat = flatten_to_segment_mesh(mesh)
+    assert flat.devices.shape == (8,)
+
+    # the query kernel runs on the flattened mesh unchanged
+    _, _, segments, _ = setup
+    from pinot_tpu.engine.executor import QueryExecutor
+    from pinot_tpu.engine.reduce import reduce_to_response
+    from pinot_tpu.pql import parse_pql
+
+    req = parse_pql("SELECT count(*) FROM testTable")
+    resp = reduce_to_response(req, [QueryExecutor(mesh=flat).execute(segments, req)])
+    assert resp.num_docs_scanned == 900
+
+
+def test_phase_timers_recorded(setup):
+    from pinot_tpu.engine.executor import QueryExecutor
+    from pinot_tpu.pql import parse_pql
+    from pinot_tpu.utils.metrics import ServerMetrics
+
+    _, _, segments, _ = setup
+    metrics = ServerMetrics("phased")
+    ex = QueryExecutor(metrics=metrics)
+    ex.execute(segments, parse_pql("SELECT sum(metInt) FROM testTable GROUP BY dimStr"))
+    snap = metrics.snapshot()
+    for phase in ("phase.staging", "phase.planBuild", "phase.planExec", "phase.finalize"):
+        assert snap["timers"][phase]["count"] >= 1
