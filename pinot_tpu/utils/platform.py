@@ -1,0 +1,48 @@
+"""Backend forcing for virtual-CPU-mesh validation runs.
+
+Multi-chip sharding is validated on a virtual CPU device mesh
+(``--xla_force_host_platform_device_count``) because only one real TPU
+chip is reachable (SURVEY §7 stage 4; the driver's ``dryrun_multichip``
+contract). The container's sitecustomize force-sets
+``JAX_PLATFORMS=axon`` before any user code runs, so plain env vars
+from a caller are not enough — the jax *config* must be updated before
+the first backend initialization.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_mesh(n_devices: int) -> bool:
+    """Arrange for jax to come up on the CPU platform with at least
+    ``n_devices`` virtual devices.
+
+    Must run before the first jax backend initialization in this
+    process. Returns True if the platform config was (or already is)
+    CPU-forcible; False if backends already initialized on another
+    platform (too late — the caller should fail with a clear message).
+    """
+    import jax
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        # Too late to change platform or device count; don't touch the
+        # env either (subprocesses should inherit the true state).
+        return jax.default_backend() == "cpu" and len(jax.devices()) >= n_devices
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{re.escape(_COUNT_FLAG)}=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = re.sub(
+            rf"{re.escape(_COUNT_FLAG)}=\d+", f"{_COUNT_FLAG}={n_devices}", flags
+        )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # The axon factory stays *registered* (pallas + mlir need the platform
+    # names known); this only keeps its PJRT client from being dialed.
+    jax.config.update("jax_platforms", "cpu")
+    return True

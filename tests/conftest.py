@@ -7,21 +7,17 @@ reference's double-precision aggregation semantics exactly.
 
 The container's sitecustomize force-registers the experimental 'axon'
 TPU backend (tunnel to the real chip) before conftest runs; its PJRT
-client init can block, so the factory is dropped here — tests are
-CPU-only by design.
+client init can block, so ``force_cpu_mesh`` updates the jax config
+(not just the env) before first backend init — tests are CPU-only by
+design.
 """
-import os
+from pinot_tpu.utils.platform import force_cpu_mesh
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+assert force_cpu_mesh(8), (
+    "jax backends initialized before conftest; tests must come up on a "
+    "virtual 8-device CPU mesh, not the axon TPU tunnel"
+)
 
 import jax
 
-# The sitecustomize force-sets JAX_PLATFORMS=axon before conftest runs;
-# updating the config (not just the env) keeps backend init CPU-only so
-# the axon PJRT client (TPU tunnel) is never dialed. The axon factory
-# stays *registered* — pallas and mlir need the platform names known.
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
