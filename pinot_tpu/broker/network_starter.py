@@ -1,0 +1,120 @@
+"""Networked broker starter: a broker process joining a remote controller.
+
+The in-process ``BrokerStarter`` gets external-view callbacks directly;
+this variant polls the controller's versioned cluster-state snapshot
+(the ZK-watch analog of ``HelixBrokerStarter.java:57`` +
+``ClusterChangeMediator``) and rebuilds:
+
+- per-table routing tables (one random ONLINE replica per segment),
+- the server-name -> TCP-address map used by scatter-gather,
+- hybrid time boundaries and per-table query quotas.
+
+Queries ride the same path as in-process deployments: HTTP front ->
+``BrokerRequestHandler`` -> TCP scatter-gather -> reduce.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.request
+from typing import Any, Dict, Optional
+
+from pinot_tpu.broker.broker import BrokerHttpServer, BrokerRequestHandler
+from pinot_tpu.transport.tcp import TcpTransport
+
+logger = logging.getLogger(__name__)
+
+
+class NetworkedBrokerStarter:
+    def __init__(
+        self,
+        controller_url: str,
+        name: str = "broker0",
+        host: str = "127.0.0.1",
+        port: int = 0,
+        heartbeat_interval_s: float = 1.0,
+        poll_interval_s: float = 0.3,
+    ) -> None:
+        self.controller_url = controller_url.rstrip("/")
+        self.name = name
+        self.handler = BrokerRequestHandler(TcpTransport(), {}, name=name)
+        self.http = BrokerHttpServer(self.handler, host=host, port=port)
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.poll_interval_s = poll_interval_s
+        self._version = -1
+        self._stop = threading.Event()
+        self._threads: list = []
+
+    def _post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        req = urllib.request.Request(
+            self.controller_url + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    def _get(self, path: str) -> Dict[str, Any]:
+        with urllib.request.urlopen(self.controller_url + path, timeout=10) as r:
+            return json.loads(r.read())
+
+    def start(self) -> None:
+        self.http.start()
+        self._register()
+        self._refresh(force=True)
+        for fn in (self._heartbeat_loop, self._poll_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self.http.stop()
+
+    def _register(self) -> None:
+        self._post(
+            "/instances",
+            {
+                "name": self.name,
+                "role": "broker",
+                "url": f"http://{self.http.host}:{self.http.port}",
+            },
+        )
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval_s):
+            try:
+                out = self._post(f"/instances/{self.name}/heartbeat", {})
+                if out.get("reregister"):
+                    self._register()
+            except Exception as e:
+                logger.warning("heartbeat to controller failed: %s", e)
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self._refresh()
+            except Exception as e:
+                logger.warning("cluster-state poll failed: %s", e)
+
+    def _refresh(self, force: bool = False) -> None:
+        state = self._get(f"/clusterstate?ifNewer={-1 if force else self._version}")
+        if state.get("unchanged"):
+            return
+        self._version = state["version"]
+        for server, addr in state["servers"].items():
+            self.handler.set_server_address(server, (addr[0], int(addr[1])))
+        known = set(self.handler.routing.tables())
+        for table, view in state["tables"].items():
+            self.handler.routing.update(table, view)
+            known.discard(table)
+        for stale in known:
+            self.handler.routing.remove(stale)
+            self.handler.time_boundary.remove(stale)
+        for table, (col, value) in state.get("timeBoundaries", {}).items():
+            self.handler.time_boundary.set(table, col, value)
+        for table, q in state.get("quotas", {}).items():
+            self.handler.quota.set_quota(q["rawName"], q.get("maxQueriesPerSecond"))

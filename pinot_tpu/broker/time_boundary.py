@@ -14,6 +14,24 @@ from typing import Dict, Iterable, Optional, Tuple
 from pinot_tpu.segment.immutable import SegmentMetadata
 
 
+def compute_boundary(
+    segment_metas: Iterable[SegmentMetadata],
+) -> Optional[Tuple[str, int]]:
+    """(time column, max end time) over the offline segments, or None —
+    the single definition of the hybrid boundary rule, shared by the
+    in-process listener path and the networked cluster-state snapshot."""
+    col: Optional[str] = None
+    max_end: Optional[int] = None
+    for meta in segment_metas:
+        if meta.time_column is None or meta.end_time is None:
+            continue
+        col = meta.time_column
+        max_end = meta.end_time if max_end is None else max(max_end, meta.end_time)
+    if col is None or max_end is None:
+        return None
+    return (col, max_end)
+
+
 class TimeBoundaryService:
     def __init__(self) -> None:
         self._boundaries: Dict[str, Tuple[str, int]] = {}
@@ -22,16 +40,10 @@ class TimeBoundaryService:
     def update_from_segments(
         self, offline_table: str, segment_metas: Iterable[SegmentMetadata]
     ) -> None:
-        col: Optional[str] = None
-        max_end: Optional[int] = None
-        for meta in segment_metas:
-            if meta.time_column is None or meta.end_time is None:
-                continue
-            col = meta.time_column
-            max_end = meta.end_time if max_end is None else max(max_end, meta.end_time)
-        if col is not None and max_end is not None:
+        boundary = compute_boundary(segment_metas)
+        if boundary is not None:
             with self._lock:
-                self._boundaries[offline_table] = (col, max_end)
+                self._boundaries[offline_table] = boundary
 
     def set(self, offline_table: str, column: str, value: int) -> None:
         with self._lock:

@@ -42,6 +42,11 @@ class Controller:
         self.realtime_manager = RealtimeSegmentManager(self.resources, self.store)
         self.validation_manager.realtime_manager = self.realtime_manager
 
+        from pinot_tpu.controller.network import ParticipantGateway
+
+        # remote-instance control plane (started by ControllerHttpServer)
+        self.gateway = ParticipantGateway(self.resources)
+
         if start_managers:
             self.retention_manager.start()
             self.validation_manager.start()
@@ -160,6 +165,13 @@ class ControllerHttpServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _respond_bytes(self, data: bytes) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_GET(self):
                 url = urlparse(self.path)
                 parts = [p for p in url.path.split("/") if p]
@@ -168,6 +180,34 @@ class ControllerHttpServer:
                         return self._respond_html(_render_dashboard(ctrl))
                     if parts == ["health"]:
                         return self._respond({"status": "ok"})
+                    if parts == ["clusterstate"]:
+                        qs = parse_qs(url.query)
+                        if_newer = int((qs.get("ifNewer") or ["-1"])[0])
+                        if ctrl.resources.version <= if_newer:
+                            return self._respond(
+                                {"version": ctrl.resources.version, "unchanged": True}
+                            )
+                        return self._respond(ctrl.gateway.cluster_state())
+                    if len(parts) == 3 and parts[0] == "instances" and parts[2] == "messages":
+                        return self._respond({"messages": ctrl.gateway.messages(parts[1])})
+                    if (
+                        len(parts) == 4
+                        and parts[0] == "segments"
+                        and parts[3] == "file"
+                    ):
+                        # raw segment download: GET /segments/{table}/{seg}/file
+                        # (the download-URL-in-ZK-metadata analog)
+                        import os
+
+                        from pinot_tpu.segment.format import SEGMENT_FILE_NAME
+
+                        path = os.path.join(
+                            ctrl.store.segment_dir(parts[1], parts[2]), SEGMENT_FILE_NAME
+                        )
+                        if not os.path.exists(path):
+                            return self._respond({"error": "not found"}, 404)
+                        with open(path, "rb") as f:
+                            return self._respond_bytes(f.read())
                     if parts == ["brokers"]:
                         return self._respond(
                             {
@@ -201,6 +241,12 @@ class ControllerHttpServer:
                 url = urlparse(self.path)
                 parts = [p for p in url.path.split("/") if p]
                 try:
+                    if parts == ["instances"]:
+                        return self._respond(ctrl.gateway.register(self._read_json()))
+                    if len(parts) == 3 and parts[0] == "instances" and parts[2] == "heartbeat":
+                        return self._respond(ctrl.gateway.heartbeat(parts[1]))
+                    if len(parts) == 3 and parts[0] == "instances" and parts[2] == "ack":
+                        return self._respond(ctrl.gateway.ack(parts[1], self._read_json()))
                     if parts == ["schemas"]:
                         schema = Schema.from_json(self._read_json())
                         ctrl.add_schema(schema)
@@ -235,14 +281,17 @@ class ControllerHttpServer:
                     return self._respond({"error": str(e)}, 400)
 
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._controller = controller
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
+        self._controller.gateway.start()
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
+        self._controller.gateway.stop()
         self._httpd.shutdown()
         self._httpd.server_close()

@@ -1,0 +1,267 @@
+"""Networked control plane: the ZooKeeper/Helix transport analog.
+
+The reference cluster runs controller, brokers, and servers as separate
+processes coordinated through ZooKeeper: the controller writes ideal
+state, Helix delivers transition *messages* to participant servers,
+servers execute them and write their *current state*, and brokers watch
+external views to rebuild routing (``HelixServerStarter.java:63``,
+``HelixBrokerStarter.java:57``, ``HelixExternalViewBasedRouting.java:65``).
+
+This module provides the same split over plain HTTP, with the
+controller playing ZooKeeper's role as the rendezvous point:
+
+- ``MessageBoard`` — per-instance queues of transition messages (the
+  Helix message paths in ZK).
+- ``RemoteParticipant`` — the controller-side stub for a server living
+  in another process: enqueues messages and returns "pending"; the
+  server reports resulting state via ``ClusterResourceManager.
+  report_state`` (the CurrentState write).
+- ``ParticipantGateway`` — registration, heartbeat-based liveness (the
+  ZK-session-timeout analog), message fetch/ack, and a versioned
+  cluster-state snapshot that remote brokers poll (the watch analog).
+
+Endpoints are mounted on ``ControllerHttpServer``; the wire format is
+JSON everywhere except segment downloads (raw bytes).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from pinot_tpu.controller.resource_manager import (
+    CONSUMING,
+    ClusterResourceManager,
+    DROPPED,
+    ERROR,
+    InstanceState,
+    OFFLINE,
+    ONLINE,
+    Participant,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class MessageBoard:
+    """Per-instance FIFO of transition messages awaiting pickup.
+
+    At-least-once delivery, as Helix messages in ZK: ``fetch`` peeks
+    (the message stays queued until the server acks it by id), so a
+    response lost on the wire is simply redelivered on the next poll.
+    Transitions are idempotent on the server side (CRC-skip load,
+    idempotent remove), which makes redelivery safe."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._queues: Dict[str, List[Dict[str, Any]]] = {}
+        self._next_id = 0
+
+    def post(self, instance: str, msg: Dict[str, Any]) -> int:
+        with self._lock:
+            self._next_id += 1
+            msg = dict(msg, msgId=self._next_id)
+            self._queues.setdefault(instance, []).append(msg)
+            return self._next_id
+
+    def fetch(self, instance: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._queues.get(instance, []))
+
+    def remove(self, instance: str, msg_id: Optional[int]) -> None:
+        if msg_id is None:
+            return
+        with self._lock:
+            q = self._queues.get(instance)
+            if q is not None:
+                self._queues[instance] = [m for m in q if m["msgId"] != msg_id]
+
+    def clear(self, instance: str) -> None:
+        with self._lock:
+            self._queues.pop(instance, None)
+
+
+class RemoteParticipant(Participant):
+    """Controller-side stub for a server process reachable over HTTP.
+
+    Transition requests become queued messages; the participant answers
+    "pending" (None) and the server's ack later lands in
+    ``report_state``. CONSUMING is refused for now: networked realtime
+    consumption needs the stream config shipped to the server, which the
+    in-process deployment covers (see realtime/llc.py).
+    """
+
+    def __init__(self, name: str, board: MessageBoard) -> None:
+        super().__init__(name, self._enqueue)
+        self.board = board
+
+    def _enqueue(
+        self, table: str, segment: str, target: str, info: Dict[str, Any]
+    ) -> Optional[bool]:
+        if target == CONSUMING:
+            logger.warning(
+                "remote participant %s cannot host CONSUMING segment %s/%s",
+                self.name, table, segment,
+            )
+            return False
+        meta = info.get("metadata")
+        self.board.post(
+            self.name,
+            {
+                "type": "transition",
+                "table": table,
+                "segment": segment,
+                "target": target,
+                "crc": getattr(meta, "crc", None),
+            },
+        )
+        return None
+
+
+class ParticipantGateway:
+    """Controller-side state for remote instances: registration,
+    heartbeats, liveness, messages, and broker-facing cluster state."""
+
+    def __init__(
+        self,
+        resources: ClusterResourceManager,
+        heartbeat_timeout_s: float = 6.0,
+        check_interval_s: float = 1.0,
+    ) -> None:
+        self.resources = resources
+        self.board = MessageBoard()
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self._check_interval_s = check_interval_s
+        self._heartbeats: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self._check_interval_s):
+            now = time.monotonic()
+            with self._lock:
+                expired = [
+                    name
+                    for name, ts in self._heartbeats.items()
+                    if now - ts > self.heartbeat_timeout_s
+                ]
+            for name in expired:
+                inst = self.resources.instances.get(name)
+                if inst is not None and inst.alive:
+                    logger.warning("instance %s missed heartbeats; marking dead", name)
+                    self.board.clear(name)
+                    self.resources.set_instance_alive(name, False)
+
+    # -- instance API (called from HTTP handlers) ----------------------
+    def register(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        name = payload["name"]
+        role = payload.get("role", "server")
+        state = InstanceState(
+            name,
+            role=role,
+            url=payload.get("url"),
+            addr=tuple(payload["addr"]) if payload.get("addr") else None,
+            tags=set(payload.get("tags") or ["DefaultTenant"]),
+        )
+        participant = RemoteParticipant(name, self.board) if role == "server" else None
+        with self._lock:
+            self._heartbeats[name] = time.monotonic()
+        known = name in self.resources.instances
+        self.resources.register_instance(state, participant)
+        if known and role == "server":
+            # re-registration after a crash: replay ideal state (the
+            # fresh InstanceState is already alive, so going through
+            # set_instance_alive would no-op)
+            self.resources.reconcile_instance(name)
+        return {
+            "status": "ok",
+            "heartbeatTimeoutSeconds": self.heartbeat_timeout_s,
+        }
+
+    def heartbeat(self, name: str) -> Dict[str, Any]:
+        inst = self.resources.instances.get(name)
+        if inst is None:
+            return {"error": "unknown instance", "reregister": True}
+        with self._lock:
+            self._heartbeats[name] = time.monotonic()
+        if not inst.alive:
+            self.resources.set_instance_alive(name, True)
+        return {"status": "ok"}
+
+    def messages(self, name: str) -> List[Dict[str, Any]]:
+        return self.board.fetch(name)
+
+    def ack(self, name: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        self.board.remove(name, payload.get("msgId"))
+        state = payload["state"] if payload.get("ok", True) else ERROR
+        self.resources.report_state(
+            name, payload["table"], payload["segment"], state
+        )
+        return {"status": "ok"}
+
+    # -- broker API ----------------------------------------------------
+    def cluster_state(self) -> Dict[str, Any]:
+        """Versioned snapshot remote brokers poll to rebuild routing,
+        server addresses, quotas, and hybrid time boundaries."""
+        res = self.resources
+        with res._lock:
+            # version captured BEFORE the snapshot: a concurrent bump then
+            # makes the broker refetch (at-least-once), never miss forever
+            version = res.version
+            instances = dict(res.instances)
+            configs = dict(res.table_configs)
+        tables: Dict[str, Any] = {}
+        boundaries: Dict[str, Any] = {}
+        quotas: Dict[str, Any] = {}
+        for table in res.tables():
+            view = res.get_external_view(table)
+            # hide dead servers from routing, as _notify_view does
+            tables[table] = {
+                seg: {
+                    srv: st
+                    for srv, st in replicas.items()
+                    if instances.get(srv) is not None and instances[srv].alive
+                }
+                for seg, replicas in view.items()
+            }
+            config = configs.get(table)
+            if config is not None:
+                quotas[table] = {
+                    "rawName": config.raw_name,
+                    "maxQueriesPerSecond": config.quota.max_queries_per_second,
+                }
+            if table.endswith("_OFFLINE"):
+                from pinot_tpu.broker.time_boundary import compute_boundary
+
+                metas = []
+                for seg in res.segments_of(table):
+                    info = res.get_segment_metadata(table, seg)
+                    if info and info.get("metadata") is not None:
+                        metas.append(info["metadata"])
+                boundary = compute_boundary(metas)
+                if boundary is not None:
+                    boundaries[table] = list(boundary)
+        servers = {
+            name: list(inst.addr)
+            for name, inst in instances.items()
+            if inst.role == "server" and inst.alive and inst.addr is not None
+        }
+        return {
+            "version": version,
+            "tables": tables,
+            "servers": servers,
+            "quotas": quotas,
+            "timeBoundaries": boundaries,
+        }

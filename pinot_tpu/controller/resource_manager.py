@@ -48,18 +48,24 @@ class InstanceState:
     alive: bool = True
     tags: Set[str] = field(default_factory=lambda: {"DefaultTenant"})
     url: Optional[str] = None  # broker HTTP url (client discovery)
+    addr: Optional[Tuple[str, int]] = None  # server query-TCP endpoint
 
 
 class Participant:
-    """Server-side transition executor registered with the controller."""
+    """Server-side transition executor registered with the controller.
+
+    ``on_transition`` returns True (done), False (failed -> ERROR), or
+    None (pending — a remote participant queued the work and will report
+    the resulting state later via ``report_state``, the Helix
+    message+current-state split)."""
 
     def __init__(
         self,
         name: str,
-        on_transition: Callable[[str, str, str, Dict[str, Any]], bool],
+        on_transition: Callable[[str, str, str, Dict[str, Any]], Optional[bool]],
     ) -> None:
         self.name = name
-        # on_transition(table, segment, target_state, metadata) -> ok
+        # on_transition(table, segment, target_state, metadata) -> ok|None
         self.on_transition = on_transition
 
 
@@ -75,6 +81,14 @@ class ClusterResourceManager:
         self._participants: Dict[str, Participant] = {}
         self._view_listeners: List[Callable[[str, Dict[str, Dict[str, str]]], None]] = []
         self._assign_rr = 0
+        # monotonically bumped on every view/instance change; remote
+        # brokers poll it to decide when to rebuild routing
+        self.version = 0
+
+    def bump_version(self) -> int:
+        with self._lock:
+            self.version += 1
+            return self.version
 
     # -- instances ----------------------------------------------------
     def register_instance(self, state: InstanceState, participant: Optional[Participant] = None) -> None:
@@ -82,6 +96,7 @@ class ClusterResourceManager:
             self.instances[state.name] = state
             if participant is not None:
                 self._participants[state.name] = participant
+        self.bump_version()
 
     def set_instance_alive(self, name: str, alive: bool) -> None:
         """Liveness flip (the ZK-session-loss analog): a dead server's
@@ -106,6 +121,12 @@ class ClusterResourceManager:
         if alive:
             self._reconcile_instance(name)
 
+    def reconcile_instance(self, name: str) -> None:
+        """Replay this instance's ideal-state transitions (used on
+        participant re-registration, where the fresh InstanceState is
+        already alive so set_instance_alive would no-op)."""
+        self._reconcile_instance(name)
+
     def _reconcile_instance(self, name: str) -> None:
         """On instance (re)start: replay its ideal-state transitions."""
         with self._lock:
@@ -124,6 +145,7 @@ class ClusterResourceManager:
             self._view_listeners.append(fn)
 
     def _notify_view(self, table: str) -> None:
+        self.bump_version()
         with self._lock:
             view = {
                 seg: {
@@ -224,14 +246,19 @@ class ClusterResourceManager:
             participant = self._participants.get(server)
             info = self.segment_metadata.get((table, segment), {})
             view = self.external_views.setdefault(table, {}).setdefault(segment, {})
-        ok = False
+        ok: Optional[bool] = False
         if participant is not None:
             try:
                 ok = participant.on_transition(table, segment, target, info)
             except Exception:
                 logger.exception("transition %s/%s -> %s on %s failed", table, segment, target, server)
+                ok = False
         with self._lock:
-            view[server] = target if ok else ERROR
+            if ok is None:
+                # pending: remote participant will report_state later
+                view.setdefault(server, OFFLINE)
+            else:
+                view[server] = target if ok else ERROR
 
     def delete_segment(self, physical_table: str, segment: str) -> None:
         with self._lock:
@@ -242,6 +269,20 @@ class ClusterResourceManager:
         with self._lock:
             self.external_views.get(physical_table, {}).pop(segment, None)
         self._notify_view(physical_table)
+
+    def report_state(self, server: str, table: str, segment: str, state: str) -> None:
+        """Async current-state report from a remote participant (the
+        Helix CurrentState write a server makes after executing a
+        queued transition message)."""
+        with self._lock:
+            tbl_view = self.external_views.setdefault(table, {})
+            if segment not in self.ideal_states.get(table, {}):
+                # segment deleted while the message was in flight; drop
+                # any residual view entry instead of resurrecting it
+                tbl_view.pop(segment, None)
+                return
+            tbl_view.setdefault(segment, {})[server] = state
+        self._notify_view(table)
 
     def reset_segment(self, physical_table: str, segment: str, server: str) -> None:
         """ERROR -> OFFLINE -> retarget (the Helix error-reset analog)."""

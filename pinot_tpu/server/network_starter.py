@@ -1,0 +1,192 @@
+"""Networked server starter: a server process joining a remote controller.
+
+The in-process ``ServerStarter`` receives transitions as direct
+callbacks; this variant is the real-deployment analog of
+``HelixServerStarter.java:63`` + ``SegmentFetcherAndLoader.java:84``:
+
+- register with the controller over HTTP (PARTICIPANT join),
+- heartbeat for liveness (the ZK session),
+- poll transition messages, execute them (download segment bytes from
+  the controller's store with CRC skip, load into the query engine, or
+  drop), ack the resulting state,
+- serve broker queries on a length-framed TCP socket.
+
+All state the controller needs rides in the register/ack payloads; the
+server keeps a local segment cache under ``data_dir`` so a restart with
+matching CRCs skips downloads.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import urllib.request
+from typing import Any, Dict, Optional
+
+from pinot_tpu.controller.resource_manager import DROPPED, OFFLINE, ONLINE
+from pinot_tpu.segment.format import SEGMENT_FILE_NAME, read_segment
+from pinot_tpu.server.instance import ServerInstance
+from pinot_tpu.transport.tcp import TcpServer
+
+logger = logging.getLogger(__name__)
+
+
+class NetworkedServerStarter:
+    def __init__(
+        self,
+        controller_url: str,
+        name: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        data_dir: Optional[str] = None,
+        heartbeat_interval_s: float = 1.0,
+        poll_interval_s: float = 0.3,
+    ) -> None:
+        self.controller_url = controller_url.rstrip("/")
+        self.name = name
+        self.server = ServerInstance(name)
+        self.tcp = TcpServer(self.server.handle_request, host=host, port=port)
+        self.data_dir = data_dir
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.poll_interval_s = poll_interval_s
+        self._local_crcs: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._threads: list = []
+
+    # -- HTTP helpers --------------------------------------------------
+    def _post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        req = urllib.request.Request(
+            self.controller_url + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    def _get(self, path: str) -> Dict[str, Any]:
+        with urllib.request.urlopen(self.controller_url + path, timeout=10) as r:
+            return json.loads(r.read())
+
+    def _download(self, path: str) -> bytes:
+        with urllib.request.urlopen(self.controller_url + path, timeout=120) as r:
+            return r.read()
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self.tcp.start()
+        self._post(
+            "/instances",
+            {
+                "name": self.name,
+                "role": "server",
+                "addr": [self.tcp.address[0], self.tcp.address[1]],
+            },
+        )
+        for fn in (self._heartbeat_loop, self._message_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self.tcp.stop()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval_s):
+            try:
+                out = self._post(f"/instances/{self.name}/heartbeat", {})
+                if out.get("reregister"):
+                    self._post(
+                        "/instances",
+                        {
+                            "name": self.name,
+                            "role": "server",
+                            "addr": [self.tcp.address[0], self.tcp.address[1]],
+                        },
+                    )
+            except Exception as e:
+                logger.warning("heartbeat to controller failed: %s", e)
+
+    def _message_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                msgs = self._get(f"/instances/{self.name}/messages")["messages"]
+            except Exception as e:
+                logger.warning("message poll failed: %s", e)
+                continue
+            for msg in msgs:
+                self._handle(msg)
+
+    # -- transitions ---------------------------------------------------
+    def _handle(self, msg: Dict[str, Any]) -> None:
+        table, segment, target = msg["table"], msg["segment"], msg["target"]
+        try:
+            if target == ONLINE:
+                ok = self._load(table, segment, msg.get("crc"))
+            elif target in (OFFLINE, DROPPED):
+                self.server.remove_segment(table, segment)
+                self._local_crcs.pop(segment, None)
+                ok = True
+            else:
+                logger.error("unsupported transition target %s", target)
+                ok = False
+        except Exception:
+            logger.exception("transition %s/%s -> %s failed", table, segment, target)
+            ok = False
+        try:
+            self._post(
+                f"/instances/{self.name}/ack",
+                {
+                    "msgId": msg.get("msgId"),
+                    "table": table,
+                    "segment": segment,
+                    "state": target,
+                    "ok": ok,
+                },
+            )
+        except Exception as e:
+            # the un-acked message stays on the board and is redelivered
+            logger.warning("ack failed for %s/%s: %s", table, segment, e)
+
+    def _local_dir(self, table: str, segment: str) -> Optional[str]:
+        if self.data_dir is None:
+            return None
+        return os.path.join(self.data_dir, table, segment)
+
+    def _load(self, table: str, segment: str, crc: Optional[int]) -> bool:
+        tdm = self.server.data_manager.table(table)
+        loaded = tdm is not None and segment in tdm.segment_names()
+        if loaded and crc is not None and self._local_crcs.get(segment) == crc:
+            return True  # CRC match (SegmentFetcherAndLoader.java:84)
+
+        local = self._local_dir(table, segment)
+        seg_obj = None
+        if local is not None and os.path.exists(os.path.join(local, SEGMENT_FILE_NAME)):
+            try:
+                cached = read_segment(local)
+                if crc is None or cached.metadata.crc == crc:
+                    seg_obj = cached  # local cache hit, skip download
+            except Exception:
+                logger.warning("corrupt local cache for %s/%s; re-downloading", table, segment)
+        if seg_obj is None:
+            data = self._download(f"/segments/{table}/{segment}/file")
+            if local is not None:
+                os.makedirs(local, exist_ok=True)
+                with open(os.path.join(local, SEGMENT_FILE_NAME), "wb") as f:
+                    f.write(data)
+                seg_obj = read_segment(local)
+            else:
+                import tempfile
+
+                with tempfile.TemporaryDirectory() as td:
+                    p = os.path.join(td, SEGMENT_FILE_NAME)
+                    with open(p, "wb") as f:
+                        f.write(data)
+                    seg_obj = read_segment(td)
+        self.server.add_segment(table, seg_obj)
+        if crc is not None:
+            self._local_crcs[segment] = crc
+        return True

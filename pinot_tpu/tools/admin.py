@@ -5,6 +5,9 @@ Commands:
   Quickstart            offline baseballStats demo (Quickstart.java:33)
   RealtimeQuickstart    streaming meetupRsvp demo
   StartCluster          in-process cluster with HTTP broker+controller
+  StartController       standalone controller process (networked cluster)
+  StartServer           standalone server process joining a controller
+  StartBroker           standalone broker process joining a controller
   CreateSegment         build a segment from CSV/JSONL + schema JSON
   UploadSegment         POST a segment file to a controller
   AddSchema / AddTable  controller CRUD
@@ -84,6 +87,52 @@ def cmd_start_cluster(args) -> None:
         cluster.stop()
 
 
+def _serve_forever(stoppers) -> None:
+    print("Ctrl-C to exit.", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        for s in stoppers:
+            s()
+
+
+def cmd_start_controller(args) -> None:
+    """Standalone controller process (ControllerStarter.java:47 analog)."""
+    from pinot_tpu.controller.controller import Controller, ControllerHttpServer
+
+    ctrl = Controller(args.data_dir, start_managers=True)
+    ctrl.gateway.heartbeat_timeout_s = args.heartbeat_timeout
+    http = ControllerHttpServer(ctrl, port=args.port)
+    http.start()
+    print(f"READY controller http://127.0.0.1:{http.port}", flush=True)
+    _serve_forever([http.stop, ctrl.stop])
+
+
+def cmd_start_server(args) -> None:
+    """Standalone server process joining a remote controller
+    (HelixServerStarter.java:63 analog)."""
+    from pinot_tpu.server.network_starter import NetworkedServerStarter
+
+    starter = NetworkedServerStarter(
+        args.controller, args.name, port=args.port, data_dir=args.data_dir
+    )
+    starter.start()
+    print(f"READY server {starter.tcp.address[0]}:{starter.tcp.address[1]}", flush=True)
+    _serve_forever([starter.stop])
+
+
+def cmd_start_broker(args) -> None:
+    """Standalone broker process joining a remote controller
+    (HelixBrokerStarter.java:57 analog)."""
+    from pinot_tpu.broker.network_starter import NetworkedBrokerStarter
+
+    starter = NetworkedBrokerStarter(args.controller, args.name, port=args.port)
+    starter.start()
+    print(f"READY broker http://127.0.0.1:{starter.http.port}", flush=True)
+    _serve_forever([starter.stop])
+
+
 def cmd_create_segment(args) -> None:
     from pinot_tpu.common.schema import Schema
     from pinot_tpu.segment.builder import build_segment
@@ -154,6 +203,16 @@ def cmd_show_segment(args) -> None:
 
 
 def main(argv=None) -> None:
+    import os
+
+    n = os.environ.get("PINOT_TPU_FORCE_CPU")
+    if n:
+        # test harnesses run role processes on a virtual CPU mesh (the
+        # sitecustomize otherwise dials the single-chip TPU tunnel)
+        from pinot_tpu.utils.platform import force_cpu_mesh
+
+        force_cpu_mesh(int(n))
+
     p = argparse.ArgumentParser(prog="pinot_tpu-admin", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
 
@@ -174,6 +233,25 @@ def main(argv=None) -> None:
     sc.add_argument("-broker-port", type=int, default=8099)
     sc.add_argument("-controller-port", type=int, default=9000)
     sc.set_defaults(fn=cmd_start_cluster)
+
+    stc = sub.add_parser("StartController")
+    stc.add_argument("-port", type=int, default=9000)
+    stc.add_argument("-data-dir", required=True, dest="data_dir")
+    stc.add_argument("-heartbeat-timeout", type=float, default=6.0, dest="heartbeat_timeout")
+    stc.set_defaults(fn=cmd_start_controller)
+
+    sts = sub.add_parser("StartServer")
+    sts.add_argument("-controller", default="http://127.0.0.1:9000")
+    sts.add_argument("-name", default="server0")
+    sts.add_argument("-port", type=int, default=0)
+    sts.add_argument("-data-dir", default=None, dest="data_dir")
+    sts.set_defaults(fn=cmd_start_server)
+
+    stb = sub.add_parser("StartBroker")
+    stb.add_argument("-controller", default="http://127.0.0.1:9000")
+    stb.add_argument("-name", default="broker0")
+    stb.add_argument("-port", type=int, default=8099)
+    stb.set_defaults(fn=cmd_start_broker)
 
     cs = sub.add_parser("CreateSegment")
     cs.add_argument("-schema-file", required=True, dest="schema_file")

@@ -13,10 +13,11 @@ design.
 """
 from pinot_tpu.utils.platform import force_cpu_mesh
 
-assert force_cpu_mesh(8), (
-    "jax backends initialized before conftest; tests must come up on a "
-    "virtual 8-device CPU mesh, not the axon TPU tunnel"
-)
+if not force_cpu_mesh(8):  # not an assert: must survive PYTHONOPTIMIZE
+    raise RuntimeError(
+        "jax backends initialized before conftest; tests must come up on a "
+        "virtual 8-device CPU mesh, not the axon TPU tunnel"
+    )
 
 import jax
 

@@ -1,0 +1,185 @@
+"""Networked control plane: controller, servers, broker as real OS
+processes coordinated over HTTP (the multi-JVM ClusterTest analog —
+``pinot-integration-tests/.../ClusterTest.java:62`` — but with actual
+process boundaries instead of one JVM).
+
+Covers: instance registration + heartbeats, transition messages +
+acks (segment download with local cache), broker cluster-state polling
+for routing, liveness-based failover when a server is SIGKILLed.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from pinot_tpu.common.tableconfig import TableConfig
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.segment.format import SEGMENT_FILE_NAME, write_segment
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TABLE = "netTable"
+PHYSICAL = "netTable_OFFLINE"
+
+
+def _admin_env():
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # no TPU tunnel in child processes
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PINOT_TPU_FORCE_CPU"] = "1"
+    return env
+
+
+def _spawn(args, ready_prefix="READY"):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pinot_tpu.tools.admin", *args],
+        cwd=REPO_ROOT,
+        env=_admin_env(),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith(ready_prefix):
+            return proc, line.split()[-1]
+        if proc.poll() is not None:
+            raise RuntimeError(f"process exited early: {args}")
+    proc.kill()
+    raise RuntimeError(f"no READY from {args}")
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post_json(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _wait_for(cond, timeout=30, interval=0.25, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.slow
+def test_networked_cluster_end_to_end(tmp_path):
+    schema = make_test_schema(with_mv=False)
+    schema.schema_name = TABLE
+    rows = random_rows(schema, 400, seed=29)
+
+    procs = []
+    try:
+        ctrl_proc, ctrl_url = _spawn(
+            ["StartController", "-port", "0", "-data-dir", str(tmp_path / "store"),
+             "-heartbeat-timeout", "2.0"]
+        )
+        procs.append(ctrl_proc)
+
+        srv_procs = {}
+        for name in ("s0", "s1"):
+            p, _addr = _spawn(
+                ["StartServer", "-controller", ctrl_url, "-name", name,
+                 "-data-dir", str(tmp_path / f"cache_{name}")]
+            )
+            procs.append(p)
+            srv_procs[name] = p
+
+        broker_proc, broker_url = _spawn(
+            ["StartBroker", "-controller", ctrl_url, "-port", "0"]
+        )
+        procs.append(broker_proc)
+
+        # schema + table over REST (replication 2 -> every segment on both)
+        _post_json(ctrl_url + "/schemas", schema.to_json())
+        config = TableConfig(table_name=TABLE, table_type="OFFLINE", replication=2)
+        _post_json(ctrl_url + "/tables", config.to_json())
+
+        # build + upload two segments
+        for i in range(2):
+            seg = build_segment(schema, rows[i * 200 : (i + 1) * 200], PHYSICAL, f"net_{i}")
+            d = str(tmp_path / f"build_{i}")
+            write_segment(seg, d)
+            with open(os.path.join(d, SEGMENT_FILE_NAME), "rb") as f:
+                data = f.read()
+            req = urllib.request.Request(
+                ctrl_url + f"/segments/{PHYSICAL}", data=data,
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert json.loads(r.read())["status"] == "ok"
+
+        # transitions are async messages: wait until both replicas report ONLINE
+        def _all_online():
+            view = _get(ctrl_url + f"/tables/{PHYSICAL}/externalview")
+            return (
+                len(view) == 2
+                and all(
+                    set(replicas) == {"s0", "s1"}
+                    and all(st == "ONLINE" for st in replicas.values())
+                    for replicas in view.values()
+                )
+            )
+
+        _wait_for(_all_online, timeout=60, what="segments ONLINE on both servers")
+
+        # broker picked the view up by polling cluster state
+        def _query(pql):
+            return _post_json(broker_url + "/query", {"pql": pql})
+
+        def _full_count():
+            resp = _query(f"SELECT count(*) FROM {TABLE}")
+            return resp.get("numDocsScanned") == 400 and not resp.get("exceptions")
+
+        _wait_for(_full_count, timeout=60, what="broker routing serving all segments")
+
+        expected_sum = sum(r["metInt"] for r in rows)
+        resp = _query(f"SELECT sum(metInt) FROM {TABLE}")
+        assert not resp["exceptions"]
+        got = float(resp["aggregationResults"][0]["value"])
+        assert got == pytest.approx(expected_sum, rel=1e-6)
+
+        # SIGKILL one server: heartbeats stop, controller marks it dead,
+        # broker reroutes to the surviving replica -> still full results
+        srv_procs["s0"].send_signal(signal.SIGKILL)
+        srv_procs["s0"].wait(timeout=10)
+
+        def _s0_dead():
+            state = _get(ctrl_url + "/clusterstate")
+            return "s0" not in state["servers"]
+
+        _wait_for(_s0_dead, timeout=20, what="controller declaring s0 dead")
+
+        def _failover_ok():
+            resp = _query(f"SELECT count(*) FROM {TABLE}")
+            return resp.get("numDocsScanned") == 400 and not resp.get("exceptions")
+
+        _wait_for(_failover_ok, timeout=30, what="failover to surviving replica")
+
+        # restart s0 under the same name + cache dir: re-registration must
+        # reconcile (replay ideal state) and reload from the local cache
+        p, _addr = _spawn(
+            ["StartServer", "-controller", ctrl_url, "-name", "s0",
+             "-data-dir", str(tmp_path / "cache_s0")]
+        )
+        procs.append(p)
+        _wait_for(_all_online, timeout=60, what="restarted s0 back ONLINE")
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
