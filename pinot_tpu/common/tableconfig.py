@@ -59,6 +59,24 @@ class QuotaConfig:
     storage: Optional[str] = None
     max_queries_per_second: Optional[float] = None
 
+    _UNITS = {"": 1, "K": 2**10, "M": 2**20, "G": 2**30, "T": 2**40}
+
+    def storage_bytes(self) -> Optional[int]:
+        """Parse the human-readable storage quota ("128M", "2.5G", "1024")
+        into bytes; None when unset (the QuotaConfig.storage contract of
+        ``common/config/QuotaConfig`` in the reference)."""
+        if not self.storage:
+            return None
+        import re
+
+        m = re.fullmatch(r"(\d+(?:\.\d+)?)\s*([kKmMgGtT]?)[bB]?", self.storage.strip())
+        if m is None:
+            raise ValueError(f"bad storage quota {self.storage!r}")
+        return int(float(m.group(1)) * self._UNITS[m.group(2).upper()])
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"storage": self.storage, "maxQueriesPerSecond": self.max_queries_per_second}
+
 
 @dataclass
 class TableConfig:
@@ -96,6 +114,7 @@ class TableConfig:
             },
             "tableIndexConfig": self.indexing.to_json(),
             "tenants": {"broker": self.broker_tenant, "server": self.server_tenant},
+            "quota": self.quota.to_json(),
         }
         if self.stream is not None:
             d["streamConfigs"] = {
@@ -119,10 +138,18 @@ class TableConfig:
                 decoder=sc.get("decoder", "json"),
                 rows_per_segment=sc.get("rowsPerSegment", 100_000),
             )
+        tenants = d.get("tenants", {})
+        quota_json = d.get("quota", {})
         return cls(
             table_name=d["tableName"],
             table_type=d.get("tableType", "OFFLINE"),
             replication=seg.get("replication", 1),
+            broker_tenant=tenants.get("broker", "DefaultTenant"),
+            server_tenant=tenants.get("server", "DefaultTenant"),
+            quota=QuotaConfig(
+                storage=quota_json.get("storage"),
+                max_queries_per_second=quota_json.get("maxQueriesPerSecond"),
+            ),
             retention=RetentionConfig(
                 retention_time_unit=seg.get("retentionTimeUnit", "DAYS"),
                 retention_time_value=seg.get("retentionTimeValue", 0),

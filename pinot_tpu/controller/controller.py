@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
@@ -59,7 +60,12 @@ class Controller:
     def add_table(self, config: TableConfig) -> str:
         if self.resources.get_schema(config.raw_name) is None:
             raise ValueError(f"no schema named {config.raw_name!r}; upload the schema first")
+        self.resources.validate_tenants(config)
         return self.resources.add_table(config)
+
+    def rebalance_table(self, table_physical: str, dry_run: bool = False) -> Dict[str, Any]:
+        return self.resources.rebalance_table(table_physical, dry_run=dry_run)
+
 
     def add_realtime_table(self, config: TableConfig, stream) -> str:
         """Create a REALTIME table and open its first CONSUMING segments
@@ -67,19 +73,58 @@ class Controller:
         schema = self.resources.get_schema(config.raw_name)
         if schema is None:
             raise ValueError(f"no schema named {config.raw_name!r}; upload the schema first")
+        self.resources.validate_tenants(config)
         return self.realtime_manager.setup_table(config, schema, stream)
+
+    def _check_storage_quota(
+        self, table_physical: str, segment_name: str, incoming_bytes: int
+    ) -> None:
+        """Raise BEFORE the store is touched when the upload would push
+        the table's durable copy past its quota (StorageQuotaChecker
+        analog); a rejected upload — fresh or refresh — leaves the
+        previous copy intact."""
+        config = self.resources.table_configs.get(table_physical)
+        quota = config.quota.storage_bytes() if config is not None else None
+        if quota is None:
+            return
+        used = self.store.table_size_bytes(table_physical)
+        # a refresh replaces the old copy, so it doesn't double-count
+        used -= self.store.segment_size_bytes(table_physical, segment_name)
+        if used + incoming_bytes > quota:
+            raise ValueError(
+                f"storage quota exceeded for {table_physical}: "
+                f"{used} used + {incoming_bytes} incoming > {quota} quota"
+            )
 
     def upload_segment(self, table_physical: str, segment: ImmutableSegment) -> List[str]:
         """Store the segment durably and drive replicas ONLINE."""
-        path = self.store.save(table_physical, segment)
+        import tempfile
+
+        from pinot_tpu.segment.format import SEGMENT_FILE_NAME, write_segment
+
+        config = self.resources.table_configs.get(table_physical)
+        if config is None or config.quota.storage_bytes() is None:
+            path = self.store.save(table_physical, segment)
+        else:
+            # serialize once into a staging dir, quota-check the real
+            # size, then move the bytes into the store
+            with tempfile.TemporaryDirectory() as td:
+                write_segment(segment, td)
+                staged = os.path.join(td, SEGMENT_FILE_NAME)
+                self._check_storage_quota(
+                    table_physical, segment.segment_name, os.path.getsize(staged)
+                )
+                path = self.store.save_file(
+                    table_physical, segment.segment_name, staged
+                )
         return self.resources.add_segment(
             table_physical, segment.metadata, {"dir": path}
         )
 
     def upload_segment_bytes(self, table_physical: str, data: bytes) -> List[str]:
-        """HTTP upload path: raw segment-file bytes -> store + assign."""
-        import io
-        import os
+        """HTTP upload path: raw segment-file bytes -> store + assign.
+        The received payload is the exact on-disk size, so the quota
+        check needs no extra serialization."""
         import tempfile
 
         from pinot_tpu.segment.format import SEGMENT_FILE_NAME, read_segment
@@ -89,7 +134,11 @@ class Controller:
             with open(path, "wb") as f:
                 f.write(data)
             segment = read_segment(td)
-        return self.upload_segment(table_physical, segment)
+            self._check_storage_quota(table_physical, segment.segment_name, len(data))
+            stored = self.store.save_file(table_physical, segment.segment_name, path)
+        return self.resources.add_segment(
+            table_physical, segment.metadata, {"dir": stored}
+        )
 
     def delete_segment(self, table_physical: str, segment_name: str) -> None:
         self.resources.delete_segment(table_physical, segment_name)
@@ -220,6 +269,23 @@ class ControllerHttpServer:
                         )
                     if parts == ["tables"]:
                         return self._respond({"tables": ctrl.resources.tables()})
+                    if parts == ["tenants"]:
+                        return self._respond({"tenants": ctrl.resources.list_tenants()})
+                    if len(parts) == 2 and parts[0] == "tenants":
+                        return self._respond(
+                            {
+                                "tenant": parts[1],
+                                "ServerInstances": ctrl.resources.tenant_instances(parts[1], "server"),
+                                "BrokerInstances": ctrl.resources.tenant_instances(parts[1], "broker"),
+                            }
+                        )
+                    if len(parts) == 3 and parts[0] == "tables" and parts[2] == "size":
+                        return self._respond(
+                            {
+                                "table": parts[1],
+                                "reportedSizeInBytes": ctrl.store.table_size_bytes(parts[1]),
+                            }
+                        )
                     if len(parts) == 2 and parts[0] == "schemas":
                         schema = ctrl.resources.get_schema(parts[1])
                         if schema is None:
@@ -255,6 +321,16 @@ class ControllerHttpServer:
                         config = TableConfig.from_json(self._read_json())
                         physical = ctrl.add_table(config)
                         return self._respond({"status": "ok", "table": physical})
+                    if parts == ["tenants"]:
+                        body = self._read_json()
+                        tagged = ctrl.resources.create_tenant(
+                            body["name"], body.get("role", "server"), int(body.get("count", 1))
+                        )
+                        return self._respond({"status": "ok", "instances": tagged})
+                    if len(parts) == 3 and parts[0] == "tables" and parts[2] == "rebalance":
+                        qs = parse_qs(url.query)
+                        dry = (qs.get("dryRun") or ["false"])[0].lower() == "true"
+                        return self._respond(ctrl.rebalance_table(parts[1], dry_run=dry))
                     if len(parts) == 2 and parts[0] == "segments":
                         # binary segment upload: POST /segments/{table}
                         # (PinotSegmentUploadRestletResource analog)

@@ -168,12 +168,20 @@ class ParticipantGateway:
     def register(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         name = payload["name"]
         role = payload.get("role", "server")
+        if payload.get("tags"):
+            tags = set(payload["tags"])
+        else:
+            # tenant tags are controller-assigned state (create_tenant):
+            # a restarting instance that doesn't announce tags must keep
+            # the ones it had, not fall back to DefaultTenant
+            prev = self.resources.instances.get(name)
+            tags = set(prev.tags) if prev is not None else {"DefaultTenant"}
         state = InstanceState(
             name,
             role=role,
             url=payload.get("url"),
             addr=tuple(payload["addr"]) if payload.get("addr") else None,
-            tags=set(payload.get("tags") or ["DefaultTenant"]),
+            tags=tags,
         )
         participant = RemoteParticipant(name, self.board) if role == "server" else None
         with self._lock:

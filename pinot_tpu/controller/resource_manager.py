@@ -162,6 +162,156 @@ class ClusterResourceManager:
             except Exception:
                 logger.exception("view listener failed for %s", table)
 
+    # -- tenants ------------------------------------------------------
+    def create_tenant(self, name: str, role: str, count: int) -> List[str]:
+        """Tag ``count`` live, not-yet-dedicated instances of ``role``
+        with the tenant tag (the PinotTenantRestletResource /
+        tag-instances flow of the reference).  Returns tagged names."""
+        with self._lock:
+            free = sorted(
+                i.name
+                for i in self.instances.values()
+                if i.role == role and i.alive and not (i.tags - {"DefaultTenant"})
+            )
+            if len(free) < count:
+                raise RuntimeError(
+                    f"tenant {name!r}: need {count} untagged {role}s, have {len(free)}"
+                )
+            tagged = free[:count]
+            for n in tagged:
+                # dedication: the tenant tag replaces DefaultTenant (the
+                # reference untags the default when an instance joins a
+                # tenant), so default-tenant tables stop landing here
+                self.instances[n].tags.add(name)
+                self.instances[n].tags.discard("DefaultTenant")
+        self.bump_version()
+        return tagged
+
+    def list_tenants(self) -> Dict[str, List[str]]:
+        """All tenant tags -> member instance names."""
+        with self._lock:
+            out: Dict[str, List[str]] = {}
+            for inst in self.instances.values():
+                for tag in inst.tags:
+                    out.setdefault(tag, []).append(inst.name)
+            return {t: sorted(ns) for t, ns in sorted(out.items())}
+
+    def tenant_instances(self, name: str, role: Optional[str] = None) -> List[str]:
+        with self._lock:
+            return sorted(
+                i.name
+                for i in self.instances.values()
+                if name in i.tags and (role is None or i.role == role)
+            )
+
+    def _live_tenant_members(self, tag: str, role: str) -> List[str]:
+        with self._lock:
+            return sorted(
+                i.name
+                for i in self.instances.values()
+                if tag in i.tags and i.role == role and i.alive
+            )
+
+    def validate_tenants(self, config: TableConfig) -> None:
+        """Reject table creation when a non-default tenant has no live
+        members (the reference validates tenants before writing the
+        table config; SURVEY §3.5)."""
+        if config.server_tenant != "DefaultTenant" and not self._live_tenant_members(
+            config.server_tenant, "server"
+        ):
+            raise ValueError(f"server tenant {config.server_tenant!r} has no live servers")
+        if config.broker_tenant != "DefaultTenant" and not self._live_tenant_members(
+            config.broker_tenant, "broker"
+        ):
+            raise ValueError(f"broker tenant {config.broker_tenant!r} has no live brokers")
+
+    # -- rebalance -----------------------------------------------------
+    def rebalance_table(
+        self, physical_table: str, dry_run: bool = False
+    ) -> Dict[str, Any]:
+        """Recompute a balanced segment->server assignment over the
+        current live tenant servers and (unless ``dry_run``) apply the
+        diff: new replicas driven ONLINE, removed replicas DROPPED.
+
+        The RebalanceTableCommand / Helix auto-rebalance analog: load
+        per server is capped at ceil(total_replica_slots / num_servers)
+        and existing placements are kept whenever the cap allows, so
+        movement is minimal.  Segments with a CONSUMING replica are
+        skipped (moving a live consumer is the LLC manager's job)."""
+        with self._lock:
+            config = self.table_configs.get(physical_table)
+            if config is None:
+                raise KeyError(f"no table {physical_table!r}")
+            eligible = sorted(
+                n
+                for n, inst in self.instances.items()
+                if inst.role == "server" and inst.alive and config.server_tenant in inst.tags
+            )
+            if not eligible:
+                raise RuntimeError("no live servers to rebalance onto")
+            ideal = {
+                s: dict(r) for s, r in self.ideal_states.get(physical_table, {}).items()
+            }
+        n_rep = min(config.replication, len(eligible))
+        movable = sorted(s for s, r in ideal.items() if CONSUMING not in r.values())
+        total_slots = n_rep * len(movable)
+        cap = -(-total_slots // len(eligible)) if movable else 0  # ceil
+        load = {s: 0 for s in eligible}
+        target: Dict[str, List[str]] = {}
+        # pass 1: keep existing eligible replicas while under the cap
+        for seg in movable:
+            kept = []
+            for srv in sorted(ideal[seg]):
+                if srv in load and load[srv] < cap and len(kept) < n_rep:
+                    kept.append(srv)
+                    load[srv] += 1
+            target[seg] = kept
+        # pass 2: fill open slots with the least-loaded servers
+        for seg in movable:
+            while len(target[seg]) < n_rep:
+                srv = min(
+                    (s for s in eligible if s not in target[seg]),
+                    key=lambda s: (load[s], s),
+                )
+                target[seg].append(srv)
+                load[srv] += 1
+        added: Dict[str, List[str]] = {}
+        removed: Dict[str, List[str]] = {}
+        for seg in movable:
+            state = next(iter(ideal[seg].values()), ONLINE)
+            adds = [s for s in target[seg] if s not in ideal[seg]]
+            drops = [s for s in ideal[seg] if s not in target[seg]]
+            if adds:
+                added[seg] = adds
+            if drops:
+                removed[seg] = drops
+            if dry_run or (not adds and not drops):
+                continue
+            with self._lock:
+                tbl = self.ideal_states.get(physical_table)
+                if tbl is None or seg not in tbl:
+                    # table/segment deleted since the snapshot was taken:
+                    # don't resurrect it, drop it from the plan
+                    added.pop(seg, None)
+                    removed.pop(seg, None)
+                    continue
+                tbl[seg] = {s: state for s in target[seg]}
+            for srv in adds:
+                self._execute_transition(physical_table, seg, srv, state)
+            for srv in drops:
+                self._execute_transition(physical_table, seg, srv, DROPPED)
+                with self._lock:
+                    self.external_views.get(physical_table, {}).get(seg, {}).pop(srv, None)
+        if not dry_run and (added or removed):
+            self._notify_view(physical_table)
+        return {
+            "dryRun": dry_run,
+            "segmentsMoved": len(set(added) | set(removed)),
+            "added": added,
+            "removed": removed,
+            "target": {s: sorted(r) for s, r in target.items()},
+        }
+
     # -- schema / table CRUD ------------------------------------------
     def add_schema(self, schema: Schema) -> None:
         with self._lock:
@@ -281,7 +431,13 @@ class ClusterResourceManager:
                 # any residual view entry instead of resurrecting it
                 tbl_view.pop(segment, None)
                 return
-            tbl_view.setdefault(segment, {})[server] = state
+            if state == DROPPED:
+                # the Helix analog deletes the current-state entry on
+                # DROPPED — keeping it would leave a phantom replica
+                # after a rebalance moved the segment off this server
+                tbl_view.get(segment, {}).pop(server, None)
+            else:
+                tbl_view.setdefault(segment, {})[server] = state
         self._notify_view(table)
 
     def reset_segment(self, physical_table: str, segment: str, server: str) -> None:

@@ -47,6 +47,24 @@ class SegmentStore:
         if os.path.exists(d):
             shutil.rmtree(d)
 
+    def segment_size_bytes(self, table: str, segment_name: str) -> int:
+        d = self.segment_dir(table, segment_name)
+        total = 0
+        for root, _dirs, files in os.walk(d):
+            for f in files:
+                total += os.path.getsize(os.path.join(root, f))
+        return total
+
+    def table_size_bytes(self, table: str) -> int:
+        """Total on-disk bytes of the controller's durable copies for a
+        table (the TableSizeResource / storage-quota input)."""
+        d = os.path.join(self.base_dir, table)
+        total = 0
+        for root, _dirs, files in os.walk(d):
+            for f in files:
+                total += os.path.getsize(os.path.join(root, f))
+        return total
+
     def list_segments(self, table: str) -> List[str]:
         d = os.path.join(self.base_dir, table)
         if not os.path.isdir(d):

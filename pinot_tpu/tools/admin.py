@@ -195,6 +195,27 @@ def cmd_query_runner(args) -> None:
     print(json.dumps(report.to_json(), indent=2))
 
 
+def cmd_rebalance_table(args) -> None:
+    url = args.controller.rstrip("/") + f"/tables/{args.table}/rebalance"
+    if args.dry_run:
+        url += "?dryRun=true"
+    print(json.dumps(_post(url, {}), indent=2))
+
+
+def cmd_add_tenant(args) -> None:
+    print(
+        _post(
+            args.controller.rstrip("/") + "/tenants",
+            {"name": args.name, "role": args.role, "count": args.count},
+        )
+    )
+
+
+def cmd_list_tenants(args) -> None:
+    with urllib.request.urlopen(args.controller.rstrip("/") + "/tenants", timeout=30) as r:
+        print(json.dumps(json.loads(r.read()), indent=2))
+
+
 def cmd_show_segment(args) -> None:
     from pinot_tpu.segment.format import read_segment
 
@@ -293,6 +314,23 @@ def main(argv=None) -> None:
     qr.add_argument("-qps", type=float, default=10.0)
     qr.add_argument("-duration", type=float, default=10.0)
     qr.set_defaults(fn=cmd_query_runner)
+
+    rb = sub.add_parser("RebalanceTable")
+    rb.add_argument("-controller", default="http://127.0.0.1:9000")
+    rb.add_argument("-table", required=True)
+    rb.add_argument("-dry-run", action="store_true", dest="dry_run")
+    rb.set_defaults(fn=cmd_rebalance_table)
+
+    ate = sub.add_parser("AddTenant")
+    ate.add_argument("-controller", default="http://127.0.0.1:9000")
+    ate.add_argument("-name", required=True)
+    ate.add_argument("-role", choices=["server", "broker"], default="server")
+    ate.add_argument("-count", type=int, default=1)
+    ate.set_defaults(fn=cmd_add_tenant)
+
+    lt = sub.add_parser("ListTenants")
+    lt.add_argument("-controller", default="http://127.0.0.1:9000")
+    lt.set_defaults(fn=cmd_list_tenants)
 
     ss = sub.add_parser("ShowSegment")
     ss.add_argument("-segment-dir", required=True, dest="segment_dir")

@@ -57,6 +57,20 @@ class InProcessCluster:
             self.http = BrokerHttpServer(self.broker)
             self.http.start()
 
+    def add_server(self, name: Optional[str] = None, mesh=None) -> ServerInstance:
+        """Join a new server into the running cluster (elastic scale-out;
+        pair with controller.rebalance_table to move segments onto it)."""
+        name = name or f"server{len(self.servers)}"
+        server = ServerInstance(name, mesh=mesh)
+        starter = ServerStarter(server, self.controller.resources)
+        starter.start()
+        address = (server.name, 0)
+        self.transport.register(address, server.handle_request)
+        self.broker.set_server_address(server.name, address)
+        self.servers.append(server)
+        self.server_starters.append(starter)
+        return server
+
     # -- convenience API ---------------------------------------------
     def add_offline_table(
         self, schema: Schema, table_name: Optional[str] = None, **config_kwargs

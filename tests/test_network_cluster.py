@@ -9,6 +9,7 @@ for routing, liveness-based failover when a server is SIGKILLed.
 """
 import json
 import os
+import select
 import signal
 import subprocess
 import sys
@@ -46,9 +47,13 @@ def _spawn(args, ready_prefix="READY"):
     )
     deadline = time.time() + 90
     while time.time() < deadline:
-        line = proc.stdout.readline()
-        if line.startswith(ready_prefix):
-            return proc, line.split()[-1]
+        # select so a child that hangs without printing can't block
+        # readline() forever past the deadline
+        ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+        if ready:
+            line = proc.stdout.readline()
+            if line.startswith(ready_prefix):
+                return proc, line.split()[-1]
         if proc.poll() is not None:
             raise RuntimeError(f"process exited early: {args}")
     proc.kill()
