@@ -131,6 +131,16 @@ MV_AGGREGATION_FUNCTIONS = tuple(f + "mv" for f in SV_AGGREGATION_FUNCTIONS)
 AGGREGATION_FUNCTIONS = SV_AGGREGATION_FUNCTIONS + MV_AGGREGATION_FUNCTIONS
 
 
+def group_sort_ascending(function: str) -> bool:
+    """Group-by results for min (and minMV) sort ascending; every other
+    function — including minmaxrange — sorts descending.  Mirrors
+    AggregationGroupByOperatorService.java:52,146: the trim comparator
+    reverses only when getFunctionName() starts with "min_", which is
+    true for min_<col> (the registry maps minmv there too) but NOT for
+    minMaxRange_<col>."""
+    return function in ("min", "minmv")
+
+
 @dataclass
 class AggregationInfo:
     """One aggregation call, e.g. sum(runs) (request.thrift AggregationInfo)."""

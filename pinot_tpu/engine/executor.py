@@ -18,7 +18,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from pinot_tpu.common.request import BrokerRequest
+from pinot_tpu.common.request import BrokerRequest, group_sort_ascending
 from pinot_tpu.common.values import render_value
 from pinot_tpu.engine import config
 from pinot_tpu.engine.context import TableContext, get_table_context
@@ -278,7 +278,7 @@ class QueryExecutor:
             candidates: set = set()
             for i, agg in enumerate(plan.aggs):
                 order_vals = self._group_order_values(agg, outs[f"gb_{i}"], keys, ctx)
-                asc = agg.func.startswith("min")
+                asc = group_sort_ascending(agg.func)
                 order = np.argsort(order_vals, kind="stable")
                 chosen = order[:trim] if asc else order[-trim:]
                 candidates.update(keys[chosen].tolist())

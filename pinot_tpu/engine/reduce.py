@@ -12,7 +12,7 @@ from __future__ import annotations
 import math
 from typing import Any, List, Optional, Sequence, Tuple
 
-from pinot_tpu.common.request import BrokerRequest
+from pinot_tpu.common.request import BrokerRequest, group_sort_ascending
 from pinot_tpu.common.response import (
     AggregationResult,
     BrokerResponse,
@@ -88,7 +88,7 @@ def _reduce_group_by(request: BrokerRequest, merged: IntermediateResult):
             h = request.having
             if h.function == agg.function and (h.column == agg.column or h.column == "*"):
                 pairs = [kv for kv in pairs if _having_ok(kv[1], h.operator, h.value)]
-        asc = agg.function.startswith("min")
+        asc = group_sort_ascending(agg.function)
         pairs.sort(key=lambda kv: (kv[1], kv[0]) if asc else (-_num(kv[1]), kv[0]))
         trimmed = pairs[: gb.top_n]
         out.append(

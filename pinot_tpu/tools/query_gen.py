@@ -8,6 +8,7 @@ speak PQL directly, so only PQL is generated; the oracle plays H2's role.
 from __future__ import annotations
 
 import random
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Sequence
 
 from pinot_tpu.common.schema import DataType, FieldType, Schema
@@ -46,8 +47,11 @@ class QueryGenerator:
             return f"'{escaped}'"
         return str(v)
 
+    def _predicate_columns(self) -> List[str]:
+        return self.all_sv + self.mv_dims
+
     def _predicate(self) -> str:
-        col = self.rng.choice(self.all_sv + self.mv_dims)
+        col = self.rng.choice(self._predicate_columns())
         kind = self.rng.randrange(6)
         if kind == 0:
             return f"{col} = {self._literal(col)}"
@@ -119,3 +123,136 @@ class QueryGenerator:
         if r < 0.8:
             return self.group_by_query()
         return self.selection_query()
+
+
+# ---------------------------------------------------------------------------
+# PQL + SQL pair generation for differential testing against SQLite
+# (the reference generates PQL together with equivalent H2 SQL:
+# pinot-integration-tests QueryGenerator.java generateH2Sql :311-426;
+# SQLite plays H2's role here)
+# ---------------------------------------------------------------------------
+
+_SQL_AGG_FMT = {
+    "count": "COUNT(*)",
+    "sum": "SUM({c})",
+    "min": "MIN({c})",
+    "max": "MAX({c})",
+    "avg": "AVG({c})",
+    "minmaxrange": "(MAX({c}) - MIN({c}))",
+    "distinctcount": "COUNT(DISTINCT {c})",
+}
+
+
+@dataclass
+class DiffQuery:
+    """One generated query in both dialects plus the structure the
+    comparator needs to interpret results."""
+
+    pql: str
+    kind: str  # "agg" | "groupby" | "selection"
+    where: str  # "" or " WHERE ..." — valid in both PQL and SQLite
+    aggs: List[tuple] = field(default_factory=list)  # (func, col)
+    group_cols: List[str] = field(default_factory=list)
+    top: int = 0
+    select_cols: List[str] = field(default_factory=list)
+    order_by: List[tuple] = field(default_factory=list)  # (col, ascending)
+    limit: int = 0
+
+    def agg_sql_exprs(self) -> List[str]:
+        return [_SQL_AGG_FMT[f].format(c=c) for f, c in self.aggs]
+
+
+class SqlDiffQueryGenerator(QueryGenerator):
+    """Generates (PQL, SQLite-SQL) pairs over the SQL-translatable query
+    subset: single-value columns only, exact-arithmetic predicate columns
+    (STRING/INT/LONG — FLOAT columns are stored float32 on device, so
+    equality/order against SQLite's float64 would diff spuriously), and
+    the aggregation functions SQLite can express."""
+
+    _DIFF_AGGS = ["count", "sum", "min", "max", "avg", "minmaxrange", "distinctcount"]
+
+    def __init__(self, schema: Schema, rows: Sequence[Row], table: str = "testTable", seed: int = 0):
+        super().__init__(schema, rows, table, seed)
+        exact = (DataType.STRING, DataType.INT, DataType.LONG)
+        self.exact_cols = [
+            s.name
+            for s in schema.all_fields()
+            if s.single_value and s.data_type in exact
+        ]
+
+    def _predicate_columns(self) -> List[str]:
+        return self.exact_cols
+
+    def _aggs(self) -> List[tuple]:
+        out = []
+        for _ in range(self.rng.randint(1, 3)):
+            f = self.rng.choice(self._DIFF_AGGS)
+            if f == "count":
+                out.append(("count", "*"))
+            elif f == "distinctcount":
+                out.append((f, self.rng.choice(self.exact_cols)))
+            else:
+                out.append((f, self.rng.choice(self.metrics)))
+        return out
+
+    def _agg_pql(self, aggs: List[tuple]) -> str:
+        return ", ".join("count(*)" if f == "count" else f"{f}({c})" for f, c in aggs)
+
+    def agg_diff(self) -> DiffQuery:
+        aggs = self._aggs()
+        where = self._where()
+        return DiffQuery(
+            pql=f"SELECT {self._agg_pql(aggs)} FROM {self.table}{where}",
+            kind="agg",
+            where=where,
+            aggs=aggs,
+        )
+
+    def group_by_diff(self) -> DiffQuery:
+        aggs = self._aggs()
+        where = self._where()
+        dims = [c for c in self.exact_cols]
+        cols = self.rng.sample(dims, self.rng.randint(1, 2))
+        top = self.rng.choice([3, 10, 50])
+        return DiffQuery(
+            pql=(
+                f"SELECT {self._agg_pql(aggs)} FROM {self.table}{where} "
+                f"GROUP BY {', '.join(cols)} TOP {top}"
+            ),
+            kind="groupby",
+            where=where,
+            aggs=aggs,
+            group_cols=cols,
+            top=top,
+        )
+
+    def selection_diff(self) -> DiffQuery:
+        cols = self.rng.sample(self.exact_cols, self.rng.randint(1, min(3, len(self.exact_cols))))
+        order: List[tuple] = []
+        order_sql = ""
+        if self.rng.random() < 0.6:
+            ocols = self.rng.sample(cols, self.rng.randint(1, min(2, len(cols))))
+            order = [(c, self.rng.random() < 0.5) for c in ocols]
+            order_sql = " ORDER BY " + ", ".join(
+                f"{c} {'ASC' if asc else 'DESC'}" for c, asc in order
+            )
+        limit = self.rng.choice([5, 10, 25])
+        where = self._where()
+        return DiffQuery(
+            pql=(
+                f"SELECT {', '.join(cols)} FROM {self.table}{where}{order_sql} LIMIT {limit}"
+            ),
+            kind="selection",
+            where=where,
+            select_cols=cols,
+            order_by=order,
+            limit=limit,
+        )
+
+    def next_diff(self) -> DiffQuery:
+        r = self.rng.random()
+        if r < 0.35:
+            return self.agg_diff()
+        if r < 0.7:
+            return self.group_by_diff()
+        return self.selection_diff()

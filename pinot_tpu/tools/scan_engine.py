@@ -38,6 +38,7 @@ from pinot_tpu.common.request import (
     FilterOperator,
     FilterQueryTree,
     RangeSpec,
+    group_sort_ascending,
 )
 from pinot_tpu.common.response import (
     AggregationResult,
@@ -226,7 +227,7 @@ def _percentile(values: List[float], p: int) -> float:
 
 def _group_sort_ascending(function: str) -> bool:
     """AggregationGroupByOperatorService.java:146 — min* sorts ascending."""
-    return function.startswith("min")
+    return group_sort_ascending(function)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,227 @@
+"""Randomized differential testing against SQLite.
+
+The reference establishes cluster-level correctness by loading the same
+data into H2 and asserting result equality over thousands of generated
+PQL/SQL pairs (pinot-integration-tests BaseClusterIntegrationTest.runQuery
+:224, QueryGenerator.generateH2Sql :311-426).  SQLite plays H2's role
+here: an INDEPENDENT engine, so a shared misunderstanding between our
+TPU engine and our scan oracle cannot hide.
+
+Queries go through the full in-process cluster (broker scatter-gather
+over multiple servers/segments), not the engine directly.
+"""
+import math
+import sqlite3
+
+import pytest
+
+from pinot_tpu.common.request import group_sort_ascending
+from pinot_tpu.common.schema import DataType
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.tools.cluster_harness import InProcessCluster
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+from pinot_tpu.tools.query_gen import SqlDiffQueryGenerator
+
+REL_TOL = 1e-4
+
+
+def _norm(v):
+    """Normalize a cell for cross-engine comparison: numeric if possible."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def _close(a, b):
+    a, b = _norm(a), _norm(b)
+    if isinstance(a, float) and isinstance(b, float):
+        return math.isclose(a, b, rel_tol=REL_TOL, abs_tol=1e-6)
+    return a == b
+
+
+def _sqlite_type(dt: DataType) -> str:
+    if dt == DataType.STRING:
+        return "TEXT"
+    if dt in (DataType.FLOAT, DataType.DOUBLE):
+        return "REAL"
+    return "INTEGER"
+
+
+def _load_sqlite(schema, rows):
+    conn = sqlite3.connect(":memory:")
+    fields = [s for s in schema.all_fields() if s.single_value]
+    cols = ", ".join(f"{s.name} {_sqlite_type(s.data_type)}" for s in fields)
+    conn.execute(f"CREATE TABLE testTable ({cols})")
+    names = [s.name for s in fields]
+    ph = ", ".join("?" * len(names))
+    conn.executemany(
+        f"INSERT INTO testTable VALUES ({ph})",
+        [[r[n] for n in names] for r in rows],
+    )
+    conn.commit()
+    return conn
+
+
+def _check_agg(q, resp, conn, errs):
+    row = conn.execute(
+        f"SELECT COUNT(*), {', '.join(q.agg_sql_exprs())} FROM testTable{q.where}"
+    ).fetchone()
+    matched = row[0]
+    if matched == 0:
+        # engines differ legitimately on empty-set aggregates (NULL vs
+        # identity); assert only that ours also saw zero docs
+        if resp.num_docs_scanned != 0:
+            errs.append((q.pql, "expected 0 docs", resp.num_docs_scanned))
+        return
+    for i, want in enumerate(row[1:]):
+        got = resp.aggregation_results[i].value
+        if not _close(got, want):
+            errs.append((q.pql, f"agg[{i}] got {got}", f"want {want}"))
+
+
+def _check_group_by(q, resp, conn, errs, single_server):
+    gcols = ", ".join(q.group_cols)
+    rows = conn.execute(
+        f"SELECT {gcols}, {', '.join(q.agg_sql_exprs())} "
+        f"FROM testTable{q.where} GROUP BY {gcols}"
+    ).fetchall()
+    k = len(q.group_cols)
+    # group key -> per-agg values; keys normalized like the engine renders
+    table = {tuple(str(v) for v in r[:k]): r[k:] for r in rows}
+    expect_n = min(q.top, len(table))
+    # Distributed group-by is approximate by design once a server trims
+    # its candidate set to topN*5 (reference semantics:
+    # AggregationGroupByOperatorService.java:76 _trimSize = minTrimSize*5;
+    # a group split across servers can lose low partials).  Values and
+    # membership are exact only when no server can have trimmed.
+    exact = single_server or len(table) <= max(q.top * 5, 100)
+    for i, (func, _col) in enumerate(q.aggs):
+        result = resp.aggregation_results[i].group_by_result
+        if len(result) != expect_n:
+            errs.append((q.pql, f"agg[{i}] {len(result)} groups", f"want {expect_n}"))
+            continue
+        for g in result:
+            key = tuple(g.group)
+            if key not in table:
+                errs.append((q.pql, f"agg[{i}] ghost group {key}", "absent in sqlite"))
+            elif exact and not _close(g.value, table[key][i]):
+                errs.append(
+                    (q.pql, f"agg[{i}] group {key} got {g.value}", f"want {table[key][i]}")
+                )
+        if not exact:
+            continue
+        # the returned groups must be a valid top-N by value (ascending
+        # for min-style functions, descending otherwise, matching
+        # BrokerReduceService trim semantics); compare value multisets
+        # so tie-boundary group swaps don't false-positive
+        asc = group_sort_ascending(func)
+        all_vals = sorted((float(v[i]) for v in table.values()), reverse=not asc)
+        want_vals = all_vals[:expect_n]
+        got_vals = [float(_norm(g.value)) for g in result]
+        for gv, wv in zip(sorted(got_vals, reverse=not asc), want_vals):
+            if not math.isclose(gv, wv, rel_tol=REL_TOL, abs_tol=1e-6):
+                errs.append((q.pql, f"agg[{i}] top values {got_vals}", f"want {want_vals}"))
+                break
+
+
+def _check_selection(q, resp, conn, errs):
+    cols = ", ".join(q.select_cols)
+    rows = conn.execute(f"SELECT {cols} FROM testTable{q.where}").fetchall()
+    got_rows = resp.selection_results.rows if resp.selection_results else []
+    expect_n = min(q.limit, len(rows))
+    if len(got_rows) != expect_n:
+        errs.append((q.pql, f"{len(got_rows)} rows", f"want {expect_n}"))
+        return
+    universe = {}
+    for r in rows:
+        key = tuple(_norm(v) for v in r)
+        universe[key] = universe.get(key, 0) + 1
+    for r in got_rows:
+        key = tuple(_norm(v) for v in r)
+        if universe.get(key, 0) <= 0:
+            errs.append((q.pql, f"row {key}", "not in sqlite result (or overused)"))
+        else:
+            universe[key] -= 1
+    if q.order_by:
+        # ordered prefix of sort KEYS must match exactly (tie rows may
+        # differ, but tied keys are equal so the key sequence is stable)
+        idx = [q.select_cols.index(c) for c, _asc in q.order_by]
+        ordered = sorted(
+            (tuple(_norm(v) for v in r) for r in rows),
+            key=lambda t: tuple(
+                _SortKey(t[j], asc) for j, (_c, asc) in zip(idx, q.order_by)
+            ),
+        )
+        want_keys = [tuple(t[j] for j in idx) for t in ordered[:expect_n]]
+        got_keys = [tuple(_norm(r[j]) for j in idx) for r in got_rows]
+        if got_keys != want_keys:
+            errs.append((q.pql, f"order keys {got_keys[:5]}", f"want {want_keys[:5]}"))
+
+
+class _SortKey:
+    """Direction-aware sort key for mixed str/float columns."""
+
+    __slots__ = ("v", "asc")
+
+    def __init__(self, v, asc):
+        self.v = v
+        self.asc = asc
+
+    def __lt__(self, other):
+        if self.v == other.v:
+            return False
+        lt = self.v < other.v
+        return lt if self.asc else not lt
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+
+def _run(seed, num_queries=120, num_servers=2, num_segments=4):
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 600, seed=seed)
+    cluster = InProcessCluster(num_servers=num_servers)
+    physical = cluster.add_offline_table(schema)
+    chunk = len(rows) // num_segments
+    for i in range(num_segments):
+        part = rows[i * chunk : (i + 1) * chunk if i < num_segments - 1 else len(rows)]
+        cluster.upload(physical, build_segment(schema, part, physical, f"sqd{i}"))
+    conn = _load_sqlite(schema, rows)
+    gen = SqlDiffQueryGenerator(schema, rows, seed=seed)
+    errs = []
+    try:
+        for _ in range(num_queries):
+            q = gen.next_diff()
+            resp = cluster.query(q.pql)
+            assert not resp.exceptions, (q.pql, resp.exceptions)
+            if q.kind == "agg":
+                _check_agg(q, resp, conn, errs)
+            elif q.kind == "groupby":
+                _check_group_by(q, resp, conn, errs, num_servers == 1)
+            else:
+                _check_selection(q, resp, conn, errs)
+    finally:
+        conn.close()
+        cluster.stop()
+    assert not errs, f"{len(errs)} mismatches vs sqlite; first 3: {errs[:3]}"
+
+
+def test_sqlite_differential_seed1():
+    _run(seed=101)
+
+
+def test_sqlite_differential_seed2():
+    _run(seed=202)
+
+
+def test_sqlite_differential_many_segments():
+    _run(seed=303, num_queries=60, num_servers=3, num_segments=7)
+
+
+def test_sqlite_differential_single_server_exact():
+    """One server sees every segment, so even huge group key spaces are
+    exact (the regime the reference's H2 cluster tests run in)."""
+    _run(seed=404, num_queries=60, num_servers=1, num_segments=4)
