@@ -223,6 +223,35 @@ def cmd_show_segment(args) -> None:
     print(json.dumps(seg.metadata.to_json(), indent=2, default=str))
 
 
+def cmd_convert_segment(args) -> None:
+    from pinot_tpu.tools.converters import segment_to_csv, segment_to_jsonl
+
+    if args.format == "csv":
+        n = segment_to_csv(args.segment_dir, args.out_file)
+    else:
+        n = segment_to_jsonl(args.segment_dir, args.out_file)
+    print(f"exported {n} rows -> {args.out_file}")
+
+
+def cmd_show_star_tree(args) -> None:
+    from pinot_tpu.tools.converters import star_tree_summary
+
+    print(json.dumps(star_tree_summary(args.segment_dir, max_nodes=args.max_nodes), indent=2))
+
+
+def cmd_generate_data(args) -> None:
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.tools.datagen import random_rows
+
+    with open(args.schema_file) as f:
+        schema = Schema.from_json(json.load(f))
+    rows = random_rows(schema, args.num_rows, seed=args.seed)
+    with open(args.out_file, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    print(f"generated {len(rows)} rows -> {args.out_file}")
+
+
 def main(argv=None) -> None:
     import os
 
@@ -335,6 +364,24 @@ def main(argv=None) -> None:
     ss = sub.add_parser("ShowSegment")
     ss.add_argument("-segment-dir", required=True, dest="segment_dir")
     ss.set_defaults(fn=cmd_show_segment)
+
+    cv = sub.add_parser("ConvertSegment")
+    cv.add_argument("-segment-dir", required=True, dest="segment_dir")
+    cv.add_argument("-format", choices=["csv", "jsonl"], default="jsonl")
+    cv.add_argument("-out-file", required=True, dest="out_file")
+    cv.set_defaults(fn=cmd_convert_segment)
+
+    sst = sub.add_parser("ShowStarTree")
+    sst.add_argument("-segment-dir", required=True, dest="segment_dir")
+    sst.add_argument("-max-nodes", type=int, default=50, dest="max_nodes")
+    sst.set_defaults(fn=cmd_show_star_tree)
+
+    gd = sub.add_parser("GenerateData")
+    gd.add_argument("-schema-file", required=True, dest="schema_file")
+    gd.add_argument("-num-rows", type=int, default=1000, dest="num_rows")
+    gd.add_argument("-seed", type=int, default=0)
+    gd.add_argument("-out-file", required=True, dest="out_file")
+    gd.set_defaults(fn=cmd_generate_data)
 
     args = p.parse_args(argv)
     args.fn(args)

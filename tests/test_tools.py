@@ -135,3 +135,80 @@ def test_http_segment_upload(tmp_path):
     finally:
         http.stop()
         cluster.stop()
+
+
+def test_segment_converters_roundtrip(tmp_path):
+    """Export a segment to CSV/JSONL and rebuild an identical segment
+    from the export (the pinot-tools segment-converter contract)."""
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.segment.readers import read_csv, read_jsonl
+    from pinot_tpu.tools.converters import segment_to_csv, segment_to_jsonl
+
+    schema = make_test_schema(with_mv=True)
+    rows = random_rows(schema, 150, seed=5)
+    seg = build_segment(schema, rows, "t_OFFLINE", "conv")
+
+    jl = str(tmp_path / "out.jsonl")
+    assert segment_to_jsonl(seg, jl) == 150
+    back = read_jsonl(jl, schema)
+    seg2 = build_segment(schema, back, "t_OFFLINE", "conv2")
+    assert seg2.num_docs == seg.num_docs
+    assert seg2.rows() == seg.rows()
+
+    cv = str(tmp_path / "out.csv")
+    assert segment_to_csv(seg, cv) == 150
+    back_csv = read_csv(cv, schema)
+    seg3 = build_segment(schema, back_csv, "t_OFFLINE", "conv3")
+    assert seg3.rows() == seg.rows()
+
+
+def test_star_tree_viewer(tmp_path):
+    from pinot_tpu.startree.builder import StarTreeBuilderConfig
+    from pinot_tpu.tools.converters import star_tree_summary
+
+    schema = baseball_schema()
+    rows = baseball_rows(500, seed=9)
+    seg = build_segment(
+        schema, rows, "bb_OFFLINE", "st1",
+        startree_config=StarTreeBuilderConfig(max_leaf_records=50),
+    )
+    summary = star_tree_summary(seg)
+    assert summary["hasStarTree"]
+    assert summary["splitOrder"]
+    assert summary["numAggRecords"] > 0
+    assert summary["numStarNodes"] > 0
+    assert summary["numLeaves"] > 0
+    assert summary["nodes"][0]["path"] == "(root)"
+    # a plain segment reports no star tree
+    plain = build_segment(schema, rows, "bb_OFFLINE", "plain1")
+    assert star_tree_summary(plain) == {"hasStarTree": False}
+
+
+def test_admin_convert_and_generate(tmp_path, capsys):
+    from pinot_tpu.segment.format import write_segment
+    from pinot_tpu.tools.admin import main as admin_main
+
+    schema = make_test_schema(with_mv=False)
+    schema_file = tmp_path / "schema.json"
+    schema_file.write_text(json.dumps(schema.to_json()))
+
+    out_data = tmp_path / "gen.jsonl"
+    admin_main([
+        "GenerateData", "-schema-file", str(schema_file),
+        "-num-rows", "120", "-out-file", str(out_data),
+    ])
+    assert len(out_data.read_text().splitlines()) == 120
+
+    seg_dir = tmp_path / "seg"
+    admin_main([
+        "CreateSegment", "-schema-file", str(schema_file),
+        "-data-file", str(out_data), "-table", "testTable_OFFLINE",
+        "-segment-name", "g1", "-out-dir", str(seg_dir),
+    ])
+    out_csv = tmp_path / "export.csv"
+    admin_main([
+        "ConvertSegment", "-segment-dir", str(seg_dir),
+        "-format", "csv", "-out-file", str(out_csv),
+    ])
+    assert "exported 120 rows" in capsys.readouterr().out
+    assert len(out_csv.read_text().splitlines()) == 121  # header + rows
