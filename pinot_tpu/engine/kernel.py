@@ -352,6 +352,22 @@ def make_single_segment_kernel(plan: StaticPlan) -> Callable:
     return kernel
 
 
+def _sort_ordinals(sel, seg, q, dtype):
+    """Per sort column: global ordinal of each doc's value, ascending
+    order (descending columns flipped). MV columns order by first value
+    (oracle semantics)."""
+    for col, asc, gcard, remap in zip(
+        sel.sort_columns, sel.sort_ascending, sel.sort_gcards, q["sel_remap"]
+    ):
+        scol = seg.get(f"{col}.fwd")
+        if scol is None:
+            scol = seg[f"{col}.mv"][:, 0]
+        g = remap[scol].astype(dtype)
+        if not asc:
+            g = (gcard - 1) - g
+        yield g, gcard
+
+
 def _selection_outputs(plan: StaticPlan, seg, q, mask) -> Dict[str, Any]:
     sel = plan.selection
     n = mask.shape[0]
@@ -359,18 +375,20 @@ def _selection_outputs(plan: StaticPlan, seg, q, mask) -> Dict[str, Any]:
     if not sel.sort_columns:
         # first-k matching docIds, in doc order
         score = jnp.where(mask, jnp.arange(n, dtype=kdt), n)
+    elif not sel.packed:
+        # Wide key space: radix product overflows the key dtype, so sort
+        # lexicographically with one int32 operand per sort column instead
+        # of packing (XLA sorts multi-operand natively; reference handles
+        # this with its heap comparator, SelectionOperatorService.java:66).
+        keys = [jnp.logical_not(mask).astype(jnp.int32)]  # matches first
+        keys.extend(g for g, _ in _sort_ordinals(sel, seg, q, jnp.int32))
+        keys.append(jnp.arange(n, dtype=jnp.int32))  # doc-order tie-break
+        sorted_ops = jax.lax.sort(tuple(keys), num_keys=len(keys))
+        idx = sorted_ops[-1][: sel.k]
+        return {"sel_docids": idx, "sel_valid": mask[idx]}
     else:
         key = jnp.zeros(n, dtype=kdt)
-        for col, asc, gcard, remap in zip(
-            sel.sort_columns, sel.sort_ascending, sel.sort_gcards, q["sel_remap"]
-        ):
-            scol = seg.get(f"{col}.fwd")
-            if scol is None:
-                # MV sort column: order by first value (oracle semantics)
-                scol = seg[f"{col}.mv"][:, 0]
-            g = remap[scol].astype(kdt)
-            if not asc:
-                g = (gcard - 1) - g
+        for g, gcard in _sort_ordinals(sel, seg, q, kdt):
             key = key * gcard + g
         score = jnp.where(mask, key, jnp.iinfo(kdt).max)
     neg = -score

@@ -86,6 +86,9 @@ class StaticSelection:
     sort_ascending: Tuple[bool, ...]
     sort_gcards: Tuple[int, ...]  # global cards = composite-key radices
     k: int  # per-segment candidates = offset + size
+    # True -> sort key packs into one integer (radix product fits key dtype,
+    # lax.top_k path); False -> multi-operand lexicographic lax.sort path.
+    packed: bool = True
 
 
 @dataclass(frozen=True)
@@ -197,19 +200,20 @@ def build_static_plan(
         sort_cols = tuple(s.column for s in sel.sorts)
         sort_asc = tuple(s.ascending for s in sel.sorts)
         k = min(sel.offset + sel.size, staged.n_pad)
-        # composite sort key must fit the key dtype
+        # Composite sort key packs into one integer only when the radix
+        # product fits the key dtype; wider key spaces stay on device via
+        # multi-operand lexicographic lax.sort (no host fallback needed).
         sort_gcards = tuple(max(ctx.column(c).global_cardinality, 1) for c in sort_cols)
         space = 1
         for g in sort_gcards:
             space *= g
-        if space > config.max_key_space():
-            on_device = False
         selection = StaticSelection(
             columns=cols,
             sort_columns=sort_cols,
             sort_ascending=sort_asc,
             sort_gcards=sort_gcards,
             k=int(k),
+            packed=space <= config.max_key_space(),
         )
 
     return StaticPlan(

@@ -55,6 +55,33 @@ def test_host_fallback_huge_keyspace():
     assert got == want
 
 
+def test_wide_key_order_by_stays_on_device(monkeypatch):
+    """ORDER BY whose composite-key radix product overflows the key dtype
+    uses the multi-operand lexicographic lax.sort path on device (no host
+    fallback), and matches the oracle exactly."""
+    from pinot_tpu.engine import config
+    from pinot_tpu.engine.context import get_table_context
+    from pinot_tpu.engine.device import get_staged
+    from pinot_tpu.engine.plan import build_static_plan
+
+    monkeypatch.setattr(config, "max_key_space", lambda: 10)
+
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 400, seed=77)
+    seg = build_segment(schema, rows, "testTable", "wideksel")
+
+    pql = "SELECT dimStr, metInt FROM testTable ORDER BY dimInt, metInt DESC LIMIT 12"
+    req = parse_pql(pql)
+    ctx = get_table_context([seg])
+    staged = get_staged([seg], ["dimStr", "metInt", "dimInt"])
+    plan = build_static_plan(req, ctx, staged)
+    assert plan.on_device
+    assert plan.selection is not None and not plan.selection.packed
+
+    got, want = run_both(schema, rows, [seg], pql)
+    assert got == want
+
+
 def test_mv_order_by_selection():
     schema = make_test_schema()
     rows = random_rows(schema, 300, seed=21)
