@@ -18,6 +18,7 @@ from urllib.parse import parse_qs, urlparse
 
 from pinot_tpu.common.schema import Schema
 from pinot_tpu.common.tableconfig import TableConfig
+from pinot_tpu.controller import dashboard
 from pinot_tpu.controller.managers import (
     RetentionManager,
     SegmentStatusChecker,
@@ -153,34 +154,42 @@ class Controller:
         self.status_checker.stop()
 
 
-def _render_dashboard(ctrl: Controller) -> str:
-    """Ops status page (the pinot-dashboard Flask UI analog): instances,
-    tables, per-segment ideal vs external state."""
-    rows = []
-    rows.append("<h1>pinot_tpu cluster</h1>")
-    rows.append("<h2>Instances</h2><table border=1 cellpadding=4><tr><th>name</th><th>role</th><th>alive</th><th>url</th></tr>")
-    for inst in ctrl.resources.instances.values():
-        rows.append(
-            f"<tr><td>{inst.name}</td><td>{inst.role}</td><td>{inst.alive}</td><td>{inst.url or ''}</td></tr>"
+def _alive_broker_urls(resources: ClusterResourceManager) -> List[str]:
+    return [
+        i.url
+        for i in resources.instances_snapshot()
+        if i.role == "broker" and i.alive and i.url
+    ]
+
+
+def _proxy_pql(ctrl: Controller, pql: str, trace: bool = False) -> Dict[str, Any]:
+    """Forward a PQL query to an alive broker and return its JSON
+    response (``PqlQueryResource.java`` — the controller-side query
+    proxy used by the dashboard's query console). Brokers are tried in
+    random order with failover, as the reference picks a random broker."""
+    import random
+    import urllib.error
+    import urllib.request
+
+    brokers = _alive_broker_urls(ctrl.resources)
+    if not brokers:
+        return {"error": "no alive broker registered"}
+    random.shuffle(brokers)
+    last_err: Optional[Exception] = None
+    for url in brokers:
+        req = urllib.request.Request(
+            url.rstrip("/") + "/query",
+            data=json.dumps({"pql": pql, "trace": trace}).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
         )
-    rows.append("</table>")
-    for table in ctrl.resources.tables():
-        ideal = ctrl.resources.get_ideal_state(table)
-        view = ctrl.resources.get_external_view(table)
-        rows.append(f"<h2>{table}</h2>")
-        rows.append(
-            "<table border=1 cellpadding=4><tr><th>segment</th><th>ideal</th><th>external</th><th>docs</th></tr>"
-        )
-        for seg in sorted(ideal):
-            info = ctrl.resources.get_segment_metadata(table, seg) or {}
-            meta = info.get("metadata")
-            docs = meta.num_docs if meta is not None else ""
-            mark = "" if ideal[seg] == view.get(seg, {}) else " style='background:#fdd'"
-            rows.append(
-                f"<tr{mark}><td>{seg}</td><td>{ideal[seg]}</td><td>{view.get(seg, {})}</td><td>{docs}</td></tr>"
-            )
-        rows.append("</table>")
-    return "<html><body style='font-family:monospace'>" + "\n".join(rows) + "</body></html>"
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            # ValueError covers JSONDecodeError from a non-broker process
+            # squatting on a stale registration's port
+            last_err = e
+    return {"error": f"all brokers failed: {last_err}"}
 
 
 class ControllerHttpServer:
@@ -226,7 +235,18 @@ class ControllerHttpServer:
                 parts = [p for p in url.path.split("/") if p]
                 try:
                     if not parts or parts == ["dashboard"]:
-                        return self._respond_html(_render_dashboard(ctrl))
+                        return self._respond_html(dashboard.render_home(ctrl))
+                    if parts == ["dashboard", "query"]:
+                        return self._respond_html(dashboard.render_query_console())
+                    if len(parts) == 3 and parts[:2] == ["dashboard", "table"]:
+                        if parts[2] not in ctrl.resources.tables():
+                            return self._respond({"error": "table not found"}, 404)
+                        return self._respond_html(dashboard.render_table(ctrl, parts[2]))
+                    if parts == ["pql"]:
+                        qs = parse_qs(url.query)
+                        pql = (qs.get("pql") or [""])[0]
+                        trace = (qs.get("trace") or ["false"])[0].lower() == "true"
+                        return self._respond(_proxy_pql(ctrl, pql, trace))
                     if parts == ["health"]:
                         return self._respond({"status": "ok"})
                     if parts == ["clusterstate"]:
@@ -259,13 +279,7 @@ class ControllerHttpServer:
                             return self._respond_bytes(f.read())
                     if parts == ["brokers"]:
                         return self._respond(
-                            {
-                                "brokers": [
-                                    i.url
-                                    for i in ctrl.resources.instances.values()
-                                    if i.role == "broker" and i.alive and i.url
-                                ]
-                            }
+                            {"brokers": _alive_broker_urls(ctrl.resources)}
                         )
                     if parts == ["tables"]:
                         return self._respond({"tables": ctrl.resources.tables()})
@@ -307,6 +321,13 @@ class ControllerHttpServer:
                 url = urlparse(self.path)
                 parts = [p for p in url.path.split("/") if p]
                 try:
+                    if parts == ["pql"]:
+                        body = self._read_json()
+                        return self._respond(
+                            _proxy_pql(
+                                ctrl, body.get("pql", ""), bool(body.get("trace"))
+                            )
+                        )
                     if parts == ["instances"]:
                         return self._respond(ctrl.gateway.register(self._read_json()))
                     if len(parts) == 3 and parts[0] == "instances" and parts[2] == "heartbeat":

@@ -1,0 +1,191 @@
+"""Controller ops UI (the pinot-dashboard Flask app analog).
+
+The reference ships a separate Python Flask dashboard
+(``pinot-dashboard/pinotui/__init__.py`` — routes for fabric/cluster
+lists, per-table info, and a query console ``send_pql``) plus a
+controller-side query proxy (``PqlQueryResource.java``). Here the same
+surface is served by the controller's own HTTP front: stdlib-rendered
+HTML pages over the live ResourceManager state, and a ``/pql`` proxy
+that forwards to an alive broker.
+"""
+from __future__ import annotations
+
+import html as _html
+from typing import List
+
+_STYLE = """
+<style>
+  body { font-family: -apple-system, 'Segoe UI', sans-serif; margin: 2em;
+         color: #1a1a1a; }
+  h1, h2 { font-weight: 600; }
+  a { color: #0b57d0; text-decoration: none; }
+  a:hover { text-decoration: underline; }
+  table { border-collapse: collapse; margin: 0.6em 0 1.4em; }
+  th, td { border: 1px solid #ccc; padding: 4px 10px; text-align: left;
+           font-size: 14px; }
+  th { background: #f2f2f2; }
+  tr.mismatch { background: #fdd; }
+  .ok { color: #188038; } .bad { color: #c5221f; }
+  nav { margin-bottom: 1.4em; }
+  nav a { margin-right: 1.2em; }
+  textarea { width: 100%; max-width: 56em; font-family: monospace; }
+  pre { background: #f6f8fa; padding: 1em; max-width: 56em;
+        overflow-x: auto; font-size: 13px; }
+</style>
+"""
+
+_NAV = (
+    "<nav><a href='/dashboard'>Cluster</a>"
+    "<a href='/dashboard/query'>Query console</a>"
+    "<a href='/clusterstate'>Raw state (JSON)</a></nav>"
+)
+
+
+def _esc(v) -> str:
+    return _html.escape(str(v))
+
+
+def _page(title: str, body: List[str]) -> str:
+    return (
+        f"<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>{_esc(title)}</title>{_STYLE}</head><body>"
+        + _NAV
+        + "\n".join(body)
+        + "</body></html>"
+    )
+
+
+def render_home(ctrl) -> str:
+    """Cluster overview: instances, tenants, tables (cluster_profile.html
+    + fabric home of the reference dashboard)."""
+    body = ["<h1>pinot_tpu cluster</h1>"]
+
+    body.append("<h2>Instances</h2>")
+    body.append(
+        "<table><tr><th>name</th><th>role</th><th>status</th>"
+        "<th>tags</th><th>url</th></tr>"
+    )
+    for inst in ctrl.resources.instances_snapshot():
+        status = (
+            "<span class='ok'>alive</span>"
+            if inst.alive
+            else "<span class='bad'>down</span>"
+        )
+        tags = ", ".join(sorted(getattr(inst, "tags", []) or []))
+        body.append(
+            f"<tr><td>{_esc(inst.name)}</td><td>{_esc(inst.role)}</td>"
+            f"<td>{status}</td><td>{_esc(tags)}</td>"
+            f"<td>{_esc(inst.url or '')}</td></tr>"
+        )
+    body.append("</table>")
+
+    tenants = ctrl.resources.list_tenants()
+    if tenants:
+        body.append("<h2>Tenants</h2>")
+        body.append("<table><tr><th>tenant</th><th>servers</th><th>brokers</th></tr>")
+        for t in sorted(tenants):
+            body.append(
+                f"<tr><td>{_esc(t)}</td>"
+                f"<td>{_esc(', '.join(ctrl.resources.tenant_instances(t, 'server')))}</td>"
+                f"<td>{_esc(', '.join(ctrl.resources.tenant_instances(t, 'broker')))}</td></tr>"
+            )
+        body.append("</table>")
+
+    body.append("<h2>Tables</h2>")
+    body.append(
+        "<table><tr><th>table</th><th>segments</th><th>docs</th>"
+        "<th>size (bytes)</th><th>converged</th></tr>"
+    )
+    for table in ctrl.resources.tables():
+        ideal = ctrl.resources.get_ideal_state(table)
+        view = ctrl.resources.get_external_view(table)
+        docs = 0
+        for seg in ideal:
+            info = ctrl.resources.get_segment_metadata(table, seg) or {}
+            meta = info.get("metadata")
+            docs += meta.num_docs if meta is not None else 0
+        converged = all(ideal[s] == view.get(s, {}) for s in ideal)
+        cv = (
+            "<span class='ok'>yes</span>"
+            if converged
+            else "<span class='bad'>no</span>"
+        )
+        body.append(
+            f"<tr><td><a href='/dashboard/table/{_esc(table)}'>{_esc(table)}</a></td>"
+            f"<td>{len(ideal)}</td><td>{docs}</td>"
+            f"<td>{ctrl.store.table_size_bytes(table)}</td><td>{cv}</td></tr>"
+        )
+    body.append("</table>")
+    return _page("pinot_tpu cluster", body)
+
+
+def render_table(ctrl, table: str) -> str:
+    """Per-table page: schema + per-segment ideal vs external state
+    (table_info.html analog; highlights unconverged segments like the
+    controller TableViews resource)."""
+    body = [f"<h1>{_esc(table)}</h1>"]
+
+    raw = table.rsplit("_", 1)[0]
+    schema = ctrl.resources.get_schema(raw) or ctrl.resources.get_schema(table)
+    if schema is not None:
+        body.append("<h2>Schema</h2>")
+        body.append("<table><tr><th>column</th><th>type</th><th>field</th></tr>")
+        for spec in schema.all_fields():
+            body.append(
+                f"<tr><td>{_esc(spec.name)}</td><td>{_esc(spec.data_type.name)}</td>"
+                f"<td>{_esc(spec.field_type.name)}</td></tr>"
+            )
+        body.append("</table>")
+
+    ideal = ctrl.resources.get_ideal_state(table)
+    view = ctrl.resources.get_external_view(table)
+    body.append("<h2>Segments</h2>")
+    body.append(
+        "<table><tr><th>segment</th><th>ideal</th><th>external</th>"
+        "<th>docs</th></tr>"
+    )
+    for seg in sorted(ideal):
+        info = ctrl.resources.get_segment_metadata(table, seg) or {}
+        meta = info.get("metadata")
+        docs = meta.num_docs if meta is not None else ""
+        cls = " class='mismatch'" if ideal[seg] != view.get(seg, {}) else ""
+        body.append(
+            f"<tr{cls}><td>{_esc(seg)}</td><td>{_esc(ideal[seg])}</td>"
+            f"<td>{_esc(view.get(seg, {}))}</td><td>{docs}</td></tr>"
+        )
+    body.append("</table>")
+    return _page(table, body)
+
+
+def render_query_console() -> str:
+    """Query console page (query_console.html analog): posts PQL to the
+    controller's /pql proxy, renders the broker JSON response."""
+    body = [
+        "<h1>Query console</h1>",
+        "<form id='f'>",
+        "<textarea id='pql' rows='4' placeholder='SELECT count(*) FROM myTable'>"
+        "</textarea><br>",
+        "<label><input type='checkbox' id='trace'> trace</label> ",
+        "<button type='submit'>Run</button>",
+        "</form>",
+        "<pre id='out'></pre>",
+        """<script>
+document.getElementById('f').addEventListener('submit', async (e) => {
+  e.preventDefault();
+  const out = document.getElementById('out');
+  out.textContent = 'running...';
+  try {
+    const r = await fetch('/pql', {
+      method: 'POST',
+      headers: {'Content-Type': 'application/json'},
+      body: JSON.stringify({
+        pql: document.getElementById('pql').value,
+        trace: document.getElementById('trace').checked,
+      }),
+    });
+    out.textContent = JSON.stringify(await r.json(), null, 2);
+  } catch (err) { out.textContent = String(err); }
+});
+</script>""",
+    ]
+    return _page("Query console", body)

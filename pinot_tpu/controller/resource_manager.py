@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from pinot_tpu.common.schema import Schema
@@ -91,6 +91,13 @@ class ClusterResourceManager:
             return self.version
 
     # -- instances ----------------------------------------------------
+    def instances_snapshot(self) -> List[InstanceState]:
+        """Point-in-time instance copies for lock-free iteration by
+        readers (dashboard pages, broker discovery). Tags are copied too
+        so create_tenant can't mutate a set mid-iteration."""
+        with self._lock:
+            return [replace(i, tags=set(i.tags)) for i in self.instances.values()]
+
     def register_instance(self, state: InstanceState, participant: Optional[Participant] = None) -> None:
         with self._lock:
             self.instances[state.name] = state

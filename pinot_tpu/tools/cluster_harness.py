@@ -49,13 +49,16 @@ class InProcessCluster:
             self.server_starters.append(starter)
 
         self.broker = BrokerRequestHandler(self.transport, addresses, name="broker0")
-        self.broker_starter = BrokerStarter(self.broker, self.controller.resources)
-        self.broker_starter.start()
-
         self.http: Optional[BrokerHttpServer] = None
+        broker_url = None
         if http:
             self.http = BrokerHttpServer(self.broker)
             self.http.start()
+            broker_url = f"http://{self.http.host}:{self.http.port}"
+        self.broker_starter = BrokerStarter(
+            self.broker, self.controller.resources, url=broker_url
+        )
+        self.broker_starter.start()
 
     def add_server(self, name: Optional[str] = None, mesh=None) -> ServerInstance:
         """Join a new server into the running cluster (elastic scale-out;

@@ -16,8 +16,8 @@ from pinot_tpu.tools.datagen import make_test_schema, random_rows
 from pinot_tpu.tools.scan_engine import ScanQueryProcessor
 
 
-def make_cluster(num_servers=2, replication=1, tmp=None):
-    cluster = InProcessCluster(num_servers=num_servers, data_dir=tmp)
+def make_cluster(num_servers=2, replication=1, tmp=None, http=False):
+    cluster = InProcessCluster(num_servers=num_servers, data_dir=tmp, http=http)
     schema = make_test_schema(with_mv=False)
     physical = cluster.add_offline_table(schema, replication=replication)
     return cluster, schema, physical
@@ -186,20 +186,42 @@ def test_controller_http(tmp_path):
         http.stop()
 
 
-def test_dashboard_page(tmp_path):
-    cluster, schema, physical = make_cluster(tmp=str(tmp_path))
+def test_dashboard_pages_and_pql_proxy(tmp_path):
+    """Ops UI (pinot-dashboard analog): home, per-table, query-console
+    pages, and the PqlQueryResource-style /pql proxy to a live broker."""
+    cluster, schema, physical = make_cluster(tmp=str(tmp_path / "ctrl"), http=True)
     rows = random_rows(schema, 30, seed=8)
     cluster.upload(physical, build_segment(schema, rows, physical, "dash1"))
     http = ControllerHttpServer(cluster.controller)
     http.start()
+    base = f"http://127.0.0.1:{http.port}"
     try:
-        with urllib.request.urlopen(f"http://127.0.0.1:{http.port}/", timeout=5) as r:
-            html = r.read().decode()
-        assert "pinot_tpu cluster" in html
-        assert "dash1" in html
-        assert "server0" in html
+        with urllib.request.urlopen(base + "/", timeout=5) as r:
+            home = r.read().decode()
+        assert "pinot_tpu cluster" in home
+        assert physical in home and "server0" in home
+
+        with urllib.request.urlopen(
+            base + f"/dashboard/table/{physical}", timeout=5
+        ) as r:
+            table_page = r.read().decode()
+        assert "dash1" in table_page
+        assert "dimStr" in table_page  # schema rendered
+
+        with urllib.request.urlopen(base + "/dashboard/query", timeout=5) as r:
+            assert "Query console" in r.read().decode()
+
+        req = urllib.request.Request(
+            base + "/pql",
+            data=json.dumps({"pql": "SELECT count(*) FROM testTable"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["numDocsScanned"] == 30, out
     finally:
         http.stop()
+        cluster.stop()
 
 
 def test_upload_refresh_replaces_segment(tmp_path):
