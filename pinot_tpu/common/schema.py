@@ -189,6 +189,20 @@ class TimeFieldSpec(FieldSpec):
 
     @classmethod
     def from_json(cls, d: Dict[str, Any], field_type: Optional[FieldType] = None) -> "TimeFieldSpec":
+        # Accept both the flat form this package writes and the
+        # reference's nested TimeGranularitySpec form
+        # (``"timeFieldSpec": {"incomingGranularitySpec": {"name", "dataType",
+        # "timeType"}}`` — common/data/TimeFieldSpec.java, as in the
+        # sample_data/*.schema files), so reference schema JSON loads as-is.
+        g = d.get("incomingGranularitySpec")
+        if g is not None:
+            return cls(
+                name=g["name"],
+                data_type=DataType(g["dataType"]),
+                single_value=g.get("singleValueField", True),
+                default_null_value=d.get("defaultNullValue"),
+                time_unit=g.get("timeType", d.get("timeUnit", "DAYS")),
+            )
         return cls(
             name=d["name"],
             data_type=DataType(d["dataType"]),

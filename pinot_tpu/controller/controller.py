@@ -14,7 +14,7 @@ import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 from pinot_tpu.common.schema import Schema
 from pinot_tpu.common.tableconfig import TableConfig
@@ -154,6 +154,16 @@ class Controller:
         self.status_checker.stop()
 
 
+def _split_path(path: str) -> Optional[List[str]]:
+    """URL-decoded path segments, or None for segments that would
+    traverse the filesystem when joined into store paths (%2F / '..')."""
+    parts = [unquote(p) for p in path.split("/") if p]
+    for p in parts:
+        if "/" in p or "\\" in p or p in (".", ".."):
+            return None
+    return parts
+
+
 def _alive_broker_urls(resources: ClusterResourceManager) -> List[str]:
     return [
         i.url
@@ -232,7 +242,9 @@ class ControllerHttpServer:
 
             def do_GET(self):
                 url = urlparse(self.path)
-                parts = [p for p in url.path.split("/") if p]
+                parts = _split_path(url.path)
+                if parts is None:
+                    return self._respond({"error": "bad path"}, 400)
                 try:
                     if not parts or parts == ["dashboard"]:
                         return self._respond_html(dashboard.render_home(ctrl))
@@ -319,7 +331,9 @@ class ControllerHttpServer:
 
             def do_POST(self):
                 url = urlparse(self.path)
-                parts = [p for p in url.path.split("/") if p]
+                parts = _split_path(url.path)
+                if parts is None:
+                    return self._respond({"error": "bad path"}, 400)
                 try:
                     if parts == ["pql"]:
                         body = self._read_json()
@@ -365,7 +379,9 @@ class ControllerHttpServer:
 
             def do_DELETE(self):
                 url = urlparse(self.path)
-                parts = [p for p in url.path.split("/") if p]
+                parts = _split_path(url.path)
+                if parts is None:
+                    return self._respond({"error": "bad path"}, 400)
                 try:
                     if len(parts) == 2 and parts[0] == "tables":
                         ctrl.delete_table(parts[1])

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import html as _html
 from typing import List
+from urllib.parse import quote
 
 _STYLE = """
 <style>
@@ -111,7 +112,7 @@ def render_home(ctrl) -> str:
             else "<span class='bad'>no</span>"
         )
         body.append(
-            f"<tr><td><a href='/dashboard/table/{_esc(table)}'>{_esc(table)}</a></td>"
+            f"<tr><td><a href='/dashboard/table/{quote(table, safe='')}'>{_esc(table)}</a></td>"
             f"<td>{len(ideal)}</td><td>{docs}</td>"
             f"<td>{ctrl.store.table_size_bytes(table)}</td><td>{cv}</td></tr>"
         )

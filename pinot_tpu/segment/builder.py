@@ -153,31 +153,42 @@ class SegmentBuilder:
                     metadata=meta, dictionary=d, mv_values=mv_values, mv_offsets=offsets
                 )
 
-        # ---- segment metadata ---------------------------------------
-        seg_name = self.config.segment_name or f"{self.config.table_name}_{num_docs}_{int(time.time())}"
-        meta = SegmentMetadata(
-            segment_name=seg_name,
-            table_name=self.config.table_name,
-            num_docs=num_docs,
-            columns={c.metadata.name: c.metadata for c in columns.values()},
-            time_column=schema.time_column_name,
-            time_unit=schema.time_field.time_unit if schema.time_field else "DAYS",
-            creation_time_ms=int(time.time() * 1000),
-        )
-        if schema.time_field is not None and num_docs > 0:
-            tcol = columns[schema.time_column_name]
-            if not tcol.dictionary.is_string:
-                meta.start_time = int(tcol.dictionary.min_value)
-                meta.end_time = int(tcol.dictionary.max_value)
+        return finalize_segment(schema, self.config, num_docs, columns)
 
-        segment = ImmutableSegment(metadata=meta, columns=columns)
-        meta.crc = segment.compute_crc()
 
-        if self.config.startree_config is not None:
-            from pinot_tpu.startree.builder import build_star_tree
+def finalize_segment(
+    schema: Schema,
+    config: SegmentGeneratorConfig,
+    num_docs: int,
+    columns: Dict[str, ColumnData],
+) -> ImmutableSegment:
+    """Segment metadata + CRC + optional star-tree — shared tail of the
+    row-wise and columnar build paths (metadata.properties /
+    creation.meta analogs)."""
+    seg_name = config.segment_name or f"{config.table_name}_{num_docs}_{int(time.time())}"
+    meta = SegmentMetadata(
+        segment_name=seg_name,
+        table_name=config.table_name,
+        num_docs=num_docs,
+        columns={c.metadata.name: c.metadata for c in columns.values()},
+        time_column=schema.time_column_name,
+        time_unit=schema.time_field.time_unit if schema.time_field else "DAYS",
+        creation_time_ms=int(time.time() * 1000),
+    )
+    if schema.time_field is not None and num_docs > 0:
+        tcol = columns[schema.time_column_name]
+        if not tcol.dictionary.is_string:
+            meta.start_time = int(tcol.dictionary.min_value)
+            meta.end_time = int(tcol.dictionary.max_value)
 
-            segment = build_star_tree(segment, self.schema, self.config.startree_config)
-        return segment
+    segment = ImmutableSegment(metadata=meta, columns=columns)
+    meta.crc = segment.compute_crc()
+
+    if config.startree_config is not None:
+        from pinot_tpu.startree.builder import build_star_tree
+
+        segment = build_star_tree(segment, schema, config.startree_config)
+    return segment
 
 
 def build_segment(

@@ -25,13 +25,30 @@ _tried = False
 _lock = threading.Lock()
 
 
+def _stale() -> bool:
+    try:
+        if not os.path.exists(_LIB_PATH):
+            return True
+        so_mtime = os.path.getmtime(_LIB_PATH)
+        return any(
+            f.endswith(".cpp")
+            and os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > so_mtime
+            for f in os.listdir(_NATIVE_DIR)
+        )
+    except OSError:  # concurrent clean/checkout: let make sort it out
+        return True
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     with _lock:
         if _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+        if os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")) and _stale():
+            # only spawn make when the .so is missing or older than a
+            # source; the Makefile builds atomically (temp + rename) so
+            # concurrent processes can't corrupt it
             try:
                 subprocess.run(
                     ["make", "-C", _NATIVE_DIR],
@@ -56,6 +73,24 @@ def _load() -> Optional[ctypes.CDLL]:
                     ctypes.c_int,
                     ctypes.POINTER(ctypes.c_int32),
                 ]
+                # a stale prebuilt .so (no toolchain to rebuild) keeps its
+                # working symbols; only csv_parse degrades to the fallback
+                if hasattr(lib, "pinot_csv_parse"):
+                    lib.pinot_csv_parse.argtypes = [
+                        ctypes.c_char_p,
+                        ctypes.c_int64,
+                        ctypes.c_int64,
+                        ctypes.c_char,
+                        ctypes.c_int,
+                        ctypes.POINTER(ctypes.c_int8),
+                        ctypes.POINTER(ctypes.c_int64),
+                        ctypes.POINTER(ctypes.c_double),
+                        ctypes.c_int64,
+                        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+                        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+                        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+                    ]
+                    lib.pinot_csv_parse.restype = ctypes.c_int64
                 _lib = lib
             except OSError as e:
                 logger.info("native codec load failed: %s", e)
@@ -64,6 +99,11 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+def csv_available() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "pinot_csv_parse")
 
 
 def pack_bits(values: np.ndarray, nbits: int) -> Optional[np.ndarray]:
@@ -80,6 +120,74 @@ def pack_bits(values: np.ndarray, nbits: int) -> Optional[np.ndarray]:
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
     )
     return out
+
+
+def csv_parse(data: bytes, start: int, delimiter: str, types, i64_defaults, f64_defaults):
+    """One-pass columnar CSV parse (native/csvread.cpp), starting at
+    byte offset ``start`` (past the header) — the buffer is not copied.
+
+    ``types[c]``: 0 -> int64 column, 1 -> float64 column, 2 -> raw
+    (offset,length) slices for string/MV cells (offsets absolute into
+    ``data``), 3 -> tokenize but record nothing (non-schema columns).
+    Returns ``(nrows, i64_cols, f64_cols, str_offs)`` — dicts keyed by
+    column index, each value a numpy array trimmed to nrows — or None
+    when the native library is unavailable or the data needs the Python
+    parser (quoted cells, unparseable numerics, ragged-wide rows,
+    non-ASCII delimiter).
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "pinot_csv_parse"):
+        return None
+    try:
+        delim = delimiter.encode("ascii")
+    except UnicodeEncodeError:
+        return None  # python csv handles exotic delimiters
+    if len(delim) != 1:
+        return None
+    ncols = len(types)
+    types_arr = np.asarray(types, dtype=np.int8)
+    i64_def = np.asarray(i64_defaults, dtype=np.int64)
+    f64_def = np.asarray(f64_defaults, dtype=np.float64)
+    max_rows = data.count(b"\n", start) + 1
+    i64_cols = {c: np.empty(max_rows, dtype=np.int64) for c in range(ncols) if types[c] == 0}
+    f64_cols = {c: np.empty(max_rows, dtype=np.float64) for c in range(ncols) if types[c] == 1}
+    str_offs = {c: np.empty(2 * max_rows, dtype=np.int64) for c in range(ncols) if types[c] == 2}
+
+    PI64 = ctypes.POINTER(ctypes.c_int64)
+    PF64 = ctypes.POINTER(ctypes.c_double)
+    null_i64 = ctypes.cast(None, PI64)
+    null_f64 = ctypes.cast(None, PF64)
+    i64_ptrs = (PI64 * ncols)(
+        *[i64_cols[c].ctypes.data_as(PI64) if c in i64_cols else null_i64 for c in range(ncols)]
+    )
+    f64_ptrs = (PF64 * ncols)(
+        *[f64_cols[c].ctypes.data_as(PF64) if c in f64_cols else null_f64 for c in range(ncols)]
+    )
+    off_ptrs = (PI64 * ncols)(
+        *[str_offs[c].ctypes.data_as(PI64) if c in str_offs else null_i64 for c in range(ncols)]
+    )
+    nrows = lib.pinot_csv_parse(
+        data,
+        len(data),
+        start,
+        delim,
+        ncols,
+        types_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        i64_def.ctypes.data_as(PI64),
+        f64_def.ctypes.data_as(PF64),
+        max_rows,
+        i64_ptrs,
+        f64_ptrs,
+        off_ptrs,
+    )
+    if nrows < 0:
+        return None  # fall back to the Python csv module
+    return (
+        int(nrows),
+        {c: a[:nrows] for c, a in i64_cols.items()},
+        {c: a[:nrows] for c, a in f64_cols.items()},
+        {c: a[: 2 * nrows] for c, a in str_offs.items()},
+    )
 
 
 def unpack_bits(packed: np.ndarray, nbits: int, count: int) -> Optional[np.ndarray]:

@@ -136,20 +136,24 @@ def cmd_start_broker(args) -> None:
 def cmd_create_segment(args) -> None:
     from pinot_tpu.common.schema import Schema
     from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.segment.columnar import build_segment_from_csv
     from pinot_tpu.segment.format import write_segment
-    from pinot_tpu.segment.readers import read_csv, read_jsonl
+    from pinot_tpu.segment.readers import read_jsonl
     from pinot_tpu.startree.builder import StarTreeBuilderConfig
 
     with open(args.schema_file) as f:
         schema = Schema.from_json(json.load(f))
+    cfg = StarTreeBuilderConfig() if args.startree else None
     if args.data_file.endswith(".csv"):
-        rows = read_csv(args.data_file, schema)
+        # columnar path (native one-pass parse when available)
+        seg = build_segment_from_csv(
+            schema, args.data_file, args.table, args.segment_name, startree_config=cfg
+        )
     else:
         rows = read_jsonl(args.data_file, schema)
-    cfg = StarTreeBuilderConfig() if args.startree else None
-    seg = build_segment(
-        schema, rows, args.table, args.segment_name, startree_config=cfg
-    )
+        seg = build_segment(
+            schema, rows, args.table, args.segment_name, startree_config=cfg
+        )
     path = write_segment(seg, args.out_dir)
     print(f"built segment {seg.segment_name}: {seg.num_docs} docs -> {path}")
 

@@ -238,3 +238,27 @@ def test_upload_refresh_replaces_segment(tmp_path):
 
     cluster.controller.delete_segment(physical, "refresh_me")
     assert cluster.query("SELECT count(*) FROM testTable").num_docs_scanned == 0
+
+
+def test_http_path_traversal_rejected(tmp_path):
+    """Percent-encoded '/' or '..' in path segments must not reach the
+    segment store as filesystem paths."""
+    cluster, schema, physical = make_cluster(tmp=str(tmp_path))
+    http = ControllerHttpServer(cluster.controller)
+    http.start()
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        for path in (
+            "/segments/..%2F..%2Fetc/x/file",
+            "/tables/..%2F..",
+            "/dashboard/table/..",
+        ):
+            code = None
+            try:
+                urllib.request.urlopen(base + path, timeout=5)
+            except urllib.error.HTTPError as e:
+                code = e.code
+            assert code in (400, 404), (path, code)
+    finally:
+        http.stop()
+        cluster.stop()

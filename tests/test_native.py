@@ -42,3 +42,153 @@ def test_native_matches_numpy(card):
 
     # public API roundtrip (dispatches to native for n >= 4096)
     np.testing.assert_array_equal(ub(pb(vals, nbits), nbits, n), vals)
+
+
+# ---------------------------------------------------------------------------
+# Native CSV -> columnar build path
+# ---------------------------------------------------------------------------
+
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.segment.columnar import build_segment_from_csv, read_csv_columnar
+from pinot_tpu.segment.readers import MV_DELIMITER, read_csv
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+
+
+def _write_csv(path, schema, rows):
+    names = [s.name for s in schema.all_fields()]
+    with open(path, "w") as f:
+        f.write(",".join(names) + "\n")
+        for row in rows:
+            cells = []
+            for n in names:
+                v = row[n]
+                if isinstance(v, list):
+                    cells.append(MV_DELIMITER.join(str(x) for x in v))
+                else:
+                    cells.append(str(v))
+            f.write(",".join(cells) + "\n")
+
+
+def _assert_segments_equal(a, b):
+    assert a.num_docs == b.num_docs
+    assert set(a.columns) == set(b.columns)
+    for name, ca in a.columns.items():
+        cb = b.columns[name]
+        ma, mb = ca.metadata, cb.metadata
+        for attr in (
+            "cardinality",
+            "is_sorted",
+            "max_num_multi_values",
+            "total_number_of_entries",
+            "min_value",
+            "max_value",
+        ):
+            assert getattr(ma, attr) == getattr(mb, attr), (name, attr)
+        if ca.dictionary.is_string:
+            assert list(ca.dictionary.values) == list(cb.dictionary.values)
+        else:
+            np.testing.assert_array_equal(ca.dictionary.values, cb.dictionary.values)
+        if ca.fwd is not None:
+            np.testing.assert_array_equal(ca.fwd, cb.fwd)
+        else:
+            np.testing.assert_array_equal(ca.mv_values, cb.mv_values)
+            np.testing.assert_array_equal(ca.mv_offsets, cb.mv_offsets)
+    assert a.compute_crc() == b.compute_crc()
+
+
+def test_columnar_csv_build_matches_row_build(tmp_path):
+    """The native columnar CSV path must produce a segment identical to
+    the row-wise Python path (same dictionaries, fwd indexes, metadata,
+    CRC)."""
+    schema = make_test_schema()  # includes MV columns
+    rows = random_rows(schema, 500, seed=13)
+    path = str(tmp_path / "data.csv")
+    _write_csv(path, schema, rows)
+
+    cols, n = read_csv_columnar(path, schema)
+    assert cols is not None, "native fast path should engage on plain CSV"
+    assert n == 500
+
+    seg_columnar = build_segment_from_csv(schema, path, "t", "seg_c")
+    seg_rows = build_segment(schema, read_csv(path, schema), "t", "seg_c")
+    _assert_segments_equal(seg_columnar, seg_rows)
+
+
+def test_columnar_csv_missing_cells_and_blank_lines(tmp_path):
+    schema = make_test_schema(with_mv=False)
+    path = str(tmp_path / "gaps.csv")
+    names = [s.name for s in schema.all_fields()]
+    with open(path, "w") as f:
+        f.write(",".join(names) + "\n")
+        f.write("alpha,1,2,3.5,4.5,100\n")
+        f.write("\n")  # blank line skipped
+        f.write("beta,7\n")  # missing trailing cells -> defaults
+        f.write("gamma,,,,,200\n")  # empty numeric cells -> defaults
+
+    seg_columnar = build_segment_from_csv(schema, path, "t", "g1")
+    seg_rows = build_segment(schema, read_csv(path, schema), "t", "g1")
+    _assert_segments_equal(seg_columnar, seg_rows)
+
+
+def test_columnar_csv_quoted_falls_back(tmp_path):
+    """Quoted CSV routes to the Python csv module and still builds."""
+    schema = make_test_schema(with_mv=False)
+    path = str(tmp_path / "quoted.csv")
+    names = [s.name for s in schema.all_fields()]
+    with open(path, "w") as f:
+        f.write(",".join(names) + "\n")
+        f.write('"hello, world",1,2,3.5,4.5,100\n')
+
+    cols, _ = read_csv_columnar(path, schema)
+    assert cols is None
+    seg = build_segment_from_csv(schema, path, "t", "q1")
+    assert seg.num_docs == 1
+    assert seg.columns["dimStr"].dictionary.values[0] == "hello, world"
+
+
+def test_columnar_csv_nan_cells_match_row_path(tmp_path):
+    """'nan' in a float column maps to the default null value on both
+    paths (the row builder's isnan -> default rule)."""
+    schema = make_test_schema(with_mv=False)
+    path = str(tmp_path / "nan.csv")
+    names = [s.name for s in schema.all_fields()]
+    with open(path, "w") as f:
+        f.write(",".join(names) + "\n")
+        f.write("a,1,2,3,nan,nan,100\n")
+        f.write("b,3,4,5,1.5,2.5,200\n")
+
+    seg_columnar = build_segment_from_csv(schema, path, "t", "n1")
+    seg_rows = build_segment(schema, read_csv(path, schema), "t", "n1")
+    _assert_segments_equal(seg_columnar, seg_rows)
+
+
+def test_columnar_csv_int_overflow_is_loud(tmp_path):
+    """Out-of-range INT cells raise on the columnar path just like the
+    row-wise np.asarray(int32) does — no silent wraparound."""
+    schema = make_test_schema(with_mv=False)
+    path = str(tmp_path / "ovf.csv")
+    names = [s.name for s in schema.all_fields()]
+    with open(path, "w") as f:
+        f.write(",".join(names) + "\n")
+        f.write("a,3000000000,2,1.0,1.0,100\n")
+
+    with pytest.raises(OverflowError):
+        build_segment_from_csv(schema, path, "t", "o1")
+
+
+def test_columnar_csv_extra_columns_skipped(tmp_path):
+    """Header columns not in the schema are tokenized but discarded
+    (skip type), matching DictReader's ignore-extra-keys behavior."""
+    schema = make_test_schema(with_mv=False)
+    path = str(tmp_path / "extra.csv")
+    names = [s.name for s in schema.all_fields()]
+    with open(path, "w") as f:
+        f.write("junk1," + ",".join(names) + ",junk2\n")
+        f.write("x,a,1,2,3,1.5,2.5,100,y\n")
+        f.write("x,b,4,5,6,3.5,4.5,200,y\n")
+
+    cols, n = read_csv_columnar(path, schema)
+    assert cols is not None and n == 2
+    seg_columnar = build_segment_from_csv(schema, path, "t", "e1")
+    seg_rows = build_segment(schema, read_csv(path, schema), "t", "e1")
+    _assert_segments_equal(seg_columnar, seg_rows)

@@ -163,3 +163,31 @@ def test_readers_csv_jsonl(tmp_path):
     rows = read_jsonl(str(jl), schema)
     assert rows[0] == {"d": "x", "tags": ["a"], "m": 3}
     assert rows[1] == {"d": "z", "tags": ["null"], "m": 4}
+
+
+def test_schema_json_reference_nested_time_spec():
+    """Reference-format schema JSON (nested incomingGranularitySpec,
+    common/data/TimeFieldSpec.java as in sample_data/*.schema) loads
+    as-is, alongside this package's flat form."""
+    from pinot_tpu.common.schema import DataType, Schema
+
+    d = {
+        "schemaName": "meetupRsvp",
+        "dimensionFieldSpecs": [{"name": "venue", "dataType": "STRING"}],
+        "metricFieldSpecs": [{"name": "rsvp_count", "dataType": "INT"}],
+        "timeFieldSpec": {
+            "incomingGranularitySpec": {
+                "timeType": "MILLISECONDS",
+                "dataType": "LONG",
+                "name": "mtime",
+            }
+        },
+    }
+    schema = Schema.from_json(d)
+    assert schema.time_field is not None
+    assert schema.time_field.name == "mtime"
+    assert schema.time_field.data_type == DataType.LONG
+    assert schema.time_field.time_unit == "MILLISECONDS"
+    # round-trips through our flat form
+    again = Schema.from_json(schema.to_json())
+    assert again.time_field.time_unit == "MILLISECONDS"
