@@ -73,19 +73,26 @@ class BrokerRequestHandler:
             return self._request_id
 
     # ------------------------------------------------------------------
-    def handle_pql(self, pql: str, trace: bool = False) -> BrokerResponse:
+    def handle_pql(
+        self,
+        pql: str,
+        trace: bool = False,
+        debug_options: Optional[Dict[str, str]] = None,
+    ) -> BrokerResponse:
         t0 = time.perf_counter()
         self.metrics.meter("queries").mark()
         try:
-            request = optimize_request(parse_pql(pql))
-        except PqlParseError as e:
+            request = parse_pql(pql)
+            if debug_options:
+                request.debug_options = dict(debug_options)
+            request = optimize_request(request)
+        except (PqlParseError, ValueError) as e:
             resp = BrokerResponse(
                 exceptions=[QueryException(ErrorCode.PQL_PARSING, str(e))]
             )
             resp.time_used_ms = (time.perf_counter() - t0) * 1000
             return resp
         request.enable_trace = trace
-        self._trace_flag = trace
         resp = self.handle_request(request, pql)
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
         self.metrics.timer("queryTotal").update(resp.time_used_ms)
@@ -131,7 +138,13 @@ class BrokerRequestHandler:
                     (
                         server,
                         self._pool.submit(
-                            self._send_one, server, phys_table, sub_pql, segments
+                            self._send_one,
+                            server,
+                            phys_table,
+                            sub_pql,
+                            segments,
+                            request.enable_trace,
+                            request.debug_options or None,
                         ),
                     )
                 )
@@ -218,14 +231,24 @@ class BrokerRequestHandler:
             pql[: ufrom + len(" FROM ")] + after[:stop] + f" WHERE {pred}" + after[stop:]
         )
 
-    _trace_flag: bool = False
-
     def _send_one(
-        self, server: str, table: str, pql: str, segments: List[str]
+        self,
+        server: str,
+        table: str,
+        pql: str,
+        segments: List[str],
+        trace: bool = False,
+        debug_options: Optional[Dict[str, str]] = None,
     ) -> IntermediateResult:
         address = self.server_addresses[server]
         payload = serialize_instance_request(
-            self._next_id(), pql, table, segments, self.timeout_ms, trace=self._trace_flag
+            self._next_id(),
+            pql,
+            table,
+            segments,
+            self.timeout_ms,
+            trace=trace,
+            debug_options=debug_options,
         )
         reply = self.transport.request(address, payload, timeout=self.timeout_ms / 1000.0)
         return deserialize_result(reply)
@@ -234,6 +257,21 @@ class BrokerRequestHandler:
 # ---------------------------------------------------------------------------
 # HTTP front (PinotClientRequestServlet analog)
 # ---------------------------------------------------------------------------
+
+
+def _parse_debug_options(s: str) -> Optional[Dict[str, str]]:
+    """``"k=v;k2=v2"`` -> dict (the reference's semicolon/equals debug
+    option string, ``BrokerRequestHandler.java:156-159``)."""
+    if not s:
+        return None
+    out: Dict[str, str] = {}
+    for part in s.split(";"):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        k, v = part.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out or None
 
 
 class BrokerHttpServer:
@@ -266,7 +304,8 @@ class BrokerHttpServer:
                 qs = parse_qs(url.query)
                 pql = (qs.get("pql") or qs.get("bql") or [""])[0]
                 trace = (qs.get("trace") or ["false"])[0].lower() == "true"
-                resp = broker.handle_pql(pql, trace=trace)
+                debug = _parse_debug_options((qs.get("debugOptions") or [""])[0])
+                resp = broker.handle_pql(pql, trace=trace, debug_options=debug)
                 self._respond(resp.to_json())
 
             def do_POST(self):
@@ -278,7 +317,16 @@ class BrokerHttpServer:
                         {"exceptions": [{"errorCode": ErrorCode.JSON_PARSING, "message": str(e)}]}
                     )
                 pql = body.get("pql") or body.get("bql") or ""
-                resp = broker.handle_pql(pql, trace=bool(body.get("trace")))
+                debug = body.get("debugOptions") or ""
+                if isinstance(debug, dict):
+                    debug = {str(k): str(v) for k, v in debug.items()}
+                else:
+                    # the reference's "k=v;k2=v2" string form; any other
+                    # JSON type is ignored rather than crashing the handler
+                    debug = _parse_debug_options(debug if isinstance(debug, str) else "")
+                resp = broker.handle_pql(
+                    pql, trace=bool(body.get("trace")), debug_options=debug
+                )
                 self._respond(resp.to_json())
 
         self._httpd = ThreadingHTTPServer((host, port), _Handler)

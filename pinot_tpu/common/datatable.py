@@ -328,6 +328,7 @@ def serialize_instance_request(
     segments: List[str],
     timeout_ms: float,
     trace: bool = False,
+    debug_options: Optional[Dict[str, str]] = None,
 ) -> bytes:
     w = _Writer()
     w.i64(request_id)
@@ -336,6 +337,9 @@ def serialize_instance_request(
     w.value(list(segments))
     w.f64(timeout_ms)
     w.u8(1 if trace else 0)
+    # per-query debug options ride to the server so its re-parse applies
+    # the same optimizer flags (BrokerRequest.debugOptions thrift field)
+    w.value(dict(debug_options or {}))
     return w.getvalue()
 
 
@@ -348,4 +352,5 @@ def deserialize_instance_request(data: bytes) -> Dict[str, Any]:
         "segments": list(r.value()),
         "timeoutMs": r.f64(),
         "trace": bool(r.u8()),
+        "debugOptions": dict(r.value() or {}),
     }

@@ -62,12 +62,62 @@ def or_equalities_to_in(tree: FilterQueryTree) -> FilterQueryTree:
     return FilterQueryTree(operator=FilterOperator.OR, children=out)
 
 
-def optimize_filter(tree: Optional[FilterQueryTree]) -> Optional[FilterQueryTree]:
+class OptimizationFlags:
+    """Per-query optimizer toggles from the ``optimizationFlags`` debug
+    option (``requestHandler/OptimizationFlags.java``): a comma list of
+    names each prefixed ``+`` (enable — disabling all others) or ``-``
+    (disable that one); mixing both is an error, as in the reference."""
+
+    def __init__(self, enabled: set, disabled: set) -> None:
+        if enabled and disabled:
+            raise ValueError(
+                "cannot exclude and include optimizations at the same time"
+            )
+        self._enabled = enabled
+        self._disabled = disabled
+
+    def is_enabled(self, name: str) -> bool:
+        if self._enabled:
+            return name in self._enabled
+        return name not in self._disabled
+
+    @staticmethod
+    def from_debug_options(debug_options) -> Optional["OptimizationFlags"]:
+        s = (debug_options or {}).get("optimizationFlags", "")
+        if not s:
+            return None
+        enabled: set = set()
+        disabled: set = set()
+        for opt in (o.strip() for o in s.split(",")):
+            if not opt:
+                continue
+            if opt[0] == "+":
+                enabled.add(opt[1:])
+            elif opt[0] == "-":
+                disabled.add(opt[1:])
+            else:
+                raise ValueError(
+                    f"optimization flag {opt!r} must be prefixed with + or -"
+                )
+        return OptimizationFlags(enabled, disabled)
+
+
+def optimize_filter(
+    tree: Optional[FilterQueryTree], flags: Optional[OptimizationFlags] = None
+) -> Optional[FilterQueryTree]:
     if tree is None:
         return None
-    return flatten(or_equalities_to_in(flatten(tree)))
+    flatten_on = flags is None or flags.is_enabled("flattenNestedPredicates")
+    if flatten_on:
+        tree = flatten(tree)
+    if flags is None or flags.is_enabled("multipleOrEqualitiesToInClause"):
+        tree = or_equalities_to_in(tree)
+        if flatten_on:
+            tree = flatten(tree)
+    return tree
 
 
 def optimize_request(request: BrokerRequest) -> BrokerRequest:
-    request.filter = optimize_filter(request.filter)
+    flags = OptimizationFlags.from_debug_options(request.debug_options)
+    request.filter = optimize_filter(request.filter, flags)
     return request

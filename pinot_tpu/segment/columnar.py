@@ -143,6 +143,10 @@ def read_csv_columnar(
         data = f.read()
     if b'"' in data:
         return None, 0  # quoted CSV: python csv module semantics needed
+    if data.count(b"\r") != data.count(b"\r\n"):
+        # a lone \r is a row separator for python's csv module but cell
+        # data for the native parser — keep both paths identical
+        return None, 0
     nl = data.find(b"\n")
     if nl < 0:
         return None, 0

@@ -67,7 +67,9 @@ class ServerInstance:
         return serialize_result(result)
 
     def _process(self, req: dict) -> IntermediateResult:
-        request = optimize_request(parse_pql(req["pql"]))
+        request = parse_pql(req["pql"])
+        request.debug_options = dict(req.get("debugOptions") or {})
+        request = optimize_request(request)
         request.enable_trace = bool(req.get("trace"))
         trace = TraceContext(enabled=request.enable_trace, scope=self.name)
         tdm = self.data_manager.table(req["table"])

@@ -246,3 +246,33 @@ def test_http_endpoint():
         assert payload["numDocsScanned"] == 50
     finally:
         http.stop()
+
+
+def test_debug_options_reach_servers():
+    """optimizationFlags ride the InstanceRequest wire format so the
+    server-side re-parse applies the same optimizer toggles as the
+    broker (OptimizationFlags.java semantics, end to end)."""
+    from pinot_tpu.tools.cluster_harness import InProcessCluster
+    from pinot_tpu.tools.datagen import make_test_schema, random_rows
+    from pinot_tpu.segment.builder import build_segment
+
+    cluster = InProcessCluster(num_servers=1)
+    schema = make_test_schema(with_mv=False)
+    physical = cluster.add_offline_table(schema)
+    rows = random_rows(schema, 200, seed=12)
+    cluster.upload(physical, build_segment(schema, rows, physical, "dbg1"))
+    try:
+        pql = "SELECT count(*) FROM testTable WHERE dimInt = 1 OR dimInt = 2"
+        want = cluster.broker.handle_pql(pql).to_json()
+        got = cluster.broker.handle_pql(
+            pql, debug_options={"optimizationFlags": "-multipleOrEqualitiesToInClause"}
+        ).to_json()
+        assert not got["exceptions"]
+        assert got["aggregationResults"] == want["aggregationResults"]
+
+        bad = cluster.broker.handle_pql(
+            pql, debug_options={"optimizationFlags": "bogus"}
+        ).to_json()
+        assert bad["exceptions"]
+    finally:
+        cluster.stop()

@@ -192,3 +192,20 @@ def test_columnar_csv_extra_columns_skipped(tmp_path):
     seg_columnar = build_segment_from_csv(schema, path, "t", "e1")
     seg_rows = build_segment(schema, read_csv(path, schema), "t", "e1")
     _assert_segments_equal(seg_columnar, seg_rows)
+
+
+def test_columnar_csv_lone_cr_falls_back(tmp_path):
+    """A bare \\r (row separator for python csv, cell data for the
+    native parser) routes to the python path so both agree."""
+    schema = make_test_schema(with_mv=False)
+    path = str(tmp_path / "cr.csv")
+    names = [s.name for s in schema.all_fields()]
+    with open(path, "wb") as f:
+        f.write((",".join(names) + "\n").encode())
+        f.write(b"a\rb,1,2,3,1.0,1.0,100\n")
+
+    cols, _ = read_csv_columnar(path, schema)
+    assert cols is None
+    seg = build_segment_from_csv(schema, path, "t", "cr1")
+    seg_rows = build_segment(schema, read_csv(path, schema), "t", "cr1")
+    _assert_segments_equal(seg, seg_rows)

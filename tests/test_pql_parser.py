@@ -144,3 +144,52 @@ def test_mv_aggregations():
 def test_trailing_semicolon_and_case():
     req = parse_pql("select SUM(x) from T where Y = 'z' group by Z top 3;")
     assert req.group_by.top_n == 3
+
+
+def test_optimization_flags():
+    """Per-query optimizer toggles via debugOptions optimizationFlags
+    (OptimizationFlags.java: '+' enables only those listed, '-' disables
+    that one, mixing is an error)."""
+    import pytest
+
+    from pinot_tpu.pql.optimizer import OptimizationFlags, optimize_request
+
+    pql = "SELECT count(*) FROM t WHERE (a = '1' OR a = '2') AND (b = 'x' AND c = 'y')"
+
+    # default: OR-of-equalities collapses to IN
+    req = optimize_request(parse_pql(pql))
+    ops = {leaf.operator for leaf in _leaves(req.filter)}
+    from pinot_tpu.common.request import FilterOperator
+
+    assert FilterOperator.IN in ops
+
+    # disabling the IN-clause rewrite keeps the OR of equalities
+    req = parse_pql(pql)
+    req.debug_options = {"optimizationFlags": "-multipleOrEqualitiesToInClause"}
+    req = optimize_request(req)
+    ops = {leaf.operator for leaf in _leaves(req.filter)}
+    assert FilterOperator.IN not in ops
+
+    # '+' form enables only the listed optimization
+    req = parse_pql(pql)
+    req.debug_options = {"optimizationFlags": "+flattenNestedPredicates"}
+    req = optimize_request(req)
+    ops = {leaf.operator for leaf in _leaves(req.filter)}
+    assert FilterOperator.IN not in ops
+
+    # mixing + and - is rejected, as in the reference
+    with pytest.raises(ValueError):
+        OptimizationFlags.from_debug_options({"optimizationFlags": "+a,-b"})
+    # missing prefix is rejected
+    with pytest.raises(ValueError):
+        OptimizationFlags.from_debug_options({"optimizationFlags": "noprefix"})
+
+
+def _leaves(tree):
+    if tree is None:
+        return
+    if tree.is_leaf:
+        yield tree
+        return
+    for c in tree.children:
+        yield from _leaves(c)
