@@ -44,6 +44,10 @@ class StagedColumn:
     mv: Optional[jnp.ndarray] = None
     mv_valid: Optional[jnp.ndarray] = None
     dict_vals: Optional[jnp.ndarray] = None
+    # optional role-specific arrays (big-dictionary gathers are slow on
+    # TPU, so these trade HBM for streaming access):
+    raw: Optional[jnp.ndarray] = None  # float [S, n_pad] dictionary-decoded values
+    gfwd: Optional[jnp.ndarray] = None  # int32 [S, n_pad] global-dictId fwd
 
     @property
     def is_numeric(self) -> bool:
@@ -74,12 +78,20 @@ def stage_segments(
     column_names: Sequence[str],
     device=None,
     pad_segments_to: int = 0,
+    raw_columns: Sequence[str] = (),
+    gfwd_columns: Sequence[str] = (),
+    ctx=None,
 ) -> StagedTable:
     """Stack + pad + transfer the given columns of the segments.
 
     ``pad_segments_to`` rounds the segment axis up with all-invalid
     dummy segments so it divides the mesh's device count (multi-chip
     ``shard_map`` needs an evenly shardable leading axis).
+
+    ``raw_columns`` (numeric SV) additionally stage dictionary-decoded
+    value arrays; ``gfwd_columns`` (SV, requires ``ctx``) stage
+    global-dictId forward arrays. Both are host-side numpy gathers done
+    once at staging so query kernels stream instead of gathering.
     """
     S = max(len(segments), pad_segments_to)
     n_pad = config.pad_docs(max(seg.num_docs for seg in segments))
@@ -117,6 +129,18 @@ def stage_segments(
             for i, c in enumerate(cols):
                 fwd[i, : c.fwd.size] = c.fwd
             sc.fwd = put(fwd)
+            if name in raw_columns and sc.is_numeric:
+                raw = np.zeros((S, n_pad), dtype=fdt)
+                for i, c in enumerate(cols):
+                    vals = np.asarray(c.dictionary.values, dtype=fdt)
+                    raw[i, : c.fwd.size] = vals[c.fwd]
+                sc.raw = put(raw)
+            if name in gfwd_columns and ctx is not None:
+                gf = np.zeros((S, n_pad), dtype=np.int32)
+                remaps = ctx.column(name).remaps
+                for i, c in enumerate(cols):
+                    gf[i, : c.fwd.size] = remaps[i][c.fwd]
+                sc.gfwd = put(gf)
         else:
             mv_pad = max(1, max(c.metadata.max_num_multi_values for c in cols))
             mv_pad = config.pad_card(mv_pad)  # pow2 bucket
@@ -156,7 +180,14 @@ def get_staged(
     segments: Sequence[ImmutableSegment],
     column_names: Sequence[str],
     pad_segments_to: int = 0,
+    raw_columns: Sequence[str] = (),
+    gfwd_columns: Sequence[str] = (),
+    ctx=None,
 ) -> StagedTable:
+    """Cached staging. The cache key covers only the base arrays; role
+    arrays (raw/gfwd) are attached to the cached StagedTable on demand,
+    so queries differing only in roles share one HBM copy of the base
+    columns."""
     key = (
         tuple(f"{s.segment_name}:{s.metadata.crc}" for s in segments),
         tuple(sorted(column_names)),
@@ -164,11 +195,52 @@ def get_staged(
     )
     st = _stage_cache.get(key)
     if st is None:
-        st = stage_segments(segments, sorted(column_names), pad_segments_to=pad_segments_to)
+        st = stage_segments(
+            segments,
+            sorted(column_names),
+            pad_segments_to=pad_segments_to,
+            raw_columns=raw_columns,
+            gfwd_columns=gfwd_columns,
+            ctx=ctx,
+        )
         if len(_stage_cache) > 32:
             _stage_cache.clear()
         _stage_cache[key] = st
+    else:
+        _augment_staged(st, segments, raw_columns, gfwd_columns, ctx)
     return st
+
+
+def _augment_staged(
+    st: StagedTable,
+    segments: Sequence[ImmutableSegment],
+    raw_columns: Sequence[str],
+    gfwd_columns: Sequence[str],
+    ctx,
+) -> None:
+    """Attach missing role arrays to an already-staged table."""
+    fdt = config.np_float_dtype()
+    S, n_pad = st.num_segments, st.n_pad
+    for name in raw_columns:
+        sc = st.columns.get(name)
+        if sc is None or sc.raw is not None or not sc.is_numeric or not sc.single_value:
+            continue
+        raw = np.zeros((S, n_pad), dtype=fdt)
+        for i, seg in enumerate(segments):
+            c = seg.column(name)
+            vals = np.asarray(c.dictionary.values, dtype=fdt)
+            raw[i, : c.fwd.size] = vals[c.fwd]
+        sc.raw = jnp.asarray(raw)
+    for name in gfwd_columns:
+        sc = st.columns.get(name)
+        if sc is None or sc.gfwd is not None or not sc.single_value or ctx is None:
+            continue
+        gf = np.zeros((S, n_pad), dtype=np.int32)
+        remaps = ctx.column(name).remaps
+        for i, seg in enumerate(segments):
+            c = seg.column(name)
+            gf[i, : c.fwd.size] = remaps[i][c.fwd]
+        sc.gfwd = jnp.asarray(gf)
 
 
 def clear_staging_cache() -> None:

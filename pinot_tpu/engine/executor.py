@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pinot_tpu.common.request import BrokerRequest, group_sort_ascending
+from pinot_tpu.common.schema import DataType
 from pinot_tpu.common.values import render_value
 from pinot_tpu.engine import config
 from pinot_tpu.engine.context import TableContext, get_table_context
@@ -111,7 +112,15 @@ class QueryExecutor:
             pad_to = -(-len(live) // n) * n
 
         ctx = get_table_context(live)
-        staged = get_staged(live, sorted(needed), pad_segments_to=pad_to)
+        raw_cols, gfwd_cols = self._role_columns(request, live[0])
+        staged = get_staged(
+            live,
+            sorted(needed),
+            pad_segments_to=pad_to,
+            raw_columns=raw_cols,
+            gfwd_columns=gfwd_cols,
+            ctx=ctx,
+        )
         t0 = self._phase("staging", t0)
         plan = build_static_plan(request, ctx, staged)
 
@@ -155,6 +164,36 @@ class QueryExecutor:
             return list(seg.columns.keys())
         return list(cols)
 
+    def _role_columns(self, request: BrokerRequest, seg: ImmutableSegment):
+        """Columns to stage with role-specific arrays: aggregation
+        inputs get raw value arrays, group-by/sort keys get global-id
+        forward arrays (both avoid slow big-table gathers on device)."""
+
+        def numeric_sv(c: str) -> bool:
+            if c == "*" or c not in seg.columns:
+                return False
+            m = seg.column(c).metadata
+            return m.single_value and m.data_type.stored_type != DataType.STRING
+
+        def sv(c: str) -> bool:
+            return c in seg.columns and seg.column(c).metadata.single_value
+
+        from pinot_tpu.engine.plan import _agg_kind
+
+        # only scalar/pair agg kernels read .raw (presence/hist/hll work
+        # in dictId space)
+        raw_cols = {
+            a.column
+            for a in request.aggregations
+            if numeric_sv(a.column) and _agg_kind(a.base_function) in ("scalar", "pair")
+        }
+        gfwd_cols = set()
+        if request.is_group_by:
+            gfwd_cols.update(c for c in request.group_by.columns if sv(c))
+        if request.is_selection:
+            gfwd_cols.update(s.column for s in request.selection.sorts if sv(s.column))
+        return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols))
+
     def _segment_arrays(
         self, plan: StaticPlan, staged: StagedTable, needed: set
     ) -> Dict[str, Any]:
@@ -168,6 +207,10 @@ class QueryExecutor:
                 arrays[f"{name}.mv_valid"] = col.mv_valid
             if col.dict_vals is not None:
                 arrays[f"{name}.dict"] = col.dict_vals
+            if col.raw is not None:
+                arrays[f"{name}.raw"] = col.raw
+            if col.gfwd is not None:
+                arrays[f"{name}.gfwd"] = col.gfwd
         return arrays
 
     def _to_device_inputs(self, inputs: Dict[str, Any]) -> Dict[str, Any]:

@@ -76,13 +76,26 @@ def _segment_add_matmul(flat_idx, w, capacity: int):
 
 def _leaf_mask(plan: StaticPlan, i: int, seg: Dict[str, Any], q: Dict[str, Any]) -> jnp.ndarray:
     leaf = plan.leaves[i]
-    table = q["match"][i]  # [card_pad] bool
+    kind = leaf.eval_kind
+
+    def ids_match(ids):
+        """Per-dictId predicate truth, by the leaf's static eval kind.
+        interval/points are pure vector compares (dictIds are
+        order-preserving); table is the bool[card] gather fallback."""
+        if kind == "interval":
+            lo, hi = q["bounds"][i][0], q["bounds"][i][1]
+            return (ids >= lo) & (ids < hi)
+        if kind in ("points", "points_none"):
+            pts = q["pts"][i]  # [k_pad], -1 padded
+            hit = jnp.any(ids[..., None] == pts, axis=-1)
+            return ~hit if (kind == "points_none" and leaf.mode == SV) else hit
+        return q["match"][i][ids]
+
     if leaf.mode == SV:
-        fwd = seg[f"{leaf.column}.fwd"]  # [n]
-        return table[fwd]
+        return ids_match(seg[f"{leaf.column}.fwd"])  # [n]
     mv = seg[f"{leaf.column}.mv"]  # [n, mv]
     mvv = seg[f"{leaf.column}.mv_valid"]
-    hit = jnp.any(table[mv] & mvv, axis=-1)
+    hit = jnp.any(ids_match(mv) & mvv, axis=-1)
     if leaf.mode == MV_ANY:
         return hit
     return ~hit  # MV_NONE
@@ -107,6 +120,8 @@ def _row_values(agg: StaticAgg, seg, mask):
         mvv = seg[f"{agg.column}.mv_valid"] & mask[:, None]
         vals = seg[f"{agg.column}.dict"][mv]
         return vals, mvv
+    if agg.use_raw:
+        return seg[f"{agg.column}.raw"], mask  # streamed, no gather
     fwd = seg[f"{agg.column}.fwd"]
     vals = seg[f"{agg.column}.dict"][fwd]
     return vals, mask
@@ -191,11 +206,14 @@ def _group_keys(plan: StaticPlan, seg, q, mask):
     n = mask.shape[0]
     keys = jnp.zeros((n, 1), dtype=kdt)
     kvalid = mask[:, None]
-    for col, is_mv, gcard, remap in zip(
-        gb.columns, gb.col_is_mv, gb.gcards, q["group_remap"]
+    for col, is_mv, gcard, remap, use_g in zip(
+        gb.columns, gb.col_is_mv, gb.gcards, q["group_remap"], gb.use_gfwd
     ):
         if not is_mv:
-            g = remap[seg[f"{col}.fwd"]].astype(kdt)  # [n]
+            if use_g:
+                g = seg[f"{col}.gfwd"].astype(kdt)  # [n], staged global ids
+            else:
+                g = remap[seg[f"{col}.fwd"]].astype(kdt)  # [n]
             keys = keys * gcard + g[:, None]
         else:
             mv = seg[f"{col}.mv"]
@@ -334,11 +352,21 @@ def make_single_segment_kernel(plan: StaticPlan) -> Callable:
         if plan.group_by is not None:
             keys, kvalid = _group_keys(plan, seg, q, mask)
             cap = plan.group_by.capacity
-            out["gb_presence"] = (
-                jnp.zeros(cap, dtype=jnp.int32)
-                .at[jnp.where(kvalid, keys, cap).reshape(-1)]
-                .max(kvalid.reshape(-1).astype(jnp.int32), mode="drop")
-            )
+            flat_idx = jnp.where(kvalid, keys, cap).reshape(-1)
+            fvalid = kvalid.reshape(-1)
+            if cap <= MATMUL_GROUP_CAP and _use_matmul_groupby():
+                # presence = occupancy count > 0, on the MXU path —
+                # a scatter-max here would dominate the whole kernel
+                counts = _segment_add_matmul(
+                    flat_idx, fvalid.astype(config.float_dtype()), cap
+                )
+                out["gb_presence"] = (counts > 0).astype(jnp.int32)
+            else:
+                out["gb_presence"] = (
+                    jnp.zeros(cap, dtype=jnp.int32)
+                    .at[flat_idx]
+                    .max(fvalid.astype(jnp.int32), mode="drop")
+                )
             for i, agg in enumerate(plan.aggs):
                 out[f"gb_{i}"] = _group_state(agg, i, seg, q, mask, keys, kvalid, cap)
         else:
@@ -356,13 +384,20 @@ def _sort_ordinals(sel, seg, q, dtype):
     """Per sort column: global ordinal of each doc's value, ascending
     order (descending columns flipped). MV columns order by first value
     (oracle semantics)."""
-    for col, asc, gcard, remap in zip(
-        sel.sort_columns, sel.sort_ascending, sel.sort_gcards, q["sel_remap"]
+    for col, asc, gcard, remap, use_g in zip(
+        sel.sort_columns,
+        sel.sort_ascending,
+        sel.sort_gcards,
+        q["sel_remap"],
+        sel.use_gfwd,
     ):
-        scol = seg.get(f"{col}.fwd")
-        if scol is None:
-            scol = seg[f"{col}.mv"][:, 0]
-        g = remap[scol].astype(dtype)
+        if use_g:
+            g = seg[f"{col}.gfwd"].astype(dtype)
+        else:
+            scol = seg.get(f"{col}.fwd")
+            if scol is None:
+                scol = seg[f"{col}.mv"][:, 0]
+            g = remap[scol].astype(dtype)
         if not asc:
             g = (gcard - 1) - g
         yield g, gcard
@@ -462,7 +497,12 @@ def apply_reduce(op: str, value: Any):
 @functools.lru_cache(maxsize=256)
 def make_table_kernel(plan: StaticPlan) -> Callable:
     """vmap the single-segment kernel over the stacked segment axis and
-    merge; jitted once per (plan, shape signature)."""
+    merge; jitted once per (plan, shape signature).
+
+    The lru_cache is what makes jit's own executable cache effective:
+    returning a fresh jit wrapper per query would retrace and recompile
+    the same plan on every call.
+    """
     single = make_single_segment_kernel(plan)
     reducers = output_reducers(plan)
 

@@ -56,6 +56,14 @@ SV, MV_ANY, MV_NONE = "sv", "mv_any", "mv_none"
 class StaticLeaf:
     column: str
     mode: str  # SV | MV_ANY | MV_NONE
+    # Gathers through big tables are slow on TPU, but dictIds are
+    # order-preserving, so most predicates become vector compares:
+    #   interval    — (fwd >= lo) & (fwd < hi), bounds from q["bounds"]
+    #   points      — any(fwd == pts[k]) for small IN/EQ sets
+    #   points_none — complement of points (NOT / NOT_IN)
+    #   table       — bool[card] gather (regex, large IN lists)
+    eval_kind: str = "table"
+    k_pad: int = 0  # static points-array length (pow2-padded)
 
 
 @dataclass(frozen=True)
@@ -68,6 +76,9 @@ class StaticAgg:
     kind: str
     # static size of the value-state axis (presence/hist), 0 otherwise
     gcard_pad: int = 0
+    # read values from the staged raw array (streaming) instead of
+    # gathering dict_vals[fwd] — big-dictionary gathers are slow on TPU
+    use_raw: bool = False
 
 
 @dataclass(frozen=True)
@@ -77,6 +88,9 @@ class StaticGroupBy:
     gcards: Tuple[int, ...]  # global cardinalities (strides derive from these)
     capacity: int  # dense holder size = prod(gcards), device path only
     top_n: int
+    # per column: read staged global-id fwd (gfwd) instead of gathering
+    # remap[fwd] on device (remap gathers are slow for big dictionaries)
+    use_gfwd: Tuple[bool, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -89,6 +103,7 @@ class StaticSelection:
     # True -> sort key packs into one integer (radix product fits key dtype,
     # lax.top_k path); False -> multi-operand lexicographic lax.sort path.
     packed: bool = True
+    use_gfwd: Tuple[bool, ...] = ()  # per sort column, as StaticGroupBy
 
 
 @dataclass(frozen=True)
@@ -116,6 +131,31 @@ def _agg_kind(base: str) -> str:
     raise ValueError(f"unknown aggregation {base!r}")
 
 
+_MAX_POINTS = 16  # IN lists up to this size evaluate as compares
+
+
+def _pad_pow2(k: int) -> int:
+    p = 1
+    while p < k:
+        p *= 2
+    return p
+
+
+def _leaf_eval_kind(node: FilterQueryTree) -> Tuple[str, int]:
+    op = node.operator
+    if op == FilterOperator.RANGE:
+        return "interval", 0
+    if op in (FilterOperator.EQUALITY, FilterOperator.IN):
+        k = len(node.values)
+        if 0 < k <= _MAX_POINTS:
+            return "points", _pad_pow2(k)
+    if op in (FilterOperator.NOT, FilterOperator.NOT_IN):
+        k = len(node.values)
+        if 0 < k <= _MAX_POINTS:
+            return "points_none", _pad_pow2(k)
+    return "table", 0
+
+
 def build_static_plan(
     request: BrokerRequest,
     ctx: TableContext,
@@ -133,7 +173,12 @@ def build_static_plan(
                 mode = MV_NONE
             else:
                 mode = MV_ANY
-            leaves.append(StaticLeaf(column=node.column, mode=mode))
+            eval_kind, k_pad = _leaf_eval_kind(node)
+            leaves.append(
+                StaticLeaf(
+                    column=node.column, mode=mode, eval_kind=eval_kind, k_pad=k_pad
+                )
+            )
             return ("leaf", len(leaves) - 1)
         op = "and" if node.operator == FilterOperator.AND else "or"
         return (op, tuple(encode(c) for c in node.children))
@@ -156,8 +201,21 @@ def build_static_plan(
         is_mv = a.is_mv
         if a.column != "*" and not staged.column(a.column).single_value:
             is_mv = True
+        use_raw = (
+            a.column != "*"
+            and not is_mv
+            and staged.column(a.column).raw is not None
+        )
         aggs.append(
-            StaticAgg(func=a.function, base=base, column=a.column, is_mv=is_mv, kind=kind, gcard_pad=gcard_pad)
+            StaticAgg(
+                func=a.function,
+                base=base,
+                column=a.column,
+                is_mv=is_mv,
+                kind=kind,
+                gcard_pad=gcard_pad,
+                use_raw=use_raw,
+            )
         )
 
     # ---- group-by ---------------------------------------------------
@@ -183,6 +241,10 @@ def build_static_plan(
             gcards=gcards,
             capacity=int(cap),
             top_n=request.group_by.top_n,
+            use_gfwd=tuple(
+                not mv and staged.column(c).gfwd is not None
+                for c, mv in zip(cols, col_is_mv)
+            ),
         )
         # MV group-by expansion blowup guard
         expansion = 1
@@ -214,6 +276,10 @@ def build_static_plan(
             sort_gcards=sort_gcards,
             k=int(k),
             packed=space <= config.max_key_space(),
+            use_gfwd=tuple(
+                staged.column(c).single_value and staged.column(c).gfwd is not None
+                for c in sort_cols
+            ),
         )
 
     return StaticPlan(
@@ -233,6 +299,46 @@ def build_static_plan(
 
 def _coerce(literal: str, stored: DataType) -> Any:
     return stored.convert(literal)
+
+
+def leaf_interval(node: FilterQueryTree, dictionary: Dictionary) -> Tuple[int, int]:
+    """Half-open [lo, hi) dictId interval satisfying a RANGE leaf —
+    dictIds are order-preserving, so range predicates are interval
+    compares in dictId space (no table, no gather)."""
+    stored = dictionary.stored_type
+    card = dictionary.cardinality
+    r = node.range_spec or RangeSpec()
+    lo = 0
+    hi = card
+    if r.lower is not None and r.lower != "*":
+        v = _coerce(r.lower, stored)
+        i = dictionary.insertion_index(v)
+        if r.include_lower:
+            lo = i
+        else:
+            lo = i + 1 if (i < card and dictionary._eq(dictionary.values[i], v)) else i
+    if r.upper is not None and r.upper != "*":
+        v = _coerce(r.upper, stored)
+        i = dictionary.insertion_index(v)
+        if r.include_upper:
+            hi = i + 1 if (i < card and dictionary._eq(dictionary.values[i], v)) else i
+        else:
+            hi = i
+    return lo, max(lo, hi)
+
+
+def leaf_points(node: FilterQueryTree, dictionary: Dictionary, k_pad: int) -> np.ndarray:
+    """dictIds of a small EQ/IN/NOT_IN value set, padded with -1 (which
+    never matches a forward index)."""
+    stored = dictionary.stored_type
+    pts = np.full(k_pad, -1, dtype=np.int32)
+    j = 0
+    for v in node.values:
+        i = dictionary.index_of(_coerce(v, stored))
+        if i >= 0:
+            pts[j] = i
+            j += 1
+    return pts
 
 
 def match_table(node: FilterQueryTree, dictionary: Dictionary, card_pad: int) -> np.ndarray:
@@ -259,23 +365,7 @@ def match_table(node: FilterQueryTree, dictionary: Dictionary, card_pad: int) ->
                 member[i] = True
         table = member  # caller flips for SV below
     elif op == FilterOperator.RANGE:
-        r = node.range_spec or RangeSpec()
-        lo = 0
-        hi = card
-        if r.lower is not None and r.lower != "*":
-            v = _coerce(r.lower, stored)
-            i = dictionary.insertion_index(v)
-            if r.include_lower:
-                lo = i
-            else:
-                lo = i + 1 if (i < card and dictionary._eq(dictionary.values[i], v)) else i
-        if r.upper is not None and r.upper != "*":
-            v = _coerce(r.upper, stored)
-            i = dictionary.insertion_index(v)
-            if r.include_upper:
-                hi = i + 1 if (i < card and dictionary._eq(dictionary.values[i], v)) else i
-            else:
-                hi = i
+        lo, hi = leaf_interval(node, dictionary)
         if hi > lo:
             table[lo:hi] = True
     elif op == FilterOperator.REGEX:
@@ -316,23 +406,41 @@ def build_query_inputs(
 
         collect(request.filter)
         tables = []
+        bounds = []
+        points = []
         for leaf_node, leaf_static in zip(flat_leaves, plan.leaves):
             col = staged.column(leaf_static.column)
-            per_seg = np.zeros((S, col.card_pad), dtype=bool)
+            kind = leaf_static.eval_kind
+            # dummies keep the pytree structure identical per plan
+            table_e = np.zeros((S, 1), dtype=bool)
+            bound_e = np.zeros((S, 2), dtype=np.int32)
+            point_e = np.zeros((S, max(leaf_static.k_pad, 1)), dtype=np.int32)
             for i, seg in enumerate(ctx.segments):
-                t = match_table(leaf_node, seg.column(leaf_static.column).dictionary, col.card_pad)
-                if leaf_static.mode == SV and leaf_node.operator in (
-                    FilterOperator.NOT,
-                    FilterOperator.NOT_IN,
-                ):
-                    # SV complement: true cardinality slots only
-                    c = col.cards[i]
-                    flipped = np.zeros(col.card_pad, dtype=bool)
-                    flipped[:c] = ~t[:c]
-                    t = flipped
-                per_seg[i] = t
-            tables.append(per_seg)
+                d = seg.column(leaf_static.column).dictionary
+                if kind == "interval":
+                    bound_e[i] = leaf_interval(leaf_node, d)
+                elif kind in ("points", "points_none"):
+                    point_e[i] = leaf_points(leaf_node, d, leaf_static.k_pad)
+                else:
+                    if table_e.shape[1] == 1:
+                        table_e = np.zeros((S, col.card_pad), dtype=bool)
+                    t = match_table(leaf_node, d, col.card_pad)
+                    if leaf_static.mode == SV and leaf_node.operator in (
+                        FilterOperator.NOT,
+                        FilterOperator.NOT_IN,
+                    ):
+                        # SV complement: true cardinality slots only
+                        c = col.cards[i]
+                        flipped = np.zeros(col.card_pad, dtype=bool)
+                        flipped[:c] = ~t[:c]
+                        t = flipped
+                    table_e[i] = t
+            tables.append(table_e)
+            bounds.append(bound_e)
+            points.append(point_e)
         inputs["match"] = tables
+        inputs["bounds"] = bounds
+        inputs["pts"] = points
 
     # per-agg auxiliary tables
     agg_aux: List[Dict[str, np.ndarray]] = []
@@ -347,16 +455,24 @@ def build_query_inputs(
         agg_aux.append(aux)
     inputs["agg_aux"] = agg_aux
 
-    # group-by remaps
+    # group-by remaps (dummy entry when the staged gfwd array is used)
     if plan.group_by is not None and plan.on_device:
         inputs["group_remap"] = [
-            _stacked_remap(ctx, staged, c) for c in plan.group_by.columns
+            np.zeros((S, 1), dtype=np.int32)
+            if use_g
+            else _stacked_remap(ctx, staged, c)
+            for c, use_g in zip(plan.group_by.columns, plan.group_by.use_gfwd)
         ]
 
     # selection sort remaps
     if plan.selection is not None and plan.selection.sort_columns:
         inputs["sel_remap"] = [
-            _stacked_remap(ctx, staged, c) for c in plan.selection.sort_columns
+            np.zeros((S, 1), dtype=np.int32)
+            if use_g
+            else _stacked_remap(ctx, staged, c)
+            for c, use_g in zip(
+                plan.selection.sort_columns, plan.selection.use_gfwd
+            )
         ]
 
     return inputs

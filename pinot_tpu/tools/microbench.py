@@ -1,0 +1,210 @@
+"""Micro benchmarks for individual engine components.
+
+The pinot-perf JMH analog — one entry per reference benchmark class:
+
+  bitpack    -> ForwardIndexReaderBenchmark.java:42 (fixed-bit codec)
+  dictionary -> StringDictionaryPerfTest.java:46 (lookup throughput)
+  filter     -> FilterOperatorBenchmark.java:51 (predicate over a segment)
+  groupby    -> BenchmarkQueryEngine.java:50 (aggregation group-by kernel)
+  realtime   -> BenchmarkRealtimeConsumptionSpeed.java:38 (index() rate)
+  csv        -> ingest pipeline (columnar vs row-wise build)
+
+Run: ``python -m pinot_tpu.tools.microbench [name ...] [-rows N]``.
+Each benchmark prints one JSON line: {"bench", "value", "unit", detail}.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+
+def _time_best(fn: Callable[[], object], repeat: int = 5) -> float:
+    """Best-of-N wall seconds (JMH SampleTime-ish, minus the forks)."""
+    best = float("inf")
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_bitpack(rows: int) -> Dict:
+    from pinot_tpu.segment.bitpack import bits_required, pack_bits, unpack_bits
+
+    rng = np.random.default_rng(7)
+    vals = rng.integers(0, 4097, size=rows).astype(np.int32)
+    nbits = bits_required(4097)
+    packed = pack_bits(vals, nbits)
+    t_pack = _time_best(lambda: pack_bits(vals, nbits))
+    t_unpack = _time_best(lambda: unpack_bits(packed, nbits, rows))
+    return {
+        "bench": "bitpack",
+        "value": round(rows / t_unpack / 1e6, 1),
+        "unit": "M vals/s unpack",
+        "detail": {"packMps": round(rows / t_pack / 1e6, 1), "nbits": nbits},
+    }
+
+
+def bench_dictionary(rows: int) -> Dict:
+    from pinot_tpu.common.schema import DataType
+    from pinot_tpu.segment.dictionary import Dictionary
+
+    rng = np.random.default_rng(11)
+    card = 100_000
+    values = [f"value_{i:08d}" for i in range(card)]
+    d = Dictionary(DataType.STRING, values)
+    probe = [values[i] for i in rng.integers(0, card, size=10_000)]
+    t_lookup = _time_best(lambda: [d.index_of(v) for v in probe])
+    arr = np.asarray(
+        [values[i] for i in rng.integers(0, card, size=rows)], dtype=object
+    )
+    t_index = _time_best(lambda: d.index_array(arr))
+    return {
+        "bench": "dictionary",
+        "value": round(len(probe) / t_lookup / 1e3, 1),
+        "unit": "K lookups/s",
+        "detail": {"indexArrayMps": round(rows / t_index / 1e6, 2), "card": card},
+    }
+
+
+def _engine_fixture(rows: int):
+    from pinot_tpu.engine.executor import QueryExecutor
+    from pinot_tpu.segment.columnar import build_segment_from_columns
+    from pinot_tpu.tools.datagen import make_test_schema
+
+    rng = np.random.default_rng(13)
+    schema = make_test_schema(with_mv=False)
+    cols = {
+        "dimStr": np.asarray(
+            [f"s{i}" for i in rng.integers(0, 50, size=rows)], dtype=object
+        ),
+        "dimInt": rng.integers(0, 1000, size=rows).astype(np.int32),
+        "dimLong": rng.integers(0, 10_000, size=rows).astype(np.int64),
+        "metInt": rng.integers(0, 10_000, size=rows).astype(np.int32),
+        "metFloat": rng.random(rows, dtype=np.float32),
+        "metDouble": rng.random(rows, dtype=np.float64),
+        "daysSinceEpoch": rng.integers(17000, 17100, size=rows).astype(np.int32),
+    }
+    seg = build_segment_from_columns(schema, cols, rows, "mb", "mb0")
+    return QueryExecutor(), [seg]
+
+
+def _bench_query(executor, segments, pql: str, rows: int, name: str) -> Dict:
+    from pinot_tpu.pql import parse_pql
+
+    req = parse_pql(pql)
+    executor.execute(segments, req)  # compile / warm
+    t = _time_best(lambda: executor.execute(segments, req))
+    return {
+        "bench": name,
+        "value": round(rows / t / 1e6, 1),
+        "unit": "M rows/s",
+        "detail": {"medianMs": round(t * 1000, 3), "pql": pql},
+    }
+
+
+def bench_filter(rows: int) -> Dict:
+    ex, segs = _engine_fixture(rows)
+    return _bench_query(
+        ex,
+        segs,
+        "SELECT count(*) FROM testTable WHERE dimInt > 100 AND dimInt <= 900",
+        rows,
+        "filter",
+    )
+
+
+def bench_groupby(rows: int) -> Dict:
+    ex, segs = _engine_fixture(rows)
+    return _bench_query(
+        ex,
+        segs,
+        "SELECT sum(metInt), max(metDouble) FROM testTable GROUP BY dimStr TOP 10",
+        rows,
+        "groupby",
+    )
+
+
+def bench_realtime(rows: int) -> Dict:
+    from pinot_tpu.realtime.mutable import MutableSegment
+    from pinot_tpu.tools.datagen import make_test_schema, random_rows
+
+    schema = make_test_schema(with_mv=False)
+    data = random_rows(schema, min(rows, 200_000), seed=5)
+
+    def consume():
+        seg = MutableSegment(schema, "rt0", "rt")
+        for row in data:
+            seg.index(row)
+        return seg
+
+    t = _time_best(consume, repeat=3)
+    return {
+        "bench": "realtime",
+        "value": round(len(data) / t / 1e3, 1),
+        "unit": "K rows/s indexed",
+        "detail": {"rows": len(data)},
+    }
+
+
+def bench_csv(rows: int) -> Dict:
+    import os
+    import tempfile
+
+    from pinot_tpu.segment.columnar import build_segment_from_csv
+    from pinot_tpu.tools.datagen import make_test_schema, random_rows
+
+    schema = make_test_schema(with_mv=False)
+    data = random_rows(schema, rows, seed=3)
+    names = [s.name for s in schema.all_fields()]
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "d.csv")
+        with open(path, "w") as f:
+            f.write(",".join(names) + "\n")
+            for row in data:
+                f.write(",".join(str(row[n]) for n in names) + "\n")
+        t = _time_best(lambda: build_segment_from_csv(schema, path, "t", "b"), repeat=3)
+    return {
+        "bench": "csv",
+        "value": round(rows / t / 1e3, 1),
+        "unit": "K rows/s ingested",
+        "detail": {"rows": rows},
+    }
+
+
+BENCHES: Dict[str, Callable[[int], Dict]] = {
+    "bitpack": bench_bitpack,
+    "dictionary": bench_dictionary,
+    "filter": bench_filter,
+    "groupby": bench_groupby,
+    "realtime": bench_realtime,
+    "csv": bench_csv,
+}
+
+
+def run(names: List[str], rows: int) -> List[Dict]:
+    out = []
+    for name in names:
+        out.append(BENCHES[name](rows))
+        print(json.dumps(out[-1]), flush=True)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("pinot_tpu-microbench")
+    ap.add_argument("benches", nargs="*", default=[], help=f"subset of {list(BENCHES)}")
+    ap.add_argument("-rows", type=int, default=1_000_000)
+    args = ap.parse_args()
+    names = args.benches or list(BENCHES)
+    for n in names:
+        if n not in BENCHES:
+            raise SystemExit(f"unknown bench {n!r}; choose from {list(BENCHES)}")
+    run(names, args.rows)
+
+
+if __name__ == "__main__":
+    main()
