@@ -2,17 +2,23 @@
 
 The reference's stored numbers (contrib/pinot-benchmark, BASELINE.md):
 full-scan SUM queries on 6M-row lineitem run at ~14.2M rows/s in the
-single config (422 ms for Q0).  The north star is rows-scanned/sec/chip
-on a Q1-shaped filtered group-by.
+single config (422 ms for Q0, broker-reported timeUsedMs).  The north
+star is rows-scanned/sec/chip on a Q1-shaped filtered group-by at 100M+
+rows, plus p99 group-by latency < 50 ms through the broker.
 
-This harness stages synthetic lineitem segments into device memory and
-times the compiled query kernel steady-state (post-compile) by the
-marginal-batch method: time back-to-back batches of M_large and M_small
-dispatches (each batch fetches its last result, and the device stream
-is FIFO, so every dispatched query provably executed); the difference
-divided by (M_large - M_small) is the sustained per-query device time
-with the fixed host<->device round-trip latency subtracted out — on a
-tunneled chip that latency otherwise swamps the device time.
+Two measurements, both reported:
+
+1. **Kernel throughput** (headline): staged segments, compiled query
+   kernel, steady-state marginal-batch timing (time batches of M_large
+   and M_small back-to-back dispatches and divide the difference by
+   M_large - M_small).  This subtracts the fixed host<->device
+   round-trip latency — on a tunneled chip that RTT swamps device time
+   and is an artifact of this environment, not the design.  It is the
+   closest analog of the reference's broker-reported server execution
+   time (which also excludes client RTT).
+2. **Broker end-to-end p50/p99** (detail): the same query through the
+   full broker path (parse -> route -> scatter -> kernel -> reduce ->
+   JSON) on an in-process cluster, client-observed wall time per query.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N}
@@ -21,56 +27,46 @@ from __future__ import annotations
 
 import json
 import os
-import sys
 import time
 
 import numpy as np
 
 BASELINE_ROWS_PER_SEC = 14_200_000.0  # BASELINE.md: 6,001,215 rows / 0.422 s
 
+Q1_PQL = (
+    "SELECT sum(l_quantity), sum(l_extendedprice), sum(l_discount), count(*) "
+    "FROM lineitem WHERE l_shipdate <= '1998-09-02' "
+    "GROUP BY l_returnflag, l_linestatus TOP 10"
+)
 
-def main() -> None:
-    import jax
 
-    platform = jax.devices()[0].platform
-    on_tpu = platform not in ("cpu",)
-
-    num_segments = int(os.environ.get("PINOT_TPU_BENCH_SEGMENTS", "4"))
-    rows_per_segment = int(
-        os.environ.get(
-            "PINOT_TPU_BENCH_ROWS_PER_SEGMENT", "2000000" if on_tpu else "250000"
-        )
-    )
-    iters = int(os.environ.get("PINOT_TPU_BENCH_ITERS", "20"))
-    total_rows = num_segments * rows_per_segment
-
-    from pinot_tpu.engine.context import get_table_context
-    from pinot_tpu.engine.device import stage_segments
-    from pinot_tpu.engine.executor import QueryExecutor
-    from pinot_tpu.engine.kernel import make_table_kernel
-    from pinot_tpu.engine.plan import build_query_inputs, build_static_plan
-    from pinot_tpu.pql import optimize_request, parse_pql
+def _build_segments(num_segments: int, rows_per_segment: int):
     from pinot_tpu.tools.datagen import synthetic_lineitem_segment
 
-    segments = [
+    return [
         synthetic_lineitem_segment(rows_per_segment, seed=11 + i, name=f"li{i}")
         for i in range(num_segments)
     ]
 
-    # TPC-H Q1 shape: date-range filter, 2-col group-by, multiple SUMs
-    pql = (
-        "SELECT sum(l_quantity), sum(l_extendedprice), sum(l_discount), count(*) "
-        "FROM lineitem WHERE l_shipdate <= '1998-09-02' "
-        "GROUP BY l_returnflag, l_linestatus TOP 10"
-    )
-    request = optimize_request(parse_pql(pql))
 
+def _kernel_rows_per_sec(segments, iters: int):
+    """Steady-state device throughput via marginal-batch timing.
+    Returns (rows_per_sec, per_query_ms, e2e_dispatch_ms)."""
+    from pinot_tpu.engine.context import get_table_context
+    from pinot_tpu.engine.device import segment_arrays, stage_segments
+    from pinot_tpu.engine.kernel import make_table_kernel
+    from pinot_tpu.engine.plan import build_query_inputs, build_static_plan
+    from pinot_tpu.pql import optimize_request, parse_pql
+
+    request = optimize_request(parse_pql(Q1_PQL))
     ctx = get_table_context(segments)
     needed = sorted(set(request.referenced_columns()))
+    # agg columns here are all low-cardinality (quantity 50, discount 11,
+    # extendedprice 16k): they stage as uint8/uint16 fwd + dictionary
+    # gather, not float32 raw streams (config.RAW_CARD_MIN policy)
     staged = stage_segments(
         segments,
         needed,
-        raw_columns=("l_quantity", "l_extendedprice", "l_discount"),
         gfwd_columns=("l_returnflag", "l_linestatus"),
         ctx=ctx,
     )
@@ -90,19 +86,9 @@ def main() -> None:
         return x
 
     q_inputs = conv(q_np)
-    seg_arrays = {"valid": staged.valid}
-    for name in needed:
-        col = staged.column(name)
-        if col.fwd is not None:
-            seg_arrays[f"{name}.fwd"] = col.fwd
-        if col.dict_vals is not None:
-            seg_arrays[f"{name}.dict"] = col.dict_vals
-        if col.raw is not None:
-            seg_arrays[f"{name}.raw"] = col.raw
-        if col.gfwd is not None:
-            seg_arrays[f"{name}.gfwd"] = col.gfwd
-
+    seg_arrays = segment_arrays(staged, needed)
     kernel = make_table_kernel(plan)
+    total_rows = sum(s.num_docs for s in segments)
 
     def fetch(outs):
         # pull one scalar leaf to the host: executions are FIFO on the
@@ -121,20 +107,73 @@ def main() -> None:
         return time.perf_counter() - t0
 
     fetch(kernel(seg_arrays, q_inputs))  # compile
-    run_batch(2)  # warm
+    run_batch(5)  # warm the dispatch pipeline past tunnel cold-start
 
-    # Marginal per-query time from back-to-back batches: subtracting the
-    # small batch removes the fixed host<->device round-trip latency
-    # (which on a tunneled chip otherwise swamps the device time), so
-    # the metric reflects sustained device throughput.
     m_small, m_large = 5, 5 + iters
     diffs = []
+    e2e = []
     for _ in range(3):
         t_large = run_batch(m_large)
         t_small = run_batch(m_small)
         diffs.append((t_large - t_small) / (m_large - m_small))
+        e2e.append(t_large / m_large)
     median = max(sorted(diffs)[len(diffs) // 2], 1e-6)
-    rows_per_sec = total_rows / median
+    e2e_ms = sorted(e2e)[len(e2e) // 2] * 1000
+    return total_rows / median, median * 1000, e2e_ms
+
+
+def _broker_latencies(segments, queries_per_round: int = 40):
+    """p50/p99 of the Q1 query through the full broker path (parse ->
+    route -> scatter -> vmapped kernel -> reduce), client-observed."""
+    from pinot_tpu.broker.broker import BrokerRequestHandler
+    from pinot_tpu.broker.routing import RoutingTableProvider
+    from pinot_tpu.server.instance import ServerInstance
+    from pinot_tpu.tools.query_runner import QueryRunner
+    from pinot_tpu.transport.local import LocalTransport
+
+    server = ServerInstance("benchServer")
+    for seg in segments:
+        server.add_segment("lineitem", seg)
+    transport = LocalTransport()
+    transport.register(("benchServer", 0), server.handle_request)
+    routing = RoutingTableProvider()
+    routing.update(
+        "lineitem", {s.segment_name: {"benchServer": "ONLINE"} for s in segments}
+    )
+    broker = BrokerRequestHandler(
+        transport, {"benchServer": ("benchServer", 0)}, routing=routing
+    )
+
+    def run(pql: str) -> None:
+        resp = broker.handle_pql(pql)
+        assert not resp.exceptions, resp.exceptions
+
+    runner = QueryRunner(run)
+    runner.single_thread([Q1_PQL], rounds=3)  # warm: stage + compile
+    report = runner.single_thread([Q1_PQL] * queries_per_round, rounds=1)
+    return report
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+
+    num_segments = int(os.environ.get("PINOT_TPU_BENCH_SEGMENTS", "16" if on_tpu else "4"))
+    rows_per_segment = int(
+        os.environ.get(
+            "PINOT_TPU_BENCH_ROWS_PER_SEGMENT", "8388608" if on_tpu else "250000"
+        )
+    )
+    iters = int(os.environ.get("PINOT_TPU_BENCH_ITERS", "20"))
+    total_rows = num_segments * rows_per_segment
+
+    segments = _build_segments(num_segments, rows_per_segment)
+    rows_per_sec, per_query_ms, e2e_ms = _kernel_rows_per_sec(segments, iters)
+    broker_report = _broker_latencies(segments)
+    rj = broker_report.to_json()
+    p50_s = max(broker_report.percentile(50), 1e-6) / 1000.0
 
     print(
         json.dumps(
@@ -147,9 +186,19 @@ def main() -> None:
                     "platform": platform,
                     "total_rows": total_rows,
                     "num_segments": num_segments,
-                    "per_query_ms": round(median * 1000, 3),
-                    "method": "marginal-batch (fixed RTT subtracted)",
+                    "per_query_ms": round(per_query_ms, 3),
+                    "batch_amortized_ms": round(e2e_ms, 3),
+                    "method": "marginal-batch (fixed RTT subtracted); "
+                    "batch_amortized spreads one fetch RTT over the batch; "
+                    "broker numbers are true per-query client-observed "
+                    "latency incl. one tunnel RTT each",
                     "iters": iters,
+                    "broker_p50_ms": rj["p50Ms"],
+                    "broker_p99_ms": rj["p99Ms"],
+                    "broker_rows_per_sec_p50": round(total_rows / p50_s, 1),
+                    "vs_baseline_broker_p50": round(
+                        total_rows / p50_s / BASELINE_ROWS_PER_SEC, 3
+                    ),
                 },
             }
         )

@@ -68,3 +68,35 @@ def pad_card(c: int) -> int:
     while m < c:
         m *= 2
     return m
+
+
+# ---------------------------------------------------------------------------
+# HBM staging widths.  The query kernels are memory-bound (SURVEY §6:
+# rows/s ~ HBM bytes/row), so forward indexes stage at the narrowest
+# integer dtype that holds the dictId range — the analog of the
+# reference's bit-packed fwd index (FixedBitSingleValueReader.java:25),
+# except the "unpack" is a free in-register upcast on TPU.
+# ---------------------------------------------------------------------------
+
+# Columns with cardinality above this stage a dictionary-decoded float
+# raw array for aggregation reads; at or below it, the kernel gathers
+# dict_vals[fwd] (fwd is int8/int16 -> strictly fewer HBM bytes than a
+# float32 stream, and VMEM-resident small-table gathers are cheap).
+RAW_CARD_MIN = 1 << 15
+
+
+def index_dtype(max_exclusive: int):
+    """np dtype for dictId arrays indexing tables of max_exclusive rows.
+
+    Unsigned, and sized so the table length itself is representable
+    (jax index normalization materializes the axis size as a constant
+    of the index dtype)."""
+    if max_exclusive <= 255:
+        return np.uint8
+    if max_exclusive <= 65535:
+        return np.uint16
+    return np.int32
+
+
+# count arrays (values <= bound) share the same width ladder
+count_dtype = index_dtype

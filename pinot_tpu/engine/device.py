@@ -8,18 +8,25 @@ Layout (S = number of segments stacked on the leading axis — the
 parallelism axis that replaces MCombineOperator's thread pools and is
 sharded over the chip mesh in ``pinot_tpu.parallel``):
 
-  fwd        int32 [S, n_pad]            SV dictId forward index
-  mv         int32 [S, n_pad, mv_pad]    MV dictIds (padded)
-  mv_valid   bool  [S, n_pad, mv_pad]    MV entry validity
-  dict_vals  float [S, card_pad]         numeric dictionary values
-  valid      bool  [S, n_pad]            doc validity (padding rows False)
+  fwd        int8/16/32 [S, n_pad]          SV dictId forward index
+  mv         int8/16/32 [S, n_pad, mv_pad]  MV dictIds (padded)
+  mv_counts  int8/16    [S, n_pad]          per-doc MV entry count
+  dict_vals  float      [S, card_pad]       numeric dictionary values
+  num_docs_arr int32    [S]                 true doc count per segment
+
+Integer widths are minimal for the column's cardinality
+(``config.index_dtype``) — the kernels are HBM-bandwidth-bound, so a
+card-3 column should cost 1 byte/row, not 4.  Validity masks are never
+stored: the kernel derives doc validity from ``iota < num_docs`` and MV
+entry validity from ``iota < mv_counts``, trading a free register
+compare for an HBM byte per row (or per MV slot).
 
 All shapes are bucketed (pow2 padding, ``config.pad_docs/pad_card``) so
-the jit cache stays bounded; padding docs carry dictId 0 and valid=False,
-and every kernel masks with ``valid``.
+the jit cache stays bounded; padding docs carry dictId 0.
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -42,7 +49,7 @@ class StagedColumn:
     cards: Tuple[int, ...]  # per-segment true cardinality
     fwd: Optional[jnp.ndarray] = None
     mv: Optional[jnp.ndarray] = None
-    mv_valid: Optional[jnp.ndarray] = None
+    mv_counts: Optional[jnp.ndarray] = None
     dict_vals: Optional[jnp.ndarray] = None
     # optional role-specific arrays (big-dictionary gathers are slow on
     # TPU, so these trade HBM for streaming access):
@@ -62,8 +69,9 @@ class StagedTable:
     num_segments: int
     n_pad: int
     num_docs: Tuple[int, ...]
-    valid: jnp.ndarray  # bool [S, n_pad]
+    num_docs_arr: jnp.ndarray  # int32 [S]
     columns: Dict[str, StagedColumn] = field(default_factory=dict)
+    _valid: Optional[jnp.ndarray] = None
 
     def column(self, name: str) -> StagedColumn:
         return self.columns[name]
@@ -71,6 +79,17 @@ class StagedTable:
     @property
     def total_docs(self) -> int:
         return int(sum(self.num_docs))
+
+    @property
+    def valid(self) -> jnp.ndarray:
+        """bool [S, n_pad] doc-validity mask, materialized on demand —
+        kernels derive validity from num_docs instead of reading this."""
+        if self._valid is None:
+            v = np.zeros((self.num_segments, self.n_pad), dtype=bool)
+            for i, n in enumerate(self.num_docs):
+                v[i, :n] = True
+            self._valid = jnp.asarray(v)
+        return self._valid
 
 
 def stage_segments(
@@ -98,16 +117,17 @@ def stage_segments(
 
     put = (lambda x: jax.device_put(x, device)) if device is not None else jnp.asarray
 
-    valid_np = np.zeros((S, n_pad), dtype=bool)
-    for i, seg in enumerate(segments):
-        valid_np[i, : seg.num_docs] = True
-
     staged = StagedTable(
         segment_names=tuple(s.segment_name for s in segments),
         num_segments=S,
         n_pad=n_pad,
         num_docs=tuple(s.num_docs for s in segments) + (0,) * (S - len(segments)),
-        valid=put(valid_np),
+        num_docs_arr=put(
+            np.asarray(
+                [s.num_docs for s in segments] + [0] * (S - len(segments)),
+                dtype=np.int32,
+            )
+        ),
     )
 
     fdt = config.np_float_dtype()
@@ -116,6 +136,7 @@ def stage_segments(
         meta0 = cols[0].metadata
         cards = tuple(c.dictionary.cardinality for c in cols)
         card_pad = config.pad_card(max(cards))
+        idt = config.index_dtype(card_pad)
         sc = StagedColumn(
             name=name,
             stored_type=meta0.data_type.stored_type,
@@ -125,7 +146,7 @@ def stage_segments(
             cards=cards,
         )
         if meta0.single_value:
-            fwd = np.zeros((S, n_pad), dtype=np.int32)
+            fwd = np.zeros((S, n_pad), dtype=idt)
             for i, c in enumerate(cols):
                 fwd[i, : c.fwd.size] = c.fwd
             sc.fwd = put(fwd)
@@ -136,7 +157,10 @@ def stage_segments(
                     raw[i, : c.fwd.size] = vals[c.fwd]
                 sc.raw = put(raw)
             if name in gfwd_columns and ctx is not None:
-                gf = np.zeros((S, n_pad), dtype=np.int32)
+                gdt = config.index_dtype(
+                    config.pad_card(ctx.column(name).global_cardinality)
+                )
+                gf = np.zeros((S, n_pad), dtype=gdt)
                 remaps = ctx.column(name).remaps
                 for i, c in enumerate(cols):
                     gf[i, : c.fwd.size] = remaps[i][c.fwd]
@@ -144,8 +168,8 @@ def stage_segments(
         else:
             mv_pad = max(1, max(c.metadata.max_num_multi_values for c in cols))
             mv_pad = config.pad_card(mv_pad)  # pow2 bucket
-            mv = np.zeros((S, n_pad, mv_pad), dtype=np.int32)
-            mvv = np.zeros((S, n_pad, mv_pad), dtype=bool)
+            mv = np.zeros((S, n_pad, mv_pad), dtype=idt)
+            mvc = np.zeros((S, n_pad), dtype=config.count_dtype(mv_pad))
             for i, c in enumerate(cols):
                 offs = c.mv_offsets
                 counts = np.diff(offs)
@@ -154,10 +178,10 @@ def stage_segments(
                 row_idx = np.repeat(np.arange(n), counts)
                 col_idx = np.concatenate([np.arange(k) for k in counts]) if n else np.zeros(0, int)
                 mv[i, row_idx, col_idx] = c.mv_values
-                mvv[i, row_idx, col_idx] = True
+                mvc[i, :n] = counts
             sc.mv_pad = mv_pad
             sc.mv = put(mv)
-            sc.mv_valid = put(mvv)
+            sc.mv_counts = put(mvc)
         if sc.is_numeric:
             dv = np.zeros((S, card_pad), dtype=fdt)
             for i, c in enumerate(cols):
@@ -174,6 +198,23 @@ def stage_segments(
 # ---------------------------------------------------------------------------
 
 _stage_cache: Dict[Tuple, StagedTable] = {}
+# per-key locks serialize staging (cache miss) and role-array
+# augmentation so two concurrent queries over the same segments don't
+# both materialize + transfer multi-GB column sets (ADVICE r1:
+# redundant work + transient 2x HBM, not a race); distinct tables
+# stage concurrently and cache hits never wait on a cold stage
+_locks_guard = threading.Lock()
+_key_locks: Dict[Tuple, "threading.Lock"] = {}
+
+
+def _lock_for(key: Tuple) -> "threading.Lock":
+    with _locks_guard:
+        lock = _key_locks.get(key)
+        if lock is None:
+            if len(_key_locks) > 256:
+                _key_locks.clear()
+            lock = _key_locks.setdefault(key, threading.Lock())
+        return lock
 
 
 def get_staged(
@@ -193,21 +234,22 @@ def get_staged(
         tuple(sorted(column_names)),
         pad_segments_to,
     )
-    st = _stage_cache.get(key)
-    if st is None:
-        st = stage_segments(
-            segments,
-            sorted(column_names),
-            pad_segments_to=pad_segments_to,
-            raw_columns=raw_columns,
-            gfwd_columns=gfwd_columns,
-            ctx=ctx,
-        )
-        if len(_stage_cache) > 32:
-            _stage_cache.clear()
-        _stage_cache[key] = st
-    else:
-        _augment_staged(st, segments, raw_columns, gfwd_columns, ctx)
+    with _lock_for(key):
+        st = _stage_cache.get(key)
+        if st is None:
+            st = stage_segments(
+                segments,
+                sorted(column_names),
+                pad_segments_to=pad_segments_to,
+                raw_columns=raw_columns,
+                gfwd_columns=gfwd_columns,
+                ctx=ctx,
+            )
+            if len(_stage_cache) > 32:
+                _stage_cache.clear()
+            _stage_cache[key] = st
+        else:
+            _augment_staged(st, segments, raw_columns, gfwd_columns, ctx)
     return st
 
 
@@ -235,7 +277,8 @@ def _augment_staged(
         sc = st.columns.get(name)
         if sc is None or sc.gfwd is not None or not sc.single_value or ctx is None:
             continue
-        gf = np.zeros((S, n_pad), dtype=np.int32)
+        gdt = config.index_dtype(config.pad_card(ctx.column(name).global_cardinality))
+        gf = np.zeros((S, n_pad), dtype=gdt)
         remaps = ctx.column(name).remaps
         for i, seg in enumerate(segments):
             c = seg.column(name)
@@ -245,3 +288,39 @@ def _augment_staged(
 
 def clear_staging_cache() -> None:
     _stage_cache.clear()
+
+
+def segment_arrays(staged: StagedTable, needed) -> Dict[str, jnp.ndarray]:
+    """Assemble the kernel's ``seg`` pytree for the given columns.
+
+    Row validity ships as the per-segment ``num_docs`` scalar (the
+    kernel compares against an iota); the materialized ``valid`` mask is
+    only sent when no row-shaped column array exists to take the row
+    count from (e.g. ``SELECT COUNT(*)`` with no filter).
+    """
+    arrays: Dict[str, jnp.ndarray] = {}
+    has_rows = False
+    for name in needed:
+        col = staged.columns.get(name)
+        if col is None:
+            continue
+        if col.fwd is not None:
+            arrays[f"{name}.fwd"] = col.fwd
+            has_rows = True
+        if col.mv is not None:
+            arrays[f"{name}.mv"] = col.mv
+            arrays[f"{name}.mvc"] = col.mv_counts
+            has_rows = True
+        if col.dict_vals is not None:
+            arrays[f"{name}.dict"] = col.dict_vals
+        if col.raw is not None:
+            arrays[f"{name}.raw"] = col.raw
+            has_rows = True
+        if col.gfwd is not None:
+            arrays[f"{name}.gfwd"] = col.gfwd
+            has_rows = True
+    if has_rows:
+        arrays["num_docs"] = staged.num_docs_arr
+    else:
+        arrays["valid"] = staged.valid
+    return arrays

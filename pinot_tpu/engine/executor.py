@@ -112,7 +112,7 @@ class QueryExecutor:
             pad_to = -(-len(live) // n) * n
 
         ctx = get_table_context(live)
-        raw_cols, gfwd_cols = self._role_columns(request, live[0])
+        raw_cols, gfwd_cols = self._role_columns(request, live)
         staged = get_staged(
             live,
             sorted(needed),
@@ -129,8 +129,10 @@ class QueryExecutor:
 
             return execute_host(live, ctx, request, total_docs, sel_columns)
 
+        from pinot_tpu.engine.device import segment_arrays
+
         q_inputs = self._to_device_inputs(build_query_inputs(request, plan, ctx, staged))
-        seg_arrays = self._segment_arrays(plan, staged, needed)
+        seg_arrays = segment_arrays(staged, needed)
         t0 = self._phase("planBuild", t0)
         kernel = self._kernel(plan)
         outs = kernel(seg_arrays, q_inputs)
@@ -164,16 +166,25 @@ class QueryExecutor:
             return list(seg.columns.keys())
         return list(cols)
 
-    def _role_columns(self, request: BrokerRequest, seg: ImmutableSegment):
+    def _role_columns(self, request: BrokerRequest, live: Sequence[ImmutableSegment]):
         """Columns to stage with role-specific arrays: aggregation
         inputs get raw value arrays, group-by/sort keys get global-id
         forward arrays (both avoid slow big-table gathers on device)."""
+        seg = live[0]
 
         def numeric_sv(c: str) -> bool:
             if c == "*" or c not in seg.columns:
                 return False
             m = seg.column(c).metadata
             return m.single_value and m.data_type.stored_type != DataType.STRING
+
+        def big_card(c: str) -> bool:
+            # below RAW_CARD_MIN the fwd index stages narrow (uint8/16)
+            # and a VMEM dictionary gather beats streaming float32 raws;
+            # the staged dtype is sized by the table-wide max cardinality,
+            # so the decision must be too
+            card = max(s.column(c).metadata.cardinality for s in live)
+            return card > config.RAW_CARD_MIN
 
         def sv(c: str) -> bool:
             return c in seg.columns and seg.column(c).metadata.single_value
@@ -185,7 +196,9 @@ class QueryExecutor:
         raw_cols = {
             a.column
             for a in request.aggregations
-            if numeric_sv(a.column) and _agg_kind(a.base_function) in ("scalar", "pair")
+            if numeric_sv(a.column)
+            and big_card(a.column)
+            and _agg_kind(a.base_function) in ("scalar", "pair")
         }
         gfwd_cols = set()
         if request.is_group_by:
@@ -193,25 +206,6 @@ class QueryExecutor:
         if request.is_selection:
             gfwd_cols.update(s.column for s in request.selection.sorts if sv(s.column))
         return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols))
-
-    def _segment_arrays(
-        self, plan: StaticPlan, staged: StagedTable, needed: set
-    ) -> Dict[str, Any]:
-        arrays: Dict[str, Any] = {"valid": staged.valid}
-        for name in needed:
-            col = staged.column(name)
-            if col.fwd is not None:
-                arrays[f"{name}.fwd"] = col.fwd
-            if col.mv is not None:
-                arrays[f"{name}.mv"] = col.mv
-                arrays[f"{name}.mv_valid"] = col.mv_valid
-            if col.dict_vals is not None:
-                arrays[f"{name}.dict"] = col.dict_vals
-            if col.raw is not None:
-                arrays[f"{name}.raw"] = col.raw
-            if col.gfwd is not None:
-                arrays[f"{name}.gfwd"] = col.gfwd
-        return arrays
 
     def _to_device_inputs(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
         def conv(x):

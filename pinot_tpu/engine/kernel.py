@@ -74,6 +74,31 @@ def _segment_add_matmul(flat_idx, w, capacity: int):
     return acc
 
 
+def _row_shaped(key: str) -> bool:
+    return key.endswith((".fwd", ".raw", ".gfwd", ".mv"))
+
+
+def _valid_mask(seg: Dict[str, Any]) -> jnp.ndarray:
+    """Doc-validity mask: ``iota < num_docs`` (free register compare)
+    rather than a stored bool column (an HBM byte per row).  Falls back
+    to a materialized ``valid`` array when no row-shaped column exists
+    to take the row count from."""
+    if "num_docs" in seg:
+        for k, v in seg.items():
+            if _row_shaped(k):
+                n = v.shape[0]
+                return jax.lax.iota(jnp.int32, n) < seg["num_docs"]
+    return seg["valid"]
+
+
+def _mv_valid(seg: Dict[str, Any], column: str) -> jnp.ndarray:
+    """MV entry-validity mask from per-doc counts: iota < mvc."""
+    mv = seg[f"{column}.mv"]
+    counts = seg[f"{column}.mvc"]
+    iota = jax.lax.broadcasted_iota(jnp.int32, mv.shape, mv.ndim - 1)
+    return iota < counts[..., None]
+
+
 def _leaf_mask(plan: StaticPlan, i: int, seg: Dict[str, Any], q: Dict[str, Any]) -> jnp.ndarray:
     leaf = plan.leaves[i]
     kind = leaf.eval_kind
@@ -94,7 +119,7 @@ def _leaf_mask(plan: StaticPlan, i: int, seg: Dict[str, Any], q: Dict[str, Any])
     if leaf.mode == SV:
         return ids_match(seg[f"{leaf.column}.fwd"])  # [n]
     mv = seg[f"{leaf.column}.mv"]  # [n, mv]
-    mvv = seg[f"{leaf.column}.mv_valid"]
+    mvv = _mv_valid(seg, leaf.column)
     hit = jnp.any(ids_match(mv) & mvv, axis=-1)
     if leaf.mode == MV_ANY:
         return hit
@@ -117,7 +142,7 @@ def _row_values(agg: StaticAgg, seg, mask):
     fdt = config.float_dtype()
     if agg.is_mv:
         mv = seg[f"{agg.column}.mv"]
-        mvv = seg[f"{agg.column}.mv_valid"] & mask[:, None]
+        mvv = _mv_valid(seg, agg.column) & mask[:, None]
         vals = seg[f"{agg.column}.dict"][mv]
         return vals, mvv
     if agg.use_raw:
@@ -133,7 +158,7 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
     base = agg.base
     if base == "count":
         if agg.is_mv:
-            mvv = seg[f"{agg.column}.mv_valid"] & mask[:, None]
+            mvv = _mv_valid(seg, agg.column) & mask[:, None]
             return jnp.sum(mvv, dtype=fdt)
         return jnp.sum(mask, dtype=fdt)
 
@@ -162,7 +187,7 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
         presence = jnp.zeros(agg.gcard_pad, dtype=jnp.int32)
         if agg.is_mv:
             mv = seg[f"{agg.column}.mv"]
-            m = seg[f"{agg.column}.mv_valid"] & mask[:, None]
+            m = _mv_valid(seg, agg.column) & mask[:, None]
             gids = remap[mv]
             return presence.at[gids].max(m.astype(jnp.int32), mode="drop")
         gids = remap[seg[f"{agg.column}.fwd"]]
@@ -173,7 +198,7 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
         hist = jnp.zeros(agg.gcard_pad, dtype=fdt)
         if agg.is_mv:
             mv = seg[f"{agg.column}.mv"]
-            m = seg[f"{agg.column}.mv_valid"] & mask[:, None]
+            m = _mv_valid(seg, agg.column) & mask[:, None]
             return hist.at[remap[mv]].add(m.astype(fdt), mode="drop")
         gids = remap[seg[f"{agg.column}.fwd"]]
         return hist.at[gids].add(mask.astype(fdt), mode="drop")
@@ -183,7 +208,7 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
         regs = jnp.zeros(config.HLL_M, dtype=jnp.int32)
         if agg.is_mv:
             mv = seg[f"{agg.column}.mv"]
-            m = seg[f"{agg.column}.mv_valid"] & mask[:, None]
+            m = _mv_valid(seg, agg.column) & mask[:, None]
             return regs.at[bucket[mv]].max(
                 jnp.where(m, rho[mv], 0), mode="drop"
             )
@@ -217,7 +242,7 @@ def _group_keys(plan: StaticPlan, seg, q, mask):
             keys = keys * gcard + g[:, None]
         else:
             mv = seg[f"{col}.mv"]
-            mvv = seg[f"{col}.mv_valid"]
+            mvv = _mv_valid(seg, col)
             g = remap[mv].astype(kdt)  # [n, mv]
             E = keys.shape[1]
             keys = (keys[:, :, None] * gcard + g[:, None, :]).reshape(n, -1)
@@ -246,7 +271,7 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
 
     if base == "count":
         if agg.is_mv:
-            mvv = seg[f"{agg.column}.mv_valid"]
+            mvv = _mv_valid(seg, agg.column)
             row_counts = jnp.sum(mvv, axis=-1).astype(fdt)
             w = per_entry(row_counts)
         else:
@@ -295,7 +320,7 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
         remap = aux["remap"]
         if agg.is_mv:
             mv = seg[f"{agg.column}.mv"]
-            mvv = seg[f"{agg.column}.mv_valid"]
+            mvv = _mv_valid(seg, agg.column)
             gids = remap[mv]  # [n, mv]
             E = idx.shape[1]
             pair_k = jnp.broadcast_to(idx[:, :, None], idx.shape + gids.shape[-1:]).reshape(-1)
@@ -316,7 +341,7 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
         bucket, rho = aux["bucket"], aux["rho"]
         if agg.is_mv:
             mv = seg[f"{agg.column}.mv"]
-            mvv = seg[f"{agg.column}.mv_valid"]
+            mvv = _mv_valid(seg, agg.column)
             b = bucket[mv]
             r = rho[mv]
             E = idx.shape[1]
@@ -340,7 +365,7 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
 
 def make_single_segment_kernel(plan: StaticPlan) -> Callable:
     def kernel(seg: Dict[str, Any], q: Dict[str, Any]) -> Dict[str, Any]:
-        valid = seg["valid"]
+        valid = _valid_mask(seg)
         if plan.filter_tree is not None:
             mask = _eval_tree(plan, plan.filter_tree, seg, q) & valid
         else:
