@@ -309,22 +309,21 @@ class QueryExecutor:
 
         # Trim candidate groups per aggregation (reference trims to
         # topN*5 per server, MCombineGroupByOperator.java:216); the
-        # union over aggregations is kept so merges stay consistent.
-        trim = max(gb.top_n * 5, 100)
-        if keys.size > trim:
-            candidates: set = set()
-            for i, agg in enumerate(plan.aggs):
-                order_vals = self._group_order_values(agg, outs[f"gb_{i}"], keys, ctx)
-                asc = group_sort_ascending(agg.func)
-                order = np.argsort(order_vals, kind="stable")
-                chosen = order[:trim] if asc else order[-trim:]
-                candidates.update(keys[chosen].tolist())
-                # keep every group tied with the boundary value — final
-                # ordering breaks ties by rendered key, which the trim
-                # pass cannot see
-                boundary = order_vals[order[trim - 1 if asc else -trim]]
-                candidates.update(keys[order_vals == boundary].tolist())
-            keys = np.asarray(sorted(candidates), dtype=keys.dtype)
+        # union over aggregations (incl. capped boundary ties) is kept
+        # so merges stay consistent.
+        from pinot_tpu.engine.results import trim_group_candidates
+
+        if keys.size > max(gb.top_n * 5, 100):
+            keep = trim_group_candidates(
+                [
+                    self._group_order_values(agg, outs[f"gb_{i}"], keys, ctx)
+                    for i, agg in enumerate(plan.aggs)
+                ],
+                [group_sort_ascending(agg.func) for agg in plan.aggs],
+                gb.top_n,
+                keys.size,
+            )
+            keys = keys[keep]
 
         # decompose mixed-radix keys -> per-column global ids
         gids = []

@@ -4,10 +4,16 @@ aggregations, composite sort keys beyond the key dtype).
 
 The reference's analog is the hash-map group-by storage types
 (``DefaultGroupKeyGenerator.java:60-63`` LONG_MAP_BASED/ARRAY_MAP_BASED)
-that kick in when the dense ARRAY_BASED key space overflows.  Here the
-filter still evaluates vectorized (numpy match-table gathers over the
-forward index); only the aggregation of *matched* rows falls back to the
-row-wise accumulators shared with the scan oracle.
+that kick in when the dense ARRAY_BASED key space overflows — and in the
+reference that map path is its *fast* path for big key spaces.  Here the
+filter always evaluates vectorized (numpy match-table gathers over the
+forward index), and group-by aggregation over huge key spaces runs a
+vectorized numpy hash pipeline: mixed-radix global-id keys per matched
+row -> ``np.unique`` factorization -> ``bincount``/``reduceat``
+segmented reductions -> trim to topN*5 candidates before any Python
+objects are built.  Only queries outside that shape (MV group columns,
+value-state aggregations, radix overflow) drop to the row-wise
+accumulators shared with the scan oracle.
 """
 from __future__ import annotations
 
@@ -15,12 +21,27 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from pinot_tpu.common.request import BrokerRequest, FilterOperator, FilterQueryTree
+from pinot_tpu.common.request import (
+    BrokerRequest,
+    FilterOperator,
+    FilterQueryTree,
+    group_sort_ascending,
+)
 from pinot_tpu.common.values import render_value
 from pinot_tpu.engine import config
 from pinot_tpu.engine.context import TableContext
 from pinot_tpu.engine.plan import match_table
-from pinot_tpu.engine.results import IntermediateResult, make_partial
+from pinot_tpu.engine.results import (
+    AvgPartial,
+    CountPartial,
+    IntermediateResult,
+    MaxPartial,
+    MinMaxRangePartial,
+    MinPartial,
+    SumPartial,
+    make_partial,
+    trim_group_candidates,
+)
 from pinot_tpu.segment.immutable import ImmutableSegment
 from pinot_tpu.tools.scan_engine import _Accumulator
 
@@ -49,6 +70,171 @@ def _segment_mask(seg: ImmutableSegment, tree: Optional[FilterQueryTree]) -> np.
     return out
 
 
+_VECTOR_AGGS = {"count", "sum", "min", "max", "avg", "minmaxrange"}
+
+
+def _vectorizable_groupby(request: BrokerRequest, segments, ctx: TableContext) -> bool:
+    """True when the fast numpy hash path applies: SV group columns,
+    scalar/pair aggregations over SV numeric columns, and a mixed-radix
+    key that fits int64."""
+    seg = segments[0]
+    for c in request.group_by.columns:
+        if c not in seg.columns or not seg.column(c).is_single_value:
+            return False
+    space = 1
+    for c in request.group_by.columns:
+        space *= max(ctx.column(c).global_cardinality, 1)
+        if space >= (1 << 62):
+            return False
+    for a in request.aggregations:
+        if a.base_function not in _VECTOR_AGGS:
+            return False
+        if a.column == "*":
+            continue
+        if a.column not in seg.columns:
+            return False
+        col = seg.column(a.column)
+        if not col.is_single_value or col.dictionary.stored_type.name == "STRING":
+            return False
+    return True
+
+
+def _groupby_vectorized(
+    segments: List[ImmutableSegment],
+    ctx: TableContext,
+    request: BrokerRequest,
+    res: IntermediateResult,
+) -> None:
+    """Vectorized LONG_MAP_BASED analog: one int64 key per matched row,
+    factorized with np.unique; sums/counts via bincount, min/max via
+    sorted reduceat; groups trimmed to topN*5 before materializing
+    Python keys (MCombineGroupByOperator.java:216 trim semantics)."""
+    gb = request.group_by
+    gcards = [max(ctx.column(c).global_cardinality, 1) for c in gb.columns]
+    # columns whose decoded values the states actually need (count reads
+    # none); gathered once per (segment, column) even when several
+    # aggregations share a column
+    val_columns = {
+        a.column
+        for a in request.aggregations
+        if a.base_function != "count" and a.column != "*"
+    }
+
+    all_keys: List[np.ndarray] = []
+    col_vals: Dict[str, List[np.ndarray]] = {c: [] for c in val_columns}
+    for si, seg in enumerate(segments):
+        mask = _segment_mask(seg, request.filter)
+        matched = np.nonzero(mask)[0]
+        res.num_docs_scanned += int(matched.size)
+        if matched.size == 0:
+            continue
+        keys = np.zeros(matched.size, dtype=np.int64)
+        for c, gcard in zip(gb.columns, gcards):
+            col = seg.column(c)
+            remap = ctx.column(c).remaps[si]
+            keys = keys * gcard + remap[col.fwd[matched]].astype(np.int64)
+        all_keys.append(keys)
+        for c in val_columns:
+            col = seg.column(c)
+            col_vals[c].append(
+                np.asarray(col.dictionary.values, dtype=np.float64)[col.fwd[matched]]
+            )
+
+    if not all_keys:
+        return
+    keys = np.concatenate(all_keys)
+    uniq, inv = np.unique(keys, return_inverse=True)
+    k = uniq.size
+    counts = np.bincount(inv, minlength=k).astype(np.float64)
+
+    # per-agg finalized state arrays, each [k]
+    order = None  # lazily computed stable sort of inv, for reduceat
+    boundaries = None
+
+    def seg_minmax(vals: np.ndarray):
+        nonlocal order, boundaries
+        if order is None:
+            order = np.argsort(inv, kind="stable")
+            boundaries = np.searchsorted(inv[order], np.arange(k))
+        sorted_vals = vals[order]
+        return (
+            np.minimum.reduceat(sorted_vals, boundaries),
+            np.maximum.reduceat(sorted_vals, boundaries),
+        )
+
+    cat_vals = {c: np.concatenate(v) for c, v in col_vals.items()}
+    minmax_cache: Dict[str, tuple] = {}
+
+    states: List[tuple] = []  # (kind, arrays...)
+    order_vals: List[np.ndarray] = []
+    for a in request.aggregations:
+        base = a.base_function
+        if base == "count":
+            states.append(("count", counts))
+            order_vals.append(counts)
+            continue
+        vals = cat_vals[a.column]
+        if base == "sum":
+            s = np.bincount(inv, weights=vals, minlength=k)
+            states.append(("sum", s))
+            order_vals.append(s)
+        elif base == "avg":
+            s = np.bincount(inv, weights=vals, minlength=k)
+            states.append(("avg", s, counts))
+            order_vals.append(s / np.maximum(counts, 1))
+        elif base in ("min", "max", "minmaxrange"):
+            if a.column not in minmax_cache:
+                minmax_cache[a.column] = seg_minmax(vals)
+            mn, mx = minmax_cache[a.column]
+            if base == "min":
+                states.append(("min", mn))
+                order_vals.append(mn)
+            elif base == "max":
+                states.append(("max", mx))
+                order_vals.append(mx)
+            else:
+                states.append(("minmaxrange", mn, mx))
+                order_vals.append(mx - mn)
+
+    # trim to topN*5 + boundary ties per agg (union), as the device path
+    keep = trim_group_candidates(
+        order_vals,
+        [group_sort_ascending(a.function) for a in request.aggregations],
+        gb.top_n,
+        k,
+    )
+
+    # decompose kept keys -> per-column global ids -> rendered tuples
+    gids = []
+    rem = uniq[keep].copy()
+    for gcard in reversed(gcards):
+        gids.append(rem % gcard)
+        rem = rem // gcard
+    gids.reverse()
+    gdicts = [ctx.column(c).global_dict for c in gb.columns]
+
+    def partial(state, i: int):
+        kind = state[0]
+        if kind == "count":
+            return CountPartial(float(state[1][i]))
+        if kind == "sum":
+            return SumPartial(float(state[1][i]))
+        if kind == "min":
+            return MinPartial(float(state[1][i]))
+        if kind == "max":
+            return MaxPartial(float(state[1][i]))
+        if kind == "avg":
+            return AvgPartial(float(state[1][i]), float(state[2][i]))
+        return MinMaxRangePartial(float(state[1][i]), float(state[2][i]))
+
+    for row, i in enumerate(keep):
+        ktup = tuple(
+            render_value(gdicts[j].stored_type, gdicts[j].get(int(gids[j][row])))
+            for j in range(len(gb.columns))
+        )
+        res.groups[ktup] = [partial(st, int(i)) for st in states]
+
+
 def execute_host(
     segments: List[ImmutableSegment],
     ctx: TableContext,
@@ -62,6 +248,9 @@ def execute_host(
     )
     if request.is_group_by:
         res.groups = {}
+        if _vectorizable_groupby(request, segments, ctx):
+            _groupby_vectorized(segments, ctx, request, res)
+            return res
     elif request.is_aggregation:
         res.aggregations = [make_partial(a.base_function) for a in request.aggregations]
     else:

@@ -253,3 +253,43 @@ class IntermediateResult:
                 self.selection_rows.extend(other.selection_rows)
         if self.selection_columns is None:
             self.selection_columns = other.selection_columns
+
+
+# Cap on boundary-tie groups admitted past the trim: final ordering
+# breaks value ties by rendered key (which the trim cannot see), so
+# tied-at-the-boundary groups are kept — but at huge key spaces a
+# degenerate workload (e.g. COUNT(*) over near-unique keys, every group
+# tied at 1) would otherwise re-admit millions of groups and defeat the
+# trim entirely.  Beyond the cap a deterministic subset is kept; the
+# reference's per-server topN*5 trim makes the same non-guarantee for
+# deep ties (MCombineGroupByOperator.java:216).
+MAX_TRIM_TIES = 10_000
+
+
+def trim_group_candidates(
+    order_vals_list: List[np.ndarray],
+    ascending_list: List[bool],
+    top_n: int,
+    k: int,
+) -> np.ndarray:
+    """Candidate group indices to keep after the per-server trim.
+
+    ``order_vals_list`` holds one finalized-value array of shape [k] per
+    aggregation; a group survives if it is within topN*5 (min 100) of
+    any aggregation's ordering, or tied (capped) with that boundary.
+    Returns sorted indices into [0, k).
+    """
+    trim = max(top_n * 5, 100)
+    if k <= trim:
+        return np.arange(k)
+    candidates: set = set()
+    for ov, asc in zip(order_vals_list, ascending_list):
+        order = np.argsort(ov, kind="stable")
+        chosen = order[:trim] if asc else order[-trim:]
+        candidates.update(chosen.tolist())
+        boundary = ov[order[trim - 1 if asc else -trim]]
+        ties = np.nonzero(ov == boundary)[0]
+        if ties.size > MAX_TRIM_TIES:
+            ties = ties[:MAX_TRIM_TIES]
+        candidates.update(ties.tolist())
+    return np.asarray(sorted(candidates), dtype=np.int64)
