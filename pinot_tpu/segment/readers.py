@@ -1,8 +1,9 @@
-"""Record readers: CSV / JSON-lines -> rows for the segment builder.
+"""Record readers: CSV / JSON-lines / Avro -> rows for the segment
+builder.
 
 Reference: pinot-core ``data/readers/`` (Avro/CSV/JSON record readers).
-Avro is intentionally not implemented (no avro lib baked in); JSON-lines
-covers the same role for quickstarts and tests.
+Avro containers decode via the pure-Python codec in
+``pinot_tpu.segment.avro`` (re-exported here as ``read_avro``).
 
 Multi-value CSV cells use ';' as the value separator (the reference's
 CSVRecordReaderConfig default multi-value delimiter).
@@ -47,6 +48,23 @@ def read_csv(path: str, schema: Schema, delimiter: str = ",") -> List[Row]:
                 )
             rows.append(row)
     return rows
+
+
+def read_avro(path: str, schema: Schema) -> List[Row]:
+    """Avro object container -> rows (AvroRecordReader analog)."""
+    from pinot_tpu.segment.avro import read_avro as _read_avro
+
+    return _read_avro(path, schema)
+
+
+def read_for_path(path: str, schema: Schema) -> List[Row]:
+    """Pick the reader by file extension (csv / jsonl / avro[.gz])."""
+    lower = path.lower()
+    if lower.endswith(".csv"):
+        return read_csv(path, schema)
+    if lower.endswith((".avro", ".avro.gz")):
+        return read_avro(path, schema)
+    return read_jsonl(path, schema)
 
 
 def read_jsonl(path: str, schema: Schema) -> List[Row]:

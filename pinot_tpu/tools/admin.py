@@ -150,7 +150,9 @@ def cmd_create_segment(args) -> None:
             schema, args.data_file, args.table, args.segment_name, startree_config=cfg
         )
     else:
-        rows = read_jsonl(args.data_file, schema)
+        from pinot_tpu.segment.readers import read_for_path
+
+        rows = read_for_path(args.data_file, schema)  # avro / jsonl
         seg = build_segment(
             schema, rows, args.table, args.segment_name, startree_config=cfg
         )

@@ -4,9 +4,9 @@ The reference ships segment converters (pinot-tools
 ``tools/segment/converter/`` — segment -> CSV/JSON/Avro) and a
 ``StarTreeIndexViewer``.  Same capabilities here: rows are rebuilt from
 the columnar data (dictionary decode through the forward index) and
-written back out; the star-tree dump walks the persisted tree and
-pre-aggregation cube.  Avro export is gated (no avro library baked into
-the image) — CSV and JSONL cover the round-trip tooling.
+written back out as CSV, JSONL, or Avro object containers
+(``pinot_tpu.segment.avro`` — pure-Python container codec, no library
+needed).
 """
 from __future__ import annotations
 
@@ -55,17 +55,46 @@ def segment_to_csv(segment_or_dir, out_path: str) -> int:
     return n
 
 
-def _json_default(v: Any):
-    try:
-        import numpy as np
+def segment_to_avro(segment_or_dir, out_path: str, codec: str = "deflate") -> int:
+    """Export every row of a segment as an Avro object container file
+    (segment->Avro converter parity; schema derived from the segment)."""
+    from pinot_tpu.common.schema import FieldSpec, Schema
+    from pinot_tpu.segment.avro import pinot_to_avro_schema, write_avro
 
-        if isinstance(v, np.integer):
-            return int(v)
-        if isinstance(v, np.floating):
-            return float(v)
-    except ImportError:
-        pass
-    return str(v)
+    seg = _load(segment_or_dir)
+    specs = [
+        FieldSpec(name, meta.data_type, meta.field_type, single_value=meta.single_value)
+        for name, meta in seg.metadata.columns.items()
+    ]
+    schema = Schema(seg.metadata.table_name, dimensions=specs)
+    avro_schema = pinot_to_avro_schema(schema)
+    rows = [{k: _py(v) for k, v in row.items()} for row in seg.rows()]
+    write_avro(out_path, avro_schema, rows, codec=codec)
+    return len(rows)
+
+
+def _np_scalar(v: Any) -> Optional[Any]:
+    """numpy scalar -> plain Python, or None if not a numpy scalar."""
+    import numpy as np
+
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    return None
+
+
+def _py(v: Any):
+    """Values (incl. lists) -> plain Python for the Avro encoder."""
+    if isinstance(v, list):
+        return [_py(x) for x in v]
+    s = _np_scalar(v)
+    return v if s is None else s
+
+
+def _json_default(v: Any):
+    s = _np_scalar(v)
+    return str(v) if s is None else s
 
 
 def star_tree_summary(segment_or_dir, max_nodes: int = 50) -> Dict[str, Any]:
