@@ -33,8 +33,11 @@ logger = logging.getLogger(__name__)
 
 class Controller:
     def __init__(self, data_dir: str, start_managers: bool = False) -> None:
-        self.resources = ClusterResourceManager()
-        self.store = SegmentStore(data_dir)
+        from pinot_tpu.controller.property_store import PropertyStore
+
+        self.property_store = PropertyStore(os.path.join(data_dir, "property_store"))
+        self.resources = ClusterResourceManager(property_store=self.property_store)
+        self.store = SegmentStore(os.path.join(data_dir, "segments"))
         self.retention_manager = RetentionManager(self.resources, self.store)
         self.validation_manager = ValidationManager(self.resources)
         self.status_checker = SegmentStatusChecker(self.resources)
@@ -49,10 +52,75 @@ class Controller:
         # remote-instance control plane (started by ControllerHttpServer)
         self.gateway = ParticipantGateway(self.resources)
 
+        self._recover()
+
         if start_managers:
             self.retention_manager.start()
             self.validation_manager.start()
             self.status_checker.start()
+
+    def _recover(self) -> None:
+        """Reload cluster metadata from the property store after a
+        restart (the reference recovers everything from ZK:
+        ``PinotHelixResourceManager.java:103``).  External views start
+        empty — they refill as participants re-register and replay
+        their ideal-state transitions (``reconcile_instance``); LLC
+        consumption resumes from the checkpointed offsets via
+        ``RealtimeSegmentManager.recover_table``."""
+        from pinot_tpu.segment.immutable import SegmentMetadata
+
+        ps = self.property_store
+        res = self.resources
+        for name in ps.list_keys("schemas"):
+            rec = ps.get("schemas", name)
+            if rec is not None:
+                with res._lock:
+                    res.schemas[name] = Schema.from_json(rec)
+        recovered_tables: List[str] = []
+        for physical in ps.list_keys("tables"):
+            rec = ps.get("tables", physical)
+            if rec is None:
+                continue
+            config = TableConfig.from_json(rec)
+            with res._lock:
+                res.table_configs[physical] = config
+                res.ideal_states.setdefault(physical, {})
+                res.external_views.setdefault(physical, {})
+            recovered_tables.append(physical)
+            ideal = ps.get("idealstates", physical)
+            if ideal:
+                with res._lock:
+                    res.ideal_states[physical] = {
+                        seg: dict(replicas) for seg, replicas in ideal.items()
+                    }
+            for seg in ps.list_keys(f"segments/{physical}"):
+                rec = ps.get(f"segments/{physical}", seg)
+                if rec is None:
+                    continue
+                info: Dict[str, Any] = {
+                    k: v for k, v in rec.items() if k != "metadata"
+                }
+                if rec.get("metadata") is not None:
+                    info["metadata"] = SegmentMetadata.from_json(rec["metadata"])
+                with res._lock:
+                    res.segment_metadata[(physical, seg)] = info
+        for physical in recovered_tables:
+            config = res.table_configs[physical]
+            schema = res.get_schema(config.raw_name)
+            if schema is not None and config.table_type == "REALTIME":
+                if not self.realtime_manager.recover_table(physical, config, schema):
+                    logger.error(
+                        "realtime table %s recovered without a stream "
+                        "descriptor: consumption cannot resume (provider "
+                        "was not describable); re-create the table",
+                        physical,
+                    )
+        if recovered_tables:
+            logger.info(
+                "recovered %d tables, %d schemas from property store",
+                len(recovered_tables),
+                len(res.schemas),
+            )
 
     # -- CRUD -----------------------------------------------------------
     def add_schema(self, schema: Schema) -> None:

@@ -186,12 +186,14 @@ class ParticipantGateway:
         participant = RemoteParticipant(name, self.board) if role == "server" else None
         with self._lock:
             self._heartbeats[name] = time.monotonic()
-        known = name in self.resources.instances
         self.resources.register_instance(state, participant)
-        if known and role == "server":
-            # re-registration after a crash: replay ideal state (the
-            # fresh InstanceState is already alive, so going through
-            # set_instance_alive would no-op)
+        if role == "server":
+            # replay any ideal-state transitions targeting this server:
+            # covers re-registration after a server crash AND first
+            # registration with a *recovered* controller whose ideal
+            # states came from the property store (the fresh
+            # InstanceState is already alive, so set_instance_alive
+            # would no-op; a truly new server replays nothing)
             self.resources.reconcile_instance(name)
         return {
             "status": "ok",

@@ -1,0 +1,117 @@
+"""Durable controller property store.
+
+The reference keeps all cluster metadata — schemas, table configs,
+ideal states, per-segment ZK metadata (incl. LLC offset checkpoints) —
+in the ZooKeeper property store
+(``PinotHelixResourceManager.java:103``, ``pinot-common/.../metadata/``),
+so a controller restart recovers the whole cluster from ZK.  This is
+the single-controller analog: one JSON file per record under the
+controller's data dir, written atomically (tmp + rename) so a crash
+mid-write can never corrupt a record.
+
+Namespaces:
+  schemas/<name>.json          Schema.to_json()
+  tables/<physical>.json       TableConfig.to_json()
+  idealstates/<physical>.json  {segment -> {server -> target state}}
+  segments/<physical>/<segment>.json  segment record: metadata +
+                               download dir + realtime partition/offset
+  streams/<physical>.json      stream-provider descriptor for realtime
+                               tables (so consumption resumes)
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Any, Dict, List, Optional
+
+_SAFE = "-_"  # NOT '.', or a '..' component would survive encoding
+
+
+def _encode_key(key: str) -> str:
+    """Filesystem-safe record name (segment names contain '__', table
+    names are alnum+underscore; escape anything else)."""
+    out = []
+    for ch in key:
+        if ch.isalnum() or ch in _SAFE or ch == "_":
+            out.append(ch)
+        else:
+            out.append(f"%{ord(ch):02x}")
+    return "".join(out) + ".json"
+
+
+class PropertyStore:
+    def __init__(self, base_dir: str) -> None:
+        self.base_dir = base_dir
+        self._lock = threading.Lock()
+        os.makedirs(base_dir, exist_ok=True)
+
+    def _ns_dir(self, namespace: str) -> str:
+        # encode each namespace component too: namespaces embed table
+        # names, and a hostile name must not escape the store dir
+        parts = [_encode_key(p)[: -len(".json")] for p in namespace.split("/") if p]
+        return os.path.join(self.base_dir, *parts)
+
+    def _path(self, namespace: str, key: str) -> str:
+        return os.path.join(self._ns_dir(namespace), _encode_key(key))
+
+    def put(self, namespace: str, key: str, record: Dict[str, Any]) -> None:
+        path = self._path(namespace, key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with self._lock:
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(record, f)
+                os.replace(tmp, path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+
+    def get(self, namespace: str, key: str) -> Optional[Dict[str, Any]]:
+        path = self._path(namespace, key)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    def delete(self, namespace: str, key: str) -> None:
+        path = self._path(namespace, key)
+        with self._lock:
+            if os.path.exists(path):
+                os.unlink(path)
+
+    def list_keys(self, namespace: str) -> List[str]:
+        d = self._ns_dir(namespace)
+        if not os.path.isdir(d):
+            return []
+        out = []
+        for fn in sorted(os.listdir(d)):
+            if not fn.endswith(".json"):
+                continue
+            raw = fn[: -len(".json")]
+            # reverse of _encode_key
+            parts = []
+            i = 0
+            while i < len(raw):
+                if raw[i] == "%" and i + 2 < len(raw) + 1:
+                    try:
+                        parts.append(chr(int(raw[i + 1 : i + 3], 16)))
+                        i += 3
+                        continue
+                    except ValueError:
+                        pass
+                parts.append(raw[i])
+                i += 1
+            out.append("".join(parts))
+        return out
+
+    def delete_namespace(self, namespace: str) -> None:
+        import shutil
+
+        d = self._ns_dir(namespace)
+        with self._lock:
+            if os.path.isdir(d):
+                shutil.rmtree(d)

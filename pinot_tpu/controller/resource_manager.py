@@ -70,7 +70,14 @@ class Participant:
 
 
 class ClusterResourceManager:
-    def __init__(self) -> None:
+    def __init__(self, property_store=None) -> None:
+        """``property_store`` (controller.property_store.PropertyStore)
+        makes schemas, table configs, ideal states, and segment
+        metadata — incl. LLC offset checkpoints — durable across
+        controller restarts (the ZK property-store role,
+        ``PinotHelixResourceManager.java:103``).  None keeps everything
+        in memory (embedded/test deployments)."""
+        self.property_store = property_store
         self._lock = threading.RLock()
         self.schemas: Dict[str, Schema] = {}
         self.table_configs: Dict[str, TableConfig] = {}
@@ -310,6 +317,7 @@ class ClusterResourceManager:
                 with self._lock:
                     self.external_views.get(physical_table, {}).get(seg, {}).pop(srv, None)
         if not dry_run and (added or removed):
+            self.persist_ideal_state(physical_table)
             self._notify_view(physical_table)
         return {
             "dryRun": dry_run,
@@ -319,21 +327,77 @@ class ClusterResourceManager:
             "target": {s: sorted(r) for s, r in target.items()},
         }
 
+    # -- durability ---------------------------------------------------
+    def persist_ideal_state(self, physical: str) -> None:
+        if self.property_store is None:
+            return
+        # snapshot AND write under the lock: two concurrent mutators
+        # must not be able to persist their snapshots out of order, or
+        # the durable file could lose the newer update (writes are
+        # small JSON records, so holding the lock is cheap)
+        with self._lock:
+            ideal = self.ideal_states.get(physical)
+            if ideal is None:
+                self.property_store.delete("idealstates", physical)
+            else:
+                self.property_store.put(
+                    "idealstates", physical, {s: dict(r) for s, r in ideal.items()}
+                )
+
+    def persist_segment_record(self, physical: str, segment: str) -> None:
+        """Write the JSON-serializable part of a segment's metadata
+        record (the ZK segment-metadata analog: LLC offsets live in
+        metadata.custom; ``dir`` is the controller-store download
+        path).  Callables and in-memory segment objects are runtime
+        wiring and are reattached on recovery."""
+        if self.property_store is None:
+            return
+        import json as _json
+
+        with self._lock:  # see persist_ideal_state on ordering
+            info = self.segment_metadata.get((physical, segment))
+            if info is None:
+                self.property_store.delete(f"segments/{physical}", segment)
+                return
+            rec: Dict[str, Any] = {}
+            meta = info.get("metadata")
+            if meta is not None:
+                rec["metadata"] = meta.to_json()
+            for k, v in info.items():
+                if k == "metadata" or callable(v):
+                    continue
+                try:
+                    _json.dumps(v)
+                except TypeError:
+                    continue  # runtime wiring (segment objects, etc.)
+                rec[k] = v
+            self.property_store.put(f"segments/{physical}", segment, rec)
+
     # -- schema / table CRUD ------------------------------------------
     def add_schema(self, schema: Schema) -> None:
         with self._lock:
             self.schemas[schema.schema_name] = schema
+        if self.property_store is not None:
+            self.property_store.put("schemas", schema.schema_name, schema.to_json())
 
     def get_schema(self, name: str) -> Optional[Schema]:
         with self._lock:
             return self.schemas.get(name)
 
     def add_table(self, config: TableConfig) -> str:
+        if not config.table_name.replace("_", "").replace("-", "").isalnum():
+            # table names become store paths (segment store dirs,
+            # property-store namespaces): refuse anything that could
+            # traverse the filesystem
+            raise ValueError(f"invalid table name {config.table_name!r}")
         with self._lock:
             physical = config.physical_name
             self.table_configs[physical] = config
             self.ideal_states.setdefault(physical, {})
             self.external_views.setdefault(physical, {})
+        if self.property_store is not None:
+            self.property_store.put("tables", physical, config.to_json())
+        self.persist_ideal_state(physical)
         self._notify_view(physical)
         return physical
 
@@ -346,6 +410,11 @@ class ClusterResourceManager:
             self.table_configs.pop(physical, None)
             self.ideal_states.pop(physical, None)
             self.external_views.pop(physical, None)
+        if self.property_store is not None:
+            self.property_store.delete("tables", physical)
+            self.property_store.delete("idealstates", physical)
+            self.property_store.delete("streams", physical)
+            self.property_store.delete_namespace(f"segments/{physical}")
         self._notify_view(physical)
 
     def tables(self) -> List[str]:
@@ -389,6 +458,8 @@ class ClusterResourceManager:
                 "metadata": metadata,
                 **download_info,
             }
+        self.persist_ideal_state(physical_table)
+        self.persist_segment_record(physical_table, metadata.segment_name)
         for server in chosen:
             self._execute_transition(
                 physical_table, metadata.segment_name, server, target_state
@@ -421,6 +492,8 @@ class ClusterResourceManager:
         with self._lock:
             replicas = self.ideal_states.get(physical_table, {}).pop(segment, {})
             self.segment_metadata.pop((physical_table, segment), None)
+        self.persist_ideal_state(physical_table)
+        self.persist_segment_record(physical_table, segment)
         for server in replicas:
             self._execute_transition(physical_table, segment, server, DROPPED)
         with self._lock:

@@ -165,9 +165,47 @@ class RealtimeSegmentManager:
                 "stream": stream,
                 "config": config,
             }
+        if self.resources.property_store is not None:
+            from pinot_tpu.realtime.stream import describe_stream
+
+            desc = describe_stream(stream)
+            if desc is not None:
+                self.resources.property_store.put("streams", physical, desc)
         for partition in range(stream.partition_count()):
             self._create_consuming_segment(physical, partition, seq=0, start_offset=0)
         return physical
+
+    def recover_table(self, physical: str, config: TableConfig, schema: Schema) -> bool:
+        """Rebuild the in-memory realtime wiring for a table restored
+        from the property store: reattach the stream provider and put
+        ``consuming_starter`` callbacks back on every CONSUMING
+        segment's metadata record so re-registering servers resume
+        consumption from the checkpointed offsets (the reference
+        resumes from the per-segment ZK offsets on restart, SURVEY §5
+        checkpoint/resume)."""
+        store = self.resources.property_store
+        if store is None:
+            return False
+        desc = store.get("streams", physical)
+        if desc is None:
+            return False
+        from pinot_tpu.realtime.stream import stream_from_descriptor
+
+        stream = stream_from_descriptor(desc)
+        with self._lock:
+            self._tables[physical] = {
+                "schema": schema,
+                "stream": stream,
+                "config": config,
+            }
+        with self.resources._lock:
+            for (tbl, seg), info in self.resources.segment_metadata.items():
+                if tbl != physical:
+                    continue
+                replicas = self.resources.ideal_states.get(physical, {}).get(seg, {})
+                if CONSUMING in replicas.values():
+                    info["consuming_starter"] = self._start_consumer
+        return True
 
     def physical_table_of(self, segment: str) -> str:
         return parse_segment_name(segment)[0]
@@ -246,6 +284,8 @@ class RealtimeSegmentManager:
             replicas = self.resources.ideal_states[physical].get(segment, {})
             for server in replicas:
                 replicas[server] = ONLINE
+        self.resources.persist_ideal_state(physical)
+        self.resources.persist_segment_record(physical, segment)
         for server in list(replicas):
             self.resources._execute_transition(physical, segment, server, ONLINE)
         self.resources._notify_view(physical)

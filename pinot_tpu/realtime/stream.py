@@ -86,6 +86,30 @@ class FileBasedStreamProvider(StreamProvider):
         return len(self._read(partition))
 
 
+def describe_stream(provider: StreamProvider) -> Optional[Dict[str, Any]]:
+    """JSON descriptor for a provider, so a restarted controller can
+    reattach the stream (the ZK stream-metadata analog,
+    ``common/metadata/stream/``).  Memory streams describe shape only —
+    their buffered rows die with the process."""
+    if isinstance(provider, FileBasedStreamProvider):
+        return {"type": "file", "paths": list(provider.paths)}
+    if isinstance(provider, MemoryStreamProvider):
+        return {"type": "memory", "partitions": provider.partition_count()}
+    describe = getattr(provider, "describe", None)
+    if callable(describe):
+        return describe()
+    return None
+
+
+def stream_from_descriptor(desc: Dict[str, Any]) -> StreamProvider:
+    t = desc.get("type")
+    if t == "file":
+        return FileBasedStreamProvider(desc["paths"])
+    if t == "memory":
+        return MemoryStreamProvider(int(desc.get("partitions", 1)))
+    raise ValueError(f"unknown stream descriptor {desc!r}")
+
+
 class KafkaStreamProvider(StreamProvider):  # pragma: no cover - gated
     """LLC-style Kafka consumer. Gated: no kafka client library is baked
     into this environment; raises with guidance at construction."""

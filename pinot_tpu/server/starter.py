@@ -39,6 +39,10 @@ class ServerStarter:
             InstanceState(self.server.name, role="server"),
             Participant(self.server.name, self.on_transition),
         )
+        # replay any ideal-state transitions already targeting this
+        # instance (CRC-skip makes re-loads cheap) — this is what makes
+        # a server joining a *recovered* controller reload its segments
+        self.resources.reconcile_instance(self.server.name)
 
     def on_transition(
         self, table: str, segment: str, target: str, info: Dict[str, Any]
