@@ -45,13 +45,20 @@ class IndexingConfig:
 
 @dataclass
 class StreamConfig:
-    """Realtime ingestion config (the kafka.* stream properties analog)."""
+    """Realtime ingestion config (the kafka.* stream properties analog).
 
-    stream_type: str = "file"  # file | kafka (kafka is gated; no client baked in)
+    ``stream_type`` selects the provider: ``network`` (the built-in TCP
+    stream broker, ``realtime/netstream.py`` — properties: host, port),
+    ``file`` (JSONL per partition — properties: paths), ``memory``
+    (in-process — properties: partitions), or ``kafka`` (gated; no
+    client library in this image)."""
+
+    stream_type: str = "file"  # network | file | memory | kafka (gated)
     topic: str = ""
     decoder: str = "json"
     rows_per_segment: int = 100_000  # segment flush threshold
     consume_seconds: float = 3600.0
+    properties: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass
@@ -122,6 +129,7 @@ class TableConfig:
                 "topic": self.stream.topic,
                 "decoder": self.stream.decoder,
                 "rowsPerSegment": self.stream.rows_per_segment,
+                "properties": self.stream.properties,
             }
         return d
 
@@ -137,6 +145,7 @@ class TableConfig:
                 topic=sc.get("topic", ""),
                 decoder=sc.get("decoder", "json"),
                 rows_per_segment=sc.get("rowsPerSegment", 100_000),
+                properties=sc.get("properties", {}),
             )
         tenants = d.get("tenants", {})
         quota_json = d.get("quota", {})

@@ -422,8 +422,50 @@ class ControllerHttpServer:
                         return self._respond({"status": "ok", "schema": schema.schema_name})
                     if parts == ["tables"]:
                         config = TableConfig.from_json(self._read_json())
-                        physical = ctrl.add_table(config)
+                        if config.table_type == "REALTIME":
+                            from pinot_tpu.realtime.stream import (
+                                stream_provider_from_config,
+                            )
+
+                            if config.stream is None:
+                                return self._respond(
+                                    {"error": "REALTIME table needs streamConfigs"}, 400
+                                )
+                            provider = stream_provider_from_config(config.stream)
+                            physical = ctrl.add_realtime_table(config, provider)
+                        else:
+                            physical = ctrl.add_table(config)
                         return self._respond({"status": "ok", "table": physical})
+                    if parts == ["realtime", "consumed"]:
+                        # LLC completion protocol: segmentConsumed
+                        # (SegmentCompletionProtocol responses)
+                        body = self._read_json()
+                        resp, target = ctrl.realtime_manager.completion.segment_consumed(
+                            body["segment"], body["server"], int(body["offset"])
+                        )
+                        return self._respond(
+                            {"response": resp, "targetOffset": target}
+                        )
+                    if len(parts) == 4 and parts[:2] == ["realtime", "commit"]:
+                        # committer upload: POST /realtime/commit/{segment}/{server}
+                        # body = segment file bytes (segmentCommit)
+                        import tempfile
+
+                        from pinot_tpu.segment.format import (
+                            SEGMENT_FILE_NAME,
+                            read_segment,
+                        )
+
+                        n = int(self.headers.get("Content-Length", "0"))
+                        data = self.rfile.read(n)
+                        with tempfile.TemporaryDirectory() as td:
+                            with open(os.path.join(td, SEGMENT_FILE_NAME), "wb") as f:
+                                f.write(data)
+                            committed = read_segment(td)
+                        resp = ctrl.realtime_manager.completion.segment_commit(
+                            parts[2], parts[3], committed
+                        )
+                        return self._respond({"response": resp})
                     if parts == ["tenants"]:
                         body = self._read_json()
                         tagged = ctrl.resources.create_tenant(

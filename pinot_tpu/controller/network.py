@@ -99,23 +99,37 @@ class RemoteParticipant(Participant):
     def _enqueue(
         self, table: str, segment: str, target: str, info: Dict[str, Any]
     ) -> Optional[bool]:
-        if target == CONSUMING:
-            logger.warning(
-                "remote participant %s cannot host CONSUMING segment %s/%s",
-                self.name, table, segment,
-            )
-            return False
         meta = info.get("metadata")
-        self.board.post(
-            self.name,
-            {
-                "type": "transition",
-                "table": table,
-                "segment": segment,
-                "target": target,
-                "crc": getattr(meta, "crc", None),
-            },
-        )
+        msg: Dict[str, Any] = {
+            "type": "transition",
+            "table": table,
+            "segment": segment,
+            "target": target,
+            "crc": getattr(meta, "crc", None),
+        }
+        if target == CONSUMING:
+            # ship the full consume spec so the remote process can run
+            # the consumer + LLC completion protocol on its own
+            # (LLRealtimeSegmentDataManager.java:68 does the same with
+            # the stream config from ZK segment metadata)
+            desc = info.get("streamDescriptor")
+            if desc is None:
+                logger.warning(
+                    "remote participant %s cannot host CONSUMING %s/%s: "
+                    "stream is not network-describable",
+                    self.name, table, segment,
+                )
+                return False
+            msg.update(
+                {
+                    "streamDescriptor": desc,
+                    "partition": info.get("partition", 0),
+                    "startOffset": info.get("startOffset", 0),
+                    "rowsPerSegment": info.get("rowsPerSegment", 100_000),
+                    "schemaJson": info.get("schemaJson"),
+                }
+            )
+        self.board.post(self.name, msg)
         return None
 
 

@@ -227,14 +227,32 @@ class RealtimeSegmentManager:
                 "status": "IN_PROGRESS",
             },
         )
+        info: Dict[str, Any] = {
+            "consuming_starter": self._start_consumer,
+            "partition": partition,
+            "startOffset": start_offset,
+        }
+        # serializable consume spec: lets REMOTE participants (separate
+        # server processes) start a consumer from the transition message
+        # alone, and survives in the property store for recovery
+        with self._lock:
+            tinfo = self._tables.get(physical)
+        if tinfo is not None:
+            from pinot_tpu.realtime.stream import describe_stream
+
+            desc = describe_stream(tinfo["stream"])
+            if desc is not None:
+                info["streamDescriptor"] = desc
+            info["rowsPerSegment"] = (
+                tinfo["config"].stream.rows_per_segment
+                if tinfo["config"].stream
+                else 100_000
+            )
+            info["schemaJson"] = tinfo["schema"].to_json()
         self.resources.add_segment(
             physical,
             meta,
-            {
-                "consuming_starter": self._start_consumer,
-                "partition": partition,
-                "startOffset": start_offset,
-            },
+            info,
             target_state=CONSUMING,
         )
         return name

@@ -86,6 +86,27 @@ class FileBasedStreamProvider(StreamProvider):
         return len(self._read(partition))
 
 
+def stream_provider_from_config(stream_config) -> StreamProvider:
+    """Build a provider from a table's StreamConfig (the
+    KafkaStreamProviderConfig -> consumer factory analog), so REALTIME
+    tables can be created over plain REST."""
+    t = stream_config.stream_type
+    props = stream_config.properties or {}
+    if t == "network":
+        from pinot_tpu.realtime.netstream import NetworkStreamProvider
+
+        return NetworkStreamProvider(
+            props.get("host", "127.0.0.1"), int(props["port"]), stream_config.topic
+        )
+    if t == "file":
+        return FileBasedStreamProvider(props["paths"])
+    if t == "memory":
+        return MemoryStreamProvider(int(props.get("partitions", 1)))
+    if t == "kafka":
+        return KafkaStreamProvider()
+    raise ValueError(f"unknown stream type {t!r}")
+
+
 def describe_stream(provider: StreamProvider) -> Optional[Dict[str, Any]]:
     """JSON descriptor for a provider, so a restarted controller can
     reattach the stream (the ZK stream-metadata analog,
@@ -107,6 +128,10 @@ def stream_from_descriptor(desc: Dict[str, Any]) -> StreamProvider:
         return FileBasedStreamProvider(desc["paths"])
     if t == "memory":
         return MemoryStreamProvider(int(desc.get("partitions", 1)))
+    if t == "network":
+        from pinot_tpu.realtime.netstream import NetworkStreamProvider
+
+        return NetworkStreamProvider(desc["host"], int(desc["port"]), desc["topic"])
     raise ValueError(f"unknown stream descriptor {desc!r}")
 
 

@@ -24,12 +24,165 @@ import threading
 import urllib.request
 from typing import Any, Dict, Optional
 
-from pinot_tpu.controller.resource_manager import DROPPED, OFFLINE, ONLINE
+from pinot_tpu.controller.resource_manager import CONSUMING, DROPPED, OFFLINE, ONLINE
 from pinot_tpu.segment.format import SEGMENT_FILE_NAME, read_segment
 from pinot_tpu.server.instance import ServerInstance
 from pinot_tpu.transport.tcp import TcpServer
 
 logger = logging.getLogger(__name__)
+
+
+class RemoteConsumer:
+    """Server-process-side LLC consumer: pulls rows from the stream
+    broker by offset, indexes into a mutable segment served to queries
+    immediately, and runs the completion protocol against the
+    controller over HTTP (the ``LLRealtimeSegmentDataManager.java:68``
+    consume loop + ``SegmentCompletionProtocol`` client)."""
+
+    def __init__(
+        self,
+        starter: "NetworkedServerStarter",
+        table: str,
+        segment: str,
+        msg: Dict[str, Any],
+        poll_interval_s: float = 0.2,
+    ) -> None:
+        from pinot_tpu.common.schema import Schema
+        from pinot_tpu.realtime.mutable import MutableSegment
+        from pinot_tpu.realtime.stream import stream_from_descriptor
+
+        self.starter = starter
+        self.table = table
+        self.segment = segment
+        self.partition = int(msg.get("partition", 0))
+        self.offset = int(msg.get("startOffset", 0))
+        self.rows_per_segment = int(msg.get("rowsPerSegment", 100_000))
+        self.poll_interval_s = poll_interval_s
+        self.stream = stream_from_descriptor(msg["streamDescriptor"])
+        schema = Schema.from_json(msg["schemaJson"])
+        self.mutable = MutableSegment(schema, segment, table)
+        self.mutable.start_offset = self.offset
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self.starter.server.add_segment(self.table, self.mutable)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- consume loop ---------------------------------------------------
+    def _consume_to(self, limit_rows: int) -> int:
+        budget = limit_rows - self.mutable.num_docs
+        if budget <= 0:
+            return 0
+        rows, next_offset = self.stream.fetch(self.partition, self.offset, budget)
+        for row in rows:
+            self.mutable.index(row)
+        self.offset = next_offset
+        self.mutable.end_offset = next_offset
+        return len(rows)
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    got = self._consume_to(self.rows_per_segment)
+                except Exception as e:
+                    logger.warning("stream fetch failed for %s: %s", self.segment, e)
+                    self._stop.wait(self.poll_interval_s)
+                    continue
+                if self.mutable.num_docs >= self.rows_per_segment:
+                    if self._completion_round():
+                        return  # segment finished (committed or discarded)
+                elif got == 0:
+                    self._stop.wait(self.poll_interval_s)
+        except Exception:
+            logger.exception("remote consumer for %s died", self.segment)
+
+    def _completion_round(self) -> bool:
+        """One segmentConsumed exchange; True when this consumer is done."""
+        try:
+            out = self.starter._post(
+                "/realtime/consumed",
+                {
+                    "segment": self.segment,
+                    "server": self.starter.name,
+                    "offset": self.offset,
+                },
+            )
+        except Exception as e:
+            logger.warning("segmentConsumed failed for %s: %s", self.segment, e)
+            self._stop.wait(self.poll_interval_s)
+            return False
+        resp = out.get("response")
+        target = out.get("targetOffset")
+        if resp == "COMMIT":
+            try:
+                return self._commit()
+            except Exception as e:
+                # conversion/serialization failure: stay alive and retry
+                # via the next segmentConsumed round
+                logger.warning("commit of %s failed: %s", self.segment, e)
+                self._stop.wait(self.poll_interval_s)
+                return False
+        if resp == "CATCH_UP" and target is not None:
+            while self.offset < int(target) and not self._stop.is_set():
+                try:
+                    got = self._consume_to(
+                        self.rows_per_segment + int(target) - self.offset
+                    )
+                except Exception as e:
+                    # transient stream failure mid-catch-up: keep the
+                    # consumer alive, retry on the next round
+                    logger.warning("catch-up fetch failed for %s: %s", self.segment, e)
+                    self._stop.wait(self.poll_interval_s)
+                    return False
+                if got == 0:
+                    self._stop.wait(self.poll_interval_s)
+            return False
+        if resp == "DISCARD":
+            # another replica committed a different offset range: drop
+            # local rows; the ONLINE transition will download the
+            # committed copy
+            self.starter.server.remove_segment(self.table, self.segment)
+            return True
+        if resp == "KEEP":
+            # committed elsewhere at exactly our offset; keep serving
+            # the local rows until the ONLINE transition replaces them
+            return True
+        # HOLD (or unknown): wait and retry
+        self._stop.wait(self.poll_interval_s)
+        return False
+
+    def _commit(self) -> bool:
+        import tempfile
+        import urllib.request
+
+        from pinot_tpu.segment.format import write_segment
+
+        committed = self.mutable.to_committed_segment()
+        with tempfile.TemporaryDirectory() as td:
+            write_segment(committed, td)
+            with open(os.path.join(td, SEGMENT_FILE_NAME), "rb") as f:
+                data = f.read()
+        req = urllib.request.Request(
+            f"{self.starter.controller_url}/realtime/commit/{self.segment}/{self.starter.name}",
+            data=data,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                out = json.loads(r.read())
+        except Exception as e:
+            logger.warning("segmentCommit failed for %s: %s", self.segment, e)
+            return False
+        if out.get("response") == "NOT_LEADER":
+            return False
+        logger.info("committed %s at offset %d", self.segment, self.offset)
+        return True
 
 
 class NetworkedServerStarter:
@@ -51,6 +204,7 @@ class NetworkedServerStarter:
         self.heartbeat_interval_s = heartbeat_interval_s
         self.poll_interval_s = poll_interval_s
         self._local_crcs: Dict[str, int] = {}
+        self._consumers: Dict[str, RemoteConsumer] = {}  # segment -> consumer
         self._stop = threading.Event()
         self._threads: list = []
 
@@ -90,6 +244,8 @@ class NetworkedServerStarter:
 
     def stop(self) -> None:
         self._stop.set()
+        for consumer in list(self._consumers.values()):
+            consumer.stop()
         for t in self._threads:
             t.join(timeout=2)
         self.tcp.stop()
@@ -125,8 +281,18 @@ class NetworkedServerStarter:
         table, segment, target = msg["table"], msg["segment"], msg["target"]
         try:
             if target == ONLINE:
+                # CONSUMING -> ONLINE: retire the consumer before the
+                # committed immutable copy replaces the mutable
+                consumer = self._consumers.pop(segment, None)
+                if consumer is not None:
+                    consumer.stop()
                 ok = self._load(table, segment, msg.get("crc"))
+            elif target == CONSUMING:
+                ok = self._start_consumer(table, segment, msg)
             elif target in (OFFLINE, DROPPED):
+                consumer = self._consumers.pop(segment, None)
+                if consumer is not None:
+                    consumer.stop()
                 self.server.remove_segment(table, segment)
                 self._local_crcs.pop(segment, None)
                 ok = True
@@ -150,6 +316,17 @@ class NetworkedServerStarter:
         except Exception as e:
             # the un-acked message stays on the board and is redelivered
             logger.warning("ack failed for %s/%s: %s", table, segment, e)
+
+    def _start_consumer(self, table: str, segment: str, msg: Dict[str, Any]) -> bool:
+        if segment in self._consumers:
+            return True  # redelivered message; don't reset the offset
+        if not msg.get("streamDescriptor") or not msg.get("schemaJson"):
+            logger.error("CONSUMING message for %s lacks a consume spec", segment)
+            return False
+        consumer = RemoteConsumer(self, table, segment, msg)
+        self._consumers[segment] = consumer
+        consumer.start()
+        return True
 
     def _local_dir(self, table: str, segment: str) -> Optional[str]:
         if self.data_dir is None:

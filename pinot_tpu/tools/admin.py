@@ -4,10 +4,12 @@ commands).  Usage: ``python -m pinot_tpu.tools.admin <command> [args]``.
 Commands:
   Quickstart            offline baseballStats demo (Quickstart.java:33)
   RealtimeQuickstart    streaming meetupRsvp demo
+  NetworkRealtimeQuickstart  same, across real processes + TCP stream broker
   StartCluster          in-process cluster with HTTP broker+controller
   StartController       standalone controller process (networked cluster)
   StartServer           standalone server process joining a controller
   StartBroker           standalone broker process joining a controller
+  StartStreamBroker     standalone TCP stream broker (realtime ingest)
   CreateSegment         build a segment from CSV/JSONL + schema JSON
   UploadSegment         POST a segment file to a controller
   AddSchema / AddTable  controller CRUD
@@ -45,6 +47,13 @@ def cmd_quickstart(args) -> None:
                 time.sleep(3600)
         except KeyboardInterrupt:
             cluster.stop()
+
+
+def cmd_network_realtime_quickstart(args) -> None:
+    from pinot_tpu.tools.quickstart import run_network_realtime_quickstart
+
+    count = run_network_realtime_quickstart(num_events=args.events)
+    print(f"\nDONE networked realtime quickstart: {count} events ingested")
 
 
 def cmd_realtime_quickstart(args) -> None:
@@ -131,6 +140,17 @@ def cmd_start_broker(args) -> None:
     starter.start()
     print(f"READY broker http://127.0.0.1:{starter.http.port}", flush=True)
     _serve_forever([starter.stop])
+
+
+def cmd_start_stream_broker(args) -> None:
+    """Standalone TCP stream-broker process (the Kafka-broker role for
+    realtime ingestion; realtime/netstream.py)."""
+    from pinot_tpu.realtime.netstream import StreamBrokerServer
+
+    broker = StreamBrokerServer(port=args.port, log_dir=args.log_dir)
+    broker.start()
+    print(f"READY streambroker {broker.address[0]}:{broker.address[1]}", flush=True)
+    _serve_forever([broker.stop])
 
 
 def cmd_create_segment(args) -> None:
@@ -283,6 +303,10 @@ def main(argv=None) -> None:
     rq.add_argument("-no-http", action="store_true")
     rq.set_defaults(fn=cmd_realtime_quickstart)
 
+    nrq = sub.add_parser("NetworkRealtimeQuickstart")
+    nrq.add_argument("-events", type=int, default=2000)
+    nrq.set_defaults(fn=cmd_network_realtime_quickstart)
+
     sc = sub.add_parser("StartCluster")
     sc.add_argument("-servers", type=int, default=2)
     sc.add_argument("-data-dir", default=None)
@@ -308,6 +332,11 @@ def main(argv=None) -> None:
     stb.add_argument("-name", default="broker0")
     stb.add_argument("-port", type=int, default=8099)
     stb.set_defaults(fn=cmd_start_broker)
+
+    ssb = sub.add_parser("StartStreamBroker")
+    ssb.add_argument("-port", type=int, default=0)
+    ssb.add_argument("-log-dir", default=None, dest="log_dir")
+    ssb.set_defaults(fn=cmd_start_stream_broker)
 
     cs = sub.add_parser("CreateSegment")
     cs.add_argument("-schema-file", required=True, dest="schema_file")

@@ -124,3 +124,125 @@ def run_realtime_quickstart(
             print(f"\n>>> {pql}")
             print(json.dumps(resp.to_json(), indent=2)[:900])
     return cluster
+
+
+def run_network_realtime_quickstart(
+    num_events: int = 2000, verbose: bool = True, data_dir: Optional[str] = None
+):
+    """Networked realtime quickstart: a real TCP stream-broker process
+    boundary (realtime/netstream.py), a controller + server + broker as
+    separate OS processes, REALTIME table created over REST, rows
+    produced over TCP, counts queried through the broker HTTP port —
+    the full reference deployment shape with the stream broker playing
+    Kafka's role."""
+    import random
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.request
+
+    from pinot_tpu.common.tableconfig import StreamConfig, TableConfig
+    from pinot_tpu.realtime.netstream import NetworkStreamProvider, StreamBrokerServer
+
+    root = data_dir or tempfile.mkdtemp(prefix="pinot_tpu_netrt_")
+    stream_broker = StreamBrokerServer(log_dir=f"{root}/streamlog")
+    stream_broker.start()
+    host, port = stream_broker.address
+    producer = NetworkStreamProvider(host, port, "meetupRsvp")
+    producer.create_topic(1)
+
+    def spawn(args, prefix="READY"):
+        import os as _os
+        import select
+
+        env = dict(_os.environ)
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pinot_tpu.tools.admin", *args],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            env=env,
+        )
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+            if ready:
+                line = proc.stdout.readline()
+                if line.startswith(prefix):
+                    return proc, line.split()[-1]
+            if proc.poll() is not None:
+                raise RuntimeError(f"process exited early: {args}")
+        proc.kill()
+        raise RuntimeError(f"no READY from {args}")
+
+    procs = []
+    try:
+        ctrl, ctrl_url = spawn(["StartController", "-port", "0", "-data-dir", f"{root}/store"])
+        procs.append(ctrl)
+        srv, _ = spawn(["StartServer", "-controller", ctrl_url, "-name", "qs0",
+                        "-data-dir", f"{root}/cache"])
+        procs.append(srv)
+        brk, broker_url = spawn(["StartBroker", "-controller", ctrl_url, "-port", "0"])
+        procs.append(brk)
+
+        def post(url, payload):
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        schema = meetup_schema()
+        post(ctrl_url + "/schemas", schema.to_json())
+        config = TableConfig(
+            table_name="meetupRsvp",
+            table_type="REALTIME",
+            stream=StreamConfig(
+                stream_type="network",
+                topic="meetupRsvp",
+                rows_per_segment=500,
+                properties={"host": host, "port": port},
+            ),
+        )
+        post(ctrl_url + "/tables", config.to_json())
+
+        rng = random.Random(1)
+        now = int(time.time() * 1000)
+        producer.produce_batch(
+            [
+                {
+                    "venue_name": f"venue{rng.randrange(20)}",
+                    "event_name": f"event{rng.randrange(8)}",
+                    "group_city": rng.choice(["sf", "nyc", "seattle", "austin"]),
+                    "rsvp_count": rng.randint(1, 5),
+                    "mtime": now + i,
+                }
+                for i in range(num_events)
+            ]
+        )
+
+        deadline = time.time() + 120
+        count = 0
+        while time.time() < deadline:
+            resp = post(broker_url + "/query", {"pql": "SELECT count(*) FROM meetupRsvp"})
+            count = resp.get("numDocsScanned", 0)
+            if count >= num_events and not resp.get("exceptions"):
+                break
+            time.sleep(0.5)
+        if verbose:
+            for pql in [
+                "SELECT count(*) FROM meetupRsvp",
+                "SELECT sum(rsvp_count) FROM meetupRsvp GROUP BY group_city",
+            ]:
+                resp = post(broker_url + "/query", {"pql": pql})
+                print(f"\n>>> {pql}")
+                print(json.dumps(resp, indent=2)[:900])
+        return count
+    finally:
+        stream_broker.stop()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
