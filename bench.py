@@ -151,7 +151,23 @@ def _broker_latencies(segments, queries_per_round: int = 40):
     runner = QueryRunner(run)
     runner.single_thread([Q1_PQL], rounds=3)  # warm: stage + compile
     report = runner.single_thread([Q1_PQL] * queries_per_round, rounds=1)
-    return report
+
+    # Selective point query (~0.04% of rows, clustered date): measures
+    # the zone-map block-skipping path (engine/zonemap.py) vs the full
+    # scan it replaces — the reference answers this shape via inverted
+    # indexes in O(matches) (VERDICT r1 #4).
+    sel_pql = (
+        "SELECT sum(l_extendedprice), count(*) FROM lineitem "
+        "WHERE l_shipdate = '1995-06-14'"
+    )
+    selective = {}
+    for flag, label in (("1", "zonemap"), ("0", "fullscan")):
+        os.environ["PINOT_TPU_ZONEMAP"] = flag
+        runner.single_thread([sel_pql], rounds=3)  # warm + compile
+        r = runner.single_thread([sel_pql] * 20, rounds=1)
+        selective[f"selective_p50_ms_{label}"] = r.to_json()["p50Ms"]
+    os.environ.pop("PINOT_TPU_ZONEMAP", None)
+    return report, selective
 
 
 def main() -> None:
@@ -171,18 +187,25 @@ def main() -> None:
 
     segments = _build_segments(num_segments, rows_per_segment)
     rows_per_sec, per_query_ms, e2e_ms = _kernel_rows_per_sec(segments, iters)
-    broker_report = _broker_latencies(segments)
+    broker_report, selective = _broker_latencies(segments)
     rj = broker_report.to_json()
     p50_s = max(broker_report.percentile(50), 1e-6) / 1000.0
 
+    # vs_baseline compares like-for-like (ADVICE r1): the baseline is
+    # the reference broker's reported query time, so the ratio uses our
+    # broker-path p50 (true client-observed per-query latency); the
+    # kernel marginal-batch ratio is reported alongside in detail.
     print(
         json.dumps(
             {
                 "metric": "tpch_q1_rows_scanned_per_sec_per_chip",
                 "value": round(rows_per_sec, 1),
                 "unit": "rows/s",
-                "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+                "vs_baseline": round(total_rows / p50_s / BASELINE_ROWS_PER_SEC, 3),
                 "detail": {
+                    "vs_baseline_kernel_marginal": round(
+                        rows_per_sec / BASELINE_ROWS_PER_SEC, 3
+                    ),
                     "platform": platform,
                     "total_rows": total_rows,
                     "num_segments": num_segments,
@@ -196,9 +219,7 @@ def main() -> None:
                     "broker_p50_ms": rj["p50Ms"],
                     "broker_p99_ms": rj["p99Ms"],
                     "broker_rows_per_sec_p50": round(total_rows / p50_s, 1),
-                    "vs_baseline_broker_p50": round(
-                        total_rows / p50_s / BASELINE_ROWS_PER_SEC, 3
-                    ),
+                    **selective,
                 },
             }
         )

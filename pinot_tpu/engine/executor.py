@@ -131,17 +131,68 @@ class QueryExecutor:
 
         from pinot_tpu.engine.device import segment_arrays
 
-        q_inputs = self._to_device_inputs(build_query_inputs(request, plan, ctx, staged))
+        q_np = build_query_inputs(request, plan, ctx, staged)
+        q_inputs = self._to_device_inputs(q_np)
         seg_arrays = segment_arrays(staged, needed)
+        block_ids, scanned_rows = self._block_skip_ids(plan, q_np, live, staged)
         t0 = self._phase("planBuild", t0)
-        kernel = self._kernel(plan)
-        outs = kernel(seg_arrays, q_inputs)
+        if block_ids is not None:
+            from pinot_tpu.engine.kernel import make_block_table_kernel
+            from pinot_tpu.engine.zonemap import zone_block_rows
+
+            kernel = make_block_table_kernel(plan, zone_block_rows())
+            outs = kernel(seg_arrays, q_inputs, jnp.asarray(block_ids))
+        else:
+            kernel = self._kernel(plan)
+            outs = kernel(seg_arrays, q_inputs)
         outs = {k: np.asarray(v) if not isinstance(v, tuple) else tuple(np.asarray(x) for x in v) for k, v in outs.items()}
         t0 = self._phase("planExec", t0)
 
         result = self._finalize(request, plan, ctx, staged, live, outs, total_docs, sel_columns)
+        if scanned_rows is not None:
+            # zone maps skipped non-candidate blocks: filter scan cost
+            # is O(candidate rows), the point of the skipping path
+            result.num_entries_scanned_in_filter = len(plan.leaves) * scanned_rows
         self._phase("finalize", t0)
         return result
+
+    def _block_skip_ids(
+        self,
+        plan: StaticPlan,
+        q_np: Dict[str, Any],
+        live: List[ImmutableSegment],
+        staged: StagedTable,
+    ):
+        """Zone-map block pruning decision (engine/zonemap.py): returns
+        (block_ids [S, nb_pad] or None, candidate_rows or None).
+
+        Engages when the candidate set is under half the table — below
+        that the gather overhead beats the full scan it saves.  The
+        mesh path keeps full scans (block counts vary per chip)."""
+        import os
+
+        if self.mesh is not None or os.environ.get("PINOT_TPU_ZONEMAP") == "0":
+            return None, None
+        from pinot_tpu.engine import zonemap
+
+        cand = zonemap.candidate_blocks(plan, q_np, live, staged.n_pad)
+        if cand is None:
+            return None, None
+        block = zonemap.zone_block_rows()
+        nb_total = staged.num_segments * (staged.n_pad // block)
+        nb_max = int(cand.sum(axis=1).max()) if cand.size else 0
+        nb_pad = 1
+        while nb_pad < nb_max:
+            nb_pad *= 2
+        if nb_pad * staged.num_segments > nb_total // 2:
+            return None, None
+        ids = zonemap.block_ids_input(cand, nb_pad)
+        if ids.shape[0] < staged.num_segments:  # mesh-padding segments
+            pad = np.full(
+                (staged.num_segments - ids.shape[0], nb_pad), -1, dtype=np.int32
+            )
+            ids = np.concatenate([ids, pad], axis=0)
+        return ids, int(cand.sum()) * block
 
     def _kernel(self, plan: StaticPlan):
         if self.mesh is None:

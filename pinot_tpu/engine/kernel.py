@@ -519,6 +519,70 @@ def apply_reduce(op: str, value: Any):
     raise ValueError(op)
 
 
+def _row_key(key: str) -> bool:
+    return key.endswith((".fwd", ".raw", ".gfwd", ".mv", ".mvc"))
+
+
+def _gather_blocks(seg: Dict[str, Any], ids: jnp.ndarray, block: int):
+    """Gather candidate row blocks out of one segment's staged arrays.
+
+    ids: int32 [nb_pad], -1 = padding.  Row-shaped arrays [n_pad, ...]
+    come back as [nb_pad*block, ...]; a ``valid`` mask and the original
+    doc ids (``rowid``) are derived so the single-segment kernel runs
+    unchanged on the gathered view.
+    """
+    safe = jnp.maximum(ids, 0)
+    out: Dict[str, Any] = {}
+    for k, v in seg.items():
+        if k == "num_docs" or k == "valid" or not _row_key(k):
+            if k not in ("num_docs", "valid"):
+                out[k] = v
+            continue
+        nb_tot = v.shape[0] // block
+        vb = v.reshape((nb_tot, block) + v.shape[1:])
+        out[k] = vb[safe].reshape((ids.shape[0] * block,) + v.shape[1:])
+    offs = jax.lax.broadcasted_iota(jnp.int32, (ids.shape[0], block), 1)
+    rowid = (safe[:, None] * block + offs).reshape(-1)
+    live = jnp.broadcast_to((ids >= 0)[:, None], (ids.shape[0], block)).reshape(-1)
+    if "num_docs" in seg:
+        valid = live & (rowid < seg["num_docs"])
+    else:
+        vb = seg["valid"].reshape(-1, block)
+        valid = live & vb[safe].reshape(-1)
+    out["valid"] = valid
+    return out, rowid
+
+
+def make_single_segment_block_kernel(plan: StaticPlan, block: int) -> Callable:
+    """Single-segment kernel over a gathered subset of row blocks —
+    the zone-map skipping path (engine/zonemap.py): work is
+    O(candidate blocks), not O(n)."""
+    single = make_single_segment_kernel(plan)
+
+    def kernel(seg: Dict[str, Any], q: Dict[str, Any], ids: jnp.ndarray):
+        gseg, rowid = _gather_blocks(seg, ids, block)
+        out = single(gseg, q)
+        if "sel_docids" in out:
+            out["sel_docids"] = rowid[out["sel_docids"]]
+        return out
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=256)
+def make_block_table_kernel(plan: StaticPlan, block: int) -> Callable:
+    """vmapped + jitted block-skipping variant of make_table_kernel;
+    extra input: block ids int32 [S, nb_pad] (-1 padded)."""
+    single = make_single_segment_block_kernel(plan, block)
+    reducers = output_reducers(plan)
+
+    def table_fn(segs, q, ids):
+        outs = jax.vmap(single)(segs, q, ids)
+        return {k: apply_reduce(reducers[k], v) for k, v in outs.items()}
+
+    return jax.jit(table_fn)
+
+
 @functools.lru_cache(maxsize=256)
 def make_table_kernel(plan: StaticPlan) -> Callable:
     """vmap the single-segment kernel over the stacked segment axis and

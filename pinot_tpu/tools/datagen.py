@@ -206,6 +206,11 @@ def synthetic_lineitem_segment(num_rows: int, seed: int = 7, name: str = "li0"):
             d = Dictionary(spec.stored_type, np.unique(np.asarray(vals)))
         card = d.cardinality
         fwd = rng.integers(0, card, size=num_rows, dtype=np.int64).astype(np.int32)
+        if spec.name == "l_shipdate":
+            # realtime tables arrive in time order: keep the date column
+            # clustered so zone maps (engine/zonemap.py) have something
+            # to prune, as in the reference's sorted-column fast path
+            fwd.sort()
         meta = ColumnMetadata(
             name=spec.name,
             data_type=spec.data_type,

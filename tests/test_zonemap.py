@@ -1,0 +1,139 @@
+"""Zone-map block skipping (engine/zonemap.py + block-gather kernel).
+
+Reference capability: index-based skipping for selective queries
+(``SortedInvertedIndexBasedFilterOperator.java``,
+``BitmapInvertedIndexReader.java:28``) — here per-block dictId min/max
+zones prune blocks host-side before the device gather.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine import zonemap
+from pinot_tpu.engine.executor import QueryExecutor
+from pinot_tpu.engine.plan import build_query_inputs, build_static_plan
+from pinot_tpu.engine.context import get_table_context
+from pinot_tpu.engine.device import stage_segments
+from pinot_tpu.engine.reduce import reduce_to_response
+from pinot_tpu.pql import optimize_request, parse_pql
+from pinot_tpu.tools.datagen import lineitem_schema, synthetic_lineitem_segment
+from pinot_tpu.tools.scan_engine import ScanQueryProcessor
+
+BLOCK = 1024
+
+QUERIES = [
+    # clustered-date interval: one candidate block per segment
+    "SELECT sum(l_quantity), count(*) FROM lineitem WHERE l_shipdate <= '1992-02-01' GROUP BY l_returnflag TOP 10",
+    # point lookup on the clustered column
+    "SELECT sum(l_extendedprice) FROM lineitem WHERE l_shipdate = '1995-06-14'",
+    # AND with an unclustered match-table leaf
+    "SELECT count(*) FROM lineitem WHERE l_shipmode IN ('RAIL','FOB') AND l_shipdate BETWEEN '1993-01-01' AND '1993-03-01'",
+    # empty candidate set (date past the data)
+    "SELECT max(l_discount) FROM lineitem WHERE l_shipdate > '1998-11-30'",
+    # OR of two clustered ranges
+    "SELECT count(*) FROM lineitem WHERE l_shipdate <= '1992-02-01' OR l_shipdate > '1998-10-01'",
+    # IN points on the clustered column
+    "SELECT sum(l_tax) FROM lineitem WHERE l_shipdate IN ('1994-01-05','1997-03-22')",
+    # selection + order-by through the block path (docid remapping)
+    "SELECT l_shipdate, l_quantity FROM lineitem WHERE l_shipdate = '1995-06-14' ORDER BY l_quantity DESC LIMIT 5",
+    # NOT IN stays correct (conservative candidacy)
+    "SELECT count(*) FROM lineitem WHERE l_shipdate NOT IN ('1995-06-14') AND l_shipdate BETWEEN '1995-06-01' AND '1995-06-30'",
+]
+
+STRIP = (
+    "timeUsedMs",
+    "numEntriesScannedInFilter",
+    "numEntriesScannedPostFilter",
+    "numSegmentsQueried",
+    "numServersQueried",
+    "numServersResponded",
+)
+
+
+@pytest.fixture(scope="module")
+def cluster(monkeypatch_module=None):
+    segs = [
+        synthetic_lineitem_segment(20000, seed=7 + i, name=f"li{i}") for i in range(3)
+    ]
+    rows = [r for s in segs for r in s.rows()]
+    oracle = ScanQueryProcessor(lineitem_schema(), rows)
+    return segs, oracle
+
+
+@pytest.fixture(autouse=True)
+def small_zone_block(monkeypatch):
+    monkeypatch.setenv("PINOT_TPU_ZONE_BLOCK", str(BLOCK))
+
+
+def _norm(resp):
+    j = resp.to_json()
+    for k in STRIP:
+        j.pop(k, None)
+    return json.dumps(j, sort_keys=True, default=str)
+
+
+def test_block_path_matches_oracle(cluster):
+    segs, oracle = cluster
+    ex = QueryExecutor()
+    for q in QUERIES:
+        req = optimize_request(parse_pql(q))
+        req2 = optimize_request(parse_pql(q))
+        got = reduce_to_response(req, [ex.execute(segs, req)])
+        want = oracle.execute(req2)
+        assert _norm(got) == _norm(want), q
+
+
+def test_selective_query_scans_candidate_blocks_only(cluster):
+    segs, _ = cluster
+    ex = QueryExecutor()
+    req = optimize_request(
+        parse_pql("SELECT sum(l_extendedprice) FROM lineitem WHERE l_shipdate = '1995-06-14'")
+    )
+    part = ex.execute(segs, req)
+    # clustered dates: the one matching block per segment, not the table
+    assert part.num_entries_scanned_in_filter <= 2 * BLOCK * len(segs)
+    total = sum(s.num_docs for s in segs)
+    assert part.num_entries_scanned_in_filter < total / 4
+
+
+def test_zone_map_disabled_full_scan(cluster, monkeypatch):
+    segs, oracle = cluster
+    monkeypatch.setenv("PINOT_TPU_ZONEMAP", "0")
+    ex = QueryExecutor()
+    q = "SELECT sum(l_extendedprice) FROM lineitem WHERE l_shipdate = '1995-06-14'"
+    req = optimize_request(parse_pql(q))
+    req2 = optimize_request(parse_pql(q))
+    got = reduce_to_response(req, [ex.execute(segs, req)])
+    assert _norm(got) == _norm(oracle.execute(req2))
+
+
+def test_candidate_blocks_conservative(cluster):
+    """Every row the kernel would match must live in a candidate block."""
+    segs, _ = cluster
+    q = "SELECT count(*) FROM lineitem WHERE l_shipdate BETWEEN '1994-03-01' AND '1994-04-15'"
+    req = optimize_request(parse_pql(q))
+    ctx = get_table_context(segs)
+    staged = stage_segments(segs, sorted(req.referenced_columns()), ctx=ctx)
+    plan = build_static_plan(req, ctx, staged)
+    q_np = build_query_inputs(req, plan, ctx, staged)
+    cand = zonemap.candidate_blocks(plan, q_np, segs, staged.n_pad, block=BLOCK)
+    assert cand is not None
+    for si, seg in enumerate(segs):
+        col = seg.column("l_shipdate")
+        d = col.dictionary
+        lo, hi = q_np["bounds"][0][si]
+        match_rows = np.nonzero((col.fwd >= lo) & (col.fwd < hi))[0]
+        for doc in match_rows:
+            assert cand[si][doc // BLOCK], (si, doc)
+
+
+def test_zones_cached_per_segment(cluster):
+    segs, _ = cluster
+    z1 = zonemap.column_zones(segs[0], "l_shipdate", BLOCK)
+    z2 = zonemap.column_zones(segs[0], "l_shipdate", BLOCK)
+    assert z1 is z2
+    zmin, zmax = z1
+    assert (zmin <= zmax).all()
+    # clustered column: zones are narrow
+    assert (zmax - zmin).mean() < segs[0].column("l_shipdate").metadata.cardinality / 8
