@@ -6,8 +6,11 @@ Reference: eligibility gate ``RequestUtils.isFitForStarTreeIndex``
 
 Eligible queries — aggregation (optionally group-by) where every
 function is count/sum/avg over metrics, the filter is a conjunction of
-EQ/IN predicates on split-order dimensions, and group-by columns are
-split-order dimensions — are answered from the pre-aggregated cube:
+EQ/IN/RANGE predicates on split-order dimensions (cube rows live in
+sorted-dictId space, so a range is a contiguous dictId interval —
+``StarTreeIndexOperator.java:53`` handles the same mixed shapes), and
+group-by columns are split-order dimensions — are answered from the
+pre-aggregated cube:
 host traversal picks [start, end) ranges (star rows wherever a
 dimension is unconstrained), residual predicates and the aggregation
 itself run vectorized over those rows.  ``numDocsScanned`` reports
@@ -35,12 +38,56 @@ from pinot_tpu.startree.index import STAR, StarTreeIndex, StarTreeNode
 _FIT_AGGS = ("count", "sum", "avg")
 
 
+class _Constraint:
+    """Predicate constraint on one dimension in local dictId space:
+    either an explicit id set (EQ/IN) or a half-open interval (RANGE —
+    kept as an interval so a wide range on a high-cardinality split
+    dimension costs two compares, not an O(card) materialized set)."""
+
+    __slots__ = ("ids", "lo", "hi")
+
+    def __init__(self, ids: Optional[Set[int]] = None, lo: int = 0, hi: int = 0):
+        self.ids = ids
+        self.lo = lo
+        self.hi = hi
+
+    def intersect(self, other: "_Constraint") -> "_Constraint":
+        if self.ids is not None and other.ids is not None:
+            return _Constraint(ids=self.ids & other.ids)
+        if self.ids is None and other.ids is None:
+            return _Constraint(lo=max(self.lo, other.lo), hi=min(self.hi, other.hi))
+        ids = self.ids if self.ids is not None else other.ids
+        iv = other if self.ids is not None else self
+        return _Constraint(ids={i for i in ids if iv.lo <= i < iv.hi})
+
+    def contains(self, dict_id: int) -> bool:
+        if self.ids is not None:
+            return dict_id in self.ids
+        return self.lo <= dict_id < self.hi
+
+    def matching_children(self, children: Dict[int, "StarTreeNode"]):
+        if self.ids is not None and len(self.ids) < len(children):
+            return (children[i] for i in self.ids if i in children)
+        return (c for i, c in children.items() if self.contains(i))
+
+    def mask(self, vals: np.ndarray) -> np.ndarray:
+        if self.ids is not None:
+            if not self.ids:
+                return np.zeros(vals.size, bool)
+            return np.isin(vals, np.asarray(sorted(self.ids), dtype=np.int64))
+        return (vals >= self.lo) & (vals < self.hi)
+
+
 def _conjunctive_eq_leaves(tree: Optional[FilterQueryTree]) -> Optional[List[FilterQueryTree]]:
-    """Flatten an AND-only tree of EQ/IN leaves; None if not that shape."""
+    """Flatten an AND-only tree of EQ/IN/RANGE leaves; None otherwise."""
     if tree is None:
         return []
     if tree.is_leaf:
-        if tree.operator in (FilterOperator.EQUALITY, FilterOperator.IN):
+        if tree.operator in (
+            FilterOperator.EQUALITY,
+            FilterOperator.IN,
+            FilterOperator.RANGE,
+        ):
             return [tree]
         return None
     if tree.operator != FilterOperator.AND:
@@ -86,7 +133,7 @@ def is_fit_for_star_tree(request: BrokerRequest, segment: ImmutableSegment) -> b
 def _traverse(
     node: StarTreeNode,
     split_order: List[str],
-    constraints: Dict[str, Set[int]],
+    constraints: Dict[str, "_Constraint"],
     group_dims: Set[str],
 ) -> List[Tuple[int, int]]:
     if node.is_leaf:
@@ -94,10 +141,8 @@ def _traverse(
     dim = split_order[node.level]
     ranges: List[Tuple[int, int]] = []
     if dim in constraints:
-        for dict_id in constraints[dim]:
-            child = node.children.get(dict_id)
-            if child is not None:
-                ranges.extend(_traverse(child, split_order, constraints, group_dims))
+        for child in constraints[dim].matching_children(node.children):
+            ranges.extend(_traverse(child, split_order, constraints, group_dims))
     elif dim in group_dims:
         for child in node.children.values():
             ranges.extend(_traverse(child, split_order, constraints, group_dims))
@@ -113,14 +158,22 @@ def execute_star_tree(segment: ImmutableSegment, request: BrokerRequest) -> Inte
     tree: StarTreeIndex = segment.star_tree
     split = tree.split_order
 
-    # predicate constraints in local dictId space
-    constraints: Dict[str, Set[int]] = {}
+    # predicate constraints in local dictId space; RANGE leaves stay
+    # contiguous dictId intervals (dictionaries are sorted)
+    constraints: Dict[str, _Constraint] = {}
     for leaf in _conjunctive_eq_leaves(request.filter) or []:
         d = segment.column(leaf.column).dictionary
-        ids = {d.index_of(d.stored_type.convert(v)) for v in leaf.values}
-        ids.discard(-1)
+        if leaf.operator == FilterOperator.RANGE:
+            from pinot_tpu.engine.plan import leaf_interval
+
+            lo, hi = leaf_interval(leaf, d)
+            c = _Constraint(lo=lo, hi=hi)
+        else:
+            ids = {d.index_of(d.stored_type.convert(v)) for v in leaf.values}
+            ids.discard(-1)
+            c = _Constraint(ids=ids)
         prev = constraints.get(leaf.column)
-        constraints[leaf.column] = ids if prev is None else (prev & ids)
+        constraints[leaf.column] = c if prev is None else prev.intersect(c)
 
     group_cols = list(request.group_by.columns) if request.is_group_by else []
     ranges = _traverse(tree.root, split, constraints, set(group_cols))
@@ -133,9 +186,9 @@ def execute_star_tree(segment: ImmutableSegment, request: BrokerRequest) -> Inte
     # residual predicate masks (idempotent over already-descended dims)
     mask = np.ones(rows.size, dtype=bool)
     level_of = {c: i for i, c in enumerate(split)}
-    for col, ids in constraints.items():
+    for col, c in constraints.items():
         vals = tree.dims[rows, level_of[col]]
-        mask &= np.isin(vals, np.asarray(sorted(ids), dtype=np.int32)) if ids else np.zeros(rows.size, bool)
+        mask &= c.mask(vals)
     rows = rows[mask]
 
     counts = tree.counts[rows]
