@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import urllib.request
@@ -178,6 +179,34 @@ def cmd_create_segment(args) -> None:
         )
     path = write_segment(seg, args.out_dir)
     print(f"built segment {seg.segment_name}: {seg.num_docs} docs -> {path}")
+
+
+def cmd_batch_create_segments(args) -> None:
+    """pinot-hadoop analog: one segment build per input file on a
+    worker-process pool, optional push (SegmentCreationJob.java)."""
+    import glob as _glob
+
+    from pinot_tpu.tools.batch_build import BatchBuildSpec, run_batch_build
+
+    inputs = sorted(
+        f
+        for pat in args.inputs
+        for f in _glob.glob(pat)
+        if os.path.isfile(f)
+    )
+    if not inputs:
+        raise SystemExit(f"no input files matched {args.inputs}")
+    spec = BatchBuildSpec(
+        schema_file=args.schema_file,
+        table=args.table,
+        input_files=inputs,
+        out_dir=args.out_dir,
+        controller=args.controller,
+        startree=args.startree,
+        segment_name_prefix=args.segment_name_prefix,
+    )
+    for r in run_batch_build(spec, workers=args.workers):
+        print(json.dumps(r))
 
 
 def cmd_upload_segment(args) -> None:
@@ -346,6 +375,17 @@ def main(argv=None) -> None:
     cs.add_argument("-out-dir", required=True, dest="out_dir")
     cs.add_argument("-startree", action="store_true")
     cs.set_defaults(fn=cmd_create_segment)
+
+    bcs = sub.add_parser("BatchCreateSegments")
+    bcs.add_argument("-schema-file", required=True, dest="schema_file")
+    bcs.add_argument("-inputs", required=True, nargs="+", help="input files/globs (csv/jsonl/avro), one segment each")
+    bcs.add_argument("-table", required=True)
+    bcs.add_argument("-out-dir", required=True, dest="out_dir")
+    bcs.add_argument("-controller", default=None, help="push built segments here when set")
+    bcs.add_argument("-workers", type=int, default=0)
+    bcs.add_argument("-startree", action="store_true")
+    bcs.add_argument("-segment-name-prefix", default=None, dest="segment_name_prefix")
+    bcs.set_defaults(fn=cmd_batch_create_segments)
 
     us = sub.add_parser("UploadSegment")
     us.add_argument("-controller", default="http://127.0.0.1:9000")
