@@ -43,7 +43,10 @@ def default_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.asarray(devs), (SEGMENT_AXIS,))
 
 
-def _collective(op: str, value: Any, axis: str):
+def _collective(op: str, value: Any, axis):
+    # ``axis`` may be one name or a tuple of mesh axis names: on a 2-D
+    # (hosts, chips) mesh the same psum reduces over ICI within a host
+    # and DCN across hosts (multihost.py layering)
     if op == "sum":
         return jax.lax.psum(value, axis)
     if op == "min":
@@ -72,10 +75,16 @@ def make_sharded_table_kernel(plan: StaticPlan, mesh: Mesh) -> Callable:
 
     Takes the same (seg_arrays, query_inputs) pytrees as the
     single-chip table kernel; every leaf's leading axis must equal the
-    (padded) segment count and divide evenly by the mesh size.
+    (padded) segment count and divide evenly by the mesh size.  Works
+    over a 1-D ``segments`` mesh (one server's slice, ICI collectives)
+    or a 2-D ``(hosts, segments)`` mesh (``multihost.py``): the segment
+    axis shards over all mesh axes and the merge collectives name all
+    of them, so XLA lowers the reduction hierarchically — ICI inside a
+    host, DCN across hosts.
     """
     single = make_single_segment_kernel(plan)
     reducers = output_reducers(plan)
+    axes = tuple(mesh.axis_names)  # 1-D (segments) or 2-D (hosts, segments)
 
     def local_fn(segs: Dict[str, Any], q: Dict[str, Any]) -> Dict[str, Any]:
         outs = jax.vmap(single)(segs, q)  # this chip's segments
@@ -85,10 +94,10 @@ def make_sharded_table_kernel(plan: StaticPlan, mesh: Mesh) -> Callable:
             if op == "none":
                 merged[k] = v  # stays sharded over the segment axis
             else:
-                merged[k] = _collective(op, apply_reduce(op, v), SEGMENT_AXIS)
+                merged[k] = _collective(op, apply_reduce(op, v), axes)
         return merged
 
-    shard_spec = P(SEGMENT_AXIS)
+    shard_spec = P(axes)  # segment axis sharded over every mesh axis
 
     def sharded(segs, q):
         in_specs = (

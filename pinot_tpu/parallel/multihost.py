@@ -67,6 +67,19 @@ def make_multihost_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(grid, (HOST_AXIS, SEGMENT_AXIS))
 
 
+def simulated_multihost_mesh(num_hosts: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """(hosts, chips) mesh carved out of one process's devices — the
+    single-process stand-in for ``make_multihost_mesh`` so the 2-D
+    sharding + hierarchical collective path is executable on the
+    virtual CPU mesh (tests) without a real multi-host slice."""
+    devs = list(devices) if devices is not None else jax.devices()
+    per_host = len(devs) // num_hosts
+    if per_host * num_hosts != len(devs):
+        raise ValueError(f"{len(devs)} devices do not split into {num_hosts} hosts")
+    grid = np.array(devs[: num_hosts * per_host]).reshape(num_hosts, per_host)
+    return Mesh(grid, (HOST_AXIS, SEGMENT_AXIS))
+
+
 def flatten_to_segment_mesh(mesh: Mesh) -> Mesh:
     """Collapse a (hosts, chips) mesh into the 1-D segments mesh the
     query kernels shard over (XLA still routes per-link appropriately)."""

@@ -103,6 +103,38 @@ def test_multihost_mesh_shapes(setup):
     assert resp.num_docs_scanned == 900
 
 
+@pytest.mark.parametrize(
+    "pql",
+    [
+        "SELECT count(*) FROM testTable",
+        "SELECT sum(metInt), min(metDouble) FROM testTable GROUP BY dimStr TOP 5",
+        "SELECT distinctcounthll(dimLong) FROM testTable",
+    ],
+)
+def test_query_executes_on_2d_hosts_chips_mesh(setup, pql):
+    """The full query kernel runs SPMD over a (hosts, chips) mesh: the
+    segment axis shards over both axes and the merge collective names
+    both, i.e. the reduction XLA lowers is the hierarchical ICI-then-DCN
+    one described in multihost.py (simulated 2x4 here)."""
+    from pinot_tpu.parallel.multihost import simulated_multihost_mesh
+
+    schema, rows, segments, _ = setup
+    mesh2d = simulated_multihost_mesh(2)
+    assert mesh2d.devices.shape == (2, 4)
+    assert mesh2d.axis_names == ("hosts", "segments")
+
+    req = optimize_request(parse_pql(pql))
+    req1 = optimize_request(parse_pql(pql))
+    got = reduce_to_response(req, [QueryExecutor(mesh=mesh2d).execute(segments, req)])
+    want = ScanQueryProcessor(schema, rows).execute(req1)
+    gj, wj = got.to_json(), want.to_json()
+    for k in ("timeUsedMs", "numEntriesScannedInFilter", "numEntriesScannedPostFilter",
+              "numSegmentsQueried", "numServersQueried", "numServersResponded"):
+        gj.pop(k, None)
+        wj.pop(k, None)
+    assert gj == wj
+
+
 def test_phase_timers_recorded(setup):
     from pinot_tpu.engine.executor import QueryExecutor
     from pinot_tpu.pql import parse_pql
