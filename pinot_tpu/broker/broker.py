@@ -86,7 +86,10 @@ class BrokerRequestHandler:
             if debug_options:
                 request.debug_options = dict(debug_options)
             request = optimize_request(request)
-        except (PqlParseError, ValueError) as e:
+        except PqlParseError as e:
+            # InvalidQueryOptionsError subclasses this; internal
+            # ValueErrors now propagate instead of masquerading as
+            # client parse errors (ADVICE r1)
             resp = BrokerResponse(
                 exceptions=[QueryException(ErrorCode.PQL_PARSING, str(e))]
             )

@@ -345,12 +345,19 @@ def serialize_instance_request(
 
 def deserialize_instance_request(data: bytes) -> Dict[str, Any]:
     r = _Reader(data)
-    return {
+    out = {
         "requestId": r.i64(),
         "pql": r.string(),
         "table": r.string(),
         "segments": list(r.value()),
         "timeoutMs": r.f64(),
         "trace": bool(r.u8()),
-        "debugOptions": dict(r.value() or {}),
     }
+    # debugOptions is a trailing optional field: payloads from peers
+    # predating it simply end here, and must stay readable during
+    # mixed-version operation (ADVICE r1)
+    if r.pos < len(data):
+        out["debugOptions"] = dict(r.value() or {})
+    else:
+        out["debugOptions"] = {}
+    return out

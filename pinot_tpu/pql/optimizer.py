@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from pinot_tpu.common.request import BrokerRequest, FilterOperator, FilterQueryTree
+from pinot_tpu.pql.parser import PqlParseError
 
 
 def flatten(tree: FilterQueryTree) -> FilterQueryTree:
@@ -62,6 +63,13 @@ def or_equalities_to_in(tree: FilterQueryTree) -> FilterQueryTree:
     return FilterQueryTree(operator=FilterOperator.OR, children=out)
 
 
+class InvalidQueryOptionsError(PqlParseError):
+    """Bad per-query options (e.g. malformed ``optimizationFlags``) —
+    a client error, distinct from internal ValueErrors so the broker
+    can report it as PQL_PARSING without masking engine bugs (ADVICE
+    r1: broker.py bare-ValueError catch)."""
+
+
 class OptimizationFlags:
     """Per-query optimizer toggles from the ``optimizationFlags`` debug
     option (``requestHandler/OptimizationFlags.java``): a comma list of
@@ -70,7 +78,7 @@ class OptimizationFlags:
 
     def __init__(self, enabled: set, disabled: set) -> None:
         if enabled and disabled:
-            raise ValueError(
+            raise InvalidQueryOptionsError(
                 "cannot exclude and include optimizations at the same time"
             )
         self._enabled = enabled
@@ -96,7 +104,7 @@ class OptimizationFlags:
             elif opt[0] == "-":
                 disabled.add(opt[1:])
             else:
-                raise ValueError(
+                raise InvalidQueryOptionsError(
                     f"optimization flag {opt!r} must be prefixed with + or -"
                 )
         return OptimizationFlags(enabled, disabled)

@@ -44,9 +44,30 @@ class _Topic:
         if log_paths is not None:
             for p, path in enumerate(log_paths):
                 if os.path.exists(path):
-                    with open(path) as f:
-                        self.rows[p] = [json.loads(l) for l in f if l.strip()]
+                    self.rows[p] = self._recover(path)
             self._log_files = [open(path, "a") for path in log_paths]
+
+    @staticmethod
+    def _recover(path: str) -> List[Row]:
+        """Replay a partition log, truncating a torn tail: a crash
+        (SIGKILL mid-append) can leave a partial last line, which must
+        not stop the broker from coming back up (Kafka log recovery
+        semantics).  Only a torn FINAL line is dropped; corruption
+        earlier in the log still raises."""
+        rows: List[Row] = []
+        lines = open(path).read().splitlines()
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                if i == len(lines) - 1:
+                    with open(path, "w") as f:
+                        f.write("".join(l + "\n" for l in lines[:i]))
+                    break
+                raise
+        return rows
 
     def append(self, partition: int, rows: Sequence[Row]) -> int:
         first = len(self.rows[partition])

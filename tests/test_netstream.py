@@ -202,3 +202,31 @@ def test_networked_realtime_ingestion_and_restart(tmp_path):
         for proc in procs:
             if proc.poll() is None:
                 proc.kill()
+
+
+def test_partition_log_torn_tail_recovery(tmp_path):
+    """A SIGKILL mid-append leaves a partial JSON line; broker restart
+    must truncate the torn tail and come up (Kafka log recovery
+    semantics), not crash — and corruption mid-log must still raise."""
+    import json as _json
+
+    from pinot_tpu.realtime.netstream import _Topic
+
+    log = tmp_path / "p0.jsonl"
+    log.write_text('{"i": 1}\n{"i": 2}\n{"i": 3, "x"')
+    t = _Topic(1, [str(log)])
+    assert [r["i"] for r in t.rows[0]] == [1, 2]
+    t.append(0, [{"i": 4}])
+    t.close()
+    # the torn line was truncated before re-appending
+    t2 = _Topic(1, [str(log)])
+    assert [r["i"] for r in t2.rows[0]] == [1, 2, 4]
+    t2.close()
+
+    bad = tmp_path / "p1.jsonl"
+    bad.write_text('{"i": 1}\nnot-json\n{"i": 2}\n')
+    try:
+        _Topic(1, [str(bad)])
+        raise AssertionError("mid-log corruption must raise")
+    except _json.JSONDecodeError:
+        pass
