@@ -43,6 +43,7 @@ class NetworkedBrokerStarter:
         self.heartbeat_interval_s = heartbeat_interval_s
         self.poll_interval_s = poll_interval_s
         self._version = -1
+        self._epoch = ""  # controller incarnation (see /clusterstate)
         self._stop = threading.Event()
         self._threads: list = []
 
@@ -101,10 +102,14 @@ class NetworkedBrokerStarter:
                 logger.warning("cluster-state poll failed: %s", e)
 
     def _refresh(self, force: bool = False) -> None:
-        state = self._get(f"/clusterstate?ifNewer={-1 if force else self._version}")
+        state = self._get(
+            f"/clusterstate?ifNewer={-1 if force else self._version}"
+            f"&epoch={self._epoch}"
+        )
         if state.get("unchanged"):
             return
         self._version = state["version"]
+        self._epoch = state.get("epoch", "")
         for server, addr in state["servers"].items():
             self.handler.set_server_address(server, (addr[0], int(addr[1])))
         known = set(self.handler.routing.tables())

@@ -51,6 +51,9 @@ class Controller:
 
         # remote-instance control plane (started by ControllerHttpServer)
         self.gateway = ParticipantGateway(self.resources)
+        self.gateway.on_server_available = (
+            self.realtime_manager.ensure_consuming_segments
+        )
 
         self._recover()
 
@@ -332,9 +335,22 @@ class ControllerHttpServer:
                     if parts == ["clusterstate"]:
                         qs = parse_qs(url.query)
                         if_newer = int((qs.get("ifNewer") or ["-1"])[0])
-                        if ctrl.resources.version <= if_newer:
+                        epoch = (qs.get("epoch") or [""])[0]
+                        # "unchanged" only within the SAME controller
+                        # incarnation: a restarted controller's version
+                        # counter restarts, so a broker comparing its
+                        # old (higher) version would otherwise freeze
+                        # its routing forever
+                        if (
+                            epoch == ctrl.gateway.epoch
+                            and ctrl.resources.version <= if_newer
+                        ):
                             return self._respond(
-                                {"version": ctrl.resources.version, "unchanged": True}
+                                {
+                                    "version": ctrl.resources.version,
+                                    "epoch": ctrl.gateway.epoch,
+                                    "unchanged": True,
+                                }
                             )
                         return self._respond(ctrl.gateway.cluster_state())
                     if len(parts) == 3 and parts[0] == "instances" and parts[2] == "messages":
@@ -485,6 +501,7 @@ class ControllerHttpServer:
                         return self._respond({"status": "ok", "servers": servers})
                     return self._respond({"error": "not found"}, 404)
                 except Exception as e:
+                    logger.warning("REST handler error", exc_info=True)
                     return self._respond({"error": str(e)}, 400)
 
             def do_DELETE(self):
@@ -501,6 +518,7 @@ class ControllerHttpServer:
                         return self._respond({"status": "ok"})
                     return self._respond({"error": "not found"}, 404)
                 except Exception as e:
+                    logger.warning("REST handler error", exc_info=True)
                     return self._respond({"error": str(e)}, 400)
 
         self._httpd = ThreadingHTTPServer((host, port), _Handler)

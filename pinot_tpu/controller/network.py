@@ -26,6 +26,7 @@ JSON everywhere except segment downloads (raw bytes).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -151,6 +152,12 @@ class ParticipantGateway:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # deferred-repair callback, wired by the Controller to the
+        # realtime manager's ensure_consuming_segments
+        self.on_server_available = None
+        # incarnation id: cluster-state versions are only comparable
+        # within one controller process lifetime (see /clusterstate)
+        self.epoch = f"{os.getpid()}-{time.monotonic_ns()}"
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -209,6 +216,7 @@ class ParticipantGateway:
             # InstanceState is already alive, so set_instance_alive
             # would no-op; a truly new server replays nothing)
             self.resources.reconcile_instance(name)
+            self._kick_server_available()
         return {
             "status": "ok",
             "heartbeatTimeoutSeconds": self.heartbeat_timeout_s,
@@ -222,7 +230,25 @@ class ParticipantGateway:
             self._heartbeats[name] = time.monotonic()
         if not inst.alive:
             self.resources.set_instance_alive(name, True)
+            self._kick_server_available()
         return {"status": "ok"}
+
+    def _kick_server_available(self) -> None:
+        """A server just became available: run deferred repairs (e.g.
+        recreate missing CONSUMING segments whose creation failed while
+        no replica was registered) without waiting for the periodic
+        ValidationManager tick."""
+        cb = self.on_server_available
+        if cb is None:
+            return
+
+        def run():
+            try:
+                cb()
+            except Exception:
+                logger.warning("server-available repair failed", exc_info=True)
+
+        threading.Thread(target=run, daemon=True).start()
 
     def messages(self, name: str) -> List[Dict[str, Any]]:
         return self.board.fetch(name)
@@ -246,6 +272,7 @@ class ParticipantGateway:
             version = res.version
             instances = dict(res.instances)
             configs = dict(res.table_configs)
+        out_epoch = self.epoch
         tables: Dict[str, Any] = {}
         boundaries: Dict[str, Any] = {}
         quotas: Dict[str, Any] = {}
@@ -284,6 +311,7 @@ class ParticipantGateway:
         }
         return {
             "version": version,
+            "epoch": out_epoch,
             "tables": tables,
             "servers": servers,
             "quotas": quotas,

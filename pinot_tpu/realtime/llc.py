@@ -73,6 +73,7 @@ class _SegmentFsm:
         self.target_offset: Optional[int] = None
         self.final_offset: Optional[int] = None
         self.first_report_ms: Optional[float] = None
+        self.commit_inflight = False  # an upload is being persisted
 
 
 class SegmentCompletionManager:
@@ -123,21 +124,49 @@ class SegmentCompletionManager:
                 assert fsm.target_offset is not None
                 if offset < fsm.target_offset:
                     return RESP_CATCH_UP, fsm.target_offset
-                if server == fsm.committer and fsm.state == COMMITTER_DECIDED:
+                if server == fsm.committer and not fsm.commit_inflight:
+                    # COMMITTER_UPLOADING here (not inflight) means a
+                    # previous commit attempt FAILED (e.g. the
+                    # controller had just restarted): re-issue COMMIT so
+                    # the committer retries instead of holding forever.
+                    # While an upload is actually being persisted the
+                    # committer holds — no duplicate commit.
                     fsm.state = COMMITTER_UPLOADING
                     return RESP_COMMIT, fsm.target_offset
                 return RESP_HOLD, fsm.target_offset
         return RESP_HOLD, None
 
     def segment_commit(self, segment: str, server: str, committed) -> str:
-        """Committer uploads its converted segment (segmentCommit)."""
+        """Committer uploads its converted segment (segmentCommit).
+
+        The FSM flips to COMMITTED only AFTER the metadata/ideal-state
+        persistence succeeds — a failure (controller freshly restarted,
+        replica not re-registered yet) leaves the FSM in
+        COMMITTER_UPLOADING so the committer's next segmentConsumed
+        retries the commit rather than wedging on KEEP/HOLD.
+        """
         with self._lock:
             fsm = self._get(segment)
+            if fsm.state == COMMITTED:
+                return RESP_KEEP  # duplicate upload after a lost reply
             if fsm.committer != server or fsm.state != COMMITTER_UPLOADING:
                 return RESP_NOT_LEADER
+            if fsm.commit_inflight:
+                # a previous upload of this segment is still being
+                # persisted (slow request + client retry): hold rather
+                # than run on_segment_committed twice concurrently
+                return RESP_HOLD
+            fsm.commit_inflight = True
+        try:
+            self.rm.on_segment_committed(segment, committed)
+        except Exception:
+            with self._lock:
+                fsm.commit_inflight = False
+            raise
+        with self._lock:
+            fsm.commit_inflight = False
             fsm.state = COMMITTED
             fsm.final_offset = fsm.target_offset
-        self.rm.on_segment_committed(segment, committed)
         return RESP_KEEP
 
 
@@ -153,6 +182,10 @@ class RealtimeSegmentManager:
         self._tables: Dict[str, Dict[str, Any]] = {}  # physical -> {schema, stream, config}
         self._consumers: Dict[Tuple[str, str], "RealtimeSegmentDataManager"] = {}
         self._lock = threading.Lock()
+        # serializes consuming-segment creation: commit-time creation,
+        # the periodic ValidationManager tick, and the server-available
+        # repair kick can all race the check-then-create otherwise
+        self._create_lock = threading.Lock()
 
     # -- setup ---------------------------------------------------------
     def setup_table(
@@ -214,6 +247,16 @@ class RealtimeSegmentManager:
         self, physical: str, partition: int, seq: int, start_offset: int
     ) -> str:
         name = make_segment_name(physical, partition, seq)
+        with self._create_lock:
+            if name in self.resources.get_ideal_state(physical):
+                return name  # idempotent: a concurrent path created it
+            return self._create_consuming_segment_locked(
+                physical, partition, seq, start_offset, name
+            )
+
+    def _create_consuming_segment_locked(
+        self, physical: str, partition: int, seq: int, start_offset: int, name: str
+    ) -> str:
         from pinot_tpu.segment.immutable import SegmentMetadata
 
         meta = SegmentMetadata(
@@ -312,8 +355,21 @@ class RealtimeSegmentManager:
             for key in [k for k in self._consumers if k[0] == segment]:
                 self._consumers[key].stop()
                 del self._consumers[key]
-        # open the next consuming segment at the committed end offset
-        self._create_consuming_segment(physical, partition, seq + 1, int(end_offset))
+        # open the next consuming segment at the committed end offset;
+        # a transient failure (no replica re-registered yet after a
+        # controller restart) must NOT fail the commit itself — the
+        # ValidationManager recreates missing CONSUMING segments
+        # (ensure_consuming_segments, ValidationManager.java:64)
+        try:
+            self._create_consuming_segment(physical, partition, seq + 1, int(end_offset))
+        except Exception as e:
+            logger.warning(
+                "could not open next consuming segment for %s partition %d "
+                "(validation repair will retry): %s",
+                physical,
+                partition,
+                e,
+            )
 
     # -- validation hook ----------------------------------------------
     def ensure_consuming_segments(self) -> None:

@@ -19,6 +19,16 @@ Protocol: one JSON object per frame.
   {"op": "latest",  "topic": t, "partition": p} -> {"offset": o}
   {"op": "meta",    "topic": t} -> {"partitions": n}
 
+Consumer groups (the HLC analog — broker-coordinated membership,
+partition rebalance, durable group offsets; see ``HLConsumer``):
+  {"op": "join",      "topic": t, "group": g, "consumer": c}
+      -> {"generation": n, "assignment": [p...], "members": [...], "offsets": {...}}
+  {"op": "heartbeat", "topic": t, "group": g, "consumer": c, "generation": n}
+      -> {"status": "ok"} | {"rebalance": true, "generation": n'}
+  {"op": "commit",    "topic": t, "group": g, "generation": n, "offsets": {p: o}}
+  {"op": "committed", "topic": t, "group": g} -> {"offsets": {p: o}}
+  {"op": "leave",     "topic": t, "group": g, "consumer": c}
+
 Durability: with ``log_dir`` set, every partition is an append-only
 JSONL log replayed on broker restart — consumers resume at their
 committed offsets across broker crashes, like Kafka's on-disk log.
@@ -85,6 +95,36 @@ class _Topic:
                 f.close()
 
 
+class _Group:
+    """Consumer-group state for one (group, topic): membership with
+    heartbeat expiry, a generation counter bumped on every rebalance,
+    and per-partition committed offsets — the broker-side analog of the
+    reference HLC's ZK-committed consumer-group state
+    (``KafkaHighLevelConsumerStreamProvider.java``)."""
+
+    def __init__(self) -> None:
+        self.members: Dict[str, float] = {}  # consumer id -> last heartbeat
+        self.generation = 0
+        self.offsets: Dict[int, int] = {}
+        self.session_timeout = 30.0
+        self.partitions_seen = -1  # topic width at last (re)balance
+
+    def expire(self, now: float) -> bool:
+        dead = [c for c, t in self.members.items() if now - t > self.session_timeout]
+        for c in dead:
+            del self.members[c]
+        if dead:
+            self.generation += 1
+        return bool(dead)
+
+    def assignment(self, consumer: str, partitions: int) -> List[int]:
+        order = sorted(self.members)
+        if consumer not in order:
+            return []
+        i = order.index(consumer)
+        return list(range(partitions))[i :: len(order)]
+
+
 class StreamBrokerServer:
     """The broker process: topics of offset-addressed partition logs."""
 
@@ -96,9 +136,11 @@ class StreamBrokerServer:
     ) -> None:
         self.log_dir = log_dir
         self._topics: Dict[str, _Topic] = {}
+        self._groups: Dict[Tuple[str, str], _Group] = {}  # (group, topic)
         self._lock = threading.Lock()
         if log_dir is not None:
             os.makedirs(log_dir, exist_ok=True)
+            self._load_groups()
             # recover topics from on-disk logs
             for name in sorted(os.listdir(log_dir)):
                 tdir = os.path.join(log_dir, name)
@@ -147,6 +189,93 @@ class StreamBrokerServer:
                 ]
             self._topics[topic] = _Topic(partitions, log_paths)
 
+    # -- consumer-group offset durability ------------------------------
+    def _groups_path(self) -> Optional[str]:
+        if self.log_dir is None:
+            return None
+        return os.path.join(self.log_dir, "__groups__.json")
+
+    def _load_groups(self) -> None:
+        path = self._groups_path()
+        if path is None or not os.path.exists(path):
+            return
+        for key, offs in json.load(open(path)).items():
+            group, topic = key.split("\x00", 1)
+            g = _Group()
+            g.offsets = {int(p): int(o) for p, o in offs.items()}
+            self._groups[(group, topic)] = g
+
+    def _save_groups(self) -> None:
+        path = self._groups_path()
+        if path is None:
+            return
+        data = {
+            f"{group}\x00{topic}": g.offsets
+            for (group, topic), g in self._groups.items()
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+
+    def _group_op(self, op: str, req: Dict[str, Any]) -> bytes:
+        """join / heartbeat / leave / commit / committed — must be
+        called with the lock held."""
+        import time as _time
+
+        key = (req["group"], req["topic"])
+        g = self._groups.setdefault(key, _Group())
+        now = _time.monotonic()
+        consumer = req.get("consumer", "")
+        topic = self._topics.get(req["topic"])
+        partitions = len(topic.rows) if topic is not None else 0
+        if op == "join":
+            g.expire(now)
+            g.session_timeout = float(req.get("sessionTimeout", g.session_timeout))
+            if consumer not in g.members or partitions != g.partitions_seen:
+                g.generation += 1
+            g.partitions_seen = partitions
+            g.members[consumer] = now
+            return json.dumps(
+                {
+                    "generation": g.generation,
+                    "assignment": g.assignment(consumer, partitions),
+                    "members": sorted(g.members),
+                    "offsets": g.offsets,
+                }
+            ).encode()
+        if op == "heartbeat":
+            changed = g.expire(now)
+            if consumer in g.members:
+                g.members[consumer] = now
+            if partitions != g.partitions_seen:
+                # topic created or widened since the last (re)balance:
+                # force every member through a rejoin so assignments
+                # cover the new partitions
+                g.generation += 1
+                g.partitions_seen = partitions
+                changed = True
+            if changed or int(req.get("generation", -1)) != g.generation:
+                return json.dumps({"rebalance": True, "generation": g.generation}).encode()
+            return json.dumps({"status": "ok", "generation": g.generation}).encode()
+        if op == "leave":
+            if consumer in g.members:
+                del g.members[consumer]
+                g.generation += 1
+            return json.dumps({"status": "ok"}).encode()
+        if op == "commit":
+            if int(req.get("generation", -1)) != g.generation:
+                # a stale member must not clobber offsets after a
+                # rebalance moved its partitions elsewhere
+                return json.dumps({"rebalance": True, "generation": g.generation}).encode()
+            for p, off in req.get("offsets", {}).items():
+                g.offsets[int(p)] = int(off)
+            self._save_groups()
+            return json.dumps({"status": "ok"}).encode()
+        if op == "committed":
+            return json.dumps({"offsets": g.offsets}).encode()
+        return json.dumps({"error": f"unknown group op {op!r}"}).encode()
+
     def _handle(self, payload: bytes) -> bytes:
         req = json.loads(payload.decode("utf-8"))
         op = req.get("op")
@@ -154,6 +283,9 @@ class StreamBrokerServer:
             if op == "create":
                 self.create_topic(req["topic"], int(req.get("partitions", 1)))
                 return json.dumps({"status": "ok"}).encode()
+            if op in ("join", "heartbeat", "leave", "commit", "committed"):
+                with self._lock:
+                    return self._group_op(op, req)
             with self._lock:
                 topic = self._topics.get(req.get("topic", ""))
                 if topic is None:
@@ -240,3 +372,84 @@ class NetworkStreamProvider(StreamProvider):
 
     def create_topic(self, partitions: int) -> None:
         self._call({"op": "create", "partitions": partitions})
+
+
+class HLConsumer:
+    """High-level consumer-group member — the HLC analog
+    (``HLRealtimeSegmentDataManager.java:54``,
+    ``KafkaHighLevelConsumerStreamProvider.java``): the broker assigns
+    partitions across the group's live members, rebalances on
+    join/leave/expiry, and stores group-committed offsets durably; the
+    consumer just polls its current assignment and commits.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        topic: str,
+        group: str,
+        consumer_id: str,
+        session_timeout: float = 30.0,
+    ) -> None:
+        self.provider = NetworkStreamProvider(host, port, topic)
+        self.topic = topic
+        self.group = group
+        self.consumer_id = consumer_id
+        self.session_timeout = session_timeout
+        self.generation = -1
+        self.assignment: List[int] = []
+        self.positions: Dict[int, int] = {}
+
+    def _call(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        return self.provider._call(
+            {"group": self.group, "consumer": self.consumer_id, **req}
+        )
+
+    def join(self) -> List[int]:
+        out = self._call({"op": "join", "sessionTimeout": self.session_timeout})
+        self.generation = int(out["generation"])
+        self.assignment = [int(p) for p in out["assignment"]]
+        committed = {int(p): int(o) for p, o in out.get("offsets", {}).items()}
+        # positions restart from the group's committed offsets — the
+        # crash/rebalance resume contract
+        self.positions = {p: committed.get(p, 0) for p in self.assignment}
+        return self.assignment
+
+    def poll(self, max_rows_per_partition: int = 500) -> List[Tuple[int, Row]]:
+        """Heartbeat, rejoin if the group rebalanced, then drain up to
+        ``max_rows_per_partition`` from each assigned partition.
+        Returns (partition, row) pairs."""
+        hb = self._call({"op": "heartbeat", "generation": self.generation})
+        if hb.get("rebalance"):
+            self.join()
+        out: List[Tuple[int, Row]] = []
+        for p in self.assignment:
+            rows, nxt = self.provider.fetch(
+                p, self.positions.get(p, 0), max_rows_per_partition
+            )
+            out.extend((p, r) for r in rows)
+            self.positions[p] = nxt
+        return out
+
+    def commit(self) -> bool:
+        """Commit current positions; False if a rebalance intervened
+        (caller rejoins on next poll and replays from committed)."""
+        out = self._call(
+            {
+                "op": "commit",
+                "generation": self.generation,
+                "offsets": {str(p): self.positions[p] for p in self.assignment},
+            }
+        )
+        return not out.get("rebalance", False)
+
+    def committed_offsets(self) -> Dict[int, int]:
+        out = self._call({"op": "committed"})
+        return {int(p): int(o) for p, o in out["offsets"].items()}
+
+    def close(self) -> None:
+        try:
+            self._call({"op": "leave"})
+        except Exception:
+            pass

@@ -77,7 +77,7 @@ def _load() -> Optional[ctypes.CDLL]:
                 # working symbols; only csv_parse degrades to the fallback
                 if hasattr(lib, "pinot_csv_parse"):
                     lib.pinot_csv_parse.argtypes = [
-                        ctypes.c_char_p,
+                        ctypes.c_void_p,  # readonly buffers (mmap) pass by address
                         ctypes.c_int64,
                         ctypes.c_int64,
                         ctypes.c_char,
@@ -122,7 +122,7 @@ def pack_bits(values: np.ndarray, nbits: int) -> Optional[np.ndarray]:
     return out
 
 
-def csv_parse(data: bytes, start: int, delimiter: str, types, i64_defaults, f64_defaults):
+def csv_parse(data, start: int, delimiter: str, types, i64_defaults, f64_defaults):
     """One-pass columnar CSV parse (native/csvread.cpp), starting at
     byte offset ``start`` (past the header) — the buffer is not copied.
 
@@ -148,7 +148,18 @@ def csv_parse(data: bytes, start: int, delimiter: str, types, i64_defaults, f64_
     types_arr = np.asarray(types, dtype=np.int8)
     i64_def = np.asarray(i64_defaults, dtype=np.int64)
     f64_def = np.asarray(f64_defaults, dtype=np.float64)
-    max_rows = data.count(b"\n", start) + 1
+    if isinstance(data, (bytes, bytearray)):
+        max_rows = data.count(b"\n", start) + 1
+        buf = data
+    else:  # mmap: chunked newline count + pass-by-address (readonly)
+        view = np.frombuffer(data, dtype=np.uint8)
+        # bounded chunks keep the comparison temporary at O(chunk), not
+        # O(file) — the point of mmap-ing in the first place
+        nl = 0
+        for ofs in range(start, view.size, 1 << 24):
+            nl += int(np.count_nonzero(view[ofs : ofs + (1 << 24)] == 0x0A))
+        max_rows = nl + 1
+        buf = ctypes.c_void_p(view.ctypes.data)
     i64_cols = {c: np.empty(max_rows, dtype=np.int64) for c in range(ncols) if types[c] == 0}
     f64_cols = {c: np.empty(max_rows, dtype=np.float64) for c in range(ncols) if types[c] == 1}
     str_offs = {c: np.empty(2 * max_rows, dtype=np.int64) for c in range(ncols) if types[c] == 2}
@@ -167,7 +178,7 @@ def csv_parse(data: bytes, start: int, delimiter: str, types, i64_defaults, f64_
         *[str_offs[c].ctypes.data_as(PI64) if c in str_offs else null_i64 for c in range(ncols)]
     )
     nrows = lib.pinot_csv_parse(
-        data,
+        buf,
         len(data),
         start,
         delim,

@@ -179,7 +179,9 @@ class RemoteConsumer:
         except Exception as e:
             logger.warning("segmentCommit failed for %s: %s", self.segment, e)
             return False
-        if out.get("response") == "NOT_LEADER":
+        if out.get("response") != "KEEP":
+            # NOT_LEADER / HOLD (commit already being persisted by a
+            # prior attempt): retry via the next segmentConsumed round
             return False
         logger.info("committed %s at offset %d", self.segment, self.offset)
         return True

@@ -308,7 +308,16 @@ def cmd_generate_data(args) -> None:
 
 
 def main(argv=None) -> None:
+    import logging
     import os
+
+    lvl = os.environ.get("PINOT_TPU_LOGLEVEL", "WARNING").upper()
+    if not isinstance(getattr(logging, lvl, None), int):
+        lvl = "WARNING"  # unknown names must not kill a role process
+    logging.basicConfig(
+        level=lvl,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
 
     n = os.environ.get("PINOT_TPU_FORCE_CPU")
     if n:

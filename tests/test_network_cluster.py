@@ -33,16 +33,27 @@ def _admin_env():
     env["PALLAS_AXON_POOL_IPS"] = ""  # no TPU tunnel in child processes
     env["JAX_PLATFORMS"] = "cpu"
     env["PINOT_TPU_FORCE_CPU"] = "1"
+    if os.environ.get("PINOT_TPU_LOGLEVEL"):
+        env["PINOT_TPU_LOGLEVEL"] = os.environ["PINOT_TPU_LOGLEVEL"]
     return env
 
 
 def _spawn(args, ready_prefix="READY"):
+    # PINOT_TPU_TEST_LOGDIR=<dir> tees each child's stderr to a file —
+    # the only way to see why a spawned role stalled in a flaky run
+    log_dir = os.environ.get("PINOT_TPU_TEST_LOGDIR")
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        name = "_".join(a.lstrip("-") for a in args[:3]).replace("/", "_")
+        stderr = open(os.path.join(log_dir, f"{name}_{time.time():.0f}.err"), "w")
+    else:
+        stderr = subprocess.DEVNULL
     proc = subprocess.Popen(
         [sys.executable, "-m", "pinot_tpu.tools.admin", *args],
         cwd=REPO_ROOT,
         env=_admin_env(),
         stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL,
+        stderr=stderr,
         text=True,
     )
     deadline = time.time() + 90
