@@ -137,10 +137,15 @@ class QueryExecutor:
         block_ids, scanned_rows = self._block_skip_ids(plan, q_np, live, staged)
         t0 = self._phase("planBuild", t0)
         if block_ids is not None:
-            from pinot_tpu.engine.kernel import make_block_table_kernel
             from pinot_tpu.engine.zonemap import zone_block_rows
 
-            kernel = make_block_table_kernel(plan, zone_block_rows())
+            block = zone_block_rows()
+            if self.mesh is None:
+                from pinot_tpu.engine.kernel import make_block_table_kernel
+
+                kernel = make_block_table_kernel(plan, block)
+            else:
+                kernel = self._block_kernel(plan, block)
             outs = kernel(seg_arrays, q_inputs, jnp.asarray(block_ids))
         else:
             kernel = self._kernel(plan)
@@ -167,11 +172,12 @@ class QueryExecutor:
         (block_ids [S, nb_pad] or None, candidate_rows or None).
 
         Engages when the candidate set is under half the table — below
-        that the gather overhead beats the full scan it saves.  The
-        mesh path keeps full scans (block counts vary per chip)."""
+        that the gather overhead beats the full scan it saves.  On a
+        mesh, the ids array shards over the segment axis like every
+        other per-segment input (nb_pad is a global bucket)."""
         import os
 
-        if self.mesh is not None or os.environ.get("PINOT_TPU_ZONEMAP") == "0":
+        if os.environ.get("PINOT_TPU_ZONEMAP") == "0":
             return None, None
         from pinot_tpu.engine import zonemap
 
@@ -194,19 +200,31 @@ class QueryExecutor:
             ids = np.concatenate([ids, pad], axis=0)
         return ids, int(cand.sum()) * block
 
-    def _kernel(self, plan: StaticPlan):
-        if self.mesh is None:
-            return make_table_kernel(plan)
-        key = plan
+    def _cached_sharded(self, key, factory):
         k = self._sharded_kernels.get(key)
         if k is None:
-            from pinot_tpu.parallel.multichip import make_sharded_table_kernel
-
-            k = make_sharded_table_kernel(plan, self.mesh)
+            k = factory()
             if len(self._sharded_kernels) > 128:
                 self._sharded_kernels.clear()
             self._sharded_kernels[key] = k
         return k
+
+    def _block_kernel(self, plan: StaticPlan, block: int):
+        from pinot_tpu.parallel.multichip import make_sharded_block_table_kernel
+
+        return self._cached_sharded(
+            (plan, "block", block),
+            lambda: make_sharded_block_table_kernel(plan, self.mesh, block),
+        )
+
+    def _kernel(self, plan: StaticPlan):
+        if self.mesh is None:
+            return make_table_kernel(plan)
+        from pinot_tpu.parallel.multichip import make_sharded_table_kernel
+
+        return self._cached_sharded(
+            plan, lambda: make_sharded_table_kernel(plan, self.mesh)
+        )
 
     # ------------------------------------------------------------------
     def _resolve_selection_columns(

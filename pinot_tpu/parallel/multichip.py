@@ -70,6 +70,44 @@ def _out_specs(reducers: Dict[str, str], shard_spec) -> Dict[str, Any]:
     return out
 
 
+def _make_sharded(plan: StaticPlan, mesh: Mesh, single: Callable, n_extra: int) -> Callable:
+    """Shared SPMD wiring for the full-scan and block-skipping kernels:
+    vmap the single-segment kernel per chip, merge with collectives over
+    every mesh axis.  ``n_extra`` extra positional operands (e.g. the
+    block id array) shard over the segment axis like everything else."""
+    reducers = output_reducers(plan)
+    axes = tuple(mesh.axis_names)  # 1-D (segments) or 2-D (hosts, segments)
+
+    def local_fn(segs: Dict[str, Any], q: Dict[str, Any], *extra) -> Dict[str, Any]:
+        outs = jax.vmap(single)(segs, q, *extra)  # this chip's segments
+        merged: Dict[str, Any] = {}
+        for k, v in outs.items():
+            op = reducers[k]
+            if op == "none":
+                merged[k] = v  # stays sharded over the segment axis
+            else:
+                merged[k] = _collective(op, apply_reduce(op, v), axes)
+        return merged
+
+    shard_spec = P(axes)  # segment axis sharded over every mesh axis
+
+    def sharded(segs, q, *extra):
+        in_specs = (
+            jax.tree_util.tree_map(lambda _: shard_spec, segs),
+            jax.tree_util.tree_map(lambda _: shard_spec, q),
+        ) + (shard_spec,) * n_extra
+        fn = shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=_out_specs(reducers, shard_spec),
+            check_vma=False,
+        )
+        return fn(segs, q, *extra)
+
+    return jax.jit(sharded)
+
+
 def make_sharded_table_kernel(plan: StaticPlan, mesh: Mesh) -> Callable:
     """Compile the query kernel as an SPMD program over the mesh.
 
@@ -82,38 +120,16 @@ def make_sharded_table_kernel(plan: StaticPlan, mesh: Mesh) -> Callable:
     of them, so XLA lowers the reduction hierarchically — ICI inside a
     host, DCN across hosts.
     """
-    single = make_single_segment_kernel(plan)
-    reducers = output_reducers(plan)
-    axes = tuple(mesh.axis_names)  # 1-D (segments) or 2-D (hosts, segments)
+    return _make_sharded(plan, mesh, make_single_segment_kernel(plan), 0)
 
-    def local_fn(segs: Dict[str, Any], q: Dict[str, Any]) -> Dict[str, Any]:
-        outs = jax.vmap(single)(segs, q)  # this chip's segments
-        merged: Dict[str, Any] = {}
-        for k, v in outs.items():
-            op = reducers[k]
-            if op == "none":
-                merged[k] = v  # stays sharded over the segment axis
-            else:
-                merged[k] = _collective(op, apply_reduce(op, v), axes)
-        return merged
 
-    shard_spec = P(axes)  # segment axis sharded over every mesh axis
+def make_sharded_block_table_kernel(plan: StaticPlan, mesh: Mesh, block: int) -> Callable:
+    """Zone-map block-skipping variant of the sharded kernel: the block
+    id array [S, nb_pad] shards over the segment axis with everything
+    else, so selective queries stay O(candidate blocks) per chip."""
+    from pinot_tpu.engine.kernel import make_single_segment_block_kernel
 
-    def sharded(segs, q):
-        in_specs = (
-            jax.tree_util.tree_map(lambda _: shard_spec, segs),
-            jax.tree_util.tree_map(lambda _: shard_spec, q),
-        )
-        fn = shard_map(
-            local_fn,
-            mesh=mesh,
-            in_specs=in_specs,
-            out_specs=_out_specs(reducers, shard_spec),
-            check_vma=False,
-        )
-        return fn(segs, q)
-
-    return jax.jit(sharded)
+    return _make_sharded(plan, mesh, make_single_segment_block_kernel(plan, block), 1)
 
 
 def run_sharded_query(plan: StaticPlan, mesh: Mesh, seg_arrays, q_inputs):

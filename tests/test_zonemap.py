@@ -183,3 +183,27 @@ def test_randomized_differential_through_block_path(monkeypatch):
         if not _values_close(canon(got), canon(want)):
             mismatches.append((pql, got, want))
     assert not mismatches, json.dumps(mismatches[0], default=str)[:3000]
+
+
+def test_block_path_on_8_device_mesh(cluster):
+    """Zone-map skipping composes with the sharded multi-chip kernel:
+    block ids shard over the segment axis (parallel/multichip.py)."""
+    from pinot_tpu.parallel import default_mesh
+
+    segs, oracle = cluster
+    total = sum(s.num_docs for s in segs)
+    ex = QueryExecutor(mesh=default_mesh())
+    for q in QUERIES:
+        req = optimize_request(parse_pql(q))
+        req2 = optimize_request(parse_pql(q))
+        part = ex.execute(segs, req)
+        got = reduce_to_response(req, [part])
+        want = oracle.execute(req2)
+        assert _norm(got) == _norm(want), q
+    # the selective point query must actually have taken the skipping
+    # path on the mesh, not fallen back to the full sharded scan
+    req = optimize_request(
+        parse_pql("SELECT sum(l_extendedprice) FROM lineitem WHERE l_shipdate = '1995-06-14'")
+    )
+    part = ex.execute(segs, req)
+    assert part.num_entries_scanned_in_filter < total / 4
