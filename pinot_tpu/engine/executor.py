@@ -111,6 +111,11 @@ class QueryExecutor:
             n = int(self.mesh.devices.size)
             pad_to = -(-len(live) // n) * n
 
+        # columns used ONLY by doc-range predicates on sorted columns
+        # never reach the device (the kernel compares row ids against
+        # host-computed doc bounds) — skip staging them entirely
+        needed -= self._docrange_only_columns(request, live, sel_columns)
+
         ctx = get_table_context(live)
         raw_cols, gfwd_cols = self._role_columns(request, live)
         staged = get_staged(
@@ -160,6 +165,52 @@ class QueryExecutor:
             result.num_entries_scanned_in_filter = len(plan.leaves) * scanned_rows
         self._phase("finalize", t0)
         return result
+
+    def _docrange_only_columns(
+        self,
+        request: BrokerRequest,
+        live: List[ImmutableSegment],
+        sel_columns: Optional[List[str]],
+    ) -> set:
+        """Filter columns whose every use qualifies for the docrange
+        fast path (plan.py StaticLeaf) and which appear nowhere else in
+        the query.  MUST mirror build_static_plan's classification: a
+        dropped column whose leaf does NOT classify docrange would leave
+        the kernel without its arrays."""
+        if request.filter is None:
+            return set()
+        from pinot_tpu.common.request import FilterOperator
+
+        qualifies: Dict[str, bool] = {}
+
+        def walk(node) -> None:
+            if node.is_leaf:
+                col = node.column
+                ok = False
+                if live and live[0].has_column(col):
+                    meta0 = live[0].column(col).metadata
+                    shape_ok = node.operator == FilterOperator.RANGE or (
+                        node.operator == FilterOperator.EQUALITY
+                        and len(node.values) == 1
+                    )
+                    ok = (
+                        meta0.single_value
+                        and shape_ok
+                        and all(s.column(col).metadata.is_sorted for s in live)
+                    )
+                qualifies[col] = qualifies.get(col, True) and ok
+                return
+            for c in node.children:
+                walk(c)
+
+        walk(request.filter)
+        used_elsewhere = {a.column for a in request.aggregations}
+        if request.is_group_by:
+            used_elsewhere.update(request.group_by.columns)
+        if request.is_selection:
+            used_elsewhere.update(sel_columns or [])
+            used_elsewhere.update(s.column for s in request.selection.sorts)
+        return {c for c, ok in qualifies.items() if ok and c not in used_elsewhere}
 
     def _block_skip_ids(
         self,

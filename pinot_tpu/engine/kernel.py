@@ -99,9 +99,25 @@ def _mv_valid(seg: Dict[str, Any], column: str) -> jnp.ndarray:
     return iota < counts[..., None]
 
 
+def _doc_ids(seg: Dict[str, Any]) -> jnp.ndarray:
+    """Row ids for doc-range predicates: the original doc ids when rows
+    were block-gathered (zone-map path), else a plain iota."""
+    if "rowid" in seg:
+        return seg["rowid"]
+    for k, v in seg.items():
+        if _row_shaped(k):
+            return jax.lax.iota(jnp.int32, v.shape[0])
+    return jax.lax.iota(jnp.int32, seg["valid"].shape[0])
+
+
 def _leaf_mask(plan: StaticPlan, i: int, seg: Dict[str, Any], q: Dict[str, Any]) -> jnp.ndarray:
     leaf = plan.leaves[i]
     kind = leaf.eval_kind
+    if kind == "docrange":
+        # sorted column: contiguous doc interval, no column read
+        lo, hi = q["bounds"][i][0], q["bounds"][i][1]
+        ids = _doc_ids(seg)
+        return (ids >= lo) & (ids < hi)
 
     def ids_match(ids):
         """Per-dictId predicate truth, by the leaf's static eval kind.
@@ -550,6 +566,7 @@ def _gather_blocks(seg: Dict[str, Any], ids: jnp.ndarray, block: int):
         vb = seg["valid"].reshape(-1, block)
         valid = live & vb[safe].reshape(-1)
     out["valid"] = valid
+    out["rowid"] = rowid  # original doc ids (docrange leaves, selection)
     return out, rowid
 
 

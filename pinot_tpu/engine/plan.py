@@ -58,6 +58,11 @@ class StaticLeaf:
     mode: str  # SV | MV_ANY | MV_NONE
     # Gathers through big tables are slow on TPU, but dictIds are
     # order-preserving, so most predicates become vector compares:
+    #   docrange    — (iota >= lo_doc) & (iota < hi_doc): a RANGE/EQ on
+    #                 a column sorted in every segment is a contiguous
+    #                 doc interval found host-side by binary search; the
+    #                 kernel never reads the column at all (the
+    #                 SortedInvertedIndexBasedFilterOperator analog)
     #   interval    — (fwd >= lo) & (fwd < hi), bounds from q["bounds"]
     #   points      — any(fwd == pts[k]) for small IN/EQ sets
     #   points_none — complement of points (NOT / NOT_IN)
@@ -166,14 +171,30 @@ def build_static_plan(
 
     def encode(node: FilterQueryTree) -> tuple:
         if node.is_leaf:
-            col = staged.column(node.column)
-            if col.single_value:
+            # mode from segment metadata, not the staged column: a
+            # docrange-only column may be dropped from staging entirely
+            if ctx.segments[0].column(node.column).metadata.single_value:
                 mode = SV
             elif node.operator in (FilterOperator.NOT, FilterOperator.NOT_IN):
                 mode = MV_NONE
             else:
                 mode = MV_ANY
             eval_kind, k_pad = _leaf_eval_kind(node)
+            if (
+                mode == SV
+                and (
+                    eval_kind == "interval"
+                    or (eval_kind == "points" and len(node.values) == 1
+                        and node.operator == FilterOperator.EQUALITY)
+                )
+                and all(
+                    seg.column(node.column).metadata.is_sorted
+                    for seg in ctx.segments
+                )
+            ):
+                # sorted in every segment: the predicate is one doc
+                # interval per segment — no column read in the kernel
+                eval_kind, k_pad = "docrange", 0
             leaves.append(
                 StaticLeaf(
                     column=node.column, mode=mode, eval_kind=eval_kind, k_pad=k_pad
@@ -409,19 +430,30 @@ def build_query_inputs(
         bounds = []
         points = []
         for leaf_node, leaf_static in zip(flat_leaves, plan.leaves):
-            col = staged.column(leaf_static.column)
             kind = leaf_static.eval_kind
             # dummies keep the pytree structure identical per plan
             table_e = np.zeros((S, 1), dtype=bool)
             bound_e = np.zeros((S, 2), dtype=np.int32)
             point_e = np.zeros((S, max(leaf_static.k_pad, 1)), dtype=np.int32)
             for i, seg in enumerate(ctx.segments):
-                d = seg.column(leaf_static.column).dictionary
+                scol = seg.column(leaf_static.column)
+                d = scol.dictionary
                 if kind == "interval":
                     bound_e[i] = leaf_interval(leaf_node, d)
+                elif kind == "docrange":
+                    if leaf_node.operator == FilterOperator.EQUALITY:
+                        did = d.index_of(d.stored_type.convert(leaf_node.values[0]))
+                        lo, hi = (did, did + 1) if did >= 0 else (0, 0)
+                    else:
+                        lo, hi = leaf_interval(leaf_node, d)
+                    bound_e[i] = (
+                        int(np.searchsorted(scol.fwd, lo, "left")),
+                        int(np.searchsorted(scol.fwd, hi, "left")),
+                    )
                 elif kind in ("points", "points_none"):
                     point_e[i] = leaf_points(leaf_node, d, leaf_static.k_pad)
                 else:
+                    col = staged.column(leaf_static.column)
                     if table_e.shape[1] == 1:
                         table_e = np.zeros((S, col.card_pad), dtype=bool)
                     t = match_table(leaf_node, d, col.card_pad)

@@ -75,13 +75,22 @@ def _leaf_candidates(
     segment; None = cannot evaluate (treat as all-candidate)."""
     if leaf.mode != SV:
         return None  # MV predicates: conservative
+    kind = leaf.eval_kind
+    if kind == "docrange":
+        # doc-interval predicate: candidacy is exact block overlap —
+        # no zones needed (and the column may not even be staged)
+        nb_real = -(-seg.num_docs // block)
+        out = np.zeros(nb, dtype=bool)
+        lo_doc, hi_doc = q_np["bounds"][i][si]
+        blk = np.arange(nb_real, dtype=np.int64)
+        out[:nb_real] = (blk * block < hi_doc) & ((blk + 1) * block > lo_doc)
+        return out
     z = column_zones(seg, leaf.column, block)
     if z is None:
         return None
     zmin, zmax = z
     nb_real = zmin.shape[0]
     out = np.zeros(nb, dtype=bool)  # blocks past the data are dead
-    kind = leaf.eval_kind
     if kind == "interval":
         lo, hi = q_np["bounds"][i][si]
         out[:nb_real] = (zmax >= lo) & (zmin < hi)

@@ -218,7 +218,10 @@ def synthetic_lineitem_segment(num_rows: int, seed: int = 7, name: str = "li0"):
             single_value=True,
             cardinality=card,
             total_docs=num_rows,
-            is_sorted=False,
+            # true sortedness: the clustered date column qualifies for
+            # the docrange fast path (plan.py), as a sorted Pinot
+            # column does for SortedInvertedIndexBasedFilterOperator
+            is_sorted=bool(num_rows == 0 or np.all(fwd[1:] >= fwd[:-1])),
             total_number_of_entries=num_rows,
             min_value=d.min_value,
             max_value=d.max_value,

@@ -207,3 +207,54 @@ def test_block_path_on_8_device_mesh(cluster):
     )
     part = ex.execute(segs, req)
     assert part.num_entries_scanned_in_filter < total / 4
+
+
+def test_docrange_classification_and_fallback(cluster):
+    """RANGE/EQ on a column sorted in every segment classifies as a
+    doc-interval predicate (no column read); a mixed table where one
+    segment is unsorted falls back to the dictId-interval kind."""
+    from pinot_tpu.engine.plan import build_static_plan
+    from pinot_tpu.tools.datagen import synthetic_lineitem_segment
+
+    segs, _ = cluster
+
+    def kinds(segments, pql):
+        req = optimize_request(parse_pql(pql))
+        ctx = get_table_context(segments)
+        staged = stage_segments(segments, sorted(req.referenced_columns()), ctx=ctx)
+        plan = build_static_plan(req, ctx, staged)
+        return [l.eval_kind for l in plan.leaves]
+
+    assert kinds(segs, "SELECT count(*) FROM lineitem WHERE l_shipdate <= '1995-01-01'") == ["docrange"]
+    assert kinds(segs, "SELECT count(*) FROM lineitem WHERE l_shipdate = '1995-06-14'") == ["docrange"]
+    # unsorted column: stays a dictId interval
+    assert kinds(segs, "SELECT count(*) FROM lineitem WHERE l_quantity > 25") == ["interval"]
+    # IN with several points is not contiguous: stays points
+    assert kinds(
+        segs, "SELECT count(*) FROM lineitem WHERE l_shipdate IN ('1994-01-05','1997-03-22')"
+    ) == ["points"]
+
+    # mixed sortedness across segments: fall back
+    unsorted = synthetic_lineitem_segment(5000, seed=99, name="unsorted")
+    object.__setattr__(unsorted.column("l_shipdate").metadata, "is_sorted", False)
+    mixed = list(segs) + [unsorted]
+    assert kinds(mixed, "SELECT count(*) FROM lineitem WHERE l_shipdate <= '1995-01-01'") == ["interval"]
+
+
+def test_docrange_column_not_staged(cluster):
+    """A column used only by docrange predicates never reaches device
+    memory: the kernel compares row ids against host-computed bounds."""
+    from pinot_tpu.engine.device import clear_staging_cache, _stage_cache
+
+    segs, oracle = cluster
+    clear_staging_cache()
+    ex = QueryExecutor()
+    q = "SELECT sum(l_quantity) FROM lineitem WHERE l_shipdate <= '1994-01-01'"
+    req = optimize_request(parse_pql(q))
+    req2 = optimize_request(parse_pql(q))
+    got = reduce_to_response(req, [ex.execute(segs, req)])
+    assert _norm(got) == _norm(oracle.execute(req2))
+    staged_cols = {c for st in _stage_cache.values() for c in st.columns}
+    assert "l_shipdate" not in staged_cols
+    assert "l_quantity" in staged_cols
+    clear_staging_cache()
