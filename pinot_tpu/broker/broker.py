@@ -128,11 +128,15 @@ class BrokerRequestHandler:
         futures = []
         for phys_table, sub_pql in physical:
             routing = self.routing.find_servers(phys_table)
-            if routing is None:
+            if not routing:
+                # None (table unknown) or {} (external view refilling
+                # after a restart): either way this physical table is
+                # currently unanswerable — surface a retriable error
+                # rather than silently dropping it from the result
                 exceptions.append(
                     QueryException(
                         ErrorCode.BROKER_RESOURCE_MISSING,
-                        f"no routing for table {phys_table}",
+                        f"no servers currently serving table {phys_table}",
                     )
                 )
                 continue

@@ -54,6 +54,22 @@ def column_zones(
     key = (column, block)
     z = cache.get(key)
     if z is None:
+        # persisted zones may use a different (write-time) block size;
+        # a coarser request that is a multiple of it can be derived by
+        # grouped min/max instead of rescanning the column
+        for (cname, pblock), (pmin, pmax) in cache.items():
+            if cname != column or pblock >= block or block % pblock:
+                continue
+            g = block // pblock
+            nb = -(-pmin.size // g)
+            pad = nb * g - pmin.size
+            if pad:
+                pmin = np.concatenate([pmin, np.full(pad, pmin[-1])])
+                pmax = np.concatenate([pmax, np.full(pad, pmax[-1])])
+            z = (pmin.reshape(nb, g).min(axis=1), pmax.reshape(nb, g).max(axis=1))
+            cache[key] = z
+            return z
+    if z is None:
         fwd = np.asarray(col.fwd)
         n = fwd.size
         nb = -(-n // block) if n else 0

@@ -79,6 +79,23 @@ def write_segment(segment: ImmutableSegment, directory: str) -> str:
             off = np.ascontiguousarray(col.mv_offsets, dtype=np.int32)
             add(f"{name}.mvoff", off.tobytes(), "raw", dtype="int32", count=int(off.size))
 
+    # zone maps: per-block dictId min/max per SV column, persisted at
+    # build/write time so selective-query pruning (engine/zonemap.py)
+    # never pays an O(n) first-query scan (the inverted-index artifact
+    # of the reference's segment files, re-derived)
+    from pinot_tpu.engine.zonemap import column_zones, zone_block_rows
+
+    zblock = zone_block_rows()
+    for name, col in segment.columns.items():
+        if col.fwd is None or col.fwd.size <= zblock:
+            continue
+        z = column_zones(segment, name, zblock)  # single source of truth
+        if z is None:
+            continue
+        zmin, zmax = (a.astype(np.int32) for a in z)
+        add(f"{name}.zmin", zmin.tobytes(), "raw", dtype="int32", count=int(zmin.size))
+        add(f"{name}.zmax", zmax.tobytes(), "raw", dtype="int32", count=int(zmax.size))
+
     star_tree = getattr(segment, "star_tree", None)
     star_header = None
     if star_tree is not None:
@@ -103,6 +120,7 @@ def write_segment(segment: ImmutableSegment, directory: str) -> str:
     header = {
         "metadata": segment.metadata.to_json(),
         "indexMap": index_map,
+        "zoneBlock": zblock,
     }
     if star_header is not None:
         header["starTree"] = star_header
@@ -160,6 +178,19 @@ def read_segment(directory: str) -> ImmutableSegment:
             col.mv_offsets = load(f"{name}.mvoff")
         columns[name] = col
     segment = ImmutableSegment(metadata=metadata, columns=columns)
+
+    # preload persisted zone maps into the segment's zone cache
+    zblock = header.get("zoneBlock")
+    if zblock:
+        cache = {}
+        for name in metadata.columns:
+            if f"{name}.zmin" in index_map:
+                cache[(name, int(zblock))] = (
+                    load(f"{name}.zmin").astype(np.int64),
+                    load(f"{name}.zmax").astype(np.int64),
+                )
+        if cache:
+            object.__setattr__(segment, "_zone_cache", cache)
 
     st = header.get("starTree")
     if st is not None:

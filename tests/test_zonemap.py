@@ -258,3 +258,44 @@ def test_docrange_column_not_staged(cluster):
     assert "l_shipdate" not in staged_cols
     assert "l_quantity" in staged_cols
     clear_staging_cache()
+
+
+def test_zone_maps_persisted_in_segment_file(tmp_path, monkeypatch):
+    """write_segment stores per-block zones; read_segment preloads them
+    so the first selective query does no O(n) zone scan."""
+    from pinot_tpu.segment.format import read_segment, write_segment
+
+    monkeypatch.setenv("PINOT_TPU_ZONE_BLOCK", "512")
+    seg = synthetic_lineitem_segment(5000, seed=5, name="zp")
+    d = write_segment(seg, str(tmp_path / "zp"))
+    loaded = read_segment(str(tmp_path / "zp"))
+    cache = getattr(loaded, "_zone_cache", {})
+    assert ("l_shipdate", 512) in cache
+    zmin, zmax = cache[("l_shipdate", 512)]
+    ref_min, ref_max = zonemap.column_zones(seg, "l_shipdate", 512)
+    np.testing.assert_array_equal(zmin, ref_min)
+    np.testing.assert_array_equal(zmax, ref_max)
+    # column_zones on the loaded segment returns the preloaded arrays
+    got = zonemap.column_zones(loaded, "l_shipdate", 512)
+    assert got[0] is zmin
+
+
+def test_persisted_zones_reblock_to_coarser(tmp_path, monkeypatch):
+    """Zones persisted at a fine write-time block derive coarser query
+    blocks by grouped min/max — no column rescan."""
+    from pinot_tpu.segment.format import read_segment, write_segment
+
+    monkeypatch.setenv("PINOT_TPU_ZONE_BLOCK", "256")
+    seg = synthetic_lineitem_segment(5000, seed=5, name="zr")
+    write_segment(seg, str(tmp_path / "zr"))
+    loaded = read_segment(str(tmp_path / "zr"))
+    loaded.columns["l_shipdate"] = loaded.columns["l_shipdate"].__class__(
+        metadata=loaded.column("l_shipdate").metadata,
+        dictionary=loaded.column("l_shipdate").dictionary,
+        fwd=None,  # prove the derivation never touches the column
+    )
+    monkeypatch.setenv("PINOT_TPU_ZONE_BLOCK", "1024")
+    got = zonemap.column_zones(loaded, "l_shipdate", 1024)
+    want = zonemap.column_zones(seg, "l_shipdate", 1024)
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
