@@ -58,6 +58,14 @@ class StreamConfig:
     decoder: str = "json"
     rows_per_segment: int = 100_000  # segment flush threshold
     consume_seconds: float = 3600.0
+    # "lowlevel": one controller-coordinated consumer per stream
+    #   partition, committer election + exact offset checkpoints (LLC).
+    # "highlevel": one consumer per SERVER in a broker-coordinated
+    #   consumer group; partitions rebalance across servers on
+    #   membership change; group offsets checkpoint in the stream
+    #   broker (HLC, HLRealtimeSegmentDataManager.java:54). Requires a
+    #   network stream (consumer groups live in the stream broker).
+    consumer_type: str = "lowlevel"
     properties: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -129,6 +137,7 @@ class TableConfig:
                 "topic": self.stream.topic,
                 "decoder": self.stream.decoder,
                 "rowsPerSegment": self.stream.rows_per_segment,
+                "consumerType": self.stream.consumer_type,
                 "properties": self.stream.properties,
             }
         return d
@@ -145,6 +154,7 @@ class TableConfig:
                 topic=sc.get("topic", ""),
                 decoder=sc.get("decoder", "json"),
                 rows_per_segment=sc.get("rowsPerSegment", 100_000),
+                consumer_type=sc.get("consumerType", "lowlevel"),
                 properties=sc.get("properties", {}),
             )
         tenants = d.get("tenants", {})

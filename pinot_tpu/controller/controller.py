@@ -193,10 +193,14 @@ class Controller:
             table_physical, segment.metadata, {"dir": path}
         )
 
-    def upload_segment_bytes(self, table_physical: str, data: bytes) -> List[str]:
+    def upload_segment_bytes(
+        self, table_physical: str, data: bytes, servers: Optional[List[str]] = None
+    ) -> List[str]:
         """HTTP upload path: raw segment-file bytes -> store + assign.
         The received payload is the exact on-disk size, so the quota
-        check needs no extra serialization."""
+        check needs no extra serialization.  ``servers`` pins the
+        assignment (HLC uploads keep a server-owned segment on its
+        consuming server)."""
         import tempfile
 
         from pinot_tpu.segment.format import SEGMENT_FILE_NAME, read_segment
@@ -209,7 +213,7 @@ class Controller:
             self._check_storage_quota(table_physical, segment.segment_name, len(data))
             stored = self.store.save_file(table_physical, segment.segment_name, path)
         return self.resources.add_segment(
-            table_physical, segment.metadata, {"dir": stored}
+            table_physical, segment.metadata, {"dir": stored}, servers=servers
         )
 
     def delete_segment(self, table_physical: str, segment_name: str) -> None:
@@ -494,11 +498,21 @@ class ControllerHttpServer:
                         return self._respond(ctrl.rebalance_table(parts[1], dry_run=dry))
                     if len(parts) == 2 and parts[0] == "segments":
                         # binary segment upload: POST /segments/{table}
-                        # (PinotSegmentUploadRestletResource analog)
+                        # (PinotSegmentUploadRestletResource analog);
+                        # ?server= pins assignment (HLC server-owned)
                         n = int(self.headers.get("Content-Length", "0"))
                         body = self.rfile.read(n)
-                        servers = ctrl.upload_segment_bytes(parts[1], body)
+                        qs = parse_qs(url.query)
+                        pin = qs.get("server")
+                        servers = ctrl.upload_segment_bytes(parts[1], body, servers=pin)
                         return self._respond({"status": "ok", "servers": servers})
+                    if parts == ["realtime", "hlc", "roll"]:
+                        body = self._read_json()
+                        seg = ctrl.realtime_manager.register_hlc_roll(
+                            body["table"], body["server"],
+                            int(body["idx"]), int(body["seq"]),
+                        )
+                        return self._respond({"status": "ok", "segment": seg})
                     return self._respond({"error": "not found"}, 404)
                 except Exception as e:
                     logger.warning("REST handler error", exc_info=True)

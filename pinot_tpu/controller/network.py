@@ -128,6 +128,7 @@ class RemoteParticipant(Participant):
                     "startOffset": info.get("startOffset", 0),
                     "rowsPerSegment": info.get("rowsPerSegment", 100_000),
                     "schemaJson": info.get("schemaJson"),
+                    "consumerType": info.get("consumerType", "lowlevel"),
                 }
             )
         self.board.post(self.name, msg)

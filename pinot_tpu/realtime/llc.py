@@ -204,9 +204,113 @@ class RealtimeSegmentManager:
             desc = describe_stream(stream)
             if desc is not None:
                 self.resources.property_store.put("streams", physical, desc)
-        for partition in range(stream.partition_count()):
-            self._create_consuming_segment(physical, partition, seq=0, start_offset=0)
+        if config.stream is not None and config.stream.consumer_type == "highlevel":
+            # HLC: one consumer per SERVER (not per partition) in a
+            # broker-coordinated group; segments are server-owned and
+            # roll locally (HLRealtimeSegmentDataManager.java:54)
+            self.ensure_hlc_consumers(physical)
+        else:
+            for partition in range(stream.partition_count()):
+                self._create_consuming_segment(physical, partition, seq=0, start_offset=0)
         return physical
+
+    def _is_hlc(self, physical: str) -> bool:
+        with self._lock:
+            tinfo = self._tables.get(physical)
+        return bool(
+            tinfo
+            and tinfo["config"].stream is not None
+            and tinfo["config"].stream.consumer_type == "highlevel"
+        )
+
+    def ensure_hlc_consumers(self, physical: str) -> None:
+        """Every live server gets one CONSUMING segment for an HLC
+        table (new servers join the group when they register — the
+        server-available repair hook calls this too)."""
+        if not self._is_hlc(physical):
+            return
+        with self.resources._lock:
+            live = sorted(
+                name
+                for name, inst in self.resources.instances.items()
+                if inst.role == "server" and inst.alive
+            )
+        ideal = self.resources.get_ideal_state(physical)
+        # ownership from the pinned replica sets (sealed uploads replace
+        # segment metadata, so custom keys are NOT a reliable record);
+        # track the highest seq per idx so recreated consumers never
+        # collide with a historical sealed segment name
+        owners = set()
+        max_seq: Dict[int, int] = {}
+        for seg, replicas in ideal.items():
+            try:
+                _, idx, seq = parse_segment_name(seg)
+            except ValueError:
+                continue
+            max_seq[idx] = max(max_seq.get(idx, -1), seq)
+            if CONSUMING in replicas.values():
+                owners.update(replicas)
+        next_idx = 0
+        for server in live:
+            if server in owners:
+                continue
+            while next_idx in max_seq:
+                next_idx += 1
+            max_seq[next_idx] = -1
+            self._create_hlc_segment(
+                physical, server, next_idx, seq=max_seq[next_idx] + 1
+            )
+
+    def register_hlc_roll(self, physical: str, server: str, idx: int, seq: int) -> str:
+        """A server sealed its HLC segment and continues locally on the
+        next sequence: record the new CONSUMING segment so routing
+        covers it (the server already serves it)."""
+        if not self._is_hlc(physical):
+            raise ValueError(f"{physical} is not a highlevel-consumer table")
+        return self._create_hlc_segment(physical, server, idx, seq)
+
+    def _create_hlc_segment(self, physical: str, server: str, idx: int, seq: int) -> str:
+        from pinot_tpu.segment.immutable import SegmentMetadata
+
+        name = make_segment_name(physical, idx, seq)
+        with self._create_lock:
+            if name in self.resources.get_ideal_state(physical):
+                return name
+            with self._lock:
+                tinfo = self._tables.get(physical)
+            from pinot_tpu.realtime.stream import describe_stream
+
+            desc = describe_stream(tinfo["stream"]) if tinfo else None
+            meta = SegmentMetadata(
+                segment_name=name,
+                table_name=physical,
+                num_docs=0,
+                custom={
+                    "partition": idx,
+                    "seq": seq,
+                    "hlcServer": server,
+                    "status": "IN_PROGRESS",
+                },
+            )
+            info: Dict[str, Any] = {
+                "partition": idx,
+                "startOffset": 0,
+                "consumerType": "highlevel",
+                "hlcServer": server,
+            }
+            if desc is not None:
+                info["streamDescriptor"] = desc
+            if tinfo is not None:
+                info["rowsPerSegment"] = (
+                    tinfo["config"].stream.rows_per_segment
+                    if tinfo["config"].stream
+                    else 100_000
+                )
+                info["schemaJson"] = tinfo["schema"].to_json()
+            self.resources.add_segment(
+                physical, meta, info, target_state=CONSUMING, servers=[server]
+            )
+            return name
 
     def recover_table(self, physical: str, config: TableConfig, schema: Schema) -> bool:
         """Rebuild the in-memory realtime wiring for a table restored
@@ -302,6 +406,12 @@ class RealtimeSegmentManager:
 
     # -- server-side consumer creation (via ServerStarter CONSUMING) --
     def _start_consumer(self, server_instance, table: str, segment: str, info: Dict[str, Any]) -> bool:
+        if info.get("consumerType") == "highlevel":
+            # HLC consumers live in networked server processes (the
+            # group coordinator is the stream broker); the in-process
+            # harness supports LLC tables only
+            logger.warning("in-process cluster cannot host HLC consumer %s", segment)
+            return False
         with self._lock:
             tinfo = self._tables.get(table)
             if (segment, server_instance.name) in self._consumers:
@@ -378,6 +488,10 @@ class RealtimeSegmentManager:
         with self._lock:
             tables = list(self._tables.keys())
         for physical in tables:
+            if self._is_hlc(physical):
+                # HLC repair: every live server must be consuming
+                self.ensure_hlc_consumers(physical)
+                continue
             ideal = self.resources.get_ideal_state(physical)
             with self._lock:
                 stream = self._tables[physical]["stream"]

@@ -138,6 +138,7 @@ class MutableSegment:
             remap = np.empty(max(len(mc.id_to_value), 1), dtype=np.int32)
             remap[order] = np.arange(order.size, dtype=np.int32)
 
+            fwd = remap[mc.ids[:n]] if spec.single_value else None
             meta = ColumnMetadata(
                 name=spec.name,
                 data_type=spec.data_type,
@@ -145,14 +146,19 @@ class MutableSegment:
                 single_value=spec.single_value,
                 cardinality=d.cardinality,
                 total_docs=n,
-                is_sorted=False,
+                # time-ordered streams produce sorted time columns: the
+                # committed segment records it so the docrange fast
+                # path (engine/plan.py) applies to realtime data too
+                is_sorted=bool(
+                    spec.single_value
+                    and (fwd is None or fwd.size == 0 or np.all(fwd[1:] >= fwd[:-1]))
+                ),
                 max_num_multi_values=mc.max_mv,
                 total_number_of_entries=n if spec.single_value else len(mc.flat_ids),
                 min_value=d.min_value if len(d) else None,
                 max_value=d.max_value if len(d) else None,
             )
             if spec.single_value:
-                fwd = remap[mc.ids[:n]]
                 columns[spec.name] = ColumnData(metadata=meta, dictionary=d, fwd=fwd)
             else:
                 offsets = np.asarray(mc.offsets[: n + 1], dtype=np.int32)

@@ -38,10 +38,15 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import logging
 
 from pinot_tpu.realtime.stream import StreamProvider
 from pinot_tpu.transport.tcp import TcpServer, TcpTransport
+
+logger = logging.getLogger(__name__)
 
 Row = Dict[str, Any]
 
@@ -108,11 +113,21 @@ class _Group:
         self.offsets: Dict[int, int] = {}
         self.session_timeout = 30.0
         self.partitions_seen = -1  # topic width at last (re)balance
+        self.acked: Dict[str, int] = {}  # consumer -> last generation it joined
+
+    def sync_pending(self) -> bool:
+        """True until every live member has (re)joined the current
+        generation — the rebalance sync barrier: members revoke-commit
+        before rejoining, so once sync completes the committed offsets
+        cover everything consumed under older generations and new
+        owners cannot replay another member's uncommitted rows."""
+        return any(self.acked.get(m, -1) != self.generation for m in self.members)
 
     def expire(self, now: float) -> bool:
         dead = [c for c, t in self.members.items() if now - t > self.session_timeout]
         for c in dead:
             del self.members[c]
+            self.acked.pop(c, None)
         if dead:
             self.generation += 1
         return bool(dead)
@@ -236,18 +251,30 @@ class StreamBrokerServer:
                 g.generation += 1
             g.partitions_seen = partitions
             g.members[consumer] = now
+            g.acked[consumer] = g.generation
+            assignment = g.assignment(consumer, partitions)
+            pending = g.sync_pending()
+            logger.info(
+                "group %s: %s joined gen=%d assignment=%s members=%s "
+                "syncPending=%s offsets=%s",
+                key, consumer, g.generation, assignment, sorted(g.members),
+                pending, g.offsets,
+            )
             return json.dumps(
                 {
                     "generation": g.generation,
-                    "assignment": g.assignment(consumer, partitions),
+                    "assignment": assignment,
                     "members": sorted(g.members),
                     "offsets": g.offsets,
+                    "syncPending": pending,
                 }
             ).encode()
         if op == "heartbeat":
             changed = g.expire(now)
             if consumer in g.members:
                 g.members[consumer] = now
+                if int(req.get("generation", -1)) == g.generation:
+                    g.acked[consumer] = g.generation
             if partitions != g.partitions_seen:
                 # topic created or widened since the last (re)balance:
                 # force every member through a rejoin so assignments
@@ -264,16 +291,28 @@ class StreamBrokerServer:
                 g.generation += 1
             return json.dumps({"status": "ok"}).encode()
         if op == "commit":
-            if int(req.get("generation", -1)) != g.generation:
-                # a stale member must not clobber offsets after a
-                # rebalance moved its partitions elsewhere
+            if consumer not in g.members:
+                # a departed/expired consumer must not write offsets
                 return json.dumps({"rebalance": True, "generation": g.generation}).encode()
+            # monotonic, generation-independent: a live member commits
+            # positions for partitions it is LOSING during a rebalance
+            # (the revoke-commit) so the next owner resumes where it
+            # stopped instead of replaying — offsets only move forward
             for p, off in req.get("offsets", {}).items():
-                g.offsets[int(p)] = int(off)
+                pi = int(p)
+                g.offsets[pi] = max(int(g.offsets.get(pi, 0)), int(off))
             self._save_groups()
             return json.dumps({"status": "ok"}).encode()
         if op == "committed":
             return json.dumps({"offsets": g.offsets}).encode()
+        if op == "describe":
+            return json.dumps(
+                {
+                    "members": sorted(g.members),
+                    "generation": g.generation,
+                    "syncPending": g.sync_pending(),
+                }
+            ).encode()
         return json.dumps({"error": f"unknown group op {op!r}"}).encode()
 
     def _handle(self, payload: bytes) -> bytes:
@@ -283,7 +322,7 @@ class StreamBrokerServer:
             if op == "create":
                 self.create_topic(req["topic"], int(req.get("partitions", 1)))
                 return json.dumps({"status": "ok"}).encode()
-            if op in ("join", "heartbeat", "leave", "commit", "committed"):
+            if op in ("join", "heartbeat", "leave", "commit", "committed", "describe"):
                 with self._lock:
                     return self._group_op(op, req)
             with self._lock:
@@ -312,6 +351,9 @@ class StreamBrokerServer:
             return json.dumps({"error": f"unknown op {op!r}"}).encode()
         except (KeyError, IndexError, ValueError) as e:
             return json.dumps({"error": str(e)}).encode()
+        except Exception as e:  # never kill the connection on a bad frame
+            logger.warning("stream broker op %r failed", op, exc_info=True)
+            return json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
 
 
 class NetworkStreamProvider(StreamProvider):
@@ -324,11 +366,22 @@ class NetworkStreamProvider(StreamProvider):
         self.topic = topic
         self._transport = TcpTransport()
 
+    _IDEMPOTENT_OPS = ("create", "fetch", "latest", "meta",
+                       "join", "heartbeat", "leave", "commit", "committed", "describe")
+
     def _call(self, req: Dict[str, Any]) -> Dict[str, Any]:
         payload = json.dumps({"topic": self.topic, **req}).encode()
-        reply = json.loads(
-            self._transport.request((self.host, self.port), payload).decode("utf-8")
-        )
+        try:
+            raw = self._transport.request((self.host, self.port), payload)
+        except Exception:
+            # connection resets happen under fd/process churn; all ops
+            # except produce are idempotent (group commits are
+            # monotonic), so one retry on a fresh connection is safe
+            if req.get("op") not in self._IDEMPOTENT_OPS:
+                raise
+            time.sleep(0.05)
+            raw = self._transport.request((self.host, self.port), payload)
+        reply = json.loads(raw.decode("utf-8"))
         if "error" in reply:
             raise RuntimeError(f"stream broker: {reply['error']}")
         return reply
@@ -397,23 +450,40 @@ class HLConsumer:
         self.group = group
         self.consumer_id = consumer_id
         self.session_timeout = session_timeout
+        # called when a rebalance revokes this member's assignment,
+        # BEFORE rejoining: persist consumed-but-uncommitted work (seal
+        # + commit) or discard it — returning normally means the member
+        # is clean and successors may take over its partitions
+        self.on_revoke = None
         self.generation = -1
         self.assignment: List[int] = []
         self.positions: Dict[int, int] = {}
+        self.sync_pending = False
 
     def _call(self, req: Dict[str, Any]) -> Dict[str, Any]:
-        return self.provider._call(
-            {"group": self.group, "consumer": self.consumer_id, **req}
-        )
+        payload = {"group": self.group, "consumer": self.consumer_id, **req}
+        try:
+            return self.provider._call(payload)
+        except Exception:
+            # group ops are idempotent (commits are monotonic): one
+            # retry rides out a connection reset under load
+            time.sleep(0.05)
+            return self.provider._call(payload)
 
     def join(self) -> List[int]:
         out = self._call({"op": "join", "sessionTimeout": self.session_timeout})
         self.generation = int(out["generation"])
         self.assignment = [int(p) for p in out["assignment"]]
         committed = {int(p): int(o) for p, o in out.get("offsets", {}).items()}
-        # positions restart from the group's committed offsets — the
-        # crash/rebalance resume contract
-        self.positions = {p: committed.get(p, 0) for p in self.assignment}
+        # positions restart from the group's committed offsets; a
+        # partition this member kept across the rebalance resumes from
+        # its own (possibly further) position — those rows are already
+        # in its local segment, re-reading them would duplicate
+        self.positions = {
+            p: max(committed.get(p, 0), self.positions.get(p, 0))
+            for p in self.assignment
+        }
+        self.sync_pending = bool(out.get("syncPending"))
         return self.assignment
 
     def poll(self, max_rows_per_partition: int = 500) -> List[Tuple[int, Row]]:
@@ -422,7 +492,23 @@ class HLConsumer:
         Returns (partition, row) pairs."""
         hb = self._call({"op": "heartbeat", "generation": self.generation})
         if hb.get("rebalance"):
+            # revoke: make consumed work durable (or drop it) before
+            # the new assignment, so successors neither replay rows a
+            # live member still serves nor skip rows nobody persisted
+            try:
+                if self.on_revoke is not None:
+                    self.on_revoke()
+                else:
+                    self.commit()
+            except Exception:
+                pass
             self.join()
+        if self.sync_pending:
+            # rebalance sync barrier: hold fetches until every member
+            # has revoke-committed + rejoined the current generation
+            self.join()
+            if self.sync_pending:
+                return []
         out: List[Tuple[int, Row]] = []
         for p in self.assignment:
             rows, nxt = self.provider.fetch(
@@ -447,6 +533,17 @@ class HLConsumer:
     def committed_offsets(self) -> Dict[int, int]:
         out = self._call({"op": "committed"})
         return {int(p): int(o) for p, o in out["offsets"].items()}
+
+    def reset_to_committed(self) -> None:
+        """Drop local positions back to the group's committed offsets —
+        required after discarding locally-consumed-but-unpersisted rows
+        (they must be re-fetched, not skipped)."""
+        committed = self.committed_offsets()
+        self.positions = {p: committed.get(p, 0) for p in self.assignment}
+
+    def describe_group(self) -> Dict[str, Any]:
+        """Group membership/state without joining (ops tooling + tests)."""
+        return self._call({"op": "describe"})
 
     def close(self) -> None:
         try:

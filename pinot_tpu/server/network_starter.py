@@ -24,7 +24,9 @@ import threading
 import urllib.request
 from typing import Any, Dict, Optional
 
+from pinot_tpu.common.schema import Schema
 from pinot_tpu.controller.resource_manager import CONSUMING, DROPPED, OFFLINE, ONLINE
+from pinot_tpu.realtime.mutable import MutableSegment
 from pinot_tpu.segment.format import SEGMENT_FILE_NAME, read_segment
 from pinot_tpu.server.instance import ServerInstance
 from pinot_tpu.transport.tcp import TcpServer
@@ -158,24 +160,11 @@ class RemoteConsumer:
         return False
 
     def _commit(self) -> bool:
-        import tempfile
-        import urllib.request
-
-        from pinot_tpu.segment.format import write_segment
-
         committed = self.mutable.to_committed_segment()
-        with tempfile.TemporaryDirectory() as td:
-            write_segment(committed, td)
-            with open(os.path.join(td, SEGMENT_FILE_NAME), "rb") as f:
-                data = f.read()
-        req = urllib.request.Request(
-            f"{self.starter.controller_url}/realtime/commit/{self.segment}/{self.starter.name}",
-            data=data,
-            headers={"Content-Type": "application/octet-stream"},
-        )
         try:
-            with urllib.request.urlopen(req, timeout=120) as r:
-                out = json.loads(r.read())
+            out = self.starter.upload_segment_bytes(
+                f"/realtime/commit/{self.segment}/{self.starter.name}", committed
+            )
         except Exception as e:
             logger.warning("segmentCommit failed for %s: %s", self.segment, e)
             return False
@@ -184,6 +173,153 @@ class RemoteConsumer:
             # prior attempt): retry via the next segmentConsumed round
             return False
         logger.info("committed %s at offset %d", self.segment, self.offset)
+        return True
+
+
+class HLRemoteConsumer:
+    """High-level-consumer ingestion for one server (the
+    ``HLRealtimeSegmentDataManager.java:54`` analog): this server is
+    one member of the table's consumer group; the stream broker assigns
+    it partitions and rebalances on membership change.  Rows index into
+    a server-owned mutable segment; at the row threshold the segment
+    converts and uploads pinned to this server, group offsets commit,
+    and consumption rolls locally to the next sequence (no committer
+    election — HLC segments have exactly one owner).  Delivery is
+    at-least-once across rebalances, as in the reference."""
+
+    rolls_locally = True  # ONLINE of a sealed HLC segment must not stop us
+
+    def __init__(self, starter: "NetworkedServerStarter", table: str, segment: str, msg: Dict[str, Any]) -> None:
+        from pinot_tpu.realtime.llc import parse_segment_name
+        from pinot_tpu.realtime.netstream import HLConsumer
+
+        self.starter = starter
+        self.table = table
+        self.segment = segment
+        _, self.idx, self.seq = parse_segment_name(segment)
+        self.rows_per_segment = int(msg.get("rowsPerSegment", 100_000))
+        self.poll_interval_s = float(msg.get("pollIntervalS", 0.2))
+        desc = msg["streamDescriptor"]
+        self.consumer = HLConsumer(
+            desc["host"], int(desc["port"]), desc["topic"],
+            group=table, consumer_id=starter.name,
+            session_timeout=float(msg.get("sessionTimeoutS", 10.0)),
+        )
+        self.consumer.on_revoke = self._on_revoke
+        self.schema = Schema.from_json(msg["schemaJson"])
+        self.mutable = MutableSegment(self.schema, segment, table)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self.starter.server.add_segment(self.table, self.mutable)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.consumer.close()
+        except Exception:
+            pass
+
+    def _run(self) -> None:
+        try:
+            joined = False
+            while not self._stop.is_set():
+                if not joined:
+                    try:
+                        self.consumer.join()
+                        joined = True
+                    except Exception as e:
+                        # stream broker not reachable yet: keep trying —
+                        # a one-shot join would strand the consumer
+                        logger.warning("HLC join failed for %s: %s", self.segment, e)
+                        self._stop.wait(self.poll_interval_s)
+                        continue
+                try:
+                    budget = self.rows_per_segment - self.mutable.num_docs
+                    rows = self.consumer.poll() if budget > 0 else []
+                except Exception as e:
+                    logger.warning("HLC poll failed for %s: %s", self.segment, e)
+                    self._stop.wait(self.poll_interval_s)
+                    continue
+                for _, row in rows:
+                    self.mutable.index(row)
+                if self.mutable.num_docs >= self.rows_per_segment:
+                    if not self._seal_and_roll():
+                        self._stop.wait(self.poll_interval_s)
+                elif not rows:
+                    self._stop.wait(self.poll_interval_s)
+        except Exception:
+            logger.exception("HLC consumer for %s died", self.segment)
+
+    def _on_revoke(self) -> None:
+        """Rebalance revoked (part of) our assignment: uncommitted rows
+        must become durable before a successor resumes, so seal + upload
+        + commit now (tiny segments are fine; rebalances are rare).  If
+        the upload fails, DISCARD the uncommitted rows instead — they
+        stay uncommitted, so the successor re-reads them; keeping them
+        in our mutable would double-count."""
+        if self.mutable.num_docs == 0:
+            self.consumer.commit()
+            return
+        if not self._seal_and_roll():
+            old = self.segment
+            self.mutable = MutableSegment(self.schema, self.segment, self.table)
+            self.starter.server.add_segment(self.table, self.mutable)
+            # the discarded rows were never persisted NOR committed:
+            # roll positions back to committed so whoever owns these
+            # partitions next (possibly still us) re-fetches them
+            try:
+                self.consumer.reset_to_committed()
+            except Exception as e:
+                logger.warning("HLC position rollback failed: %s", e)
+            logger.warning(
+                "HLC revoke: upload failed; discarded uncommitted rows of %s", old
+            )
+
+    def _seal_and_roll(self) -> bool:
+        import urllib.parse
+
+        from pinot_tpu.realtime.llc import make_segment_name
+
+        committed = self.mutable.to_committed_segment()
+        try:
+            self.starter.upload_segment_bytes(
+                f"/segments/{urllib.parse.quote(self.table)}?server={self.starter.name}",
+                committed,
+            )
+        except Exception as e:
+            logger.warning("HLC upload of %s failed (will retry): %s", self.segment, e)
+            return False
+        # segment durable on the controller: checkpoint group offsets,
+        # then continue on the next sequence (at-least-once on a crash
+        # between upload and commit — the reference's HLC contract)
+        try:
+            self.consumer.commit()
+        except Exception as e:
+            logger.warning("HLC offset commit failed for %s: %s", self.segment, e)
+        old = self.segment
+        self.seq += 1
+        self.segment = make_segment_name(self.table, self.idx, self.seq)
+        self.mutable = MutableSegment(self.schema, self.segment, self.table)
+        # re-key BEFORE notifying the controller so the CONSUMING
+        # transition for the new name dedupes against this consumer
+        self.starter._consumers.pop(old, None)
+        self.starter._consumers[self.segment] = self
+        self.starter.server.add_segment(self.table, self.mutable)
+        try:
+            self.starter._post(
+                "/realtime/hlc/roll",
+                {"table": self.table, "server": self.starter.name,
+                 "idx": self.idx, "seq": self.seq},
+            )
+        except Exception as e:
+            # routing misses the new consuming segment until the
+            # validation/repair tick re-registers it; data is safe
+            logger.warning("HLC roll notify failed for %s: %s", self.segment, e)
+        logger.info("HLC sealed %s (%d rows), rolled to %s", old, committed.num_docs, self.segment)
         return True
 
 
@@ -211,6 +347,25 @@ class NetworkedServerStarter:
         self._threads: list = []
 
     # -- HTTP helpers --------------------------------------------------
+    def upload_segment_bytes(self, path: str, segment) -> Dict[str, Any]:
+        """Serialize a committed segment and POST it to the controller
+        (shared by the LLC committer and HLC seal paths)."""
+        import tempfile
+
+        from pinot_tpu.segment.format import write_segment
+
+        with tempfile.TemporaryDirectory() as td:
+            write_segment(segment, td)
+            with open(os.path.join(td, SEGMENT_FILE_NAME), "rb") as f:
+                data = f.read()
+        req = urllib.request.Request(
+            self.controller_url + path,
+            data=data,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
     def _post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         req = urllib.request.Request(
             self.controller_url + path,
@@ -284,9 +439,13 @@ class NetworkedServerStarter:
         try:
             if target == ONLINE:
                 # CONSUMING -> ONLINE: retire the consumer before the
-                # committed immutable copy replaces the mutable
-                consumer = self._consumers.pop(segment, None)
-                if consumer is not None:
+                # committed immutable copy replaces the mutable.  An HLC
+                # consumer rolls itself to the next sequence (it may
+                # still be keyed under the sealed name for an instant) —
+                # never stop it here.
+                consumer = self._consumers.get(segment)
+                if consumer is not None and not getattr(consumer, "rolls_locally", False):
+                    self._consumers.pop(segment, None)
                     consumer.stop()
                 ok = self._load(table, segment, msg.get("crc"))
             elif target == CONSUMING:
@@ -325,7 +484,10 @@ class NetworkedServerStarter:
         if not msg.get("streamDescriptor") or not msg.get("schemaJson"):
             logger.error("CONSUMING message for %s lacks a consume spec", segment)
             return False
-        consumer = RemoteConsumer(self, table, segment, msg)
+        if msg.get("consumerType") == "highlevel":
+            consumer = HLRemoteConsumer(self, table, segment, msg)
+        else:
+            consumer = RemoteConsumer(self, table, segment, msg)
         self._consumers[segment] = consumer
         consumer.start()
         return True

@@ -53,8 +53,11 @@ def cmd_quickstart(args) -> None:
 def cmd_network_realtime_quickstart(args) -> None:
     from pinot_tpu.tools.quickstart import run_network_realtime_quickstart
 
-    count = run_network_realtime_quickstart(num_events=args.events)
-    print(f"\nDONE networked realtime quickstart: {count} events ingested")
+    count = run_network_realtime_quickstart(
+        num_events=args.events, consumer_type=args.consumer_type
+    )
+    print(f"\nDONE networked realtime quickstart ({args.consumer_type}): "
+          f"{count} events ingested")
 
 
 def cmd_realtime_quickstart(args) -> None:
@@ -343,6 +346,8 @@ def main(argv=None) -> None:
 
     nrq = sub.add_parser("NetworkRealtimeQuickstart")
     nrq.add_argument("-events", type=int, default=2000)
+    nrq.add_argument("-consumer-type", default="lowlevel",
+                     choices=["lowlevel", "highlevel"], dest="consumer_type")
     nrq.set_defaults(fn=cmd_network_realtime_quickstart)
 
     sc = sub.add_parser("StartCluster")

@@ -127,7 +127,10 @@ def run_realtime_quickstart(
 
 
 def run_network_realtime_quickstart(
-    num_events: int = 2000, verbose: bool = True, data_dir: Optional[str] = None
+    num_events: int = 2000,
+    verbose: bool = True,
+    data_dir: Optional[str] = None,
+    consumer_type: str = "lowlevel",
 ):
     """Networked realtime quickstart: a real TCP stream-broker process
     boundary (realtime/netstream.py), a controller + server + broker as
@@ -149,7 +152,7 @@ def run_network_realtime_quickstart(
     stream_broker.start()
     host, port = stream_broker.address
     producer = NetworkStreamProvider(host, port, "meetupRsvp")
-    producer.create_topic(1)
+    producer.create_topic(1 if consumer_type == "lowlevel" else 2)
 
     def spawn(args, prefix="READY"):
         import os as _os
@@ -204,6 +207,7 @@ def run_network_realtime_quickstart(
                 stream_type="network",
                 topic="meetupRsvp",
                 rows_per_segment=500,
+                consumer_type=consumer_type,
                 properties={"host": host, "port": port},
             ),
         )

@@ -12,12 +12,16 @@ timeouts, and failure isolation.
 """
 from __future__ import annotations
 
+import logging
 import socket
 import struct
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 MAX_FRAME = 1 << 30
+
+
+logger = logging.getLogger(__name__)
 
 
 class TransportError(Exception):
@@ -75,7 +79,15 @@ class TcpServer:
         while self._running:
             try:
                 conn, _ = self._sock.accept()
-            except OSError:
+            except OSError as e:
+                if self._running:
+                    # transient failure (e.g. EMFILE under fd pressure)
+                    # must not kill the accept loop — only shutdown does
+                    logger.warning("accept failed on %s: %s", self.address, e)
+                    import time as _time
+
+                    _time.sleep(0.05)
+                    continue
                 return
             t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
             t.start()

@@ -1,0 +1,141 @@
+"""HLC (high-level consumer) ingestion mode: one consumer-group member
+per server, broker-coordinated partition rebalance, server-owned
+segments that seal and roll locally.
+
+Reference: ``HLRealtimeSegmentDataManager.java:54`` +
+``KafkaHighLevelConsumerStreamProvider.java`` (consumer groups replace
+controller-coordinated per-partition offsets)."""
+import json
+import signal
+import socket
+
+import pytest
+
+from pinot_tpu.common.tableconfig import StreamConfig, TableConfig
+from pinot_tpu.realtime.netstream import NetworkStreamProvider, StreamBrokerServer
+from pinot_tpu.tools.datagen import make_test_schema
+from tests.test_network_cluster import _get, _post_json, _spawn, _wait_for
+
+TABLE = "hlcTable"
+PHYSICAL = "hlcTable_REALTIME"
+
+
+def _row(i):
+    return {
+        "dimStr": f"v{i % 5}",
+        "dimInt": i % 7,
+        "dimLong": i,
+        "metInt": i,
+        "metFloat": 0.5 * i,
+        "metDouble": 0.25 * i,
+        "daysSinceEpoch": 17000 + i,
+    }
+
+
+@pytest.mark.slow
+def test_hlc_group_consumption_seal_roll_and_failover(tmp_path):
+    schema = make_test_schema(with_mv=False)
+    schema.schema_name = TABLE
+
+    procs = []
+    sb = StreamBrokerServer(log_dir=str(tmp_path / "streamlog"))
+    sb.start()
+    try:
+        host, port = sb.address
+        producer = NetworkStreamProvider(host, port, "hltopic")
+        producer.create_topic(4)
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ctrl_port = s.getsockname()[1]
+        s.close()
+        ctrl_proc, ctrl_url = _spawn(
+            ["StartController", "-port", str(ctrl_port),
+             "-data-dir", str(tmp_path / "store"), "-heartbeat-timeout", "3.0"]
+        )
+        procs.append(ctrl_proc)
+        srv_procs = {}
+        for name in ("h0", "h1"):
+            p, _ = _spawn(
+                ["StartServer", "-controller", ctrl_url, "-name", name,
+                 "-data-dir", str(tmp_path / f"cache_{name}")]
+            )
+            procs.append(p)
+            srv_procs[name] = p
+        broker_proc, broker_url = _spawn(["StartBroker", "-controller", ctrl_url, "-port", "0"])
+        procs.append(broker_proc)
+
+        _post_json(ctrl_url + "/schemas", schema.to_json())
+        config = TableConfig(
+            table_name=TABLE,
+            table_type="REALTIME",
+            stream=StreamConfig(
+                stream_type="network",
+                topic="hltopic",
+                rows_per_segment=50,
+                consumer_type="highlevel",
+                properties={"host": host, "port": port},
+            ),
+        )
+        _post_json(ctrl_url + "/tables", config.to_json())
+
+        def _query(pql):
+            return _post_json(broker_url + "/query", {"pql": pql})
+
+        def _count_is(n):
+            def check():
+                resp = _query(f"SELECT count(*) FROM {TABLE}")
+                return not resp.get("exceptions") and resp.get("numDocsScanned") == n
+            return check
+
+        # wait for BOTH members before producing: a lone member would
+        # legitimately drain the whole backlog first (assignments are
+        # correct either way; this keeps the scenario deterministic)
+        from pinot_tpu.realtime.netstream import HLConsumer
+
+        probe = HLConsumer(host, port, "hltopic", PHYSICAL, "probe")
+
+        def _group_formed():
+            d = probe.describe_group()
+            return len(d["members"]) == 2 and not d["syncPending"]
+
+        _wait_for(_group_formed, timeout=60, what="both servers in the group")
+
+        for i in range(60):
+            producer.produce(_row(i), partition=i % 4)
+        _wait_for(_count_is(60), timeout=90, what="60 rows via both group members")
+
+        resp = _query(f"SELECT sum(metInt) FROM {TABLE}")
+        assert float(resp["aggregationResults"][0]["value"]) == sum(range(60))
+
+        # kill one member before it seals: the group rebalances and the
+        # survivor re-consumes the dead member's partitions from the
+        # committed offsets (at-least-once, converging to exactly the
+        # produced rows once the dead server drops out of routing)
+        srv_procs["h1"].send_signal(signal.SIGKILL)
+        srv_procs["h1"].wait(timeout=10)
+        for i in range(60, 120):
+            producer.produce(_row(i), partition=i % 4)
+        _wait_for(_count_is(120), timeout=120, what="120 rows after failover rebalance")
+
+        # the survivor has consumed >= 100 rows: its segment sealed,
+        # uploaded pinned to it, and consumption rolled to seq 1+
+        def _sealed_segment_online():
+            view = _get(ctrl_url + f"/tables/{PHYSICAL}/externalview")
+            return any(st == "ONLINE" for reps in view.values() for st in reps.values())
+
+        _wait_for(_sealed_segment_online, timeout=60, what="sealed HLC segment ONLINE")
+        resp = _query(f"SELECT sum(metInt) FROM {TABLE}")
+        assert not resp.get("exceptions"), resp
+        assert float(resp["aggregationResults"][0]["value"]) == sum(range(120))
+
+        # group offsets are checkpointed in the stream broker
+        committed = probe.committed_offsets()
+        assert committed and sum(committed.values()) >= 50
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+            except Exception:
+                pass
+        sb.stop()
