@@ -485,6 +485,12 @@ class NetworkedServerStarter:
             logger.error("CONSUMING message for %s lacks a consume spec", segment)
             return False
         if msg.get("consumerType") == "highlevel":
+            # one group member per (server, table): a replayed CONSUMING
+            # for an older sequence (e.g. after controller recovery)
+            # must not start a second consumer under the same member id
+            for c in self._consumers.values():
+                if getattr(c, "rolls_locally", False) and c.table == table:
+                    return True
             consumer = HLRemoteConsumer(self, table, segment, msg)
         else:
             consumer = RemoteConsumer(self, table, segment, msg)
