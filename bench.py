@@ -74,18 +74,9 @@ def _kernel_rows_per_sec(segments, iters: int):
     assert plan.on_device, "bench query must run on device"
     q_np = build_query_inputs(request, plan, ctx, staged)
 
-    import jax.numpy as jnp
+    from pinot_tpu.engine.device import to_device_inputs
 
-    def conv(x):
-        if isinstance(x, np.ndarray):
-            return jnp.asarray(x)
-        if isinstance(x, list):
-            return [conv(v) for v in x]
-        if isinstance(x, dict):
-            return {k: conv(v) for k, v in x.items()}
-        return x
-
-    q_inputs = conv(q_np)
+    q_inputs = to_device_inputs(q_np)
     seg_arrays = segment_arrays(staged, needed)
     kernel = make_table_kernel(plan)
     total_rows = sum(s.num_docs for s in segments)
@@ -170,7 +161,32 @@ def _broker_latencies(segments, queries_per_round: int = 40):
     return report, selective
 
 
+def _probe_tpu(timeout_s: float = 180.0) -> bool:
+    """True when the TPU backend initializes in a SUBPROCESS within the
+    timeout.  The axon tunnel can wedge so hard that jax.devices()
+    blocks forever in-process; probing out-of-process keeps this
+    process clean to fall back to CPU."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
 def main() -> None:
+    if not _probe_tpu():
+        # tunnel down: report CPU numbers rather than hanging the run
+        from pinot_tpu.utils.platform import force_cpu_mesh
+
+        force_cpu_mesh(1)
+
     import jax
 
     platform = jax.devices()[0].platform

@@ -82,7 +82,10 @@ def pad_card(c: int) -> int:
 # raw array for aggregation reads; at or below it, the kernel gathers
 # dict_vals[fwd] (fwd is int8/int16 -> strictly fewer HBM bytes than a
 # float32 stream, and VMEM-resident small-table gathers are cheap).
-RAW_CARD_MIN = 1 << 15
+# Env-overridable for on-chip A/B of the gather-vs-stream tradeoff.
+import os as _os
+
+RAW_CARD_MIN = int(_os.environ.get("PINOT_TPU_RAW_CARD_MIN", str(1 << 15)))
 
 
 def index_dtype(max_exclusive: int):

@@ -290,6 +290,18 @@ def clear_staging_cache() -> None:
     _stage_cache.clear()
 
 
+def to_device_inputs(tree):
+    """Recursively convert a numpy pytree (query inputs) to device
+    arrays — the one converter production and benchmarks share."""
+    if isinstance(tree, np.ndarray):
+        return jnp.asarray(tree)
+    if isinstance(tree, list):
+        return [to_device_inputs(v) for v in tree]
+    if isinstance(tree, dict):
+        return {k: to_device_inputs(v) for k, v in tree.items()}
+    return tree
+
+
 def segment_arrays(staged: StagedTable, needed) -> Dict[str, jnp.ndarray]:
     """Assemble the kernel's ``seg`` pytree for the given columns.
 

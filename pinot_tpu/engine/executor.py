@@ -328,16 +328,9 @@ class QueryExecutor:
         return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols))
 
     def _to_device_inputs(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
-        def conv(x):
-            if isinstance(x, np.ndarray):
-                return jnp.asarray(x)
-            if isinstance(x, list):
-                return [conv(v) for v in x]
-            if isinstance(x, dict):
-                return {k: conv(v) for k, v in x.items()}
-            return x
+        from pinot_tpu.engine.device import to_device_inputs
 
-        return conv(inputs)
+        return to_device_inputs(inputs)
 
     def _empty_result(self, request: BrokerRequest, total_docs: int) -> IntermediateResult:
         res = IntermediateResult(total_docs=total_docs)

@@ -208,3 +208,75 @@ def main() -> None:
 
 if __name__ == "__main__":
     main()
+
+
+def bench_staging_ab(rows: int) -> Dict:
+    """A/B the agg-column staging policy on the current backend: narrow
+    fwd + in-kernel dictionary gather vs dictionary-decoded float raw
+    stream, on the TPC-H-Q1 kernel shape.  Run on the real chip to pick
+    RAW_CARD_MIN (config.py); the gather's VMEM-table cost vs the raw
+    stream's 2-4x HBM bytes is hardware-dependent."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pinot_tpu.engine.context import get_table_context
+    from pinot_tpu.engine.device import segment_arrays, stage_segments
+    from pinot_tpu.engine.kernel import make_table_kernel
+    from pinot_tpu.engine.plan import build_query_inputs, build_static_plan
+    from pinot_tpu.pql import optimize_request, parse_pql
+    from pinot_tpu.tools.datagen import synthetic_lineitem_segment
+
+    segs = [synthetic_lineitem_segment(rows, seed=31 + i, name=f"ab{i}") for i in range(2)]
+    pql = ("SELECT sum(l_quantity), sum(l_extendedprice), sum(l_discount), count(*) "
+           "FROM lineitem WHERE l_shipdate <= '1998-09-02' "
+           "GROUP BY l_returnflag, l_linestatus TOP 10")
+    request = optimize_request(parse_pql(pql))
+    ctx = get_table_context(segs)
+    needed = sorted(set(request.referenced_columns()))
+
+    def run_mode(raw_cols):
+        staged = stage_segments(
+            segs, needed, raw_columns=raw_cols,
+            gfwd_columns=("l_returnflag", "l_linestatus"), ctx=ctx,
+        )
+        plan = build_static_plan(request, ctx, staged)
+        q = build_query_inputs(request, plan, ctx, staged)
+
+        def conv(x):
+            if isinstance(x, np.ndarray):
+                return jnp.asarray(x)
+            if isinstance(x, list):
+                return [conv(v) for v in x]
+            if isinstance(x, dict):
+                return {k: conv(v) for k, v in x.items()}
+            return x
+
+        qi = conv(q)
+        arrays = segment_arrays(staged, needed)
+        kernel = make_table_kernel(plan)
+        jax.block_until_ready(kernel(arrays, qi)["num_docs"])  # compile
+        t0 = time.perf_counter()
+        n = 10
+        out = None
+        for _ in range(n):
+            out = kernel(arrays, qi)
+        jax.block_until_ready(out["num_docs"])
+        return (time.perf_counter() - t0) / n * 1000
+
+    gather_ms = run_mode(())
+    raw_ms = run_mode(("l_quantity", "l_extendedprice", "l_discount"))
+    total = rows * 2
+    return {
+        "name": "staging_ab_q1",
+        "rows": total,
+        "gather_ms": round(gather_ms, 3),
+        "raw_ms": round(raw_ms, 3),
+        "gather_rows_per_sec": round(total / (gather_ms / 1000), 1),
+        "raw_rows_per_sec": round(total / (raw_ms / 1000), 1),
+    }
+
+
+BENCHES["staging_ab"] = bench_staging_ab
