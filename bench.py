@@ -132,7 +132,12 @@ def _broker_latencies(segments, queries_per_round: int = 40):
         "lineitem", {s.segment_name: {"benchServer": "ONLINE"} for s in segments}
     )
     broker = BrokerRequestHandler(
-        transport, {"benchServer": ("benchServer", 0)}, routing=routing
+        transport,
+        {"benchServer": ("benchServer", 0)},
+        routing=routing,
+        # first broker-path query pays staging ~1GB of columns over the
+        # tunnel + compile; the serving default (15s) is for steady state
+        timeout_ms=600_000.0,
     )
 
     def run(pql: str) -> None:
@@ -203,6 +208,14 @@ def main() -> None:
 
     segments = _build_segments(num_segments, rows_per_segment)
     rows_per_sec, per_query_ms, e2e_ms = _kernel_rows_per_sec(segments, iters)
+    import sys
+
+    print(
+        f"# kernel phase done: {rows_per_sec:,.0f} rows/s "
+        f"({per_query_ms:.2f} ms/query device-marginal)",
+        file=sys.stderr,
+        flush=True,
+    )
     broker_report, selective = _broker_latencies(segments)
     rj = broker_report.to_json()
     p50_s = max(broker_report.percentile(50), 1e-6) / 1000.0
@@ -218,6 +231,10 @@ def main() -> None:
                 "value": round(rows_per_sec, 1),
                 "unit": "rows/s",
                 "vs_baseline": round(total_rows / p50_s / BASELINE_ROWS_PER_SEC, 3),
+                # the north-star target is an on-chip number (BASELINE.md
+                # "on v5e-8"); a CPU fallback is an environment artifact
+                # (tunnel down), not a measurement of the design
+                "degraded": not on_tpu,
                 "detail": {
                     "vs_baseline_kernel_marginal": round(
                         rows_per_sec / BASELINE_ROWS_PER_SEC, 3

@@ -22,9 +22,10 @@ from __future__ import annotations
 
 import json
 import os
-import tempfile
 import threading
 from typing import Any, Dict, List, Optional
+
+from pinot_tpu.utils.fileio import atomic_write
 
 _SAFE = "-_"  # NOT '.', or a '..' component would survive encoding
 
@@ -60,15 +61,7 @@ class PropertyStore:
         path = self._path(namespace, key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with self._lock:
-            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
-            try:
-                with os.fdopen(fd, "w") as f:
-                    json.dump(record, f)
-                os.replace(tmp, path)
-            except BaseException:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
-                raise
+            atomic_write(path, json.dumps(record))
 
     def get(self, namespace: str, key: str) -> Optional[Dict[str, Any]]:
         path = self._path(namespace, key)

@@ -238,6 +238,12 @@ class QueryExecutor:
         block = zonemap.zone_block_rows()
         nb_total = staged.num_segments * (staged.n_pad // block)
         nb_max = int(cand.sum(axis=1).max()) if cand.size else 0
+        if plan.selection is not None:
+            # the gathered view exposes only nb_pad*block rows per
+            # segment; top_k(k) requires k <= operand length, so grow
+            # the candidate window to cover the selection k (falls back
+            # to full scan below when that defeats the pruning win)
+            nb_max = max(nb_max, -(-plan.selection.k // block))
         nb_pad = 1
         while nb_pad < nb_max:
             nb_pad *= 2

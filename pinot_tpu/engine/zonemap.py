@@ -70,6 +70,11 @@ def column_zones(
             cache[key] = z
             return z
     if z is None:
+        if col.fwd is None:
+            # no persisted zones to derive from and nothing to scan:
+            # degrade to all-candidate (matches the MV handling) rather
+            # than crash the query-time pruning path
+            return None
         fwd = np.asarray(col.fwd)
         n = fwd.size
         nb = -(-n // block) if n else 0

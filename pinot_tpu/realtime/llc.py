@@ -242,17 +242,40 @@ class RealtimeSegmentManager:
         # collide with a historical sealed segment name
         owners = set()
         max_seq: Dict[int, int] = {}
+        idx_last: Dict[int, set] = {}  # replica set of the newest segment per idx
+        consuming_idx = set()
         for seg, replicas in ideal.items():
             try:
                 _, idx, seq = parse_segment_name(seg)
             except ValueError:
                 continue
-            max_seq[idx] = max(max_seq.get(idx, -1), seq)
+            if seq > max_seq.get(idx, -1):
+                max_seq[idx] = seq
+                idx_last[idx] = set(replicas)
             if CONSUMING in replicas.values():
                 owners.update(replicas)
+                consuming_idx.add(idx)
         next_idx = 0
         for server in live:
             if server in owners:
+                continue
+            # Mid-roll (sealed upload flipped the entry ONLINE before the
+            # roll registered the successor) or crash-after-seal: the
+            # server still owns the idx whose newest segment is pinned to
+            # it.  Continue that idx at the next sequence — the name
+            # matches what the server's own /realtime/hlc/roll would
+            # register, so both paths dedupe instead of this tick opening
+            # a phantom CONSUMING segment at a fresh idx that no consumer
+            # will ever serve.
+            resumed = False
+            for idx in sorted(max_seq):
+                if idx not in consuming_idx and server in idx_last.get(idx, ()):
+                    self._create_hlc_segment(
+                        physical, server, idx, seq=max_seq[idx] + 1
+                    )
+                    resumed = True
+                    break
+            if resumed:
                 continue
             while next_idx in max_seq:
                 next_idx += 1

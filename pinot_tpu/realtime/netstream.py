@@ -48,6 +48,8 @@ from pinot_tpu.transport.tcp import TcpServer, TcpTransport
 
 logger = logging.getLogger(__name__)
 
+from pinot_tpu.utils.fileio import atomic_write as _atomic_write  # noqa: E402
+
 Row = Dict[str, Any]
 
 
@@ -78,8 +80,9 @@ class _Topic:
                 rows.append(json.loads(line))
             except json.JSONDecodeError:
                 if i == len(lines) - 1:
-                    with open(path, "w") as f:
-                        f.write("".join(l + "\n" for l in lines[:i]))
+                    # drop the torn tail atomically: a crash *during
+                    # recovery* must not lose the whole log
+                    _atomic_write(path, "".join(l + "\n" for l in lines[:i]))
                     break
                 raise
         return rows
@@ -228,10 +231,7 @@ class StreamBrokerServer:
             f"{group}\x00{topic}": g.offsets
             for (group, topic), g in self._groups.items()
         }
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(data, f)
-        os.replace(tmp, path)
+        _atomic_write(path, json.dumps(data))
 
     def _group_op(self, op: str, req: Dict[str, Any]) -> bytes:
         """join / heartbeat / leave / commit / committed — must be
@@ -501,7 +501,17 @@ class HLConsumer:
                 else:
                     self.commit()
             except Exception:
-                pass
+                # The hook owns persist-or-discard of locally consumed
+                # rows and handles its own failures (seal/upload errors
+                # discard + reset internally); it raising means local
+                # state is unknown.  Keep positions as-is — join() floors
+                # them at committed, and guessing here (e.g. resetting)
+                # would re-fetch rows whose seal already made them
+                # durable.  Surface the bug loudly instead of silently
+                # continuing (ADVICE r2).
+                logger.exception(
+                    "on_revoke failed for %s/%s", self.group, self.consumer_id
+                )
             self.join()
         if self.sync_pending:
             # rebalance sync barrier: hold fetches until every member

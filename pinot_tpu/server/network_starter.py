@@ -262,9 +262,23 @@ class HLRemoteConsumer:
         stay uncommitted, so the successor re-reads them; keeping them
         in our mutable would double-count."""
         if self.mutable.num_docs == 0:
-            self.consumer.commit()
+            try:
+                self.consumer.commit()
+            except Exception as e:
+                # every consumed row is already durable in sealed
+                # segments; a failed commit only means a successor
+                # re-reads from older committed offsets (at-least-once)
+                logger.warning("HLC revoke-time offset commit failed: %s", e)
             return
-        if not self._seal_and_roll():
+        try:
+            sealed = self._seal_and_roll()
+        except Exception:
+            # e.g. to_committed_segment() failed: fall through to the
+            # discard path — the hook must leave the member in a known
+            # state rather than raise into the consumer
+            logger.exception("HLC seal during revoke failed for %s", self.segment)
+            sealed = False
+        if not sealed:
             old = self.segment
             self.mutable = MutableSegment(self.schema, self.segment, self.table)
             self.starter.server.add_segment(self.table, self.mutable)

@@ -1,0 +1,28 @@
+"""Durable file-write helpers shared by the stream broker's log
+recovery/offset persistence and the controller property store.
+
+The reference gets this durability from ZooKeeper (writes are
+replicated + fsynced by ZK, ``common/metadata/`` records); the
+file-backed analogs here need tmp+fsync+rename so a crash at any point
+leaves either the old or the new content, never neither."""
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def atomic_write(path: str, text: str) -> None:
+    """Write ``text`` to ``path`` via a same-directory temp file with
+    fsync-before-rename (crash-durable whole-file replace)."""
+    dirname = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise

@@ -139,3 +139,52 @@ def test_hlc_group_consumption_seal_roll_and_failover(tmp_path):
             except Exception:
                 pass
         sb.stop()
+
+
+def test_ensure_hlc_consumers_resumes_owned_idx_mid_roll():
+    """Regression (ADVICE r2): the seal-and-roll window — the sealed
+    upload flips the server's segment entry ONLINE before the roll
+    registers the successor.  ``ensure_hlc_consumers`` running in that
+    window must continue the server's own idx at the next sequence (the
+    name the server's roll will also register, so both dedupe), not open
+    a phantom CONSUMING segment at a fresh idx that no consumer serves."""
+    from pinot_tpu.controller.resource_manager import (
+        CONSUMING,
+        ONLINE,
+        ClusterResourceManager,
+        InstanceState,
+    )
+    from pinot_tpu.realtime.llc import RealtimeSegmentManager, make_segment_name
+    from pinot_tpu.realtime.stream import MemoryStreamProvider
+
+    rm = ClusterResourceManager()
+    rm.register_instance(InstanceState(name="srvA", role="server"))
+    schema = make_test_schema(with_mv=False)
+    schema.schema_name = "hlcRace"
+    config = TableConfig(
+        table_name="hlcRace",
+        table_type="REALTIME",
+        stream=StreamConfig(stream_type="memory", topic="t", consumer_type="highlevel"),
+    )
+    mgr = RealtimeSegmentManager(rm, store=None)
+    physical = mgr.setup_table(config, schema, MemoryStreamProvider(2))
+
+    seg0 = make_segment_name(physical, 0, 0)
+    ideal = rm.get_ideal_state(physical)
+    assert ideal.get(seg0) == {"srvA": CONSUMING}
+
+    # simulate the mid-roll window: sealed upload replaced the entry
+    # (ONLINE, still pinned to srvA); the roll has NOT registered seq 1
+    with rm._lock:
+        rm.ideal_states[physical][seg0] = {"srvA": ONLINE}
+
+    mgr.ensure_hlc_consumers(physical)
+    ideal = rm.get_ideal_state(physical)
+    seg1 = make_segment_name(physical, 0, 1)
+    assert ideal.get(seg1) == {"srvA": CONSUMING}, ideal
+    # no phantom fresh-idx segment
+    assert set(ideal) == {seg0, seg1}, ideal
+
+    # the server's own roll for the same name dedupes controller-side
+    mgr.register_hlc_roll(physical, "srvA", 0, 1)
+    assert set(rm.get_ideal_state(physical)) == {seg0, seg1}

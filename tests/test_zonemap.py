@@ -299,3 +299,21 @@ def test_persisted_zones_reblock_to_coarser(tmp_path, monkeypatch):
     want = zonemap.column_zones(seg, "l_shipdate", 1024)
     np.testing.assert_array_equal(got[0], want[0])
     np.testing.assert_array_equal(got[1], want[1])
+
+
+def test_selection_limit_beyond_candidate_window(cluster):
+    """Regression (ADVICE r2): a selective filter with one candidate
+    block but LIMIT+OFFSET > block rows must not feed top_k a k larger
+    than the gathered view — the candidate window grows (or the plan
+    falls back to the full scan) and results still match the oracle."""
+    segs, oracle = cluster
+    ex = QueryExecutor()
+    q = (
+        "SELECT l_shipdate, l_quantity FROM lineitem "
+        "WHERE l_shipdate = '1995-06-14' "
+        f"ORDER BY l_quantity DESC LIMIT {BLOCK + 200}"
+    )
+    req = optimize_request(parse_pql(q))
+    req2 = optimize_request(parse_pql(q))
+    got = reduce_to_response(req, [ex.execute(segs, req)])
+    assert _norm(got) == _norm(oracle.execute(req2))
