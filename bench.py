@@ -61,12 +61,21 @@ def _kernel_rows_per_sec(segments, iters: int):
     request = optimize_request(parse_pql(Q1_PQL))
     ctx = get_table_context(segments)
     needed = sorted(set(request.referenced_columns()))
-    # agg columns here are all low-cardinality (quantity 50, discount 11,
-    # extendedprice 16k): they stage as uint8/uint16 fwd + dictionary
-    # gather, not float32 raw streams (config.RAW_CARD_MIN policy)
+    # agg inputs stage as raw float32 streams on TPU (dict gathers
+    # serialize — 159x slower on v5e, see engine/config.py raw_card_min);
+    # this mirrors what executor._role_columns stages for the broker path
+    from pinot_tpu.engine.config import raw_card_min
+
+    agg_cols = ("l_quantity", "l_extendedprice", "l_discount")
+    raw_cols = tuple(
+        c
+        for c in agg_cols
+        if max(s.column(c).metadata.cardinality for s in segments) > raw_card_min()
+    )
     staged = stage_segments(
         segments,
         needed,
+        raw_columns=raw_cols,
         gfwd_columns=("l_returnflag", "l_linestatus"),
         ctx=ctx,
     )

@@ -78,14 +78,36 @@ def pad_card(c: int) -> int:
 # except the "unpack" is a free in-register upcast on TPU.
 # ---------------------------------------------------------------------------
 
-# Columns with cardinality above this stage a dictionary-decoded float
-# raw array for aggregation reads; at or below it, the kernel gathers
-# dict_vals[fwd] (fwd is int8/int16 -> strictly fewer HBM bytes than a
-# float32 stream, and VMEM-resident small-table gathers are cheap).
-# Env-overridable for on-chip A/B of the gather-vs-stream tradeoff.
+# Agg-input feed policy: columns with cardinality above raw_card_min()
+# stage a dictionary-decoded float raw array for aggregation reads; at
+# or below it, the kernel gathers dict_vals[fwd].
+#
+# Measured on a real v5e chip (2026-07-30, tools/microbench.py
+# `gather_vs_raw`): XLA lowers the per-row dict gather to a serialized
+# loop — ~12.5 ns/element, 159x slower than streaming a raw float32
+# array (1257 ms vs 7.9 ms for TPC-H Q1 over 33.5M rows; raw-feed hits
+# 4.25 B rows/s vs the 295 GB/s stream roofline).  So on accelerators
+# the threshold defaults to 0: ALWAYS stage raw feeds — the 4x HBM
+# bytes/row are far cheaper than any gather.  On CPU (tests) vector
+# gathers are cheap and narrow staging halves memory, so the old
+# threshold stands.  Env-overridable for A/B (PINOT_TPU_RAW_CARD_MIN).
 import os as _os
 
-RAW_CARD_MIN = int(_os.environ.get("PINOT_TPU_RAW_CARD_MIN", str(1 << 15)))
+_raw_card_min: int | None = None
+
+
+def raw_card_min() -> int:
+    """Lazy so importing config never initializes a jax backend (tests
+    must force the CPU mesh before first backend init)."""
+    global _raw_card_min
+    env = _os.environ.get("PINOT_TPU_RAW_CARD_MIN")
+    if env is not None:
+        return int(env)
+    if _raw_card_min is None:
+        import jax
+
+        _raw_card_min = (1 << 15) if jax.default_backend() == "cpu" else 0
+    return _raw_card_min
 
 
 def index_dtype(max_exclusive: int):

@@ -305,12 +305,13 @@ class QueryExecutor:
             return m.single_value and m.data_type.stored_type != DataType.STRING
 
         def big_card(c: str) -> bool:
-            # below RAW_CARD_MIN the fwd index stages narrow (uint8/16)
-            # and a VMEM dictionary gather beats streaming float32 raws;
-            # the staged dtype is sized by the table-wide max cardinality,
-            # so the decision must be too
+            # raw_card_min() is 0 on accelerators (TPU gathers serialize
+            # — see engine/config.py measurement); on CPU the narrow
+            # fwd + dict-gather feed stands below the threshold.  The
+            # staged dtype is sized by the table-wide max cardinality,
+            # so the decision must be too.
             card = max(s.column(c).metadata.cardinality for s in live)
-            return card > config.RAW_CARD_MIN
+            return card > config.raw_card_min()
 
         def sv(c: str) -> bool:
             return c in seg.columns and seg.column(c).metadata.single_value

@@ -273,6 +273,13 @@ class RealtimeSegmentManager:
                     self._create_hlc_segment(
                         physical, server, idx, seq=max_seq[idx] + 1
                     )
+                    # mark the idx consumed so a second live server in
+                    # the same replica set (replication > 1 after a
+                    # rebalance) doesn't no-op on the deduped name and
+                    # end the tick with no CONSUMING segment at all —
+                    # it falls through to a fresh idx instead
+                    max_seq[idx] += 1
+                    consuming_idx.add(idx)
                     resumed = True
                     break
             if resumed:

@@ -45,10 +45,10 @@ import logging
 
 from pinot_tpu.realtime.stream import StreamProvider
 from pinot_tpu.transport.tcp import TcpServer, TcpTransport
+from pinot_tpu.utils.fileio import atomic_write
 
 logger = logging.getLogger(__name__)
 
-from pinot_tpu.utils.fileio import atomic_write as _atomic_write  # noqa: E402
 
 Row = Dict[str, Any]
 
@@ -82,7 +82,7 @@ class _Topic:
                 if i == len(lines) - 1:
                     # drop the torn tail atomically: a crash *during
                     # recovery* must not lose the whole log
-                    _atomic_write(path, "".join(l + "\n" for l in lines[:i]))
+                    atomic_write(path, "".join(l + "\n" for l in lines[:i]))
                     break
                 raise
         return rows
@@ -231,7 +231,7 @@ class StreamBrokerServer:
             f"{group}\x00{topic}": g.offsets
             for (group, topic), g in self._groups.items()
         }
-        _atomic_write(path, json.dumps(data))
+        atomic_write(path, json.dumps(data))
 
     def _group_op(self, op: str, req: Dict[str, Any]) -> bytes:
         """join / heartbeat / leave / commit / committed — must be
