@@ -157,21 +157,49 @@ def _broker_latencies(segments, queries_per_round: int = 40):
     runner.single_thread([Q1_PQL], rounds=3)  # warm: stage + compile
     report = runner.single_thread([Q1_PQL] * queries_per_round, rounds=1)
 
-    # Selective point query (~0.04% of rows, clustered date): measures
-    # the zone-map block-skipping path (engine/zonemap.py) vs the full
-    # scan it replaces — the reference answers this shape via inverted
-    # indexes in O(matches) (VERDICT r1 #4).
-    sel_pql = (
+    # Selective point queries (~0.05% of rows): three engine paths ----
+    #  - invindex: host postings, O(matches), doc-order independent
+    #    (engine/invindex_path.py — BitmapBasedFilterOperator analog)
+    #  - zonemap: device block-gather, needs clustered values
+    #  - fullscan: the device scan kernel
+    # The clustered date column exercises all three; the SHUFFLED
+    # high-cardinality l_extendedprice column is the case zone maps
+    # cannot prune (VERDICT r2 #2) — the postings path must hold there.
+    sel_clustered = (
         "SELECT sum(l_extendedprice), count(*) FROM lineitem "
         "WHERE l_shipdate = '1995-06-14'"
     )
+    d_price = segments[0].column("l_extendedprice").dictionary
+    pv = d_price.get(d_price.cardinality // 2)
+    sel_shuffled = (
+        f"SELECT sum(l_quantity), count(*) FROM lineitem "
+        f"WHERE l_extendedprice = {pv!r}"
+    )
+    # every row pins BOTH flags explicitly so ambient env can't
+    # mislabel a path; prior values are restored afterwards
+    matrix = [
+        ("clustered", sel_clustered, "invindex", "1", "0"),
+        ("clustered", sel_clustered, "zonemap", "0", "1"),
+        ("clustered", sel_clustered, "fullscan", "0", "0"),
+        ("shuffled", sel_shuffled, "invindex", "1", "0"),
+        ("shuffled", sel_shuffled, "fullscan", "0", "0"),
+    ]
+    flags = ("PINOT_TPU_INVINDEX", "PINOT_TPU_ZONEMAP")
+    saved = {k: os.environ.get(k) for k in flags}
     selective = {}
-    for flag, label in (("1", "zonemap"), ("0", "fullscan")):
-        os.environ["PINOT_TPU_ZONEMAP"] = flag
-        runner.single_thread([sel_pql], rounds=3)  # warm + compile
-        r = runner.single_thread([sel_pql] * 20, rounds=1)
-        selective[f"selective_p50_ms_{label}"] = r.to_json()["p50Ms"]
-    os.environ.pop("PINOT_TPU_ZONEMAP", None)
+    try:
+        for shape, pql, label, inv, zm in matrix:
+            os.environ["PINOT_TPU_INVINDEX"] = inv
+            os.environ["PINOT_TPU_ZONEMAP"] = zm
+            runner.single_thread([pql], rounds=3)  # warm + compile
+            r = runner.single_thread([pql] * 20, rounds=1)
+            selective[f"sel_{shape}_p50_ms_{label}"] = r.to_json()["p50Ms"]
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     return report, selective
 
 

@@ -24,7 +24,6 @@ from pinot_tpu.common.values import render_value
 from pinot_tpu.engine import config
 from pinot_tpu.engine.context import TableContext, get_table_context
 from pinot_tpu.engine.device import StagedTable, get_staged
-from pinot_tpu.engine.kernel import make_table_kernel
 from pinot_tpu.engine.plan import StaticPlan, build_query_inputs, build_static_plan
 from pinot_tpu.engine.pruner import prune_segments
 from pinot_tpu.engine.results import (
@@ -117,6 +116,16 @@ class QueryExecutor:
         needed -= self._docrange_only_columns(request, live, sel_columns)
 
         ctx = get_table_context(live)
+
+        # selective predicates answer from host postings in O(matches)
+        # (engine/invindex_path.py — BitmapBasedFilterOperator analog);
+        # unselective ones fall through to the device scan below
+        from pinot_tpu.engine.invindex_path import try_index_path
+
+        ires = try_index_path(request, live, ctx, total_docs, sel_columns)
+        if ires is not None:
+            self._phase("indexPath", t0)
+            return ires
         raw_cols, gfwd_cols = self._role_columns(request, live)
         staged = get_staged(
             live,
@@ -141,14 +150,16 @@ class QueryExecutor:
         seg_arrays = segment_arrays(staged, needed)
         block_ids, scanned_rows = self._block_skip_ids(plan, q_np, live, staged)
         t0 = self._phase("planBuild", t0)
+        # kernels return host numpy via ONE packed D2H transfer
+        # (engine/packing.py): per-leaf fetches pay a tunnel RTT each
         if block_ids is not None:
             from pinot_tpu.engine.zonemap import zone_block_rows
 
             block = zone_block_rows()
             if self.mesh is None:
-                from pinot_tpu.engine.kernel import make_block_table_kernel
+                from pinot_tpu.engine.kernel import make_packed_block_table_kernel
 
-                kernel = make_block_table_kernel(plan, block)
+                kernel = make_packed_block_table_kernel(plan, block)
             else:
                 kernel = self._block_kernel(plan, block)
             outs = kernel(seg_arrays, q_inputs, jnp.asarray(block_ids))
@@ -267,20 +278,27 @@ class QueryExecutor:
         return k
 
     def _block_kernel(self, plan: StaticPlan, block: int):
+        from pinot_tpu.engine.packing import make_packed_kernel
         from pinot_tpu.parallel.multichip import make_sharded_block_table_kernel
 
         return self._cached_sharded(
             (plan, "block", block),
-            lambda: make_sharded_block_table_kernel(plan, self.mesh, block),
+            lambda: make_packed_kernel(
+                make_sharded_block_table_kernel(plan, self.mesh, block)
+            ),
         )
 
     def _kernel(self, plan: StaticPlan):
         if self.mesh is None:
-            return make_table_kernel(plan)
+            from pinot_tpu.engine.kernel import make_packed_table_kernel
+
+            return make_packed_table_kernel(plan)
+        from pinot_tpu.engine.packing import make_packed_kernel
         from pinot_tpu.parallel.multichip import make_sharded_table_kernel
 
         return self._cached_sharded(
-            plan, lambda: make_sharded_table_kernel(plan, self.mesh)
+            plan,
+            lambda: make_packed_kernel(make_sharded_table_kernel(plan, self.mesh)),
         )
 
     # ------------------------------------------------------------------

@@ -32,6 +32,7 @@ from pinot_tpu.engine import config
 from pinot_tpu.engine.context import TableContext
 from pinot_tpu.engine.plan import match_table
 from pinot_tpu.engine.results import (
+    AggPartial,
     AvgPartial,
     CountPartial,
     IntermediateResult,
@@ -86,6 +87,25 @@ def _vectorizable_groupby(request: BrokerRequest, segments, ctx: TableContext) -
         space *= max(ctx.column(c).global_cardinality, 1)
         if space >= (1 << 62):
             return False
+    return _vectorizable_aggs(request, segments)
+
+
+def _default_matched_rows(request: BrokerRequest):
+    """Row-id resolver: full vectorized mask + nonzero (O(n) host scan).
+    The inverted-index path (engine/invindex_path.py) substitutes an
+    O(matches) postings resolver through the same seam."""
+
+    def resolve(si: int, seg: ImmutableSegment) -> np.ndarray:
+        return np.nonzero(_segment_mask(seg, request.filter))[0]
+
+    return resolve
+
+
+def _vectorizable_aggs(request: BrokerRequest, segments) -> bool:
+    """True when every aggregation fits the numpy fast paths:
+    scalar/pair functions over SV numeric columns (shared check of the
+    group-by and aggregation-only vectorized paths)."""
+    seg = segments[0]
     for a in request.aggregations:
         if a.base_function not in _VECTOR_AGGS:
             return False
@@ -99,11 +119,65 @@ def _vectorizable_groupby(request: BrokerRequest, segments, ctx: TableContext) -
     return True
 
 
+def _aggregation_vectorized(
+    segments: List[ImmutableSegment],
+    request: BrokerRequest,
+    res: IntermediateResult,
+    matched_rows,
+) -> None:
+    """Scalar/pair aggregations over matched rows via numpy
+    fancy-indexing — O(matches) when the resolver is postings-backed
+    (engine/invindex_path.py), O(n) under the default mask resolver."""
+    needed = {
+        a.column
+        for a in request.aggregations
+        if a.base_function != "count" and a.column != "*"
+    }
+    col_sum = {c: 0.0 for c in needed}
+    col_min = {c: float("inf") for c in needed}
+    col_max = {c: float("-inf") for c in needed}
+    total = 0
+    for si, seg in enumerate(segments):
+        matched = matched_rows(si, seg)
+        res.num_docs_scanned += int(matched.size)
+        total += int(matched.size)
+        if matched.size == 0:
+            continue
+        for c in needed:
+            col = seg.column(c)
+            vals = np.asarray(col.dictionary.values, dtype=np.float64)[
+                np.asarray(col.fwd)[matched]
+            ]
+            col_sum[c] += float(vals.sum())
+            col_min[c] = min(col_min[c], float(vals.min()))
+            col_max[c] = max(col_max[c], float(vals.max()))
+    if total == 0:
+        res.aggregations = [make_partial(a.base_function) for a in request.aggregations]
+        return
+    out: List[AggPartial] = []
+    for a in request.aggregations:
+        b = a.base_function
+        if b == "count":
+            out.append(CountPartial(float(total)))
+        elif b == "sum":
+            out.append(SumPartial(col_sum[a.column]))
+        elif b == "avg":
+            out.append(AvgPartial(col_sum[a.column], float(total)))
+        elif b == "min":
+            out.append(MinPartial(col_min[a.column]))
+        elif b == "max":
+            out.append(MaxPartial(col_max[a.column]))
+        else:
+            out.append(MinMaxRangePartial(col_min[a.column], col_max[a.column]))
+    res.aggregations = out
+
+
 def _groupby_vectorized(
     segments: List[ImmutableSegment],
     ctx: TableContext,
     request: BrokerRequest,
     res: IntermediateResult,
+    matched_rows=None,
 ) -> None:
     """Vectorized LONG_MAP_BASED analog: one int64 key per matched row,
     factorized with np.unique; sums/counts via bincount, min/max via
@@ -120,11 +194,12 @@ def _groupby_vectorized(
         if a.base_function != "count" and a.column != "*"
     }
 
+    if matched_rows is None:
+        matched_rows = _default_matched_rows(request)
     all_keys: List[np.ndarray] = []
     col_vals: Dict[str, List[np.ndarray]] = {c: [] for c in val_columns}
     for si, seg in enumerate(segments):
-        mask = _segment_mask(seg, request.filter)
-        matched = np.nonzero(mask)[0]
+        matched = matched_rows(si, seg)
         res.num_docs_scanned += int(matched.size)
         if matched.size == 0:
             continue
@@ -241,25 +316,30 @@ def execute_host(
     request: BrokerRequest,
     total_docs: int,
     sel_columns: Optional[List[str]],
+    matched_rows=None,
 ) -> IntermediateResult:
     res = IntermediateResult(
         total_docs=total_docs,
         num_segments_queried=len(segments),
     )
+    if matched_rows is None:
+        matched_rows = _default_matched_rows(request)
     if request.is_group_by:
         res.groups = {}
         if _vectorizable_groupby(request, segments, ctx):
-            _groupby_vectorized(segments, ctx, request, res)
+            _groupby_vectorized(segments, ctx, request, res, matched_rows)
             return res
     elif request.is_aggregation:
+        if _vectorizable_aggs(request, segments):
+            _aggregation_vectorized(segments, request, res, matched_rows)
+            return res
         res.aggregations = [make_partial(a.base_function) for a in request.aggregations]
     else:
         res.selection_rows = []
         res.selection_columns = sel_columns
 
-    for seg in segments:
-        mask = _segment_mask(seg, request.filter)
-        matched = np.nonzero(mask)[0]
+    for si, seg in enumerate(segments):
+        matched = matched_rows(si, seg)
         res.num_docs_scanned += int(matched.size)
 
         if request.is_group_by:

@@ -1,0 +1,182 @@
+"""Selective-query fast path: host postings instead of a device scan.
+
+The reference picks its filter operator per predicate by selectivity:
+``BitmapBasedFilterOperator.java:34`` walks the inverted index in
+O(matches); ``ScanBasedFilterOperator.java:38`` scans.  This module is
+that dispatch re-cut for TPU economics: the device scan path runs at
+~2.8 B rows/s but costs a dispatch + tunnel round trip; for a
+predicate matching a few thousand rows, resolving row ids from
+host-resident CSR postings (``segment/invindex.py``) and aggregating
+those rows with numpy fancy-indexing finishes in well under a
+millisecond of host time and never touches the device.
+
+Shape: one *driving* leaf (EQ/IN/RANGE/REGEX, non-negated) resolves
+row ids from postings; every other predicate of a root-level AND
+evaluates as a *residual* on just those rows (recursive subset masks,
+mirroring ``host_fallback._segment_mask`` semantics).  Estimated and
+actual match counts above the selectivity threshold bail back to the
+device scan — exactly the reference's operator-choice contract.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from pinot_tpu.common.request import BrokerRequest, FilterOperator, FilterQueryTree
+from pinot_tpu.engine.context import TableContext
+from pinot_tpu.engine.plan import match_table
+from pinot_tpu.engine.results import IntermediateResult
+from pinot_tpu.segment.immutable import ImmutableSegment
+from pinot_tpu.segment.invindex import inverted_index
+
+_DRIVING_OPS = (
+    FilterOperator.EQUALITY,
+    FilterOperator.IN,
+    FilterOperator.RANGE,
+    FilterOperator.REGEX,
+)
+
+
+def _max_matches(total_docs: int) -> int:
+    env = os.environ.get("PINOT_TPU_INDEX_MAX_MATCHES")
+    if env:
+        return int(env)
+    # crossover heuristic: numpy fancy-index aggregation costs ~10 ns/row
+    # host-side; the device scan costs ~0.35 ns/row (2.8 B rows/s) plus a
+    # fixed dispatch+RTT floor.  The fraction bound (1/64 of the table)
+    # keeps the host path an order of magnitude under the scan at any
+    # size AND keeps unselective predicates on the device even for small
+    # tables — this is a needle-query path, not a general fallback.
+    return total_docs // 64
+
+
+def _mv_subset_hits(col, table: np.ndarray, rows: np.ndarray) -> np.ndarray:
+    offs = np.asarray(col.mv_offsets)
+    starts = offs[rows]
+    counts = offs[rows + 1] - starts
+    total = int(counts.sum())
+    if total == 0:
+        return np.zeros(rows.size, dtype=bool)
+    reps = np.repeat(np.arange(rows.size), counts)
+    base = np.repeat(starts, counts)
+    cum = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos = np.arange(total) - np.repeat(cum, counts)
+    hits = table[np.asarray(col.mv_values)[base + pos]]
+    any_hit = np.zeros(rows.size, dtype=bool)
+    np.logical_or.at(any_hit, reps, hits)
+    return any_hit
+
+
+def _subset_mask(
+    seg: ImmutableSegment, tree: FilterQueryTree, rows: np.ndarray
+) -> np.ndarray:
+    """Evaluate a filter tree over a row-id subset — bool[rows.size].
+    Semantics mirror host_fallback._segment_mask exactly."""
+    if tree.is_leaf:
+        col = seg.column(tree.column)
+        d = col.dictionary
+        table = match_table(tree, d, d.cardinality if d.cardinality else 1)
+        negative = tree.operator in (FilterOperator.NOT, FilterOperator.NOT_IN)
+        if col.is_single_value:
+            m = table[np.asarray(col.fwd)[rows]]
+            return ~m if negative else m
+        any_hit = _mv_subset_hits(col, table, rows)
+        return ~any_hit if negative else any_hit
+    masks = [_subset_mask(seg, c, rows) for c in tree.children]
+    out = masks[0]
+    for m in masks[1:]:
+        out = (out & m) if tree.operator == FilterOperator.AND else (out | m)
+    return out
+
+
+def _decompose(tree: FilterQueryTree):
+    """-> (driving candidates, all conjuncts) or None.  The filter must
+    be a single leaf or a root-level AND of subtrees; the driving leaf
+    is any direct-child positive leaf, the rest evaluate as residuals."""
+    if tree.is_leaf:
+        return ([tree], [tree]) if tree.operator in _DRIVING_OPS else None
+    if tree.operator != FilterOperator.AND:
+        return None
+    cands = [
+        c for c in tree.children if c.is_leaf and c.operator in _DRIVING_OPS
+    ]
+    return (cands, list(tree.children)) if cands else None
+
+
+def try_index_path(
+    request: BrokerRequest,
+    live: List[ImmutableSegment],
+    ctx: TableContext,
+    total_docs: int,
+    sel_columns: Optional[List[str]],
+) -> Optional[IntermediateResult]:
+    """O(matches) host path, or None to take the device scan."""
+    if os.environ.get("PINOT_TPU_INVINDEX") == "0":
+        return None
+    tree = request.filter
+    if tree is None:
+        return None
+    dec = _decompose(tree)
+    if dec is None:
+        return None
+    cands, conjuncts = dec
+    live_docs = sum(s.num_docs for s in live)
+    limit = _max_matches(live_docs)
+
+    # cheap pre-estimate (uniform assumption: matched dict fraction *
+    # rows) picks ONE candidate before any postings build
+    best = None
+    best_frac = None
+    for leaf in cands:
+        frac = 0.0
+        ok = True
+        for seg in live:
+            col = seg.columns.get(leaf.column)
+            if col is None or col.dictionary.cardinality <= 0:
+                ok = False
+                break
+            d = col.dictionary
+            t = match_table(leaf, d, d.cardinality)
+            frac = max(frac, float(t.sum()) / d.cardinality)
+        if ok and (best_frac is None or frac < best_frac):
+            best, best_frac = leaf, frac
+    if best is None or best_frac * live_docs > limit:
+        return None
+
+    # real postings counts confirm (skew can defeat the uniform guess)
+    indexes = []
+    est = 0
+    for seg in live:
+        idx = inverted_index(seg, best.column)
+        if idx is None:
+            return None
+        d = seg.column(best.column).dictionary
+        t = match_table(best, d, d.cardinality)
+        est += idx.count_for_table(t)
+        indexes.append((idx, t))
+    if est > limit:
+        return None
+
+    residuals = [c for c in conjuncts if c is not best]
+
+    def matched_rows(si: int, seg: ImmutableSegment) -> np.ndarray:
+        idx, t = indexes[si]
+        rows = idx.resolve_table(t)
+        if rows.size and residuals:
+            keep = np.ones(rows.size, dtype=bool)
+            for r in residuals:
+                keep &= _subset_mask(seg, r, rows)
+            rows = rows[keep]
+        return rows
+
+    from pinot_tpu.engine.host_fallback import execute_host
+
+    res = execute_host(
+        live, ctx, request, total_docs, sel_columns, matched_rows=matched_rows
+    )
+    # filter work was O(postings), not O(n): report candidate rows like
+    # the zone-map path does (num_entries_scanned contract)
+    res.num_entries_scanned_in_filter = est * max(1, len(residuals) + 1)
+    return res

@@ -617,3 +617,20 @@ def make_table_kernel(plan: StaticPlan) -> Callable:
         return {k: apply_reduce(reducers[k], v) for k, v in outs.items()}
 
     return jax.jit(table_fn)
+
+@functools.lru_cache(maxsize=256)
+def make_packed_table_kernel(plan: StaticPlan) -> Callable:
+    """make_table_kernel + single-transfer output fetch: returns HOST
+    numpy outputs via one packed D2H transfer (engine/packing.py) —
+    the serving path's kernel (per-leaf fetches pay one tunnel RTT
+    each; the bench's async dispatch keeps using the raw kernel)."""
+    from pinot_tpu.engine.packing import make_packed_kernel
+
+    return make_packed_kernel(make_table_kernel(plan))
+
+
+@functools.lru_cache(maxsize=256)
+def make_packed_block_table_kernel(plan: StaticPlan, block: int) -> Callable:
+    from pinot_tpu.engine.packing import make_packed_kernel
+
+    return make_packed_kernel(make_block_table_kernel(plan, block))

@@ -64,6 +64,10 @@ def cluster(monkeypatch_module=None):
 @pytest.fixture(autouse=True)
 def small_zone_block(monkeypatch):
     monkeypatch.setenv("PINOT_TPU_ZONE_BLOCK", str(BLOCK))
+    # these tests exercise the zone-map BLOCK path; the postings fast
+    # path (engine/invindex_path.py) would swallow the selective
+    # queries first
+    monkeypatch.setenv("PINOT_TPU_INVINDEX", "0")
 
 
 def _norm(resp):
