@@ -103,7 +103,9 @@ def stream_provider_from_config(stream_config) -> StreamProvider:
     if t == "memory":
         return MemoryStreamProvider(int(props.get("partitions", 1)))
     if t == "kafka":
-        return KafkaStreamProvider()
+        return KafkaStreamProvider(
+            props.get("host", "127.0.0.1"), int(props["port"]), stream_config.topic
+        )
     raise ValueError(f"unknown stream type {t!r}")
 
 
@@ -132,17 +134,17 @@ def stream_from_descriptor(desc: Dict[str, Any]) -> StreamProvider:
         from pinot_tpu.realtime.netstream import NetworkStreamProvider
 
         return NetworkStreamProvider(desc["host"], int(desc["port"]), desc["topic"])
+    if t == "kafka":
+        from pinot_tpu.realtime.kafka import KafkaStreamProvider
+
+        return KafkaStreamProvider(desc["host"], int(desc["port"]), desc["topic"])
     raise ValueError(f"unknown stream descriptor {desc!r}")
 
 
-class KafkaStreamProvider(StreamProvider):  # pragma: no cover - gated
-    """LLC-style Kafka consumer. Gated: no kafka client library is baked
-    into this environment; raises with guidance at construction."""
+def KafkaStreamProvider(host: str, port: int, topic: str) -> StreamProvider:
+    """LLC-style Kafka consumer over the binary wire protocol
+    (Metadata/ListOffsets/Fetch v0) — no client library needed; see
+    ``realtime/kafka.py`` (``SimpleConsumerWrapper.java`` analog)."""
+    from pinot_tpu.realtime.kafka import KafkaStreamProvider as _K
 
-    def __init__(self, *args: Any, **kwargs: Any) -> None:
-        raise ImportError(
-            "KafkaStreamProvider needs a kafka client (kafka-python/confluent-kafka), "
-            "which is not available in this environment. Use "
-            "FileBasedStreamProvider or MemoryStreamProvider, which implement the "
-            "same offset-addressed interface."
-        )
+    return _K(host, port, topic)

@@ -54,10 +54,12 @@ def cmd_network_realtime_quickstart(args) -> None:
     from pinot_tpu.tools.quickstart import run_network_realtime_quickstart
 
     count = run_network_realtime_quickstart(
-        num_events=args.events, consumer_type=args.consumer_type
+        num_events=args.events,
+        consumer_type=args.consumer_type,
+        stream_protocol=args.stream_protocol,
     )
-    print(f"\nDONE networked realtime quickstart ({args.consumer_type}): "
-          f"{count} events ingested")
+    print(f"\nDONE networked realtime quickstart ({args.consumer_type}, "
+          f"{args.stream_protocol} stream): {count} events ingested")
 
 
 def cmd_realtime_quickstart(args) -> None:
@@ -348,6 +350,8 @@ def main(argv=None) -> None:
     nrq.add_argument("-events", type=int, default=2000)
     nrq.add_argument("-consumer-type", default="lowlevel",
                      choices=["lowlevel", "highlevel"], dest="consumer_type")
+    nrq.add_argument("-stream-protocol", default="native",
+                     choices=["native", "kafka"], dest="stream_protocol")
     nrq.set_defaults(fn=cmd_network_realtime_quickstart)
 
     sc = sub.add_parser("StartCluster")

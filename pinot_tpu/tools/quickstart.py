@@ -131,13 +131,21 @@ def run_network_realtime_quickstart(
     verbose: bool = True,
     data_dir: Optional[str] = None,
     consumer_type: str = "lowlevel",
+    stream_protocol: str = "native",
 ):
     """Networked realtime quickstart: a real TCP stream-broker process
     boundary (realtime/netstream.py), a controller + server + broker as
     separate OS processes, REALTIME table created over REST, rows
     produced over TCP, counts queried through the broker HTTP port —
     the full reference deployment shape with the stream broker playing
-    Kafka's role."""
+    Kafka's role.
+
+    ``stream_protocol="kafka"`` fronts the stream broker with the Kafka
+    v0 wire-protocol shim (realtime/kafka.py) and creates the table
+    with ``stream_type="kafka"``: the server processes then consume
+    through the Kafka binary protocol (Metadata/ListOffsets/Fetch),
+    exactly as they would against a real Kafka 0.8+ deployment
+    (``SimpleConsumerWrapper.java`` parity)."""
     import random
     import subprocess
     import sys
@@ -147,12 +155,21 @@ def run_network_realtime_quickstart(
     from pinot_tpu.common.tableconfig import StreamConfig, TableConfig
     from pinot_tpu.realtime.netstream import NetworkStreamProvider, StreamBrokerServer
 
+    if stream_protocol == "kafka" and consumer_type != "lowlevel":
+        # Kafka v0 has no group-coordinator wire API (0.8 HLC lived in
+        # ZK); groups ride the native stream-broker protocol instead
+        raise ValueError("stream_protocol='kafka' supports consumer_type='lowlevel' only")
     root = data_dir or tempfile.mkdtemp(prefix="pinot_tpu_netrt_")
     stream_broker = StreamBrokerServer(log_dir=f"{root}/streamlog")
     stream_broker.start()
     host, port = stream_broker.address
     producer = NetworkStreamProvider(host, port, "meetupRsvp")
     producer.create_topic(1 if consumer_type == "lowlevel" else 2)
+    kafka_shim = None
+    if stream_protocol == "kafka":
+        from pinot_tpu.realtime.kafka import KafkaProtocolShim
+
+        kafka_shim = KafkaProtocolShim(stream_broker).start()
 
     def spawn(args, prefix="READY"):
         import os as _os
@@ -200,16 +217,27 @@ def run_network_realtime_quickstart(
 
         schema = meetup_schema()
         post(ctrl_url + "/schemas", schema.to_json())
-        config = TableConfig(
-            table_name="meetupRsvp",
-            table_type="REALTIME",
-            stream=StreamConfig(
+        if kafka_shim is not None:
+            k_host, k_port = kafka_shim.address
+            stream_cfg = StreamConfig(
+                stream_type="kafka",
+                topic="meetupRsvp",
+                rows_per_segment=500,
+                consumer_type=consumer_type,
+                properties={"host": k_host, "port": k_port},
+            )
+        else:
+            stream_cfg = StreamConfig(
                 stream_type="network",
                 topic="meetupRsvp",
                 rows_per_segment=500,
                 consumer_type=consumer_type,
                 properties={"host": host, "port": port},
-            ),
+            )
+        config = TableConfig(
+            table_name="meetupRsvp",
+            table_type="REALTIME",
+            stream=stream_cfg,
         )
         post(ctrl_url + "/tables", config.to_json())
 
@@ -246,6 +274,8 @@ def run_network_realtime_quickstart(
                 print(json.dumps(resp, indent=2)[:900])
         return count
     finally:
+        if kafka_shim is not None:
+            kafka_shim.stop()
         stream_broker.stop()
         for proc in procs:
             if proc.poll() is None:

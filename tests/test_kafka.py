@@ -1,0 +1,187 @@
+"""Kafka binary wire protocol (realtime/kafka.py): codec round trips,
+client vs the protocol-compat shim over real sockets, and LLC ingestion
+through the Kafka-protocol client.
+
+Reference parity: ``SimpleConsumerWrapper.java`` (Metadata/ListOffsets/
+Fetch against a real broker's wire protocol) — here implemented from
+the protocol spec, tested against the shim serving the same bytes a
+Kafka 0.8+ broker would."""
+import json
+
+import pytest
+
+from pinot_tpu.realtime.kafka import (
+    EARLIEST,
+    LATEST,
+    KafkaProtocolShim,
+    KafkaStreamProvider,
+    KafkaWireClient,
+    decode_message_set,
+    encode_message,
+)
+from pinot_tpu.realtime.netstream import NetworkStreamProvider, StreamBrokerServer
+
+
+# -- codec level -------------------------------------------------------
+
+
+def test_message_set_round_trip():
+    data = b"".join(
+        encode_message(i, json.dumps({"i": i}).encode()) for i in range(5)
+    )
+    out = decode_message_set(data)
+    assert [o for o, _, _ in out] == list(range(5))
+    assert json.loads(out[3][2]) == {"i": 3}
+
+
+def test_message_set_truncated_tail_dropped():
+    data = b"".join(encode_message(i, b"x" * 100) for i in range(3))
+    out = decode_message_set(data[:-30])  # cut mid-message
+    assert [o for o, _, _ in out] == [0, 1]
+
+
+def test_message_set_crc_checked():
+    data = bytearray(encode_message(0, b"payload"))
+    data[-2] ^= 0xFF  # corrupt the value
+    with pytest.raises(ValueError, match="CRC"):
+        decode_message_set(bytes(data))
+
+
+# -- client vs shim over real sockets ---------------------------------
+
+
+@pytest.fixture()
+def kafka_stack():
+    sb = StreamBrokerServer()
+    sb.start()
+    host, port = sb.address
+    producer = NetworkStreamProvider(host, port, "ktopic")
+    producer.create_topic(2)
+    shim = KafkaProtocolShim(sb).start()
+    try:
+        yield sb, producer, shim
+    finally:
+        shim.stop()
+        sb.stop()
+
+
+def test_metadata_list_offsets_fetch(kafka_stack):
+    sb, producer, shim = kafka_stack
+    for i in range(10):
+        producer.produce({"i": i}, partition=i % 2)
+
+    host, port = shim.address
+    client = KafkaWireClient(host, port)
+    meta = client.metadata(["ktopic"])
+    assert len(meta["topics"]["ktopic"]["partitions"]) == 2
+    assert meta["brokers"][0]["port"] == port
+
+    assert client.list_offsets("ktopic", 0, EARLIEST) == [0]
+    assert client.list_offsets("ktopic", 0, LATEST) == [5]
+
+    msgs = client.fetch("ktopic", 0, 0)
+    assert [o for o, _, _ in msgs] == list(range(5))
+    assert json.loads(msgs[0][2]) == {"i": 0}
+
+    # fetch from a mid offset
+    msgs = client.fetch("ktopic", 1, 3)
+    assert [o for o, _, _ in msgs] == [3, 4]
+
+    # out of range
+    with pytest.raises(IndexError):
+        client.fetch("ktopic", 0, 99)
+    client.close()
+
+
+def test_fetch_respects_max_bytes(kafka_stack):
+    sb, producer, shim = kafka_stack
+    for i in range(20):
+        producer.produce({"pad": "z" * 200, "i": i}, partition=0)
+    host, port = shim.address
+    client = KafkaWireClient(host, port)
+    msgs = client.fetch("ktopic", 0, 0, max_bytes=700)
+    assert 0 < len(msgs) < 20  # bounded batch, no truncated-garbage rows
+    assert msgs[0][0] == 0
+    client.close()
+
+
+def test_stream_provider_interface(kafka_stack):
+    sb, producer, shim = kafka_stack
+    for i in range(7):
+        producer.produce({"i": i}, partition=i % 2)
+    host, port = shim.address
+    sp = KafkaStreamProvider(host, port, "ktopic")
+    assert sp.partition_count() == 2
+    rows, nxt = sp.fetch(0, 0, max_rows=100)
+    assert [r["i"] for r in rows] == [0, 2, 4, 6]
+    assert nxt == 4
+    assert sp.latest_offset(1) == 3
+    # descriptor round trip (controller recovery path)
+    from pinot_tpu.realtime.stream import describe_stream, stream_from_descriptor
+
+    desc = describe_stream(sp)
+    assert desc["type"] == "kafka"
+    sp2 = stream_from_descriptor(desc)
+    assert sp2.latest_offset(0) == 4
+
+
+# -- LLC ingestion through the wire client ----------------------------
+
+
+def test_llc_consumes_through_kafka_protocol(kafka_stack, tmp_path):
+    from pinot_tpu.tools.cluster_harness import InProcessCluster
+    from pinot_tpu.realtime.llc import RESP_KEEP, make_segment_name
+    from tests.test_realtime import make_row, rsvp_schema
+
+    sb, producer, shim = kafka_stack
+    host, port = shim.address
+
+    cluster = InProcessCluster(num_servers=1, data_dir=str(tmp_path))
+    schema = rsvp_schema()
+    stream = KafkaStreamProvider(host, port, "ktopic")
+    physical = cluster.add_realtime_table(schema, stream, rows_per_segment=50)
+
+    for i in range(70):
+        producer.produce(make_row(i), partition=i % 2)
+
+    seg0 = make_segment_name(physical, 0, 0)
+    dm = cluster.controller.realtime_manager.consumers_of(seg0)[0]
+    dm.consume_step(max_rows=1000)
+    seg1 = make_segment_name(physical, 1, 0)
+    dm1 = cluster.controller.realtime_manager.consumers_of(seg1)[0]
+    dm1.consume_step(max_rows=1000)
+
+    resp = cluster.query("SELECT count(*) FROM meetupRsvp")
+    assert resp.num_docs_scanned == 70
+
+    # partition 0 sealed at the 35-row... below threshold: force another
+    # round of production to cross the 50-row threshold and commit
+    for i in range(70, 140):
+        producer.produce(make_row(i), partition=i % 2)
+    dm.consume_step(max_rows=1000)
+    assert dm.threshold_reached
+    assert dm.try_commit() == RESP_KEEP
+
+    # committed offsets recorded from the Kafka-protocol stream
+    info = cluster.controller.resources.get_segment_metadata(physical, seg0)
+    assert info["metadata"].custom["startOffset"] == 0
+    assert info["metadata"].custom["endOffset"] == 50
+
+
+def test_oversized_message_grows_and_progresses(kafka_stack):
+    """A message larger than the fetch max_bytes must not livelock the
+    consumer: the truncated empty MessageSet triggers max_bytes growth
+    and retry (real-broker SimpleConsumer behavior)."""
+    sb, producer, shim = kafka_stack
+    producer.produce({"big": "x" * 50_000}, partition=0)
+    producer.produce({"i": 1}, partition=0)
+    host, port = shim.address
+    client = KafkaWireClient(host, port)
+    msgs = client.fetch("ktopic", 0, 0, max_bytes=1024)  # << message size
+    assert msgs and msgs[0][0] == 0
+    assert len(json.loads(msgs[0][2])["big"]) == 50_000
+    client.close()
+
+    sp = KafkaStreamProvider(host, port, "ktopic")
+    rows, nxt = sp.fetch(0, 0, max_rows=10)
+    assert len(rows) == 2 and nxt == 2
