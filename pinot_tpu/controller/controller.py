@@ -190,7 +190,9 @@ class Controller:
                     table_physical, segment.segment_name, staged
                 )
         return self.resources.add_segment(
-            table_physical, segment.metadata, {"dir": path}
+            table_physical,
+            segment.metadata,
+            {"dir": path, "downloadUri": "file://" + os.path.abspath(path)},
         )
 
     def upload_segment_bytes(
@@ -213,7 +215,10 @@ class Controller:
             self._check_storage_quota(table_physical, segment.segment_name, len(data))
             stored = self.store.save_file(table_physical, segment.segment_name, path)
         return self.resources.add_segment(
-            table_physical, segment.metadata, {"dir": stored}, servers=servers
+            table_physical,
+            segment.metadata,
+            {"dir": stored, "downloadUri": "file://" + os.path.abspath(stored)},
+            servers=servers,
         )
 
     def delete_segment(self, table_physical: str, segment_name: str) -> None:

@@ -108,6 +108,13 @@ class RemoteParticipant(Participant):
             "target": target,
             "crc": getattr(meta, "crc", None),
         }
+        # external download URIs (hdfs://, blob-store http…) ride to the
+        # server for scheme-dispatched fetching; file:// points at the
+        # controller's own disk, so remote servers keep the
+        # controller-served HTTP download instead
+        uri = info.get("downloadUri")
+        if uri and not uri.startswith("file://"):
+            msg["downloadUri"] = uri
         if target == CONSUMING:
             # ship the full consume spec so the remote process can run
             # the consumer + LLC completion protocol on its own

@@ -31,7 +31,9 @@ wire compatibility.  Pointing ``KafkaStreamProvider`` at a real Kafka
 """
 from __future__ import annotations
 
+import gzip
 import io
+import json
 import socket
 import struct
 import threading
@@ -148,10 +150,22 @@ def decode_message_set(data: bytes) -> List[Tuple[int, Optional[bytes], bytes]]:
         if _signed_crc(body) != crc:
             raise ValueError(f"message CRC mismatch at offset {offset}")
         r.i8()  # magic
-        r.i8()  # attributes
+        attrs = r.i8()
         key = r.bytes()
         value = r.bytes()
-        out.append((offset, key, value if value is not None else b""))
+        codec = attrs & 0x07
+        if codec == 0:
+            out.append((offset, key, value if value is not None else b""))
+        elif codec == 1:  # gzip wrapper: value is an inner MessageSet
+            out.extend(decode_message_set(gzip.decompress(value or b"")))
+        else:
+            # snappy/lz4: no codec library in this image — fail loudly
+            # instead of handing compressed bytes to the row decoder
+            raise ValueError(
+                f"unsupported message compression codec {codec} at offset "
+                f"{offset} (gzip=1 is supported; configure the producer "
+                "accordingly)"
+            )
         pos += 12 + size
     return out
 
@@ -337,9 +351,6 @@ class KafkaStreamProvider(StreamProvider):
     decode to rows (``KafkaJSONMessageDecoder`` analog)."""
 
     def __init__(self, host: str, port: int, topic: str) -> None:
-        import json as _json
-
-        self._json = _json
         self.host, self.port, self.topic = host, int(port), topic
         self.client = KafkaWireClient(host, int(port))
 
@@ -364,7 +375,7 @@ class KafkaStreamProvider(StreamProvider):
         nxt = offset
         total_b = 0
         for moff, _key, value in msgs[:max_rows]:
-            rows.append(self._json.loads(value.decode()))
+            rows.append(json.loads(value.decode()))
             total_b += len(value) + 26  # + v0 header/crc overhead
             nxt = moff + 1
         if rows:
@@ -487,8 +498,6 @@ class KafkaProtocolShim:
         return body
 
     def _fetch(self, r: _Reader) -> bytes:
-        import json as _json
-
         r.i32()  # replica_id
         r.i32()  # max_wait
         r.i32()  # min_bytes
@@ -514,7 +523,7 @@ class KafkaProtocolShim:
                 msgs = b""
                 o = offset
                 while o < hw:
-                    m = encode_message(o, _json.dumps(log[o]).encode())
+                    m = encode_message(o, json.dumps(log[o]).encode())
                     if len(msgs) + len(m) > max_bytes:
                         # real-broker behavior: cut the MessageSet at
                         # max_bytes, leaving a truncated partial message

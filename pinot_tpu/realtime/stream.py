@@ -103,6 +103,10 @@ def stream_provider_from_config(stream_config) -> StreamProvider:
     if t == "memory":
         return MemoryStreamProvider(int(props.get("partitions", 1)))
     if t == "kafka":
+        # binary wire-protocol consumer, no client library needed
+        # (realtime/kafka.py, SimpleConsumerWrapper.java analog)
+        from pinot_tpu.realtime.kafka import KafkaStreamProvider
+
         return KafkaStreamProvider(
             props.get("host", "127.0.0.1"), int(props["port"]), stream_config.topic
         )
@@ -141,10 +145,3 @@ def stream_from_descriptor(desc: Dict[str, Any]) -> StreamProvider:
     raise ValueError(f"unknown stream descriptor {desc!r}")
 
 
-def KafkaStreamProvider(host: str, port: int, topic: str) -> StreamProvider:
-    """LLC-style Kafka consumer over the binary wire protocol
-    (Metadata/ListOffsets/Fetch v0) — no client library needed; see
-    ``realtime/kafka.py`` (``SimpleConsumerWrapper.java`` analog)."""
-    from pinot_tpu.realtime.kafka import KafkaStreamProvider as _K
-
-    return _K(host, port, topic)

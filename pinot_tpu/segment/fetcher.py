@@ -1,0 +1,129 @@
+"""Pluggable segment fetchers, dispatched by download-URI scheme.
+
+Reference parity: ``common/segment/fetcher/SegmentFetcherFactory.java``
+selects ``HttpSegmentFetcher`` / ``LocalFileSegmentFetcher`` (and the
+WebHDFS client, ``common/utils/webhdfs/WebHdfsV1Client.java``) from the
+segment's download URI scheme; servers use it in
+``SegmentFetcherAndLoader.java:84`` and push jobs use it to hand
+segments to the controller.  The *pluggability seam* is the point:
+deployments register fetchers for their blob store.
+
+Here the factory maps scheme -> fetcher and both load paths (in-process
+server starter and the networked server) resolve ``downloadUri``
+through it; ``register`` adds custom schemes at runtime.  The WebHDFS
+fetcher speaks the WebHDFS v1 REST protocol (OPEN op) over urllib, so
+it works against any WebHDFS-compatible endpoint without Hadoop
+libraries.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import urllib.parse
+import urllib.request
+from typing import Callable, Dict
+
+from pinot_tpu.utils.retry import ExponentialBackoffRetryPolicy
+
+
+class SegmentFetcher:
+    """Copy the segment file at ``uri`` to ``dest_path`` (a local file
+    path; parent directories are the caller's concern)."""
+
+    def fetch(self, uri: str, dest_path: str) -> None:
+        raise NotImplementedError
+
+
+class LocalFileSegmentFetcher(SegmentFetcher):
+    """``file://`` URIs and bare paths (LocalFileSegmentFetcher.java)."""
+
+    def fetch(self, uri: str, dest_path: str) -> None:
+        parsed = urllib.parse.urlparse(uri)
+        src = parsed.path if parsed.scheme == "file" else uri
+        if os.path.isdir(src):
+            from pinot_tpu.segment.format import SEGMENT_FILE_NAME
+
+            src = os.path.join(src, SEGMENT_FILE_NAME)
+        shutil.copyfile(src, dest_path)
+
+
+def _http_download(
+    url: str, dest_path: str, timeout_s: float, policy: ExponentialBackoffRetryPolicy
+) -> None:
+    """Shared retried GET-to-file (tmp + rename) for the http-based
+    fetchers."""
+
+    def _once():
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            tmp = dest_path + ".part"
+            with open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            os.replace(tmp, dest_path)
+
+    policy.attempt(_once)
+
+
+class HttpSegmentFetcher(SegmentFetcher):
+    """``http(s)://`` download with exponential-backoff retries
+    (HttpSegmentFetcher.java + its RetryPolicy)."""
+
+    def __init__(self, timeout_s: float = 120.0, attempts: int = 3) -> None:
+        self.timeout_s = timeout_s
+        self.policy = ExponentialBackoffRetryPolicy(attempts, 0.2)
+
+    def fetch(self, uri: str, dest_path: str) -> None:
+        _http_download(uri, dest_path, self.timeout_s, self.policy)
+
+
+class WebHdfsSegmentFetcher(SegmentFetcher):
+    """``hdfs://`` via the WebHDFS v1 REST gateway
+    (``WebHdfsV1Client.java`` analog: GET ?op=OPEN, follow the datanode
+    redirect urllib handles automatically), with the same retry policy
+    as the http fetcher."""
+
+    def __init__(self, gateway: str = "", timeout_s: float = 120.0, attempts: int = 3) -> None:
+        # gateway e.g. "http://namenode:50070"; empty -> derive from the
+        # uri authority (hdfs://host:port/path -> http://host:port)
+        self.gateway = gateway.rstrip("/")
+        self.timeout_s = timeout_s
+        self.policy = ExponentialBackoffRetryPolicy(attempts, 0.2)
+
+    def fetch(self, uri: str, dest_path: str) -> None:
+        parsed = urllib.parse.urlparse(uri)
+        gateway = self.gateway or f"http://{parsed.netloc}"
+        url = f"{gateway}/webhdfs/v1{parsed.path}?op=OPEN"
+        _http_download(url, dest_path, self.timeout_s, self.policy)
+
+
+class SegmentFetcherFactory:
+    """scheme -> fetcher registry (SegmentFetcherFactory.java)."""
+
+    def __init__(self) -> None:
+        local = LocalFileSegmentFetcher()
+        http = HttpSegmentFetcher()
+        self._fetchers: Dict[str, SegmentFetcher] = {
+            "": local,
+            "file": local,
+            "http": http,
+            "https": http,
+            "hdfs": WebHdfsSegmentFetcher(),
+        }
+
+    def register(self, scheme: str, fetcher: SegmentFetcher) -> None:
+        self._fetchers[scheme] = fetcher
+
+    def for_uri(self, uri: str) -> SegmentFetcher:
+        scheme = urllib.parse.urlparse(uri).scheme
+        f = self._fetchers.get(scheme)
+        if f is None:
+            raise ValueError(
+                f"no segment fetcher registered for scheme {scheme!r} ({uri})"
+            )
+        return f
+
+    def fetch(self, uri: str, dest_path: str) -> None:
+        os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
+        self.for_uri(uri).fetch(uri, dest_path)
+
+
+DEFAULT_FACTORY = SegmentFetcherFactory()

@@ -393,10 +393,6 @@ class NetworkedServerStarter:
         with urllib.request.urlopen(self.controller_url + path, timeout=10) as r:
             return json.loads(r.read())
 
-    def _download(self, path: str) -> bytes:
-        with urllib.request.urlopen(self.controller_url + path, timeout=120) as r:
-            return r.read()
-
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
         self.tcp.start()
@@ -461,7 +457,7 @@ class NetworkedServerStarter:
                 if consumer is not None and not getattr(consumer, "rolls_locally", False):
                     self._consumers.pop(segment, None)
                     consumer.stop()
-                ok = self._load(table, segment, msg.get("crc"))
+                ok = self._load(table, segment, msg.get("crc"), msg.get("downloadUri"))
             elif target == CONSUMING:
                 ok = self._start_consumer(table, segment, msg)
             elif target in (OFFLINE, DROPPED):
@@ -517,7 +513,13 @@ class NetworkedServerStarter:
             return None
         return os.path.join(self.data_dir, table, segment)
 
-    def _load(self, table: str, segment: str, crc: Optional[int]) -> bool:
+    def _load(
+        self,
+        table: str,
+        segment: str,
+        crc: Optional[int],
+        download_uri: Optional[str] = None,
+    ) -> bool:
         tdm = self.server.data_manager.table(table)
         loaded = tdm is not None and segment in tdm.segment_names()
         if loaded and crc is not None and self._local_crcs.get(segment) == crc:
@@ -533,19 +535,23 @@ class NetworkedServerStarter:
             except Exception:
                 logger.warning("corrupt local cache for %s/%s; re-downloading", table, segment)
         if seg_obj is None:
-            data = self._download(f"/segments/{table}/{segment}/file")
+            # scheme-dispatched fetch (SegmentFetcherFactory.java):
+            # an explicit downloadUri (hdfs://, external http…) wins;
+            # default is the controller-served copy over HTTP
+            from pinot_tpu.segment.fetcher import DEFAULT_FACTORY
+
+            uri = download_uri or (
+                f"{self.controller_url}/segments/{table}/{segment}/file"
+            )
             if local is not None:
                 os.makedirs(local, exist_ok=True)
-                with open(os.path.join(local, SEGMENT_FILE_NAME), "wb") as f:
-                    f.write(data)
+                DEFAULT_FACTORY.fetch(uri, os.path.join(local, SEGMENT_FILE_NAME))
                 seg_obj = read_segment(local)
             else:
                 import tempfile
 
                 with tempfile.TemporaryDirectory() as td:
-                    p = os.path.join(td, SEGMENT_FILE_NAME)
-                    with open(p, "wb") as f:
-                        f.write(data)
+                    DEFAULT_FACTORY.fetch(uri, os.path.join(td, SEGMENT_FILE_NAME))
                     seg_obj = read_segment(td)
         self.server.add_segment(table, seg_obj)
         if crc is not None:

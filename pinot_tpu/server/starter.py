@@ -11,6 +11,7 @@ segment for freshly-committed realtime segments).
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Dict, Optional
 
 from pinot_tpu.controller.resource_manager import (
@@ -70,13 +71,27 @@ class ServerStarter:
         seg_obj = info.get("segment")  # in-memory handoff (realtime commit)
         if seg_obj is None:
             path = info.get("dir")
-            if path is None:
+            uri = info.get("downloadUri")
+            if path is None and uri is None:
                 logger.error("segment %s/%s has no download info", table, segment)
                 return False
             try:
-                seg_obj = read_segment(path)
+                if path is not None:
+                    seg_obj = read_segment(path)
+                else:
+                    # scheme-dispatched fetch (SegmentFetcherFactory.java)
+                    import tempfile
+
+                    from pinot_tpu.segment.fetcher import DEFAULT_FACTORY
+                    from pinot_tpu.segment.format import SEGMENT_FILE_NAME
+
+                    with tempfile.TemporaryDirectory() as td:
+                        DEFAULT_FACTORY.fetch(uri, os.path.join(td, SEGMENT_FILE_NAME))
+                        seg_obj = read_segment(td)
             except Exception:
-                logger.exception("failed to load %s/%s from %s", table, segment, path)
+                logger.exception(
+                    "failed to load %s/%s from %s", table, segment, path or uri
+                )
                 return False
         self.server.add_segment(table, seg_obj)
         if crc is not None:

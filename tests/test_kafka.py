@@ -185,3 +185,29 @@ def test_oversized_message_grows_and_progresses(kafka_stack):
     sp = KafkaStreamProvider(host, port, "ktopic")
     rows, nxt = sp.fetch(0, 0, max_rows=10)
     assert len(rows) == 2 and nxt == 2
+
+
+def test_gzip_compressed_message_set():
+    """A gzip wrapper message (attrs codec=1) decodes to its inner
+    messages — what a real 0.8 broker returns for a gzip producer."""
+    import gzip as _gzip
+    import struct
+
+    from pinot_tpu.realtime.kafka import _signed_crc
+
+    inner = b"".join(encode_message(i, json.dumps({"i": i}).encode()) for i in range(3))
+    compressed = _gzip.compress(inner)
+    body = struct.pack(">bb", 0, 1) + struct.pack(">i", -1) + struct.pack(
+        ">i", len(compressed)
+    ) + compressed
+    msg = struct.pack(">i", _signed_crc(body)) + body
+    wrapper = struct.pack(">qi", 2, len(msg)) + msg
+    out = decode_message_set(wrapper)
+    assert [o for o, _, _ in out] == [0, 1, 2]
+    assert json.loads(out[2][2]) == {"i": 2}
+
+    # unsupported codecs fail loudly, not with a row-decoder crash
+    body2 = struct.pack(">bb", 0, 2) + struct.pack(">i", -1) + struct.pack(">i", 1) + b"x"
+    msg2 = struct.pack(">i", _signed_crc(body2)) + body2
+    with pytest.raises(ValueError, match="compression codec 2"):
+        decode_message_set(struct.pack(">qi", 0, len(msg2)) + msg2)
