@@ -17,11 +17,13 @@ layering here is:
      latency-tolerant, which is exactly what the reference's
      DataTable-over-TCP layer is for.
 
-Only (1) is executable in this environment (one chip / virtual CPU
-devices); (2) is validated structurally — ``make_multihost_mesh``
-produces the 2-D mesh and the kernels accept it by flattening to the
-segment axis — and with a real multi-host slice it activates via
-``jax.distributed.initialize``.
+(1) runs on the real chip; (2) is exercised END TO END by
+``tests/test_multihost_process.py``: two OS processes bring up
+``jax.distributed.initialize`` (CPU backend, gloo cross-process
+collectives), build this module's 2-D mesh, and run the production
+sharded kernel through a collective that crosses the process boundary.
+On a real multi-host slice the identical wiring activates with the TPU
+backend.
 """
 from __future__ import annotations
 

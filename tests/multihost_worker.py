@@ -1,0 +1,83 @@
+"""Worker for the true multi-process multi-host test
+(tests/test_multihost_process.py): each OS process is one "host" of a
+2-host CPU cluster.
+
+Run as: python tests/multihost_worker.py <coordinator> <num_procs> <pid>
+
+Brings up jax's distributed runtime (the real multi-host wiring:
+coordinator service, process ids, global device view), builds the
+2-D (hosts, chips) mesh with ``make_multihost_mesh`` — the SAME
+function a real TPU pod slice uses — and executes the production
+sharded query kernel over it, printing this process's view of the
+globally-reduced result.
+"""
+import os
+import sys
+
+# 4 virtual CPU devices per process -> 8 global across 2 processes;
+# gloo backs the cross-process CPU collectives
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+os.environ.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    coordinator, num_procs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=num_procs, process_id=pid
+    )
+    assert jax.process_count() == num_procs, jax.process_count()
+    assert jax.local_device_count() == 4
+    assert jax.device_count() == 4 * num_procs
+
+    import numpy as np
+
+    from pinot_tpu.engine.context import get_table_context
+    from pinot_tpu.engine.device import segment_arrays, stage_segments, to_device_inputs
+    from pinot_tpu.engine.plan import build_query_inputs, build_static_plan
+    from pinot_tpu.parallel.multichip import SEGMENT_AXIS, make_sharded_table_kernel
+    from pinot_tpu.parallel.multihost import (
+        HOST_AXIS,
+        flatten_to_segment_mesh,
+        make_multihost_mesh,
+    )
+    from pinot_tpu.pql import optimize_request, parse_pql
+    from pinot_tpu.tools.datagen import synthetic_lineitem_segment
+
+    mesh = make_multihost_mesh()
+    assert mesh.axis_names == (HOST_AXIS, SEGMENT_AXIS)
+    assert mesh.devices.shape == (num_procs, 4), mesh.devices.shape
+
+    # every process builds the same 8 tiny segments (deterministic
+    # seeds); the segment axis shards across ALL devices of BOTH
+    # processes, so the psum merge crosses the process boundary (the
+    # DCN hop on a real slice)
+    segments = [
+        synthetic_lineitem_segment(512, seed=100 + i, name=f"mh{i}") for i in range(8)
+    ]
+    request = optimize_request(
+        parse_pql(
+            "SELECT sum(l_quantity), count(*) FROM lineitem "
+            "WHERE l_shipdate <= '1998-09-02' GROUP BY l_returnflag TOP 10"
+        )
+    )
+    ctx = get_table_context(segments)
+    needed = sorted(set(request.referenced_columns()))
+    staged = stage_segments(segments, needed, gfwd_columns=("l_returnflag",), ctx=ctx)
+    plan = build_static_plan(request, ctx, staged)
+    q = to_device_inputs(build_query_inputs(request, plan, ctx, staged))
+    seg = segment_arrays(staged, needed)
+
+    kernel = make_sharded_table_kernel(plan, flatten_to_segment_mesh(mesh))
+    outs = kernel(seg, q)
+    total = float(np.asarray(jax.device_get(outs["num_docs"])).sum())
+    print(f"RESULT pid={pid} num_docs={total}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
