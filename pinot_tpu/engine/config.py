@@ -24,6 +24,13 @@ MAX_GROUP_CAPACITY = 1 << 20
 # distinctcount / percentile dense state cap (global dictionary size).
 MAX_VALUE_STATE = 1 << 22
 
+# sort-dedup distinct path (StaticAgg.sort_pairs): device output buffer
+# for compacted unique (group, valueId) pairs.  Overflow (more unique
+# pairs than this) falls back to the host path at runtime — at that
+# cardinality the exact-distinct result itself is bigger than any
+# sensible response payload.
+DISTINCT_PAIR_CAP = 1 << 22
+
 HLL_LOG2M = 8  # HllConstants.java DEFAULT_LOG2M
 HLL_M = 1 << HLL_LOG2M
 

@@ -42,6 +42,30 @@ from pinot_tpu.engine.results import (
 from pinot_tpu.segment.immutable import ImmutableSegment
 
 
+class _PairsState:
+    """Host-side index over a compacted (group slot, valueId) pair
+    buffer from the sort-dedup distinct reduce (kernel.py
+    ``_reduce_distinct_pairs``): per-slot distinct counts for trim
+    ordering and per-slot gid slices for DistinctPartial building."""
+
+    def __init__(self, state, capacity: int) -> None:
+        slots, gids, n = state
+        n = int(n)
+        slots = np.asarray(slots)[:n].astype(np.int64)
+        gids = np.asarray(gids)[:n]
+        order = np.argsort(slots, kind="stable")
+        self._slots_sorted = slots[order]
+        self._gids_sorted = gids[order]
+        self._bounds = np.searchsorted(
+            self._slots_sorted, np.arange(capacity + 1, dtype=np.int64)
+        )
+        self.counts = np.diff(self._bounds).astype(np.float64)
+
+    def gids_for(self, key: int) -> np.ndarray:
+        a, b = self._bounds[key], self._bounds[key + 1]
+        return self._gids_sorted[a:b]
+
+
 class QueryExecutor:
     """Executes queries over a set of immutable segments on this host's
     device(s).
@@ -138,7 +162,12 @@ class QueryExecutor:
         t0 = self._phase("staging", t0)
         plan = build_static_plan(request, ctx, staged)
 
-        if not plan.on_device:
+        # sort-dedup distinct reduce is not a plain collective; under a
+        # mesh the sharded kernels can't merge it yet — host path
+        sort_pairs_on_mesh = self.mesh is not None and any(
+            a.sort_pairs for a in plan.aggs
+        )
+        if not plan.on_device or sort_pairs_on_mesh:
             from pinot_tpu.engine.host_fallback import execute_host
 
             return execute_host(live, ctx, request, total_docs, sel_columns)
@@ -168,6 +197,18 @@ class QueryExecutor:
             outs = kernel(seg_arrays, q_inputs)
         outs = {k: np.asarray(v) if not isinstance(v, tuple) else tuple(np.asarray(x) for x in v) for k, v in outs.items()}
         t0 = self._phase("planExec", t0)
+
+        # sort-dedup distinct overflow: more unique pairs than the
+        # device buffer holds — only the host path can finish exactly
+        for i, agg in enumerate(plan.aggs):
+            if agg.sort_pairs:
+                state = (
+                    outs[f"gb_{i}"] if plan.group_by is not None else outs[f"agg_{i}"]
+                )
+                if int(state[2]) > state[0].shape[0]:
+                    from pinot_tpu.engine.host_fallback import execute_host
+
+                    return execute_host(live, ctx, request, total_docs, sel_columns)
 
         result = self._finalize(request, plan, ctx, staged, live, outs, total_docs, sel_columns)
         if scanned_rows is not None:
@@ -350,6 +391,15 @@ class QueryExecutor:
             gfwd_cols.update(c for c in request.group_by.columns if sv(c))
         if request.is_selection:
             gfwd_cols.update(s.column for s in request.selection.sorts if sv(s.column))
+        # presence-kind aggs (distinctcount) read global value ids per
+        # row: stage them host-side (gfwd) so the kernel streams instead
+        # of gathering a remap table on device (slow at any cardinality
+        # on TPU — MICROBENCH_TPU.json)
+        gfwd_cols.update(
+            a.column
+            for a in request.aggregations
+            if _agg_kind(a.base_function) == "presence" and sv(a.column)
+        )
         return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols))
 
     def _to_device_inputs(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
@@ -420,7 +470,11 @@ class QueryExecutor:
             return MinMaxRangePartial(float(state[0]), float(state[1]))
         if agg.kind == "presence":
             gdict = ctx.column(agg.column).global_dict
-            ids = np.nonzero(np.asarray(state))[0]
+            if agg.sort_pairs:
+                _slots, gids, n = state
+                ids = np.asarray(gids)[: int(n)]
+            else:
+                ids = np.nonzero(np.asarray(state))[0]
             return DistinctPartial({gdict.get(int(i)) for i in ids if i < gdict.cardinality})
         if agg.kind == "hist":
             gdict = ctx.column(agg.column).global_dict
@@ -444,6 +498,12 @@ class QueryExecutor:
         keys = np.nonzero(presence)[0]
         if keys.size == 0:
             return {}
+
+        # sort-dedup distinct states arrive as compacted (slot, gid)
+        # pair buffers; index them once per agg for the per-group reads
+        for i, agg in enumerate(plan.aggs):
+            if agg.sort_pairs and not isinstance(outs[f"gb_{i}"], _PairsState):
+                outs[f"gb_{i}"] = _PairsState(outs[f"gb_{i}"], gb.capacity)
 
         # Trim candidate groups per aggregation (reference trims to
         # topN*5 per server, MCombineGroupByOperator.java:216); the
@@ -503,6 +563,8 @@ class QueryExecutor:
         if base == "minmaxrange":
             return np.asarray(state[1])[keys] - np.asarray(state[0])[keys]
         if agg.kind == "presence":
+            if agg.sort_pairs:
+                return state.counts[keys]
             return np.asarray(state)[keys].sum(axis=1).astype(float)
         if agg.kind == "hist":
             # exact percentile from histogram rows, vectorized:
@@ -540,8 +602,11 @@ class QueryExecutor:
             return MinMaxRangePartial(float(np.asarray(state[0])[key]), float(np.asarray(state[1])[key]))
         if agg.kind == "presence":
             gdict = ctx.column(agg.column).global_dict
-            row = np.asarray(state)[key]
-            ids = np.nonzero(row)[0]
+            if agg.sort_pairs:
+                ids = state.gids_for(key)
+            else:
+                row = np.asarray(state)[key]
+                ids = np.nonzero(row)[0]
             return DistinctPartial({gdict.get(int(i)) for i in ids if i < gdict.cardinality})
         if agg.kind == "hist":
             gdict = ctx.column(agg.column).global_dict

@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from pinot_tpu.engine import config
 from pinot_tpu.engine.plan import MV_ANY, MV_NONE, SV, StaticAgg, StaticPlan
@@ -200,13 +201,27 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
     aux = q["agg_aux"][i]
     if agg.kind == "presence":
         remap = aux["remap"]  # [card_pad] int32 -> global ids
+        if agg.sort_pairs:
+            # emit (0, valueId) pairs; sort-dedup happens in the reduce
+            if agg.is_mv:
+                mv = seg[f"{agg.column}.mv"]
+                m = (_mv_valid(seg, agg.column) & mask[:, None]).reshape(-1)
+                gids = remap[mv].reshape(-1)
+            else:
+                m = mask
+                gids = _presence_gids(agg, seg, remap)
+            sent = _PAIR_SENTINEL
+            return (
+                jnp.where(m, 0, sent).astype(jnp.int32),
+                jnp.where(m, gids.astype(jnp.int32), sent),
+            )
         presence = jnp.zeros(agg.gcard_pad, dtype=jnp.int32)
         if agg.is_mv:
             mv = seg[f"{agg.column}.mv"]
             m = _mv_valid(seg, agg.column) & mask[:, None]
             gids = remap[mv]
             return presence.at[gids].max(m.astype(jnp.int32), mode="drop")
-        gids = remap[seg[f"{agg.column}.fwd"]]
+        gids = _presence_gids(agg, seg, remap)
         return presence.at[gids].max(mask.astype(jnp.int32), mode="drop")
 
     if agg.kind == "hist":
@@ -343,10 +358,20 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
             pair_g = jnp.broadcast_to(gids[:, None, :], (gids.shape[0], E, gids.shape[-1])).reshape(-1)
             pair_v = (kvalid[:, :, None] & mvv[:, None, :]).reshape(-1)
         else:
-            gids = remap[seg[f"{agg.column}.fwd"]]  # [n]
+            gids = _presence_gids(agg, seg, remap)  # [n] global value ids
             pair_k = flat_idx
             pair_g = per_entry(gids)
             pair_v = fvalid
+        if agg.sort_pairs:
+            # high-cardinality exact distinct: emit (group slot, valueId)
+            # pairs; the cross-segment reduce sort-dedups them
+            # (apply_reduce "distinct_pairs") — no [capacity, gcard_pad]
+            # state ever materializes
+            sent = _PAIR_SENTINEL
+            return (
+                jnp.where(pair_v, pair_k.astype(jnp.int32), sent),
+                jnp.where(pair_v, pair_g.astype(jnp.int32), sent),
+            )
         if agg.kind == "presence":
             holder = jnp.zeros((capacity, agg.gcard_pad), dtype=jnp.int32)
             return holder.at[pair_k, pair_g].max(pair_v.astype(jnp.int32), mode="drop")
@@ -511,12 +536,53 @@ def _state_reduce(agg: StaticAgg) -> str:
     if base == "minmaxrange":
         return "minmax_pair"
     if agg.kind == "presence":
-        return "max"
+        return "distinct_pairs" if agg.sort_pairs else "max"
     if agg.kind == "hist":
         return "sum"
     if agg.kind == "hll":
         return "max"
     raise AssertionError(agg)
+
+
+# int32 sentinel marking invalid (masked) pairs; sorts past every real
+# (slot, gid) pair since slots < MAX_GROUP_CAPACITY and gids < 2^31-1
+_PAIR_SENTINEL = np.iinfo(np.int32).max
+
+
+def _presence_gids(agg: StaticAgg, seg, remap):
+    """Per-row GLOBAL value ids for an SV presence agg: prefer the
+    host-staged global-id stream (``.gfwd``, executor._role_columns)
+    over an on-device remap-table gather — device gathers serialize on
+    TPU at any cardinality (MICROBENCH_TPU.json)."""
+    gf = seg.get(f"{agg.column}.gfwd")
+    if gf is not None:
+        return gf
+    return remap[seg[f"{agg.column}.fwd"]]
+
+
+def _reduce_distinct_pairs(value):
+    """Global sort-dedup of (group slot, valueId) pairs across all
+    segments: the exact-distinct merge without per-pair state.
+
+    1. lexicographic sort of the flattened pairs (two int32 keys — no
+       int64 needed, so it runs with x64 disabled on TPU),
+    2. run-boundary mask = the unique pairs; sentinels excluded,
+    3. stable compaction sort (unique-first) into a DISTINCT_PAIR_CAP
+       buffer + the true unique count (host falls back when it
+       overflows the buffer).
+    """
+    s = value[0].reshape(-1)
+    g = value[1].reshape(-1)
+    s, g = jax.lax.sort((s, g), num_keys=2)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), (s[1:] != s[:-1]) | (g[1:] != g[:-1])]
+    )
+    uniq = first & (s != _PAIR_SENTINEL)
+    n_unique = jnp.sum(uniq).astype(jnp.int32)
+    rank = jnp.where(uniq, 0, 1).astype(jnp.int32)
+    _, s2, g2 = jax.lax.sort((rank, s, g), num_keys=1, is_stable=True)
+    k = min(config.DISTINCT_PAIR_CAP, int(s2.shape[0]))
+    return (s2[:k], g2[:k], n_unique)
 
 
 def apply_reduce(op: str, value: Any):
@@ -530,6 +596,8 @@ def apply_reduce(op: str, value: Any):
         return (jnp.sum(value[0], axis=0), jnp.sum(value[1], axis=0))
     if op == "minmax_pair":
         return (jnp.min(value[0], axis=0), jnp.max(value[1], axis=0))
+    if op == "distinct_pairs":
+        return _reduce_distinct_pairs(value)
     if op == "none":
         return value
     raise ValueError(op)

@@ -25,7 +25,7 @@ Filter leaf modes:
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -84,6 +84,12 @@ class StaticAgg:
     # read values from the staged raw array (streaming) instead of
     # gathering dict_vals[fwd] — big-dictionary gathers are slow on TPU
     use_raw: bool = False
+    # exact distinct via device sort-dedup of (group, valueId) pairs
+    # instead of the dense [capacity, gcard_pad] presence holder — the
+    # high-cardinality path that keeps distinctcount on-chip where the
+    # reference switches to map-based storage
+    # (DefaultGroupKeyGenerator.java:60-63)
+    sort_pairs: bool = False
 
 
 @dataclass(frozen=True)
@@ -214,11 +220,17 @@ def build_static_plan(
         base = a.base_function
         kind = _agg_kind(base)
         gcard_pad = 0
+        sort_pairs = False
         if kind in ("presence", "hist"):
             gcol = ctx.column(a.column)
             gcard_pad = config.pad_card(gcol.global_cardinality)
             if gcard_pad > config.MAX_VALUE_STATE:
-                on_device = False
+                if kind == "presence":
+                    # dense presence state would not fit: sort-dedup
+                    # (group, valueId) pairs on device instead
+                    sort_pairs = True
+                else:
+                    on_device = False
         is_mv = a.is_mv
         if a.column != "*" and not staged.column(a.column).single_value:
             is_mv = True
@@ -236,6 +248,7 @@ def build_static_plan(
                 kind=kind,
                 gcard_pad=gcard_pad,
                 use_raw=use_raw,
+                sort_pairs=sort_pairs,
             )
         )
 
@@ -250,12 +263,19 @@ def build_static_plan(
             cap *= max(c, 1)
         if cap > config.MAX_GROUP_CAPACITY or cap > config.max_key_space():
             on_device = False
-        # value-state aggs need [capacity, gcard] holders — cap the product
-        for a in aggs:
+        # value-state aggs need [capacity, gcard] holders — cap the
+        # product; presence escapes to the sort-dedup path instead of
+        # leaving the device
+        for ai, a in enumerate(aggs):
+            if a.sort_pairs:
+                continue
             if a.kind in ("presence", "hist", "hll"):
                 state = a.gcard_pad if a.kind != "hll" else config.HLL_M
                 if cap * state > config.MAX_VALUE_STATE * 4:
-                    on_device = False
+                    if a.kind == "presence":
+                        aggs[ai] = replace(a, sort_pairs=True)
+                    else:
+                        on_device = False
         group_by = StaticGroupBy(
             columns=cols,
             col_is_mv=col_is_mv,

@@ -1,0 +1,174 @@
+"""Sort-dedup exact-distinct device path (StaticAgg.sort_pairs):
+high-cardinality ``distinctcount`` stays on device via a global
+(group, valueId) pair sort instead of the dense [capacity, gcard_pad]
+holder or the host fallback.
+
+Reference parity: the map-based group-by storage the reference switches
+to beyond the dense array key space
+(``DefaultGroupKeyGenerator.java:60-63``), re-designed for TPU — sorts
+are vectorizable where hash maps are not (VERDICT r2 #3)."""
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine import config
+from pinot_tpu.engine.context import get_table_context
+from pinot_tpu.engine.device import clear_staging_cache, stage_segments
+from pinot_tpu.engine.executor import QueryExecutor
+from pinot_tpu.engine.plan import build_static_plan
+from pinot_tpu.engine.reduce import reduce_to_response
+from pinot_tpu.pql import optimize_request, parse_pql
+from pinot_tpu.tools.datagen import lineitem_schema, synthetic_lineitem_segment
+from pinot_tpu.tools.scan_engine import ScanQueryProcessor
+
+STRIP = (
+    "timeUsedMs",
+    "numEntriesScannedInFilter",
+    "numEntriesScannedPostFilter",
+    "numSegmentsQueried",
+    "numServersQueried",
+    "numServersResponded",
+    "numDocsScanned",
+)
+
+
+def _norm(resp):
+    j = resp.to_json()
+    for k in STRIP:
+        j.pop(k, None)
+    return json.dumps(j, sort_keys=True, default=str)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    segs = [
+        synthetic_lineitem_segment(15000, seed=23 + i, name=f"ds{i}") for i in range(3)
+    ]
+    rows = [r for s in segs for r in s.rows()]
+    return segs, ScanQueryProcessor(lineitem_schema(), rows)
+
+
+@pytest.fixture(autouse=True)
+def small_dense_cap(monkeypatch):
+    # l_extendedprice has ~16k global cardinality; force it past the
+    # dense-state budget so the sort-dedup path engages
+    monkeypatch.setattr(config, "MAX_VALUE_STATE", 1 << 10)
+    # keep the selective-predicate host path out of the way: these
+    # tests pin the DEVICE kernel path
+    monkeypatch.setenv("PINOT_TPU_INVINDEX", "0")
+
+
+def test_plan_selects_sort_pairs(cluster):
+    segs, _ = cluster
+    req = optimize_request(
+        parse_pql(
+            "SELECT distinctcount(l_extendedprice) FROM lineitem "
+            "GROUP BY l_returnflag TOP 10"
+        )
+    )
+    ctx = get_table_context(segs)
+    staged = stage_segments(segs, sorted(req.referenced_columns()), ctx=ctx)
+    plan = build_static_plan(req, ctx, staged)
+    assert plan.on_device
+    assert plan.aggs[0].sort_pairs
+
+
+QUERIES = [
+    "SELECT distinctcount(l_extendedprice) FROM lineitem GROUP BY l_returnflag TOP 10",
+    "SELECT distinctcount(l_extendedprice) FROM lineitem",
+    "SELECT distinctcount(l_extendedprice), count(*) FROM lineitem "
+    "WHERE l_shipmode IN ('RAIL','FOB') GROUP BY l_linestatus TOP 10",
+    "SELECT distinctcount(l_extendedprice), sum(l_quantity) FROM lineitem "
+    "GROUP BY l_returnflag, l_linestatus TOP 10",
+    "SELECT distinctcount(l_extendedprice) FROM lineitem WHERE l_shipdate > '1998-10-01'",
+]
+
+
+def test_sort_path_matches_oracle(cluster):
+    segs, oracle = cluster
+    ex = QueryExecutor()
+    for q in QUERIES:
+        req = optimize_request(parse_pql(q))
+        req2 = optimize_request(parse_pql(q))
+        got = reduce_to_response(req, [ex.execute(segs, req)])
+        want = oracle.execute(req2)
+        assert _norm(got) == _norm(want), q
+
+
+def test_cross_server_merge_stays_exact(cluster):
+    """Partials from two executors over disjoint segment sets merge to
+    the same exact distinct counts (DistinctPartial set semantics ride
+    the pair buffers)."""
+    segs, oracle = cluster
+    q = (
+        "SELECT distinctcount(l_extendedprice) FROM lineitem "
+        "GROUP BY l_returnflag TOP 10"
+    )
+    req = optimize_request(parse_pql(q))
+    ex = QueryExecutor()
+    parts = [ex.execute(segs[:2], req), ex.execute(segs[2:], req)]
+    got = reduce_to_response(req, parts)
+    want = oracle.execute(optimize_request(parse_pql(q)))
+    assert _norm(got) == _norm(want)
+
+
+def test_overflow_falls_back_to_host(cluster, monkeypatch):
+    from pinot_tpu.engine import kernel as kernel_mod
+
+    segs, oracle = cluster
+    monkeypatch.setattr(config, "DISTINCT_PAIR_CAP", 64)  # << unique pairs
+    kernel_mod.make_table_kernel.cache_clear()
+    kernel_mod.make_packed_table_kernel.cache_clear()
+    try:
+        q = "SELECT distinctcount(l_extendedprice) FROM lineitem GROUP BY l_returnflag TOP 10"
+        req = optimize_request(parse_pql(q))
+        got = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
+        want = oracle.execute(optimize_request(parse_pql(q)))
+        assert _norm(got) == _norm(want)
+    finally:
+        kernel_mod.make_table_kernel.cache_clear()
+        kernel_mod.make_packed_table_kernel.cache_clear()
+        clear_staging_cache()
+
+
+def test_trim_path_uses_pair_counts(cluster):
+    """>100 groups engages trim ordering, which reads the per-slot
+    distinct counts off the pair buffer (_PairsState.counts)."""
+    segs, oracle = cluster
+    q = (
+        "SELECT distinctcount(l_extendedprice) FROM lineitem "
+        "GROUP BY l_shipdate TOP 5"
+    )
+    req = optimize_request(parse_pql(q))
+    got = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
+    want = oracle.execute(optimize_request(parse_pql(q)))
+    assert _norm(got) == _norm(want)
+
+
+def test_mv_sort_pairs_matches_oracle(monkeypatch):
+    """MV distinctcount through the pair-emission path (per-entry
+    expansion, dedup across repeated values within a row)."""
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.tools.datagen import make_test_schema, random_rows
+
+    schema = make_test_schema(with_mv=True)
+    rows = random_rows(schema, 4000, seed=9)
+    segs = [
+        build_segment(schema, rows[:2000], "testTable", "mv0"),
+        build_segment(schema, rows[2000:], "testTable", "mv1"),
+    ]
+    oracle = ScanQueryProcessor(schema, rows)
+    # force the sort path for the MV column's cardinality too
+    monkeypatch.setattr(config, "MAX_VALUE_STATE", 1)
+    for q in [
+        "SELECT distinctcountmv(dimIntMV) FROM testTable",
+        "SELECT distinctcountmv(dimIntMV) FROM testTable GROUP BY dimStr TOP 10",
+        "SELECT distinctcountmv(dimStrMV), count(*) FROM testTable "
+        "WHERE dimInt > 300 GROUP BY dimStr TOP 10",
+    ]:
+        req = optimize_request(parse_pql(q))
+        plan_probe = optimize_request(parse_pql(q))
+        got = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
+        want = oracle.execute(plan_probe)
+        assert _norm(got) == _norm(want), q
