@@ -51,11 +51,10 @@ class _PairsState:
     def __init__(self, state, capacity: int) -> None:
         slots, gids, n = state
         n = int(n)
-        slots = np.asarray(slots)[:n].astype(np.int64)
-        gids = np.asarray(gids)[:n]
-        order = np.argsort(slots, kind="stable")
-        self._slots_sorted = slots[order]
-        self._gids_sorted = gids[order]
+        # the device reduce's stable unique-first compaction leaves the
+        # first n entries already sorted by (slot, gid) — no host re-sort
+        self._slots_sorted = np.asarray(slots)[:n].astype(np.int64)
+        self._gids_sorted = np.asarray(gids)[:n]
         self._bounds = np.searchsorted(
             self._slots_sorted, np.arange(capacity + 1, dtype=np.int64)
         )

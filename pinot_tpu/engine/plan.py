@@ -512,7 +512,17 @@ def build_query_inputs(
     for a in plan.aggs:
         aux: Dict[str, np.ndarray] = {}
         if a.kind in ("presence", "hist"):
-            aux["remap"] = _stacked_remap(ctx, staged, a.column)
+            # SV presence reads the staged .gfwd stream (kernel
+            # _presence_gids); shipping the full remap table then would
+            # be dead H2D weight — dummy it, as group_remap does
+            if (
+                a.kind == "presence"
+                and not a.is_mv
+                and staged.column(a.column).gfwd is not None
+            ):
+                aux["remap"] = np.zeros((S, 1), dtype=np.int32)
+            else:
+                aux["remap"] = _stacked_remap(ctx, staged, a.column)
         elif a.kind == "hll":
             bucket, rho = _hll_tables(ctx, staged, a.column)
             aux["bucket"] = bucket
