@@ -52,27 +52,36 @@ def _use_matmul_groupby() -> bool:
     return jax.default_backend() != "cpu"
 
 
-def _segment_add_matmul(flat_idx, w, capacity: int):
-    """sum w into capacity buckets via chunked one-hot matmuls.
-    Out-of-range indices (== capacity) contribute to a dropped bucket."""
+def _segment_add_matmul_multi(flat_idx, W, capacity: int):
+    """Sum m weight columns into capacity buckets with ONE chunked
+    one-hot contraction: [m, chunk] @ [chunk, K] per scan step.
+
+    The one-hot block is built once per chunk for EVERY aggregation —
+    per-agg scans would rebuild (and re-stream) it once per agg, which
+    dominated the Q1 kernel's HBM traffic.  Out-of-range indices
+    (== capacity) one-hot to a zero row and drop."""
     fdt = config.float_dtype()
-    n = flat_idx.shape[0]
+    m, n = W.shape
     chunk = min(_MATMUL_CHUNK, n)
     pad = (-n) % chunk
     if pad:
         flat_idx = jnp.concatenate([flat_idx, jnp.full(pad, capacity, flat_idx.dtype)])
-        w = jnp.concatenate([w, jnp.zeros(pad, w.dtype)])
+        W = jnp.concatenate([W, jnp.zeros((m, pad), W.dtype)], axis=1)
     nb = flat_idx.shape[0] // chunk
-    idx_r = flat_idx.reshape(nb, chunk)
-    w_r = w.reshape(nb, chunk).astype(fdt)
 
-    def body(acc, args):
-        i_c, w_c = args
+    def body(acc, b):
+        start = b * chunk
+        i_c = jax.lax.dynamic_slice_in_dim(flat_idx, start, chunk)
+        w_c = jax.lax.dynamic_slice_in_dim(W, start, chunk, axis=1).astype(fdt)
         onehot = jax.nn.one_hot(i_c, capacity, dtype=fdt)  # [chunk, K]
         return acc + w_c @ onehot, None
 
-    acc, _ = jax.lax.scan(body, jnp.zeros(capacity, dtype=fdt), (idx_r, w_r))
+    acc, _ = jax.lax.scan(
+        body, jnp.zeros((m, capacity), dtype=fdt), jnp.arange(nb)
+    )
     return acc
+
+
 
 
 def _row_shaped(key: str) -> bool:
@@ -281,6 +290,38 @@ def _group_keys(plan: StaticPlan, seg, q, mask):
     return keys, kvalid
 
 
+def _group_add_weights(agg: StaticAgg, seg, mask, kvalid):
+    """Flattened per-entry weight columns for the sum-shaped group aggs
+    (count / sum / avg) — the batchable operands of the fused one-hot
+    contraction.  None for aggs needing other combining ops (min/max/
+    presence/hist/hll), which keep their own scatter paths."""
+    if agg.base not in ("count", "sum", "avg"):
+        return None
+    if agg.base != "count" and agg.kind not in ("scalar", "pair"):
+        return None
+    fdt = config.float_dtype()
+    shape = kvalid.shape
+
+    def per_entry(row_scalar):
+        return jnp.broadcast_to(row_scalar[:, None], shape).reshape(-1)
+
+    if agg.base == "count":
+        if agg.is_mv:
+            mvv = _mv_valid(seg, agg.column)
+            return (per_entry(jnp.sum(mvv, axis=-1).astype(fdt)),)
+        return (jnp.ones(shape, dtype=fdt).reshape(-1),)
+    vals, m = _row_values(agg, seg, mask)
+    if agg.is_mv:
+        row_sum = jnp.sum(jnp.where(m, vals, 0), axis=-1)
+        row_cnt = jnp.sum(m, axis=-1).astype(fdt)
+    else:
+        row_sum = vals
+        row_cnt = jnp.ones_like(vals, dtype=fdt)
+    if agg.base == "sum":
+        return (per_entry(row_sum),)
+    return (per_entry(row_sum), per_entry(row_cnt))
+
+
 def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -> Any:
     fdt = config.float_dtype()
     base = agg.base
@@ -292,12 +333,11 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
         """Broadcast a per-row scalar across the expansion axis, flattened."""
         return jnp.broadcast_to(row_scalar[:, None], idx.shape).reshape(-1)
 
-    use_matmul = capacity <= MATMUL_GROUP_CAP and _use_matmul_groupby()
-
     def group_add(weights):
+        # count/sum/avg reach here only on the scatter branch — on the
+        # matmul branch the fused multi-column contraction handles them
+        # (make_single_segment_kernel)
         w = jnp.where(fvalid, weights, 0)
-        if use_matmul:
-            return _segment_add_matmul(flat_idx, w, capacity)
         return jnp.zeros(capacity, dtype=fdt).at[flat_idx].add(w, mode="drop")
 
     if base == "count":
@@ -420,21 +460,47 @@ def make_single_segment_kernel(plan: StaticPlan) -> Callable:
             cap = plan.group_by.capacity
             flat_idx = jnp.where(kvalid, keys, cap).reshape(-1)
             fvalid = kvalid.reshape(-1)
+            fdt = config.float_dtype()
             if cap <= MATMUL_GROUP_CAP and _use_matmul_groupby():
-                # presence = occupancy count > 0, on the MXU path —
-                # a scatter-max here would dominate the whole kernel
-                counts = _segment_add_matmul(
-                    flat_idx, fvalid.astype(config.float_dtype()), cap
-                )
-                out["gb_presence"] = (counts > 0).astype(jnp.int32)
+                # ONE fused one-hot contraction (MXU) covers occupancy
+                # AND every sum-shaped agg: a single pass over rows with
+                # one one-hot per chunk, instead of a scan per agg —
+                # the per-agg version re-streamed the one-hot blocks
+                # and dominated the kernel's HBM traffic
+                cols = [fvalid.astype(fdt)]
+                slots: Dict[int, List[int]] = {}
+                for i, agg in enumerate(plan.aggs):
+                    if agg.base == "count" and not agg.is_mv:
+                        # count weights == the occupancy column exactly
+                        slots[i] = [0]
+                        continue
+                    w = _group_add_weights(agg, seg, mask, kvalid)
+                    if w is None:
+                        continue
+                    slots[i] = []
+                    for vec in w:
+                        slots[i].append(len(cols))
+                        cols.append(jnp.where(fvalid, vec, 0))
+                states = _segment_add_matmul_multi(flat_idx, jnp.stack(cols), cap)
+                out["gb_presence"] = (states[0] > 0).astype(jnp.int32)
+                for i, agg in enumerate(plan.aggs):
+                    if i in slots:
+                        rows = [states[j] for j in slots[i]]
+                        out[f"gb_{i}"] = rows[0] if len(rows) == 1 else tuple(rows)
+                    else:
+                        out[f"gb_{i}"] = _group_state(
+                            agg, i, seg, q, mask, keys, kvalid, cap
+                        )
             else:
                 out["gb_presence"] = (
                     jnp.zeros(cap, dtype=jnp.int32)
                     .at[flat_idx]
                     .max(fvalid.astype(jnp.int32), mode="drop")
                 )
-            for i, agg in enumerate(plan.aggs):
-                out[f"gb_{i}"] = _group_state(agg, i, seg, q, mask, keys, kvalid, cap)
+                for i, agg in enumerate(plan.aggs):
+                    out[f"gb_{i}"] = _group_state(
+                        agg, i, seg, q, mask, keys, kvalid, cap
+                    )
         else:
             for i, agg in enumerate(plan.aggs):
                 out[f"agg_{i}"] = _agg_state(agg, i, seg, q, mask)
