@@ -390,14 +390,14 @@ class QueryExecutor:
             gfwd_cols.update(c for c in request.group_by.columns if sv(c))
         if request.is_selection:
             gfwd_cols.update(s.column for s in request.selection.sorts if sv(s.column))
-        # presence-kind aggs (distinctcount) read global value ids per
-        # row: stage them host-side (gfwd) so the kernel streams instead
-        # of gathering a remap table on device (slow at any cardinality
-        # on TPU — MICROBENCH_TPU.json)
+        # presence/hist aggs (distinctcount, percentile) read global
+        # value ids per row: stage them host-side (gfwd) so the kernel
+        # streams instead of gathering a remap table on device (slow at
+        # any cardinality on TPU — MICROBENCH_TPU.json)
         gfwd_cols.update(
             a.column
             for a in request.aggregations
-            if _agg_kind(a.base_function) == "presence" and sv(a.column)
+            if _agg_kind(a.base_function) in ("presence", "hist") and sv(a.column)
         )
         return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols))
 

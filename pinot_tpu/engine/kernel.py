@@ -218,7 +218,7 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
                 gids = remap[mv].reshape(-1)
             else:
                 m = mask
-                gids = _presence_gids(agg, seg, remap)
+                gids = _value_gids(agg, seg, remap)
             sent = _PAIR_SENTINEL
             return (
                 jnp.where(m, 0, sent).astype(jnp.int32),
@@ -230,7 +230,7 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
             m = _mv_valid(seg, agg.column) & mask[:, None]
             gids = remap[mv]
             return presence.at[gids].max(m.astype(jnp.int32), mode="drop")
-        gids = _presence_gids(agg, seg, remap)
+        gids = _value_gids(agg, seg, remap)
         return presence.at[gids].max(mask.astype(jnp.int32), mode="drop")
 
     if agg.kind == "hist":
@@ -240,7 +240,7 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
             mv = seg[f"{agg.column}.mv"]
             m = _mv_valid(seg, agg.column) & mask[:, None]
             return hist.at[remap[mv]].add(m.astype(fdt), mode="drop")
-        gids = remap[seg[f"{agg.column}.fwd"]]
+        gids = _value_gids(agg, seg, remap)
         return hist.at[gids].add(mask.astype(fdt), mode="drop")
 
     if agg.kind == "hll":
@@ -398,7 +398,7 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
             pair_g = jnp.broadcast_to(gids[:, None, :], (gids.shape[0], E, gids.shape[-1])).reshape(-1)
             pair_v = (kvalid[:, :, None] & mvv[:, None, :]).reshape(-1)
         else:
-            gids = _presence_gids(agg, seg, remap)  # [n] global value ids
+            gids = _value_gids(agg, seg, remap)  # [n] global value ids
             pair_k = flat_idx
             pair_g = per_entry(gids)
             pair_v = fvalid
@@ -615,9 +615,9 @@ def _state_reduce(agg: StaticAgg) -> str:
 _PAIR_SENTINEL = np.iinfo(np.int32).max
 
 
-def _presence_gids(agg: StaticAgg, seg, remap):
-    """Per-row GLOBAL value ids for an SV presence agg: prefer the
-    host-staged global-id stream (``.gfwd``, executor._role_columns)
+def _value_gids(agg: StaticAgg, seg, remap):
+    """Per-row GLOBAL value ids for an SV presence/hist agg: prefer
+    the host-staged global-id stream (``.gfwd``, executor._role_columns)
     over an on-device remap-table gather — device gathers serialize on
     TPU at any cardinality (MICROBENCH_TPU.json)."""
     gf = seg.get(f"{agg.column}.gfwd")

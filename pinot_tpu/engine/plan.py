@@ -512,14 +512,10 @@ def build_query_inputs(
     for a in plan.aggs:
         aux: Dict[str, np.ndarray] = {}
         if a.kind in ("presence", "hist"):
-            # SV presence reads the staged .gfwd stream (kernel
-            # _presence_gids); shipping the full remap table then would
+            # SV presence/hist read the staged .gfwd stream (kernel
+            # _value_gids); shipping the full remap table then would
             # be dead H2D weight — dummy it, as group_remap does
-            if (
-                a.kind == "presence"
-                and not a.is_mv
-                and staged.column(a.column).gfwd is not None
-            ):
+            if not a.is_mv and staged.column(a.column).gfwd is not None:
                 aux["remap"] = np.zeros((S, 1), dtype=np.int32)
             else:
                 aux["remap"] = _stacked_remap(ctx, staged, a.column)
