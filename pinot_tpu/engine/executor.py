@@ -149,7 +149,7 @@ class QueryExecutor:
         if ires is not None:
             self._phase("indexPath", t0)
             return ires
-        raw_cols, gfwd_cols = self._role_columns(request, live)
+        raw_cols, gfwd_cols = self._role_columns(request, live, ctx)
         staged = get_staged(
             live,
             sorted(needed),
@@ -350,7 +350,12 @@ class QueryExecutor:
             return list(seg.columns.keys())
         return list(cols)
 
-    def _role_columns(self, request: BrokerRequest, live: Sequence[ImmutableSegment]):
+    def _role_columns(
+        self,
+        request: BrokerRequest,
+        live: Sequence[ImmutableSegment],
+        ctx: Optional[TableContext] = None,
+    ):
         """Columns to stage with role-specific arrays: aggregation
         inputs get raw value arrays, group-by/sort keys get global-id
         forward arrays (both avoid slow big-table gathers on device)."""
@@ -393,11 +398,34 @@ class QueryExecutor:
         # presence/hist aggs (distinctcount, percentile) read global
         # value ids per row: stage them host-side (gfwd) so the kernel
         # streams instead of gathering a remap table on device (slow at
-        # any cardinality on TPU — MICROBENCH_TPU.json)
+        # any cardinality on TPU — MICROBENCH_TPU.json).  Hist must
+        # mirror build_static_plan's dense-state limits: beyond them the
+        # query takes the host fallback and staging would be dead weight
+        # (presence escapes to the on-device sort path instead).
+        def group_cap() -> int:
+            if not request.is_group_by or ctx is None:
+                return 1
+            cap = 1
+            for c in request.group_by.columns:
+                cap *= max(ctx.column(c).global_cardinality, 1)
+            return cap
+
+        def hist_on_device(c: str) -> bool:
+            if ctx is None:
+                return True
+            gcard_pad = config.pad_card(ctx.column(c).global_cardinality)
+            if gcard_pad > config.MAX_VALUE_STATE:
+                return False
+            return group_cap() * gcard_pad <= config.MAX_VALUE_STATE * 4
+
         gfwd_cols.update(
             a.column
             for a in request.aggregations
-            if _agg_kind(a.base_function) in ("presence", "hist") and sv(a.column)
+            if sv(a.column)
+            and (
+                _agg_kind(a.base_function) == "presence"
+                or (_agg_kind(a.base_function) == "hist" and hist_on_device(a.column))
+            )
         )
         return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols))
 
