@@ -200,6 +200,25 @@ def _broker_latencies(segments, queries_per_round: int = 40):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+    # the other BASELINE.md workload shapes through the broker:
+    # Q6 (IN + range filter group-by) and the HLL distinct group-by
+    extra_shapes = {
+        "q6": (
+            "SELECT sum(l_extendedprice) FROM lineitem "
+            "WHERE l_shipmode IN ('RAIL','FOB') AND "
+            "l_receiptdate BETWEEN '1997-01-01' AND '1997-12-31' "
+            "GROUP BY l_shipmode TOP 10"
+        ),
+        "hll_groupby": (
+            "SELECT distinctcounthll(l_shipdate) FROM lineitem "
+            "GROUP BY l_returnflag TOP 10"
+        ),
+    }
+    for label, pql in extra_shapes.items():
+        runner.single_thread([pql], rounds=3)  # warm + compile
+        r = runner.single_thread([pql] * 10, rounds=1)
+        selective[f"{label}_p50_ms"] = r.to_json()["p50Ms"]
     return report, selective
 
 
