@@ -594,8 +594,7 @@ class RealtimeSegmentDataManager:
         rows, next_offset = self.stream.fetch(
             self.partition, self.offset, min(max_rows, budget)
         )
-        for row in rows:
-            self.mutable.index(row)
+        self.mutable.index_batch(rows)
         self.offset = next_offset
         self.mutable.end_offset = next_offset
         return len(rows)
@@ -620,8 +619,7 @@ class RealtimeSegmentDataManager:
                 )
                 if not got_rows:
                     break
-                for row in got_rows:
-                    self.mutable.index(row)
+                self.mutable.index_batch(got_rows)
                 self.offset = next_offset
                 self.mutable.end_offset = next_offset
             return resp

@@ -81,8 +81,7 @@ class RemoteConsumer:
         if budget <= 0:
             return 0
         rows, next_offset = self.stream.fetch(self.partition, self.offset, budget)
-        for row in rows:
-            self.mutable.index(row)
+        self.mutable.index_batch(rows)
         self.offset = next_offset
         self.mutable.end_offset = next_offset
         return len(rows)
@@ -244,8 +243,7 @@ class HLRemoteConsumer:
                     logger.warning("HLC poll failed for %s: %s", self.segment, e)
                     self._stop.wait(self.poll_interval_s)
                     continue
-                for _, row in rows:
-                    self.mutable.index(row)
+                self.mutable.index_batch([row for _, row in rows])
                 if self.mutable.num_docs >= self.rows_per_segment:
                     if not self._seal_and_roll():
                         self._stop.wait(self.poll_interval_s)

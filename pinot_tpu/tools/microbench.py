@@ -137,9 +137,11 @@ def bench_realtime(rows: int) -> Dict:
     data = random_rows(schema, min(rows, 200_000), seed=5)
 
     def consume():
+        # consumers fetch in batches (netstream/kafka fetch sizes);
+        # index_batch is the production ingest call
         seg = MutableSegment(schema, "rt0", "rt")
-        for row in data:
-            seg.index(row)
+        for i in range(0, len(data), 500):
+            seg.index_batch(data[i : i + 500])
         return seg
 
     t = _time_best(consume, repeat=3)

@@ -248,3 +248,27 @@ def test_hybrid_time_boundary(tmp_path):
 
     resp = cluster.query("SELECT max(mtime) FROM meetupRsvp")
     assert resp.aggregation_results[0].value == 1_000_079.0
+
+
+def test_index_batch_dirty_row_is_atomic():
+    """Regression: a dirty value mid-batch (producer garbage a
+    DataType.convert rejects) must not misalign columns — encode
+    happens before any row array mutates, so the whole batch rejects
+    and a corrected retry lands cleanly."""
+    import pytest
+
+    schema = rsvp_schema()
+    seg = MutableSegment(schema, "atom", "t")
+    seg.index_batch([make_row(i) for i in range(10)])
+    bad = [make_row(10), {**make_row(11), "rsvp_count": "not-an-int"}]
+    with pytest.raises(Exception):
+        seg.index_batch(bad)
+    assert seg.num_docs == 10
+    seg.index_batch([make_row(10), make_row(11)])
+    assert seg.num_docs == 12
+    snap = seg.snapshot()
+    assert snap.num_docs == 12
+    # every column aligned: spot-check the last row round-trips
+    row = snap.row(11)
+    assert row["rsvp_count"] == make_row(11)["rsvp_count"]
+    assert row["venue_name"] == make_row(11)["venue_name"]
