@@ -44,17 +44,22 @@ from pinot_tpu.segment.immutable import ImmutableSegment
 
 class _PairsState:
     """Host-side index over a compacted (group slot, valueId) pair
-    buffer from the sort-dedup distinct reduce (kernel.py
-    ``_reduce_distinct_pairs``): per-slot distinct counts for trim
-    ordering and per-slot gid slices for DistinctPartial building."""
+    buffer from the sort reduce (kernel.py ``_reduce_distinct_pairs``):
+    per-slot distinct counts for trim ordering, per-slot gid slices for
+    DistinctPartial building, and per-pair OCCURRENCE counts (run
+    lengths off the carried start positions) for exact percentile
+    histograms."""
 
     def __init__(self, state, capacity: int) -> None:
-        slots, gids, n = state
+        slots, gids, starts, n, total_valid = state
         n = int(n)
         # the device reduce's stable unique-first compaction leaves the
         # first n entries already sorted by (slot, gid) — no host re-sort
         self._slots_sorted = np.asarray(slots)[:n].astype(np.int64)
         self._gids_sorted = np.asarray(gids)[:n]
+        self._pair_counts = np.diff(
+            np.append(np.asarray(starts)[:n].astype(np.int64), int(total_valid))
+        )
         self._bounds = np.searchsorted(
             self._slots_sorted, np.arange(capacity + 1, dtype=np.int64)
         )
@@ -63,6 +68,34 @@ class _PairsState:
     def gids_for(self, key: int) -> np.ndarray:
         a, b = self._bounds[key], self._bounds[key + 1]
         return self._gids_sorted[a:b]
+
+    def gid_counts_for(self, key: int):
+        """(gids ascending, occurrence counts) for one group slot."""
+        a, b = self._bounds[key], self._bounds[key + 1]
+        return self._gids_sorted[a:b], self._pair_counts[a:b]
+
+    def percentiles_for(self, keys: np.ndarray, p: int, vals: np.ndarray) -> np.ndarray:
+        """Vectorized exact percentile per requested group slot from the
+        sparse (gid, count) runs — mirrors the dense-histogram math."""
+        csum = np.concatenate([[0], np.cumsum(self._pair_counts)])
+        lo, hi = self._bounds[keys], self._bounds[keys + 1]
+        n = csum[hi] - csum[lo]
+        idx = np.minimum((n * p / 100.0).astype(np.int64), np.maximum(n - 1, 0))
+        # global cumulative position of each group's idx-th element
+        pos = np.searchsorted(csum[1:], csum[lo] + idx, side="right")
+        pos = np.minimum(pos, self._gids_sorted.size - 1) if self._gids_sorted.size else pos
+        gid = self._gids_sorted[pos] if self._gids_sorted.size else np.zeros_like(pos)
+        out = np.where(n > 0, vals[np.minimum(gid, vals.size - 1)], -np.inf)
+        return out
+
+
+def _hist_partial(gdict, gids, cnts, p: int) -> "HistogramPartial":
+    counts = {
+        float(gdict.get(int(g))): int(c)
+        for g, c in zip(gids, cnts)
+        if g < gdict.cardinality
+    }
+    return HistogramPartial(counts, percentile=p)
 
 
 class QueryExecutor:
@@ -204,7 +237,7 @@ class QueryExecutor:
                 state = (
                     outs[f"gb_{i}"] if plan.group_by is not None else outs[f"agg_{i}"]
                 )
-                if int(state[2]) > state[0].shape[0]:
+                if int(state[3]) > state[0].shape[0]:
                     from pinot_tpu.engine.host_fallback import execute_host
 
                     return execute_host(live, ctx, request, total_docs, sel_columns)
@@ -398,34 +431,13 @@ class QueryExecutor:
         # presence/hist aggs (distinctcount, percentile) read global
         # value ids per row: stage them host-side (gfwd) so the kernel
         # streams instead of gathering a remap table on device (slow at
-        # any cardinality on TPU — MICROBENCH_TPU.json).  Hist must
-        # mirror build_static_plan's dense-state limits: beyond them the
-        # query takes the host fallback and staging would be dead weight
-        # (presence escapes to the on-device sort path instead).
-        def group_cap() -> int:
-            if not request.is_group_by or ctx is None:
-                return 1
-            cap = 1
-            for c in request.group_by.columns:
-                cap *= max(ctx.column(c).global_cardinality, 1)
-            return cap
-
-        def hist_on_device(c: str) -> bool:
-            if ctx is None:
-                return True
-            gcard_pad = config.pad_card(ctx.column(c).global_cardinality)
-            if gcard_pad > config.MAX_VALUE_STATE:
-                return False
-            return group_cap() * gcard_pad <= config.MAX_VALUE_STATE * 4
-
+        # any cardinality on TPU — MICROBENCH_TPU.json).  Both kinds
+        # stay on device at any cardinality (dense holders within the
+        # budget, the sort-pairs path beyond it).
         gfwd_cols.update(
             a.column
             for a in request.aggregations
-            if sv(a.column)
-            and (
-                _agg_kind(a.base_function) == "presence"
-                or (_agg_kind(a.base_function) == "hist" and hist_on_device(a.column))
-            )
+            if _agg_kind(a.base_function) in ("presence", "hist") and sv(a.column)
         )
         return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols))
 
@@ -498,19 +510,21 @@ class QueryExecutor:
         if agg.kind == "presence":
             gdict = ctx.column(agg.column).global_dict
             if agg.sort_pairs:
-                _slots, gids, n = state
-                ids = np.asarray(gids)[: int(n)]
+                ids = np.asarray(state[1])[: int(state[3])]
             else:
                 ids = np.nonzero(np.asarray(state))[0]
             return DistinctPartial({gdict.get(int(i)) for i in ids if i < gdict.cardinality})
         if agg.kind == "hist":
             gdict = ctx.column(agg.column).global_dict
+            p = int(base[len("percentileest"):]) if base.startswith("percentileest") else int(base[len("percentile"):])
+            if agg.sort_pairs:
+                ps = _PairsState(state, 1)
+                return _hist_partial(gdict, *ps.gid_counts_for(0), p)
             h = np.asarray(state)
             ids = np.nonzero(h)[0]
             counts = {
                 float(gdict.get(int(i))): int(h[i]) for i in ids if i < gdict.cardinality
             }
-            p = int(agg.base[len("percentileest"):]) if agg.base.startswith("percentileest") else int(agg.base[len("percentile"):])
             return HistogramPartial(counts, percentile=p)
         if agg.kind == "hll":
             return HllPartial(np.asarray(state).astype(np.uint8))
@@ -597,13 +611,15 @@ class QueryExecutor:
             # exact percentile from histogram rows, vectorized:
             # sorted[int(n * p/100)] per group (PercentileUtil.java:50)
             p = int(base[len("percentileest"):]) if base.startswith("percentileest") else int(base[len("percentile"):])
+            gdict = ctx.column(agg.column).global_dict
+            vals = np.asarray(gdict.values, dtype=np.float64)
+            if agg.sort_pairs:
+                return state.percentiles_for(keys, p, vals)
             h = np.asarray(state)[keys]  # [K, gcard_pad]
             cs = np.cumsum(h, axis=1)
             n = cs[:, -1]
             idx = np.minimum((n * p / 100.0).astype(np.int64), np.maximum(n - 1, 0))
             pos = (cs <= idx[:, None]).sum(axis=1)
-            gdict = ctx.column(agg.column).global_dict
-            vals = np.asarray(gdict.values, dtype=np.float64)
             pos = np.minimum(pos, vals.size - 1)
             return np.where(n > 0, vals[pos], -np.inf)
         if agg.kind == "hll":
@@ -637,10 +653,12 @@ class QueryExecutor:
             return DistinctPartial({gdict.get(int(i)) for i in ids if i < gdict.cardinality})
         if agg.kind == "hist":
             gdict = ctx.column(agg.column).global_dict
+            p = int(base[len("percentileest"):]) if base.startswith("percentileest") else int(base[len("percentile"):])
+            if agg.sort_pairs:
+                return _hist_partial(gdict, *state.gid_counts_for(key), p)
             row = np.asarray(state)[key]
             ids = np.nonzero(row)[0]
             counts = {float(gdict.get(int(i))): int(row[i]) for i in ids if i < gdict.cardinality}
-            p = int(base[len("percentileest"):]) if base.startswith("percentileest") else int(base[len("percentile"):])
             return HistogramPartial(counts, percentile=p)
         if agg.kind == "hll":
             return HllPartial(np.asarray(state)[key].astype(np.uint8))

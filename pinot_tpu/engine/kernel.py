@@ -208,22 +208,24 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
             )
 
     aux = q["agg_aux"][i]
+    if agg.kind in ("presence", "hist") and agg.sort_pairs:
+        # emit (0, valueId) pairs; the sort reduce dedups (presence)
+        # and carries run starts for occurrence counts (hist)
+        remap = aux["remap"]
+        if agg.is_mv:
+            mv = seg[f"{agg.column}.mv"]
+            m = (_mv_valid(seg, agg.column) & mask[:, None]).reshape(-1)
+            gids = remap[mv].reshape(-1)
+        else:
+            m = mask
+            gids = _value_gids(agg, seg, remap)
+        sent = _PAIR_SENTINEL
+        return (
+            jnp.where(m, 0, sent).astype(jnp.int32),
+            jnp.where(m, gids.astype(jnp.int32), sent),
+        )
     if agg.kind == "presence":
         remap = aux["remap"]  # [card_pad] int32 -> global ids
-        if agg.sort_pairs:
-            # emit (0, valueId) pairs; sort-dedup happens in the reduce
-            if agg.is_mv:
-                mv = seg[f"{agg.column}.mv"]
-                m = (_mv_valid(seg, agg.column) & mask[:, None]).reshape(-1)
-                gids = remap[mv].reshape(-1)
-            else:
-                m = mask
-                gids = _value_gids(agg, seg, remap)
-            sent = _PAIR_SENTINEL
-            return (
-                jnp.where(m, 0, sent).astype(jnp.int32),
-                jnp.where(m, gids.astype(jnp.int32), sent),
-            )
         presence = jnp.zeros(agg.gcard_pad, dtype=jnp.int32)
         if agg.is_mv:
             mv = seg[f"{agg.column}.mv"]
@@ -604,7 +606,7 @@ def _state_reduce(agg: StaticAgg) -> str:
     if agg.kind == "presence":
         return "distinct_pairs" if agg.sort_pairs else "max"
     if agg.kind == "hist":
-        return "sum"
+        return "distinct_pairs" if agg.sort_pairs else "sum"
     if agg.kind == "hll":
         return "max"
     raise AssertionError(agg)
@@ -628,14 +630,20 @@ def _value_gids(agg: StaticAgg, seg, remap):
 
 def _reduce_distinct_pairs(value):
     """Global sort-dedup of (group slot, valueId) pairs across all
-    segments: the exact-distinct merge without per-pair state.
+    segments — the exact distinct/histogram merge without per-pair
+    state.
 
     1. lexicographic sort of the flattened pairs (two int32 keys — no
        int64 needed, so it runs with x64 disabled on TPU),
     2. run-boundary mask = the unique pairs; sentinels excluded,
-    3. stable compaction sort (unique-first) into a DISTINCT_PAIR_CAP
-       buffer + the true unique count (host falls back when it
-       overflows the buffer).
+    3. stable compaction sort (unique-first, position carried as
+       payload) into a DISTINCT_PAIR_CAP buffer.
+
+    Returns (slots[CAP], gids[CAP], starts[CAP], n_unique, total_valid):
+    ``starts`` are each run's first position in the sorted order, so
+    per-pair OCCURRENCE counts fall out as diff(starts) on host —
+    distinctcount ignores them, exact percentile histograms need them.
+    Host falls back when n_unique overflows the buffer.
     """
     s = value[0].reshape(-1)
     g = value[1].reshape(-1)
@@ -645,10 +653,12 @@ def _reduce_distinct_pairs(value):
     )
     uniq = first & (s != _PAIR_SENTINEL)
     n_unique = jnp.sum(uniq).astype(jnp.int32)
+    total_valid = jnp.sum(s != _PAIR_SENTINEL).astype(jnp.int32)
     rank = jnp.where(uniq, 0, 1).astype(jnp.int32)
-    _, s2, g2 = jax.lax.sort((rank, s, g), num_keys=1, is_stable=True)
+    pos = jax.lax.iota(jnp.int32, s.shape[0])
+    _, s2, g2, p2 = jax.lax.sort((rank, s, g, pos), num_keys=1, is_stable=True)
     k = min(config.DISTINCT_PAIR_CAP, int(s2.shape[0]))
-    return (s2[:k], g2[:k], n_unique)
+    return (s2[:k], g2[:k], p2[:k], n_unique, total_valid)
 
 
 def apply_reduce(op: str, value: Any):

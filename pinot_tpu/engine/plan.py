@@ -225,12 +225,10 @@ def build_static_plan(
             gcol = ctx.column(a.column)
             gcard_pad = config.pad_card(gcol.global_cardinality)
             if gcard_pad > config.MAX_VALUE_STATE:
-                if kind == "presence":
-                    # dense presence state would not fit: sort-dedup
-                    # (group, valueId) pairs on device instead
-                    sort_pairs = True
-                else:
-                    on_device = False
+                # dense state would not fit: sort the (group, valueId)
+                # pairs on device instead — dedup covers distinctcount,
+                # run-length counts cover exact percentile histograms
+                sort_pairs = True
         is_mv = a.is_mv
         if a.column != "*" and not staged.column(a.column).single_value:
             is_mv = True
@@ -272,7 +270,7 @@ def build_static_plan(
             if a.kind in ("presence", "hist", "hll"):
                 state = a.gcard_pad if a.kind != "hll" else config.HLL_M
                 if cap * state > config.MAX_VALUE_STATE * 4:
-                    if a.kind == "presence":
+                    if a.kind in ("presence", "hist"):
                         aggs[ai] = replace(a, sort_pairs=True)
                     else:
                         on_device = False

@@ -77,6 +77,12 @@ def test_plan_selects_sort_pairs(cluster):
 QUERIES = [
     "SELECT distinctcount(l_extendedprice) FROM lineitem GROUP BY l_returnflag TOP 10",
     "SELECT distinctcount(l_extendedprice) FROM lineitem",
+    # exact percentile through the same pair-sort machinery (run-length
+    # counts): any cardinality stays on device
+    "SELECT percentile50(l_extendedprice), percentile95(l_extendedprice) FROM lineitem",
+    "SELECT percentile90(l_extendedprice) FROM lineitem GROUP BY l_returnflag TOP 10",
+    "SELECT percentile50(l_extendedprice), distinctcount(l_extendedprice) FROM lineitem "
+    "WHERE l_shipmode = 'RAIL' GROUP BY l_linestatus TOP 10",
     "SELECT distinctcount(l_extendedprice), count(*) FROM lineitem "
     "WHERE l_shipmode IN ('RAIL','FOB') GROUP BY l_linestatus TOP 10",
     "SELECT distinctcount(l_extendedprice), sum(l_quantity) FROM lineitem "
@@ -136,14 +142,14 @@ def test_trim_path_uses_pair_counts(cluster):
     """>100 groups engages trim ordering, which reads the per-slot
     distinct counts off the pair buffer (_PairsState.counts)."""
     segs, oracle = cluster
-    q = (
-        "SELECT distinctcount(l_extendedprice) FROM lineitem "
-        "GROUP BY l_shipdate TOP 5"
-    )
-    req = optimize_request(parse_pql(q))
-    got = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
-    want = oracle.execute(optimize_request(parse_pql(q)))
-    assert _norm(got) == _norm(want)
+    for q in (
+        "SELECT distinctcount(l_extendedprice) FROM lineitem GROUP BY l_shipdate TOP 5",
+        "SELECT percentile50(l_extendedprice) FROM lineitem GROUP BY l_shipdate TOP 5",
+    ):
+        req = optimize_request(parse_pql(q))
+        got = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
+        want = oracle.execute(optimize_request(parse_pql(q)))
+        assert _norm(got) == _norm(want), q
 
 
 def test_mv_sort_pairs_matches_oracle(monkeypatch):
