@@ -178,3 +178,22 @@ def test_mv_sort_pairs_matches_oracle(monkeypatch):
         got = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
         want = oracle.execute(plan_probe)
         assert _norm(got) == _norm(want), q
+
+
+def test_sort_pairs_through_block_skip_kernel(cluster, monkeypatch):
+    """Zone-map block path + sort-pairs distinct/percentile compose:
+    pairs emit from the gathered candidate blocks only."""
+    monkeypatch.setenv("PINOT_TPU_ZONE_BLOCK", "1024")
+    segs, oracle = cluster
+    q = (
+        "SELECT distinctcount(l_extendedprice), percentile50(l_extendedprice) "
+        "FROM lineitem WHERE l_shipdate <= '1992-02-01'"
+    )
+    req = optimize_request(parse_pql(q))
+    part = QueryExecutor().execute(segs, req)
+    total = sum(s.num_docs for s in segs)
+    # the block path engaged: filter scan cost is O(candidate rows)
+    assert part.num_entries_scanned_in_filter < total / 2
+    got = reduce_to_response(req, [part])
+    want = oracle.execute(optimize_request(parse_pql(q)))
+    assert _norm(got) == _norm(want)
