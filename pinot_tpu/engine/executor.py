@@ -194,12 +194,7 @@ class QueryExecutor:
         t0 = self._phase("staging", t0)
         plan = build_static_plan(request, ctx, staged)
 
-        # sort-dedup distinct reduce is not a plain collective; under a
-        # mesh the sharded kernels can't merge it yet — host path
-        sort_pairs_on_mesh = self.mesh is not None and any(
-            a.sort_pairs for a in plan.aggs
-        )
-        if not plan.on_device or sort_pairs_on_mesh:
+        if not plan.on_device:
             from pinot_tpu.engine.host_fallback import execute_host
 
             return execute_host(live, ctx, request, total_docs, sel_columns)

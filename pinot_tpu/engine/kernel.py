@@ -661,6 +661,42 @@ def _reduce_distinct_pairs(value):
     return (s2[:k], g2[:k], p2[:k], n_unique, total_valid)
 
 
+def counts_from_starts(starts, n, total):
+    """Recover per-pair occurrence counts from a compacted 5-tuple's
+    run starts ON DEVICE (the host does this with np.diff): entry i's
+    count = starts[i+1] - starts[i], last valid entry = total - start."""
+    k = starts.shape[0]
+    iota = jax.lax.iota(jnp.int32, k)
+    nxt = jnp.concatenate([starts[1:], starts[-1:]])
+    nxt = jnp.where(iota == n - 1, total, nxt)
+    return jnp.where(iota < n, nxt - starts, 0)
+
+
+def merge_pair_buffers(slots, gids, counts):
+    """Merge gathered per-chip compacted (slot, gid, count) buffers into
+    one 5-tuple with the same contract as _reduce_distinct_pairs.
+
+    The exclusive cumsum of counts in merged-sorted order plays the
+    'starts' role: diff of consecutive unique entries' excl-cumsum is
+    exactly the summed count of the run (each (slot, gid) appears at
+    most once per chip)."""
+    s = slots.reshape(-1).astype(jnp.int32)
+    g = gids.reshape(-1).astype(jnp.int32)
+    c = counts.reshape(-1).astype(jnp.int32)
+    s, g, c = jax.lax.sort((s, g, c), num_keys=2)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), (s[1:] != s[:-1]) | (g[1:] != g[:-1])]
+    )
+    uniq = first & (s != _PAIR_SENTINEL)
+    n_unique = jnp.sum(uniq).astype(jnp.int32)
+    total_valid = jnp.sum(jnp.where(s != _PAIR_SENTINEL, c, 0)).astype(jnp.int32)
+    excl = jnp.cumsum(c) - c
+    rank = jnp.where(uniq, 0, 1).astype(jnp.int32)
+    _, s2, g2, e2 = jax.lax.sort((rank, s, g, excl), num_keys=1, is_stable=True)
+    k = min(config.DISTINCT_PAIR_CAP, int(s2.shape[0]))
+    return (s2[:k], g2[:k], e2[:k], n_unique, total_valid)
+
+
 def apply_reduce(op: str, value: Any):
     if op == "sum":
         return jnp.sum(value, axis=0)

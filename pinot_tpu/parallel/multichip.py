@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -57,6 +58,42 @@ def _collective(op: str, value: Any, axis):
         return (jax.lax.psum(value[0], axis), jax.lax.psum(value[1], axis))
     if op == "minmax_pair":
         return (jax.lax.pmin(value[0], axis), jax.lax.pmax(value[1], axis))
+    if op == "distinct_pairs":
+        # sort-dedup distinct/histogram merge across chips: each chip's
+        # compacted buffer converts run starts -> counts, all chips
+        # gather everyone's buffers (CAP-bounded, rides ICI/DCN), and a
+        # replicated re-merge sums counts of pairs seen on several chips
+        from pinot_tpu.engine.kernel import (
+            _PAIR_SENTINEL,
+            counts_from_starts,
+            merge_pair_buffers,
+        )
+
+        slots, gids, starts, n, total = value
+        k_buf = slots.shape[0]
+        counts = counts_from_starts(starts, n, total)
+        iota = jax.lax.iota(jnp.int32, k_buf)
+        valid = iota < n
+        s_ = jnp.where(valid, slots, _PAIR_SENTINEL)
+        g_ = jnp.where(valid, gids, _PAIR_SENTINEL)
+        # a chip whose local uniques overflowed its buffer already lost
+        # pairs; so can int32 cumsum positions past ~2^30 total
+        # occurrences — both force the merged n_unique past the buffer
+        # so the executor's overflow check drops to the exact host path
+        over_local = (n > k_buf).astype(jnp.int32)
+        names = axis if isinstance(axis, tuple) else (axis,)
+        stacked = jnp.stack([s_, g_, counts])  # ONE gather per axis
+        for ax in names:
+            stacked = jnp.concatenate(jax.lax.all_gather(stacked, ax), axis=1)
+        grand_total = jax.lax.psum(total.astype(jnp.float32), axis)
+        overflow = jax.lax.psum(over_local, axis) + (
+            grand_total >= 2.0**30
+        ).astype(jnp.int32)
+        s2, g2, e2, n_u, tv = merge_pair_buffers(
+            stacked[0], stacked[1], stacked[2]
+        )
+        n_u = jnp.where(overflow > 0, jnp.int32(s2.shape[0] + 1), n_u)
+        return (s2, g2, e2, n_u, tv)
     if op == "none":
         return value
     raise ValueError(op)
@@ -66,7 +103,12 @@ def _out_specs(reducers: Dict[str, str], shard_spec) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
     for k, op in reducers.items():
         spec = shard_spec if op == "none" else P()
-        out[k] = (spec, spec) if op in ("sum_pair", "minmax_pair") else spec
+        if op in ("sum_pair", "minmax_pair"):
+            out[k] = (spec, spec)
+        elif op == "distinct_pairs":
+            out[k] = (spec,) * 5
+        else:
+            out[k] = spec
     return out
 
 

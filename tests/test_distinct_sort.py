@@ -197,3 +197,53 @@ def test_sort_pairs_through_block_skip_kernel(cluster, monkeypatch):
     got = reduce_to_response(req, [part])
     want = oracle.execute(optimize_request(parse_pql(q)))
     assert _norm(got) == _norm(want)
+
+
+def test_sort_pairs_on_mesh_matches_oracle(cluster):
+    """The distinct-pairs collective: per-chip compacted buffers
+    all_gather and re-merge across the mesh (counts of pairs seen on
+    several chips sum) — high-cardinality exact distinct/percentile no
+    longer drops to the host under a mesh."""
+    import jax
+
+    from pinot_tpu.parallel.multichip import default_mesh
+
+    segs, oracle = cluster
+    mesh = default_mesh(jax.devices()[:4])
+    ex = QueryExecutor(mesh=mesh)
+    for q in (
+        "SELECT distinctcount(l_extendedprice) FROM lineitem GROUP BY l_returnflag TOP 10",
+        "SELECT percentile50(l_extendedprice), count(*) FROM lineitem "
+        "GROUP BY l_linestatus TOP 10",
+        "SELECT distinctcount(l_extendedprice) FROM lineitem",
+    ):
+        req = optimize_request(parse_pql(q))
+        got = reduce_to_response(req, [ex.execute(segs, req)])
+        want = oracle.execute(optimize_request(parse_pql(q)))
+        assert _norm(got) == _norm(want), q
+
+
+def test_mesh_overflow_forces_host_fallback(cluster, monkeypatch):
+    """A chip overflowing its pair buffer must poison the merged
+    n_unique so the executor drops to the exact host path instead of
+    silently losing pairs."""
+    import jax
+
+    from pinot_tpu.engine import kernel as kernel_mod
+    from pinot_tpu.parallel.multichip import default_mesh
+
+    segs, oracle = cluster
+    monkeypatch.setattr(config, "DISTINCT_PAIR_CAP", 64)
+    kernel_mod.make_table_kernel.cache_clear()
+    kernel_mod.make_packed_table_kernel.cache_clear()
+    try:
+        mesh = default_mesh(jax.devices()[:4])
+        q = "SELECT distinctcount(l_extendedprice) FROM lineitem GROUP BY l_returnflag TOP 10"
+        req = optimize_request(parse_pql(q))
+        got = reduce_to_response(req, [QueryExecutor(mesh=mesh).execute(segs, req)])
+        want = oracle.execute(optimize_request(parse_pql(q)))
+        assert _norm(got) == _norm(want)
+    finally:
+        kernel_mod.make_table_kernel.cache_clear()
+        kernel_mod.make_packed_table_kernel.cache_clear()
+        clear_staging_cache()
