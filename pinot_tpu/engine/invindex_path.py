@@ -126,12 +126,16 @@ def try_index_path(
     limit = _max_matches(live_docs)
 
     # cheap pre-estimate (uniform assumption: matched dict fraction *
-    # rows) picks ONE candidate before any postings build
+    # rows) picks ONE candidate before any postings build; tables are
+    # kept for the confirm/resolve stages (REGEX tables cost O(card)
+    # regex evaluations — never compute them twice)
     best = None
     best_frac = None
+    best_tables = None
     for leaf in cands:
         frac = 0.0
         ok = True
+        tables = []
         for seg in live:
             col = seg.columns.get(leaf.column)
             if col is None or col.dictionary.cardinality <= 0:
@@ -139,21 +143,20 @@ def try_index_path(
                 break
             d = col.dictionary
             t = match_table(leaf, d, d.cardinality)
+            tables.append(t)
             frac = max(frac, float(t.sum()) / d.cardinality)
         if ok and (best_frac is None or frac < best_frac):
-            best, best_frac = leaf, frac
+            best, best_frac, best_tables = leaf, frac, tables
     if best is None or best_frac * live_docs > limit:
         return None
 
     # real postings counts confirm (skew can defeat the uniform guess)
     indexes = []
     est = 0
-    for seg in live:
+    for seg, t in zip(live, best_tables):
         idx = inverted_index(seg, best.column)
         if idx is None:
             return None
-        d = seg.column(best.column).dictionary
-        t = match_table(best, d, d.cardinality)
         est += idx.count_for_table(t)
         indexes.append((idx, t))
     if est > limit:
