@@ -396,7 +396,10 @@ class KafkaProtocolShim:
     against real sockets without a Kafka deployment."""
 
     def __init__(self, stream_broker, host: str = "127.0.0.1", port: int = 0) -> None:
+        from pinot_tpu.realtime.kafka_group import GroupCoordinator
+
         self.broker = stream_broker
+        self.coordinator = GroupCoordinator()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -443,7 +446,9 @@ class KafkaProtocolShim:
                 elif api_key == API_FETCH:
                     body = self._fetch(r)
                 else:
-                    return  # unsupported api: drop the connection
+                    body = self._group_api(api_key, r)
+                    if body is None:
+                        return  # unsupported api: drop the connection
                 payload = _i32(corr) + body
                 conn.sendall(_i32(len(payload)) + payload)
         finally:
@@ -451,6 +456,27 @@ class KafkaProtocolShim:
                 conn.close()
             except OSError:
                 pass
+
+    def _group_api(self, api_key: int, r: _Reader) -> Optional[bytes]:
+        """Consumer-group coordinator APIs (kafka_group.py)."""
+        from pinot_tpu.realtime import kafka_group as kg
+
+        c = self.coordinator
+        if api_key == kg.API_FIND_COORDINATOR:
+            return c.find_coordinator(r, self.address)
+        if api_key == kg.API_JOIN_GROUP:
+            return c.join_group(r)
+        if api_key == kg.API_SYNC_GROUP:
+            return c.sync_group(r)
+        if api_key == kg.API_HEARTBEAT:
+            return c.heartbeat(r)
+        if api_key == kg.API_LEAVE_GROUP:
+            return c.leave_group(r)
+        if api_key == kg.API_OFFSET_COMMIT:
+            return c.offset_commit(r)
+        if api_key == kg.API_OFFSET_FETCH:
+            return c.offset_fetch(r)
+        return None
 
     # topic access over the stream broker's internal state
     def _topic(self, name: str):

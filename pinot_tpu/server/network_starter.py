@@ -199,7 +199,13 @@ class HLRemoteConsumer:
         self.rows_per_segment = int(msg.get("rowsPerSegment", 100_000))
         self.poll_interval_s = float(msg.get("pollIntervalS", 0.2))
         desc = msg["streamDescriptor"]
-        self.consumer = HLConsumer(
+        if desc.get("type") == "kafka":
+            # consumer groups over the Kafka wire protocol (0.9+ group
+            # coordinator APIs, realtime/kafka_group.py)
+            from pinot_tpu.realtime.kafka_group import KafkaGroupConsumer as _Consumer
+        else:
+            _Consumer = HLConsumer
+        self.consumer = _Consumer(
             desc["host"], int(desc["port"]), desc["topic"],
             group=table, consumer_id=starter.name,
             session_timeout=float(msg.get("sessionTimeoutS", 10.0)),

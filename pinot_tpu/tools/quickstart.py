@@ -155,10 +155,6 @@ def run_network_realtime_quickstart(
     from pinot_tpu.common.tableconfig import StreamConfig, TableConfig
     from pinot_tpu.realtime.netstream import NetworkStreamProvider, StreamBrokerServer
 
-    if stream_protocol == "kafka" and consumer_type != "lowlevel":
-        # Kafka v0 has no group-coordinator wire API (0.8 HLC lived in
-        # ZK); groups ride the native stream-broker protocol instead
-        raise ValueError("stream_protocol='kafka' supports consumer_type='lowlevel' only")
     root = data_dir or tempfile.mkdtemp(prefix="pinot_tpu_netrt_")
     stream_broker = StreamBrokerServer(log_dir=f"{root}/streamlog")
     stream_broker.start()
