@@ -158,13 +158,17 @@ def decode_message_set(data: bytes) -> List[Tuple[int, Optional[bytes], bytes]]:
             out.append((offset, key, value if value is not None else b""))
         elif codec == 1:  # gzip wrapper: value is an inner MessageSet
             out.extend(decode_message_set(gzip.decompress(value or b"")))
+        elif codec == 2:  # snappy (incl. xerial framing): pure-Python
+            from pinot_tpu.utils.snappy import decompress as snappy_decompress
+
+            out.extend(decode_message_set(snappy_decompress(value or b"")))
         else:
-            # snappy/lz4: no codec library in this image — fail loudly
-            # instead of handing compressed bytes to the row decoder
+            # lz4 (kafka's pre-0.10 framing was nonstandard anyway):
+            # fail loudly instead of handing compressed bytes to the
+            # row decoder
             raise ValueError(
                 f"unsupported message compression codec {codec} at offset "
-                f"{offset} (gzip=1 is supported; configure the producer "
-                "accordingly)"
+                f"{offset} (gzip=1 and snappy=2 are supported)"
             )
         pos += 12 + size
     return out

@@ -207,9 +207,9 @@ def test_gzip_compressed_message_set():
     assert json.loads(out[2][2]) == {"i": 2}
 
     # unsupported codecs fail loudly, not with a row-decoder crash
-    body2 = struct.pack(">bb", 0, 2) + struct.pack(">i", -1) + struct.pack(">i", 1) + b"x"
+    body2 = struct.pack(">bb", 0, 3) + struct.pack(">i", -1) + struct.pack(">i", 1) + b"x"
     msg2 = struct.pack(">i", _signed_crc(body2)) + body2
-    with pytest.raises(ValueError, match="compression codec 2"):
+    with pytest.raises(ValueError, match="compression codec 3"):
         decode_message_set(struct.pack(">qi", 0, len(msg2)) + msg2)
 
 
@@ -292,3 +292,31 @@ def test_hlc_through_kafka_group_protocol(kafka_stack):
         stream_protocol="kafka",
     )
     assert count >= 300
+
+
+def test_snappy_codec_round_trip():
+    """Snappy-compressed wrapper messages (codec=2, incl. snappy-java
+    xerial framing) decode — the common 0.8-era producer default."""
+    import struct
+
+    from pinot_tpu.realtime.kafka import _signed_crc
+    from pinot_tpu.utils.snappy import compress, decompress
+
+    # pure codec round trips, incl. back-references from a real encoder
+    # shape (literal-only encoding is valid snappy)
+    for payload in (b"", b"abc", b"x" * 100000, bytes(range(256)) * 300):
+        assert decompress(compress(payload)) == payload
+    # hand-built copy tags: literal "abcd" + 1-byte-offset copy len 4
+    blob = bytes([8, (4 - 1) << 2]) + b"abcd" + bytes([1, 4])
+    assert decompress(blob) == b"abcdabcd"
+
+    inner = b"".join(encode_message(i, json.dumps({"i": i}).encode()) for i in range(4))
+    compressed = compress(inner)
+    # xerial framing variant
+    xerial = b"\x82SNAPPY\x00" + struct.pack(">ii", 1, 1) + struct.pack(">i", len(compressed)) + compressed
+    for wire in (compressed, xerial):
+        body = struct.pack(">bb", 0, 2) + struct.pack(">i", -1) + struct.pack(">i", len(wire)) + wire
+        msg = struct.pack(">i", _signed_crc(body)) + body
+        out = decode_message_set(struct.pack(">qi", 3, len(msg)) + msg)
+        assert [o for o, _, _ in out] == [0, 1, 2, 3]
+        assert json.loads(out[3][2]) == {"i": 3}
