@@ -20,6 +20,8 @@ _XERIAL_MAGIC = b"\x82SNAPPY\x00"
 
 
 def _decompress_block(data: bytes) -> bytes:
+    if not data:
+        raise ValueError("snappy: empty block")
     pos = 0
     # varint: uncompressed length
     shift = 0
@@ -61,9 +63,12 @@ def _decompress_block(data: bytes) -> bytes:
         if offset == 0 or offset > len(out):
             raise ValueError("snappy: invalid back-reference")
         start = len(out) - offset
-        # overlapping copies are defined byte-by-byte
-        for i in range(ln):
-            out.append(out[start + i])
+        if offset >= ln:
+            out += out[start : start + ln]  # non-overlapping: one slice
+        else:
+            # overlapping copies are defined byte-by-byte
+            for i in range(ln):
+                out.append(out[start + i])
     if len(out) != length:
         raise ValueError(f"snappy: length mismatch {len(out)} != {length}")
     return bytes(out)
@@ -74,13 +79,13 @@ def decompress(data: bytes) -> bytes:
     blocks as Kafka on-the-wire snappy uses."""
     if data.startswith(_XERIAL_MAGIC):
         pos = len(_XERIAL_MAGIC) + 8  # magic + version + compat ints
-        out = b""
+        blocks = []
         while pos < len(data):
             (size,) = struct.unpack(">i", data[pos : pos + 4])
             pos += 4
-            out += _decompress_block(data[pos : pos + size])
+            blocks.append(_decompress_block(data[pos : pos + size]))
             pos += size
-        return out
+        return b"".join(blocks)
     return _decompress_block(data)
 
 
@@ -102,7 +107,8 @@ def _varint(n: int) -> bytes:
 
 def compress(data: bytes) -> bytes:
     """Valid (literal-only) snappy encoding — decodable by any snappy
-    implementation; used by tests and the shim."""
+    implementation; exists for the protocol tests (the shim itself
+    emits uncompressed MessageSets)."""
     out = bytearray(_varint(len(data)))
     pos = 0
     while pos < len(data):
