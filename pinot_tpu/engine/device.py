@@ -55,6 +55,8 @@ class StagedColumn:
     # TPU, so these trade HBM for streaming access):
     raw: Optional[jnp.ndarray] = None  # float [S, n_pad] dictionary-decoded values
     gfwd: Optional[jnp.ndarray] = None  # int32 [S, n_pad] global-dictId fwd
+    hll_bucket: Optional[jnp.ndarray] = None  # uint8 [S, n_pad] HLL register index
+    hll_rho: Optional[jnp.ndarray] = None  # uint8 [S, n_pad] HLL rank
 
     @property
     def is_numeric(self) -> bool:
@@ -99,6 +101,7 @@ def stage_segments(
     pad_segments_to: int = 0,
     raw_columns: Sequence[str] = (),
     gfwd_columns: Sequence[str] = (),
+    hll_columns: Sequence[str] = (),
     ctx=None,
 ) -> StagedTable:
     """Stack + pad + transfer the given columns of the segments.
@@ -109,8 +112,10 @@ def stage_segments(
 
     ``raw_columns`` (numeric SV) additionally stage dictionary-decoded
     value arrays; ``gfwd_columns`` (SV, requires ``ctx``) stage
-    global-dictId forward arrays. Both are host-side numpy gathers done
-    once at staging so query kernels stream instead of gathering.
+    global-dictId forward arrays; ``hll_columns`` (SV) stage per-row
+    HLL (register, rank) uint8 streams. All are host-side numpy
+    gathers done once at staging so query kernels stream instead of
+    gathering.
     """
     S = max(len(segments), pad_segments_to)
     n_pad = config.pad_docs(max(seg.num_docs for seg in segments))
@@ -165,6 +170,10 @@ def stage_segments(
                 for i, c in enumerate(cols):
                     gf[i, : c.fwd.size] = remaps[i][c.fwd]
                 sc.gfwd = put(gf)
+            if name in hll_columns:
+                hb, hr = _hll_streams(cols, S, n_pad)
+                sc.hll_rho = put(hr)  # rho first (see _augment_staged)
+                sc.hll_bucket = put(hb)
         else:
             mv_pad = max(1, max(c.metadata.max_num_multi_values for c in cols))
             mv_pad = config.pad_card(mv_pad)  # pow2 bucket
@@ -223,12 +232,13 @@ def get_staged(
     pad_segments_to: int = 0,
     raw_columns: Sequence[str] = (),
     gfwd_columns: Sequence[str] = (),
+    hll_columns: Sequence[str] = (),
     ctx=None,
 ) -> StagedTable:
     """Cached staging. The cache key covers only the base arrays; role
-    arrays (raw/gfwd) are attached to the cached StagedTable on demand,
-    so queries differing only in roles share one HBM copy of the base
-    columns."""
+    arrays (raw/gfwd/hll streams) are attached to the cached
+    StagedTable on demand, so queries differing only in roles share one
+    HBM copy of the base columns."""
     key = (
         tuple(f"{s.segment_name}:{s.metadata.crc}" for s in segments),
         tuple(sorted(column_names)),
@@ -243,13 +253,14 @@ def get_staged(
                 pad_segments_to=pad_segments_to,
                 raw_columns=raw_columns,
                 gfwd_columns=gfwd_columns,
+                hll_columns=hll_columns,
                 ctx=ctx,
             )
             if len(_stage_cache) > 32:
                 _stage_cache.clear()
             _stage_cache[key] = st
         else:
-            _augment_staged(st, segments, raw_columns, gfwd_columns, ctx)
+            _augment_staged(st, segments, raw_columns, gfwd_columns, hll_columns, ctx)
     return st
 
 
@@ -258,6 +269,7 @@ def _augment_staged(
     segments: Sequence[ImmutableSegment],
     raw_columns: Sequence[str],
     gfwd_columns: Sequence[str],
+    hll_columns: Sequence[str],
     ctx,
 ) -> None:
     """Attach missing role arrays to an already-staged table."""
@@ -284,6 +296,31 @@ def _augment_staged(
             c = seg.column(name)
             gf[i, : c.fwd.size] = remaps[i][c.fwd]
         sc.gfwd = jnp.asarray(gf)
+    for name in hll_columns:
+        sc = st.columns.get(name)
+        if sc is None or sc.hll_bucket is not None or not sc.single_value:
+            continue
+        hb, hr = _hll_streams([seg.column(name) for seg in segments], S, n_pad)
+        # rho FIRST: readers holding this cached table guard on
+        # hll_bucket, so both must be visible once bucket is
+        sc.hll_rho = jnp.asarray(hr)
+        sc.hll_bucket = jnp.asarray(hb)
+
+
+def _hll_streams(cols, S: int, n_pad: int):
+    """Per-row HLL (register index, rank) uint8 streams, computed
+    host-side per dictionary entry then fanned out through the forward
+    index — the kernel scatter-maxes the streams instead of gathering
+    per-dictId tables on device."""
+    from pinot_tpu.engine.hll import dictionary_tables
+
+    hb = np.zeros((S, n_pad), dtype=np.uint8)
+    hr = np.zeros((S, n_pad), dtype=np.uint8)
+    for i, c in enumerate(cols):
+        bt, rt = dictionary_tables(c.dictionary)
+        hb[i, : c.fwd.size] = bt[c.fwd]
+        hr[i, : c.fwd.size] = rt[c.fwd]
+    return hb, hr
 
 
 def clear_staging_cache() -> None:
@@ -330,6 +367,10 @@ def segment_arrays(staged: StagedTable, needed) -> Dict[str, jnp.ndarray]:
             has_rows = True
         if col.gfwd is not None:
             arrays[f"{name}.gfwd"] = col.gfwd
+            has_rows = True
+        if col.hll_bucket is not None:
+            arrays[f"{name}.hllb"] = col.hll_bucket
+            arrays[f"{name}.hllr"] = col.hll_rho
             has_rows = True
     if has_rows:
         arrays["num_docs"] = staged.num_docs_arr

@@ -182,13 +182,14 @@ class QueryExecutor:
         if ires is not None:
             self._phase("indexPath", t0)
             return ires
-        raw_cols, gfwd_cols = self._role_columns(request, live, ctx)
+        raw_cols, gfwd_cols, hll_cols = self._role_columns(request, live, ctx)
         staged = get_staged(
             live,
             sorted(needed),
             pad_segments_to=pad_to,
             raw_columns=raw_cols,
             gfwd_columns=gfwd_cols,
+            hll_columns=hll_cols,
             ctx=ctx,
         )
         t0 = self._phase("staging", t0)
@@ -434,7 +435,13 @@ class QueryExecutor:
             for a in request.aggregations
             if _agg_kind(a.base_function) in ("presence", "hist") and sv(a.column)
         )
-        return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols))
+        # HLL aggs likewise stream host-computed (register, rank) pairs
+        hll_cols = {
+            a.column
+            for a in request.aggregations
+            if _agg_kind(a.base_function) == "hll" and sv(a.column)
+        }
+        return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols)), tuple(sorted(hll_cols))
 
     def _to_device_inputs(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
         from pinot_tpu.engine.device import to_device_inputs

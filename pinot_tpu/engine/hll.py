@@ -105,3 +105,20 @@ def hll_estimate_exact_values(values: Iterable[Any], log2m: int = DEFAULT_LOG2M)
     """Estimate cardinality of a concrete value set through the sketch
     (used by the oracle so engine and oracle agree exactly)."""
     return int(estimate_from_registers(registers_from_values(values, log2m)))
+
+
+def dictionary_tables(dictionary):
+    """Per-dictId (register index, rank) uint8 tables for a column
+    dictionary — the ONE place the per-entry HLL hashing loop lives
+    (shared by the staging stream builder and the planner's table
+    fallback, which must agree bit-for-bit)."""
+    import numpy as np
+
+    card = max(dictionary.cardinality, 1)
+    bt = np.zeros(card, dtype=np.uint8)
+    rt = np.zeros(card, dtype=np.uint8)
+    for j in range(dictionary.cardinality):
+        b, r = bucket_and_rho(value_hash64(dictionary.get(j)))
+        bt[j] = b
+        rt[j] = r
+    return bt, rt

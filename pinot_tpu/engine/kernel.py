@@ -85,7 +85,7 @@ def _segment_add_matmul_multi(flat_idx, W, capacity: int):
 
 
 def _row_shaped(key: str) -> bool:
-    return key.endswith((".fwd", ".raw", ".gfwd", ".mv"))
+    return key.endswith((".fwd", ".raw", ".gfwd", ".mv", ".hllb", ".hllr"))
 
 
 def _valid_mask(seg: Dict[str, Any]) -> jnp.ndarray:
@@ -254,10 +254,8 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
             return regs.at[bucket[mv]].max(
                 jnp.where(m, rho[mv], 0), mode="drop"
             )
-        fwd = seg[f"{agg.column}.fwd"]
-        return regs.at[bucket[fwd]].max(
-            jnp.where(mask, rho[fwd], 0), mode="drop"
-        )
+        b_rows, r_rows = _hll_rows(agg, seg, bucket, rho)
+        return regs.at[b_rows].max(jnp.where(mask, r_rows, 0), mode="drop")
 
     raise AssertionError(agg)
 
@@ -433,10 +431,10 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
             pair_r = jnp.broadcast_to(r[:, None, :], (r.shape[0], E, r.shape[-1])).reshape(-1)
             pair_v = (kvalid[:, :, None] & mvv[:, None, :]).reshape(-1)
         else:
-            fwd = seg[f"{agg.column}.fwd"]
+            b_rows, r_rows = _hll_rows(agg, seg, bucket, rho)
             pair_k = flat_idx
-            pair_b = per_entry(bucket[fwd])
-            pair_r = per_entry(rho[fwd])
+            pair_b = per_entry(b_rows)
+            pair_r = per_entry(r_rows)
             pair_v = fvalid
         holder = jnp.zeros((capacity, config.HLL_M), dtype=jnp.int32)
         return holder.at[pair_k, pair_b].max(
@@ -617,6 +615,16 @@ def _state_reduce(agg: StaticAgg) -> str:
 _PAIR_SENTINEL = np.iinfo(np.int32).max
 
 
+def _hll_rows(agg: StaticAgg, seg, bucket, rho):
+    """Per-row (register index, rank) for an SV HLL agg: prefer the
+    host-staged uint8 streams over on-device table gathers."""
+    hb = seg.get(f"{agg.column}.hllb")
+    if hb is not None:
+        return hb.astype(jnp.int32), seg[f"{agg.column}.hllr"].astype(jnp.int32)
+    fwd = seg[f"{agg.column}.fwd"]
+    return bucket[fwd], rho[fwd]
+
+
 def _value_gids(agg: StaticAgg, seg, remap):
     """Per-row GLOBAL value ids for an SV presence/hist agg: prefer
     the host-staged global-id stream (``.gfwd``, executor._role_columns)
@@ -716,7 +724,7 @@ def apply_reduce(op: str, value: Any):
 
 
 def _row_key(key: str) -> bool:
-    return key.endswith((".fwd", ".raw", ".gfwd", ".mv", ".mvc"))
+    return key.endswith((".fwd", ".raw", ".gfwd", ".mv", ".mvc", ".hllb", ".hllr"))
 
 
 def _gather_blocks(seg: Dict[str, Any], ids: jnp.ndarray, block: int):

@@ -518,9 +518,14 @@ def build_query_inputs(
             else:
                 aux["remap"] = _stacked_remap(ctx, staged, a.column)
         elif a.kind == "hll":
-            bucket, rho = _hll_tables(ctx, staged, a.column)
-            aux["bucket"] = bucket
-            aux["rho"] = rho
+            if not a.is_mv and staged.column(a.column).hll_bucket is not None:
+                # staged per-row streams: the tables would be dead H2D
+                aux["bucket"] = np.zeros((S, 1), dtype=np.int32)
+                aux["rho"] = np.zeros((S, 1), dtype=np.int32)
+            else:
+                bucket, rho = _hll_tables(ctx, staged, a.column)
+                aux["bucket"] = bucket
+                aux["rho"] = rho
         agg_aux.append(aux)
     inputs["agg_aux"] = agg_aux
 
@@ -565,8 +570,7 @@ def _hll_tables(ctx: TableContext, staged: StagedTable, column: str):
     rho = np.zeros((S, col.card_pad), dtype=np.int32)
     for i, seg in enumerate(ctx.segments):
         d = seg.column(column).dictionary
-        for j in range(d.cardinality):
-            b, r = hll_mod.bucket_and_rho(hll_mod.value_hash64(d.get(j)))
-            bucket[i, j] = b
-            rho[i, j] = r
+        bt, rt = hll_mod.dictionary_tables(d)
+        bucket[i, : bt.size] = bt
+        rho[i, : rt.size] = rt
     return bucket, rho
