@@ -112,8 +112,6 @@ def dictionary_tables(dictionary):
     dictionary — the ONE place the per-entry HLL hashing loop lives
     (shared by the staging stream builder and the planner's table
     fallback, which must agree bit-for-bit)."""
-    import numpy as np
-
     card = max(dictionary.cardinality, 1)
     bt = np.zeros(card, dtype=np.uint8)
     rt = np.zeros(card, dtype=np.uint8)

@@ -140,6 +140,13 @@ def _leaf_mask(plan: StaticPlan, i: int, seg: Dict[str, Any], q: Dict[str, Any])
             pts = q["pts"][i]  # [k_pad], -1 padded
             hit = jnp.any(ids[..., None] == pts, axis=-1)
             return ~hit if (kind == "points_none" and leaf.mode == SV) else hit
+        if kind == "runs":
+            # interval union: [k_pad, 2] dictId ranges (SV complements
+            # baked in, like the table kind); empty runs match nothing
+            rr = q["runs"][i]
+            return jnp.any(
+                (ids[..., None] >= rr[:, 0]) & (ids[..., None] < rr[:, 1]), axis=-1
+            )
         return q["match"][i][ids]
 
     if leaf.mode == SV:

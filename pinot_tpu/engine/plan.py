@@ -143,6 +143,34 @@ def _agg_kind(base: str) -> str:
 
 
 _MAX_POINTS = 16  # IN lists up to this size evaluate as compares
+_MAX_RUNS = 64  # match tables with <= this many dictId runs evaluate as interval unions
+
+
+def _effective_table(leaf_node, mode: str, d: Dictionary, card_pad: int, true_card: int) -> np.ndarray:
+    """The table the kernel would read for this leaf: SV NOT/NOT_IN
+    bakes the complement (kernel negates MV_NONE after the
+    any-reduce).  Shared by plan-time run counting and input build so
+    they can never disagree."""
+    t = match_table(leaf_node, d, card_pad)
+    if mode == SV and leaf_node.operator in (FilterOperator.NOT, FilterOperator.NOT_IN):
+        flipped = np.zeros(card_pad, dtype=bool)
+        flipped[:true_card] = ~t[:true_card]
+        t = flipped
+    return t
+
+
+def _table_runs(t: np.ndarray):
+    """Maximal True runs of a bool table -> [(lo, hi)) dictId ranges."""
+    if not t.any():
+        return []
+    d = np.diff(t.astype(np.int8))
+    starts = list(np.nonzero(d == 1)[0] + 1)
+    ends = list(np.nonzero(d == -1)[0] + 1)
+    if t[0]:
+        starts.insert(0, 0)
+    if t[-1]:
+        ends.append(t.size)
+    return list(zip(starts, ends))
 
 
 def _pad_pow2(k: int) -> int:
@@ -186,6 +214,27 @@ def build_static_plan(
             else:
                 mode = MV_ANY
             eval_kind, k_pad = _leaf_eval_kind(node)
+            if eval_kind == "table":
+                # gathers through big match tables serialize on TPU; a
+                # table that is a FEW contiguous dictId runs (regex on
+                # ordered values, big IN lists over ranges) evaluates as
+                # a vectorized interval union instead.  Values-based
+                # operators bound their run count by the value count
+                # (complements add one run) without building tables;
+                # only regex pays a plan-time table scan.
+                if node.operator != FilterOperator.REGEX:
+                    max_runs = len(node.values) + 1
+                else:
+                    max_runs = 0
+                    for si, seg in enumerate(ctx.segments):
+                        scol = seg.column(node.column)
+                        stg = staged.column(node.column)
+                        t = _effective_table(
+                            node, mode, scol.dictionary, stg.card_pad, stg.cards[si]
+                        )
+                        max_runs = max(max_runs, len(_table_runs(t)))
+                if max_runs <= _MAX_RUNS:
+                    eval_kind, k_pad = "runs", _pad_pow2(max(max_runs, 1))
             if (
                 mode == SV
                 and (
@@ -460,16 +509,28 @@ def build_query_inputs(
         tables = []
         bounds = []
         points = []
+        run_arrays = []
         for leaf_node, leaf_static in zip(flat_leaves, plan.leaves):
             kind = leaf_static.eval_kind
             # dummies keep the pytree structure identical per plan
             table_e = np.zeros((S, 1), dtype=bool)
             bound_e = np.zeros((S, 2), dtype=np.int32)
             point_e = np.zeros((S, max(leaf_static.k_pad, 1)), dtype=np.int32)
+            runs_e = np.zeros(
+                (S, max(leaf_static.k_pad, 1) if kind == "runs" else 1, 2),
+                dtype=np.int32,
+            )
             for i, seg in enumerate(ctx.segments):
                 scol = seg.column(leaf_static.column)
                 d = scol.dictionary
-                if kind == "interval":
+                if kind == "runs":
+                    stg = staged.column(leaf_static.column)
+                    t = _effective_table(
+                        leaf_node, leaf_static.mode, d, stg.card_pad, stg.cards[i]
+                    )
+                    for ri, (lo, hi) in enumerate(_table_runs(t)):
+                        runs_e[i, ri] = (lo, hi)
+                elif kind == "interval":
                     bound_e[i] = leaf_interval(leaf_node, d)
                 elif kind == "docrange":
                     if leaf_node.operator == FilterOperator.EQUALITY:
@@ -487,23 +548,17 @@ def build_query_inputs(
                     col = staged.column(leaf_static.column)
                     if table_e.shape[1] == 1:
                         table_e = np.zeros((S, col.card_pad), dtype=bool)
-                    t = match_table(leaf_node, d, col.card_pad)
-                    if leaf_static.mode == SV and leaf_node.operator in (
-                        FilterOperator.NOT,
-                        FilterOperator.NOT_IN,
-                    ):
-                        # SV complement: true cardinality slots only
-                        c = col.cards[i]
-                        flipped = np.zeros(col.card_pad, dtype=bool)
-                        flipped[:c] = ~t[:c]
-                        t = flipped
-                    table_e[i] = t
+                    table_e[i] = _effective_table(
+                        leaf_node, leaf_static.mode, d, col.card_pad, col.cards[i]
+                    )
             tables.append(table_e)
             bounds.append(bound_e)
             points.append(point_e)
+            run_arrays.append(runs_e)
         inputs["match"] = tables
         inputs["bounds"] = bounds
         inputs["pts"] = points
+        inputs["runs"] = run_arrays
 
     # per-agg auxiliary tables
     agg_aux: List[Dict[str, np.ndarray]] = []

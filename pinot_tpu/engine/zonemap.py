@@ -134,6 +134,15 @@ def _leaf_candidates(
         excluded = single & np.isin(zmin, list(pts) or [-1])
         out[:nb_real] = ~excluded
         return out
+    if kind == "runs":
+        # interval union: candidate when ANY run overlaps the zone
+        rr = q_np["runs"][i][si]  # [k, 2], empty runs lo == hi == 0
+        hit = np.zeros(nb_real, dtype=bool)
+        for lo, hi in rr:
+            if hi > lo:
+                hit |= (zmax >= lo) & (zmin < hi)
+        out[:nb_real] = hit
+        return out
     # match table: any matching dictId within [zmin, zmax]
     table = q_np["match"][i][si]
     csum = np.concatenate([[0], np.cumsum(table.astype(np.int64))])

@@ -216,3 +216,46 @@ def test_differential_multi_segment():
 
 def test_differential_more_queries():
     _run_differential(2, seed=47, num_queries=60)
+
+
+def test_runs_eval_kind_regex_and_large_in():
+    """Table-kind leaves with few dictId runs evaluate as interval
+    unions (plan eval_kind 'runs'): regex on ordered values, >16-value
+    IN lists, and their negations match the oracle."""
+    from pinot_tpu.engine.context import get_table_context
+    from pinot_tpu.engine.device import stage_segments
+    from pinot_tpu.engine.plan import build_static_plan
+
+    schema = make_test_schema(with_mv=True)
+    rows = random_rows(schema, 3000, seed=31, cardinality=60)
+    segs = [
+        build_segment(schema, rows[:1500], "testTable", "r0"),
+        build_segment(schema, rows[1500:], "testTable", "r1"),
+    ]
+    oracle = ScanQueryProcessor(schema, rows)
+    in_vals = ", ".join(str(v) for v in range(0, 40))  # 40 points > _MAX_POINTS
+    queries = [
+        f"SELECT count(*), sum(metInt) FROM testTable WHERE dimInt IN ({in_vals})",
+        f"SELECT count(*) FROM testTable WHERE dimInt NOT IN ({in_vals})",
+        "SELECT count(*) FROM testTable WHERE REGEXP_LIKE(dimStr, 's1.*')",
+        f"SELECT count(*) FROM testTable WHERE dimIntMV IN ({in_vals})",
+    ]
+    for pql in queries:
+        req = optimize_request(parse_pql(pql))
+        req2 = optimize_request(parse_pql(pql))
+        got = reduce_to_response(req, [EXECUTOR.execute(segs, req)])
+        want = oracle.execute(req2)
+        gj, wj = got.to_json(), want.to_json()
+        for k in ("timeUsedMs", "numEntriesScannedInFilter", "numEntriesScannedPostFilter",
+                  "numSegmentsQueried", "numServersQueried", "numServersResponded"):
+            gj.pop(k, None)
+            wj.pop(k, None)
+        assert _values_close(gj, wj), (pql, gj, wj)
+
+    # the plan actually selected the runs kind for the big IN list
+    req = optimize_request(parse_pql(queries[0]))
+    ctx = get_table_context(segs)
+    staged = stage_segments(segs, sorted(req.referenced_columns()), ctx=ctx)
+    plan = build_static_plan(req, ctx, staged)
+    kinds = {l.eval_kind for l in plan.leaves}
+    assert "runs" in kinds, kinds

@@ -321,3 +321,25 @@ def test_selection_limit_beyond_candidate_window(cluster):
     req2 = optimize_request(parse_pql(q))
     got = reduce_to_response(req, [ex.execute(segs, req)])
     assert _norm(got) == _norm(oracle.execute(req2))
+
+
+def test_runs_leaf_through_block_path(cluster):
+    """Regression: a 'runs' eval-kind leaf (>16-value IN list) must
+    compute real zone candidacy — treating it as a table leaf read the
+    all-False dummy and pruned EVERY block (empty results)."""
+    segs, oracle = cluster
+    d = segs[0].column("l_shipdate").dictionary
+    vals = ", ".join(repr(d.get(i)) for i in range(0, 60, 3))  # 20 points
+    q = f"SELECT count(*), sum(l_quantity) FROM lineitem WHERE l_shipdate IN ({vals})"
+    req = optimize_request(parse_pql(q))
+    from pinot_tpu.engine.plan import build_static_plan
+
+    ctx = get_table_context(segs)
+    staged = stage_segments(segs, sorted(req.referenced_columns()), ctx=ctx)
+    plan = build_static_plan(req, ctx, staged)
+    assert plan.leaves[0].eval_kind == "runs"
+    got = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
+    want = oracle.execute(optimize_request(parse_pql(q)))
+    assert _norm(got) == _norm(want)
+    # sanity: the query matches something (the bug returned zero rows)
+    assert int(got.to_json()["aggregationResults"][0]["value"]) > 0
