@@ -57,6 +57,7 @@ class StagedColumn:
     gfwd: Optional[jnp.ndarray] = None  # int32 [S, n_pad] global-dictId fwd
     hll_bucket: Optional[jnp.ndarray] = None  # uint8 [S, n_pad] HLL register index
     hll_rho: Optional[jnp.ndarray] = None  # uint8 [S, n_pad] HLL rank
+    mv_raw: Optional[jnp.ndarray] = None  # float [S, n_pad, mv_pad] decoded MV values
 
     @property
     def is_numeric(self) -> bool:
@@ -92,6 +93,20 @@ class StagedTable:
                 v[i, :n] = True
             self._valid = jnp.asarray(v)
         return self._valid
+
+
+def _csr_scatter(values, offsets, out_row):
+    """Fill one segment's padded [n_pad, mv_pad] matrix row block from
+    CSR (values, offsets) — the ONE place the scatter-index math lives
+    for mv ids, mv_raw values, and augment-time mv_raw."""
+    counts = np.diff(offsets)
+    n = counts.size
+    row_idx = np.repeat(np.arange(n), counts)
+    col_idx = (
+        np.concatenate([np.arange(k) for k in counts]) if n else np.zeros(0, int)
+    )
+    out_row[row_idx, col_idx] = values
+    return counts
 
 
 def stage_segments(
@@ -179,18 +194,19 @@ def stage_segments(
             mv_pad = config.pad_card(mv_pad)  # pow2 bucket
             mv = np.zeros((S, n_pad, mv_pad), dtype=idt)
             mvc = np.zeros((S, n_pad), dtype=config.count_dtype(mv_pad))
+            want_raw = name in raw_columns and sc.is_numeric
+            mvr = np.zeros((S, n_pad, mv_pad), dtype=fdt) if want_raw else None
             for i, c in enumerate(cols):
-                offs = c.mv_offsets
-                counts = np.diff(offs)
-                n = counts.size
-                # scatter CSR into padded matrix
-                row_idx = np.repeat(np.arange(n), counts)
-                col_idx = np.concatenate([np.arange(k) for k in counts]) if n else np.zeros(0, int)
-                mv[i, row_idx, col_idx] = c.mv_values
-                mvc[i, :n] = counts
+                counts = _csr_scatter(c.mv_values, c.mv_offsets, mv[i])
+                mvc[i, : counts.size] = counts
+                if mvr is not None:
+                    vals = np.asarray(c.dictionary.values, dtype=fdt)
+                    _csr_scatter(vals[c.mv_values], c.mv_offsets, mvr[i])
             sc.mv_pad = mv_pad
             sc.mv = put(mv)
             sc.mv_counts = put(mvc)
+            if mvr is not None:
+                sc.mv_raw = put(mvr)
         if sc.is_numeric:
             dv = np.zeros((S, card_pad), dtype=fdt)
             for i, c in enumerate(cols):
@@ -296,6 +312,22 @@ def _augment_staged(
             c = seg.column(name)
             gf[i, : c.fwd.size] = remaps[i][c.fwd]
         sc.gfwd = jnp.asarray(gf)
+    for name in raw_columns:
+        sc = st.columns.get(name)
+        if (
+            sc is None
+            or sc.mv_raw is not None
+            or sc.single_value
+            or not sc.is_numeric
+            or sc.mv is None
+        ):
+            continue
+        mvr = np.zeros((S, n_pad, sc.mv_pad), dtype=fdt)
+        for i, seg in enumerate(segments):
+            c = seg.column(name)
+            vals = np.asarray(c.dictionary.values, dtype=fdt)
+            _csr_scatter(vals[c.mv_values], c.mv_offsets, mvr[i])
+        sc.mv_raw = jnp.asarray(mvr)
     for name in hll_columns:
         sc = st.columns.get(name)
         if sc is None or sc.hll_bucket is not None or not sc.single_value:
@@ -371,6 +403,9 @@ def segment_arrays(staged: StagedTable, needed) -> Dict[str, jnp.ndarray]:
         if col.hll_bucket is not None:
             arrays[f"{name}.hllb"] = col.hll_bucket
             arrays[f"{name}.hllr"] = col.hll_rho
+            has_rows = True
+        if col.mv_raw is not None:
+            arrays[f"{name}.mvraw"] = col.mv_raw
             has_rows = True
     if has_rows:
         arrays["num_docs"] = staged.num_docs_arr

@@ -193,7 +193,8 @@ class QueryExecutor:
             ctx=ctx,
         )
         t0 = self._phase("staging", t0)
-        plan = build_static_plan(request, ctx, staged)
+        scratch: Dict[Any, Any] = {}  # plan->inputs table cache (regex)
+        plan = build_static_plan(request, ctx, staged, scratch=scratch)
 
         if not plan.on_device:
             from pinot_tpu.engine.host_fallback import execute_host
@@ -202,7 +203,7 @@ class QueryExecutor:
 
         from pinot_tpu.engine.device import segment_arrays
 
-        q_np = build_query_inputs(request, plan, ctx, staged)
+        q_np = build_query_inputs(request, plan, ctx, staged, scratch=scratch)
         q_inputs = self._to_device_inputs(q_np)
         seg_arrays = segment_arrays(staged, needed)
         block_ids, scanned_rows = self._block_skip_ids(plan, q_np, live, staged)
@@ -390,12 +391,6 @@ class QueryExecutor:
         forward arrays (both avoid slow big-table gathers on device)."""
         seg = live[0]
 
-        def numeric_sv(c: str) -> bool:
-            if c == "*" or c not in seg.columns:
-                return False
-            m = seg.column(c).metadata
-            return m.single_value and m.data_type.stored_type != DataType.STRING
-
         def big_card(c: str) -> bool:
             # raw_card_min() is 0 on accelerators (TPU gathers serialize
             # — see engine/config.py measurement); on CPU the narrow
@@ -412,10 +407,15 @@ class QueryExecutor:
 
         # only scalar/pair agg kernels read .raw (presence/hist/hll work
         # in dictId space)
+        def numeric_any(c: str) -> bool:
+            if c == "*" or c not in seg.columns:
+                return False
+            return seg.column(c).metadata.data_type.stored_type != DataType.STRING
+
         raw_cols = {
             a.column
             for a in request.aggregations
-            if numeric_sv(a.column)
+            if numeric_any(a.column)
             and big_card(a.column)
             and _agg_kind(a.base_function) in ("scalar", "pair")
         }

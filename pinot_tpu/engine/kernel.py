@@ -85,7 +85,7 @@ def _segment_add_matmul_multi(flat_idx, W, capacity: int):
 
 
 def _row_shaped(key: str) -> bool:
-    return key.endswith((".fwd", ".raw", ".gfwd", ".mv", ".hllb", ".hllr"))
+    return key.endswith((".fwd", ".raw", ".gfwd", ".mv", ".hllb", ".hllr", ".mvraw"))
 
 
 def _valid_mask(seg: Dict[str, Any]) -> jnp.ndarray:
@@ -174,8 +174,11 @@ def _row_values(agg: StaticAgg, seg, mask):
     """Per-row (or per-entry) numeric values + entry mask for an agg column."""
     fdt = config.float_dtype()
     if agg.is_mv:
-        mv = seg[f"{agg.column}.mv"]
         mvv = _mv_valid(seg, agg.column) & mask[:, None]
+        mvr = seg.get(f"{agg.column}.mvraw")
+        if mvr is not None:
+            return mvr, mvv  # staged decoded values, no gather
+        mv = seg[f"{agg.column}.mv"]
         vals = seg[f"{agg.column}.dict"][mv]
         return vals, mvv
     if agg.use_raw:
@@ -731,7 +734,7 @@ def apply_reduce(op: str, value: Any):
 
 
 def _row_key(key: str) -> bool:
-    return key.endswith((".fwd", ".raw", ".gfwd", ".mv", ".mvc", ".hllb", ".hllr"))
+    return key.endswith((".fwd", ".raw", ".gfwd", ".mv", ".mvc", ".hllb", ".hllr", ".mvraw"))
 
 
 def _gather_blocks(seg: Dict[str, Any], ids: jnp.ndarray, block: int):

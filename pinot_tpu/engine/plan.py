@@ -199,7 +199,11 @@ def build_static_plan(
     request: BrokerRequest,
     ctx: TableContext,
     staged: StagedTable,
+    scratch: Optional[Dict[Any, Any]] = None,
 ) -> StaticPlan:
+    """``scratch`` (optional dict the executor threads into
+    build_query_inputs) caches plan-time effective match tables so a
+    regex never scans a dictionary twice per query."""
     # ---- filter -----------------------------------------------------
     leaves: List[StaticLeaf] = []
 
@@ -232,6 +236,8 @@ def build_static_plan(
                         t = _effective_table(
                             node, mode, scol.dictionary, stg.card_pad, stg.cards[si]
                         )
+                        if scratch is not None:
+                            scratch[(id(node), si)] = t
                         max_runs = max(max_runs, len(_table_runs(t)))
                 if max_runs <= _MAX_RUNS:
                     eval_kind, k_pad = "runs", _pad_pow2(max(max_runs, 1))
@@ -489,6 +495,7 @@ def build_query_inputs(
     plan: StaticPlan,
     ctx: TableContext,
     staged: StagedTable,
+    scratch: Optional[Dict[Any, Any]] = None,
 ) -> Dict[str, Any]:
     S = staged.num_segments
     inputs: Dict[str, Any] = {}
@@ -524,10 +531,12 @@ def build_query_inputs(
                 scol = seg.column(leaf_static.column)
                 d = scol.dictionary
                 if kind == "runs":
-                    stg = staged.column(leaf_static.column)
-                    t = _effective_table(
-                        leaf_node, leaf_static.mode, d, stg.card_pad, stg.cards[i]
-                    )
+                    t = None if scratch is None else scratch.get((id(leaf_node), i))
+                    if t is None:
+                        stg = staged.column(leaf_static.column)
+                        t = _effective_table(
+                            leaf_node, leaf_static.mode, d, stg.card_pad, stg.cards[i]
+                        )
                     for ri, (lo, hi) in enumerate(_table_runs(t)):
                         runs_e[i, ri] = (lo, hi)
                 elif kind == "interval":
@@ -548,9 +557,12 @@ def build_query_inputs(
                     col = staged.column(leaf_static.column)
                     if table_e.shape[1] == 1:
                         table_e = np.zeros((S, col.card_pad), dtype=bool)
-                    table_e[i] = _effective_table(
-                        leaf_node, leaf_static.mode, d, col.card_pad, col.cards[i]
-                    )
+                    t = None if scratch is None else scratch.get((id(leaf_node), i))
+                    if t is None:
+                        t = _effective_table(
+                            leaf_node, leaf_static.mode, d, col.card_pad, col.cards[i]
+                        )
+                    table_e[i] = t
             tables.append(table_e)
             bounds.append(bound_e)
             points.append(point_e)
