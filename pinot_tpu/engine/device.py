@@ -95,10 +95,11 @@ class StagedTable:
         return self._valid
 
 
-def _csr_scatter(values, offsets, out_row):
+def _csr_scatter(values, offsets, out_row, *extra):
     """Fill one segment's padded [n_pad, mv_pad] matrix row block from
-    CSR (values, offsets) — the ONE place the scatter-index math lives
-    for mv ids, mv_raw values, and augment-time mv_raw."""
+    CSR (values, offsets) — the ONE place the scatter-index math lives.
+    ``extra`` pairs of (values2, out_row2) scatter through the same
+    indices (mv ids + mv_raw share one offsets array)."""
     counts = np.diff(offsets)
     n = counts.size
     row_idx = np.repeat(np.arange(n), counts)
@@ -106,6 +107,8 @@ def _csr_scatter(values, offsets, out_row):
         np.concatenate([np.arange(k) for k in counts]) if n else np.zeros(0, int)
     )
     out_row[row_idx, col_idx] = values
+    for v2, o2 in zip(extra[::2], extra[1::2]):
+        o2[row_idx, col_idx] = v2
     return counts
 
 
@@ -197,11 +200,14 @@ def stage_segments(
             want_raw = name in raw_columns and sc.is_numeric
             mvr = np.zeros((S, n_pad, mv_pad), dtype=fdt) if want_raw else None
             for i, c in enumerate(cols):
-                counts = _csr_scatter(c.mv_values, c.mv_offsets, mv[i])
-                mvc[i, : counts.size] = counts
                 if mvr is not None:
                     vals = np.asarray(c.dictionary.values, dtype=fdt)
-                    _csr_scatter(vals[c.mv_values], c.mv_offsets, mvr[i])
+                    counts = _csr_scatter(
+                        c.mv_values, c.mv_offsets, mv[i], vals[c.mv_values], mvr[i]
+                    )
+                else:
+                    counts = _csr_scatter(c.mv_values, c.mv_offsets, mv[i])
+                mvc[i, : counts.size] = counts
             sc.mv_pad = mv_pad
             sc.mv = put(mv)
             sc.mv_counts = put(mvc)
