@@ -39,8 +39,10 @@ BIG = jnp.inf
 # small key spaces a chunked one-hot matmul rides the MXU instead:
 #   acc[K] += w[chunk] @ onehot(keys[chunk], K)
 # Enabled on non-CPU backends (or forced via env for tests).
-MATMUL_GROUP_CAP = 512
-_MATMUL_CHUNK = 1 << 15
+import os as _os
+
+MATMUL_GROUP_CAP = int(_os.environ.get("PINOT_TPU_MATMUL_GROUP_CAP", str(512)))
+_MATMUL_CHUNK = int(_os.environ.get("PINOT_TPU_MATMUL_CHUNK", str(1 << 15)))
 
 
 def _use_matmul_groupby() -> bool:
