@@ -190,3 +190,35 @@ def test_sum_accumulation_at_bench_scale():
         assert abs(have - want) <= RTOL_SCALE * abs(want), (
             "group SUM drift", key, have, want, abs(have - want) / abs(want),
         )
+
+
+def test_sort_pairs_distinct_on_device(cluster, monkeypatch):
+    """High-cardinality exact distinct/percentile through the on-chip
+    sort-dedup path (pair lexsort + stable compaction on the REAL
+    chip's sort implementation); distinct counts are exact integers, so
+    no float tolerance applies."""
+    from pinot_tpu.engine import config as cfg
+    from pinot_tpu.engine import kernel as kernel_mod
+
+    segs, oracle = cluster
+    monkeypatch.setattr(cfg, "MAX_VALUE_STATE", 1 << 10)
+    monkeypatch.setenv("PINOT_TPU_INVINDEX", "0")
+    kernel_mod.make_table_kernel.cache_clear()
+    kernel_mod.make_packed_table_kernel.cache_clear()
+    try:
+        for pql in (
+            "SELECT distinctcount(l_extendedprice) FROM lineitem GROUP BY l_returnflag TOP 10",
+            "SELECT percentile50(l_extendedprice) FROM lineitem",
+        ):
+            req = optimize_request(parse_pql(pql))
+            req2 = optimize_request(parse_pql(pql))
+            got = reduce_to_response(req, [QueryExecutor().execute(segs, req)]).to_json()
+            want = oracle.execute(req2).to_json()
+            assert _close(got["aggregationResults"], want["aggregationResults"], RTOL), (
+                pql,
+                json.dumps(got["aggregationResults"], default=str)[:400],
+                json.dumps(want["aggregationResults"], default=str)[:400],
+            )
+    finally:
+        kernel_mod.make_table_kernel.cache_clear()
+        kernel_mod.make_packed_table_kernel.cache_clear()
