@@ -115,6 +115,8 @@ class RemoteParticipant(Participant):
         uri = info.get("downloadUri")
         if uri and not uri.startswith("file://"):
             msg["downloadUri"] = uri
+        if info.get("invertedIndexColumns"):
+            msg["invertedIndexColumns"] = list(info["invertedIndexColumns"])
         if target == CONSUMING:
             # ship the full consume spec so the remote process can run
             # the consumer + LLC completion protocol on its own

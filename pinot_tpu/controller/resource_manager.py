@@ -472,7 +472,17 @@ class ClusterResourceManager:
     ) -> None:
         with self._lock:
             participant = self._participants.get(server)
-            info = self.segment_metadata.get((table, segment), {})
+            info = dict(self.segment_metadata.get((table, segment), {}))
+            if target == ONLINE:
+                # configured inverted-index columns resolve from the
+                # CURRENT table config at transition time (covers every
+                # metadata writer incl. realtime commits, and config
+                # edits apply on the next reload): servers pre-build
+                # postings at load so the first needle query is warm
+                cfg = self.table_configs.get(table)
+                cols = cfg.indexing.inverted_index_columns if cfg else []
+                if cols:
+                    info["invertedIndexColumns"] = list(cols)
             view = self.external_views.setdefault(table, {}).setdefault(segment, {})
         ok: Optional[bool] = False
         if participant is not None:

@@ -124,3 +124,26 @@ def inverted_index(seg: ImmutableSegment, column: str) -> Optional[InvertedIndex
             )
         cache[column] = idx
     return idx
+
+
+def warm_inverted_indexes(seg: ImmutableSegment, columns) -> None:
+    """Best-effort postings pre-build for configured columns at segment
+    load (invertedIndexColumns parity) — shared by both server
+    starters.  A configured column that cannot index (typo, no
+    dictionary) warns instead of silently no-opping."""
+    import logging
+
+    log = logging.getLogger(__name__)
+    for col in columns or ():
+        try:
+            if inverted_index(seg, col) is None:
+                log.warning(
+                    "invertedIndexColumns: %r cannot be indexed on segment %s "
+                    "(unknown column or no dictionary)",
+                    col,
+                    seg.segment_name,
+                )
+        except Exception:
+            log.exception(
+                "inverted-index warm failed for %s.%s", seg.segment_name, col
+            )

@@ -461,7 +461,13 @@ class NetworkedServerStarter:
                 if consumer is not None and not getattr(consumer, "rolls_locally", False):
                     self._consumers.pop(segment, None)
                     consumer.stop()
-                ok = self._load(table, segment, msg.get("crc"), msg.get("downloadUri"))
+                ok = self._load(
+                    table,
+                    segment,
+                    msg.get("crc"),
+                    msg.get("downloadUri"),
+                    msg.get("invertedIndexColumns"),
+                )
             elif target == CONSUMING:
                 ok = self._start_consumer(table, segment, msg)
             elif target in (OFFLINE, DROPPED):
@@ -523,6 +529,7 @@ class NetworkedServerStarter:
         segment: str,
         crc: Optional[int],
         download_uri: Optional[str] = None,
+        inv_columns=None,
     ) -> bool:
         tdm = self.server.data_manager.table(table)
         loaded = tdm is not None and segment in tdm.segment_names()
@@ -558,6 +565,9 @@ class NetworkedServerStarter:
                     DEFAULT_FACTORY.fetch(uri, os.path.join(td, SEGMENT_FILE_NAME))
                     seg_obj = read_segment(td)
         self.server.add_segment(table, seg_obj)
+        from pinot_tpu.segment.invindex import warm_inverted_indexes
+
+        warm_inverted_indexes(seg_obj, inv_columns)
         if crc is not None:
             self._local_crcs[segment] = crc
         return True

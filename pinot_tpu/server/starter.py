@@ -94,6 +94,9 @@ class ServerStarter:
                 )
                 return False
         self.server.add_segment(table, seg_obj)
+        from pinot_tpu.segment.invindex import warm_inverted_indexes
+
+        warm_inverted_indexes(seg_obj, info.get("invertedIndexColumns"))
         if crc is not None:
             self._local_crcs[segment] = crc
         return True

@@ -172,3 +172,28 @@ def test_threshold_bail(cluster, monkeypatch):
     ctx = get_table_context(segs)
     total = sum(s.num_docs for s in segs)
     assert try_index_path(req, list(segs), ctx, total, None) is None
+
+
+def test_configured_inverted_index_columns_warm_at_load(tmp_path):
+    """invertedIndexColumns table config (IndexingConfig parity): the
+    server pre-builds configured postings at segment load instead of on
+    the first needle query."""
+    from pinot_tpu.common.tableconfig import IndexingConfig
+    from pinot_tpu.tools.cluster_harness import InProcessCluster
+
+    cluster = InProcessCluster(num_servers=1)
+    physical = cluster.add_offline_table(
+        lineitem_schema(),
+        "lineitem",
+        indexing=IndexingConfig(inverted_index_columns=["l_extendedprice"]),
+    )
+    seg = synthetic_lineitem_segment(5000, seed=5, name="warm0")
+    cluster.controller.upload_segment(physical, seg)
+    tdm = cluster.servers[0].data_manager.table(physical)
+    acquired = tdm.acquire_segments(tdm.segment_names())
+    try:
+        seg_loaded = acquired[0].query_view()
+        cache = getattr(seg_loaded, "_inv_cache", {})
+        assert "l_extendedprice" in cache, "postings not warmed at load"
+    finally:
+        tdm.release_segments(acquired)
