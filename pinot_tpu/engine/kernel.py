@@ -43,6 +43,9 @@ import os as _os
 
 MATMUL_GROUP_CAP = int(_os.environ.get("PINOT_TPU_MATMUL_GROUP_CAP", str(512)))
 _MATMUL_CHUNK = int(_os.environ.get("PINOT_TPU_MATMUL_CHUNK", str(1 << 15)))
+# dense presence/hist holders ride the same contraction with a combined
+# (group, valueId) key while capacity * gcard_pad stays under this
+_MATMUL_VALUE_CAP = int(_os.environ.get("PINOT_TPU_MATMUL_VALUE_CAP", str(1 << 16)))
 
 
 def _use_matmul_groupby() -> bool:
@@ -220,9 +223,9 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
             )
 
     aux = q["agg_aux"][i]
-    if agg.kind in ("presence", "hist") and agg.sort_pairs:
-        # emit (0, valueId) pairs; the sort reduce dedups (presence)
-        # and carries run starts for occurrence counts (hist)
+    if agg.kind in ("presence", "hist"):
+        # one (entry mask, global valueId) extraction serves all three
+        # storage strategies below
         remap = aux["remap"]
         if agg.is_mv:
             mv = seg[f"{agg.column}.mv"]
@@ -231,31 +234,26 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
         else:
             m = mask
             gids = _value_gids(agg, seg, remap)
-        sent = _PAIR_SENTINEL
-        return (
-            jnp.where(m, 0, sent).astype(jnp.int32),
-            jnp.where(m, gids.astype(jnp.int32), sent),
-        )
-    if agg.kind == "presence":
-        remap = aux["remap"]  # [card_pad] int32 -> global ids
-        presence = jnp.zeros(agg.gcard_pad, dtype=jnp.int32)
-        if agg.is_mv:
-            mv = seg[f"{agg.column}.mv"]
-            m = _mv_valid(seg, agg.column) & mask[:, None]
-            gids = remap[mv]
+        if agg.sort_pairs:
+            # emit (0, valueId) pairs; the sort reduce dedups (presence)
+            # and carries run starts for occurrence counts (hist)
+            sent = _PAIR_SENTINEL
+            return (
+                jnp.where(m, 0, sent).astype(jnp.int32),
+                jnp.where(m, gids.astype(jnp.int32), sent),
+            )
+        K = agg.gcard_pad
+        if _use_matmul_groupby() and K <= _MATMUL_VALUE_CAP:
+            combined = jnp.where(m, gids.astype(jnp.int32), K).astype(jnp.int32)
+            flat = _segment_add_matmul_multi(combined, m.astype(fdt)[None, :], K)[0]
+            if agg.kind == "presence":
+                return (flat > 0).astype(jnp.int32)
+            return flat
+        if agg.kind == "presence":
+            presence = jnp.zeros(K, dtype=jnp.int32)
             return presence.at[gids].max(m.astype(jnp.int32), mode="drop")
-        gids = _value_gids(agg, seg, remap)
-        return presence.at[gids].max(mask.astype(jnp.int32), mode="drop")
-
-    if agg.kind == "hist":
-        remap = aux["remap"]
-        hist = jnp.zeros(agg.gcard_pad, dtype=fdt)
-        if agg.is_mv:
-            mv = seg[f"{agg.column}.mv"]
-            m = _mv_valid(seg, agg.column) & mask[:, None]
-            return hist.at[remap[mv]].add(m.astype(fdt), mode="drop")
-        gids = _value_gids(agg, seg, remap)
-        return hist.at[gids].add(mask.astype(fdt), mode="drop")
+        hist = jnp.zeros(K, dtype=fdt)
+        return hist.at[gids].add(m.astype(fdt), mode="drop")
 
     if agg.kind == "hll":
         bucket, rho = aux["bucket"], aux["rho"]
@@ -424,6 +422,21 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
                 jnp.where(pair_v, pair_k.astype(jnp.int32), sent),
                 jnp.where(pair_v, pair_g.astype(jnp.int32), sent),
             )
+        K = capacity * agg.gcard_pad
+        if _use_matmul_groupby() and K <= _MATMUL_VALUE_CAP:
+            # combined (group, valueId) key through the one-hot MXU
+            # contraction: ~0.7ns/row at K=2^16 vs the serialized 2-D
+            # scatter's ~12.5ns/element
+            combined = jnp.where(
+                pair_v, pair_k.astype(jnp.int32) * agg.gcard_pad + pair_g, K
+            ).astype(jnp.int32)
+            flat = _segment_add_matmul_multi(
+                combined, pair_v.astype(fdt)[None, :], K
+            )[0]
+            grid = flat.reshape(capacity, agg.gcard_pad)
+            if agg.kind == "presence":
+                return (grid > 0).astype(jnp.int32)
+            return grid
         if agg.kind == "presence":
             holder = jnp.zeros((capacity, agg.gcard_pad), dtype=jnp.int32)
             return holder.at[pair_k, pair_g].max(pair_v.astype(jnp.int32), mode="drop")

@@ -259,3 +259,36 @@ def test_runs_eval_kind_regex_and_large_in():
     plan = build_static_plan(req, ctx, staged)
     kinds = {l.eval_kind for l in plan.leaves}
     assert "runs" in kinds, kinds
+
+
+def test_matmul_holder_paths_forced(monkeypatch):
+    """The MXU one-hot paths (fused group contraction + combined-key
+    dense presence/hist holders) are off on the CPU backend by default;
+    force them on so CPU CI locks their correctness against the oracle
+    (they are the production TPU paths)."""
+    monkeypatch.setenv("PINOT_TPU_GROUPBY_MATMUL", "1")
+    schema = make_test_schema(with_mv=True)
+    rows = random_rows(schema, 2500, seed=55, cardinality=30)
+    segs = [
+        build_segment(schema, rows[:1250], "testTable", "mm0"),
+        build_segment(schema, rows[1250:], "testTable", "mm1"),
+    ]
+    oracle = ScanQueryProcessor(schema, rows)
+    for pql in [
+        "SELECT sum(metInt), count(*), avg(metFloat) FROM testTable GROUP BY dimStr TOP 10",
+        "SELECT distinctcount(dimInt) FROM testTable GROUP BY dimStr TOP 10",
+        "SELECT percentile90(metInt) FROM testTable GROUP BY dimStr TOP 10",
+        "SELECT distinctcount(dimInt), percentile50(metInt) FROM testTable",
+        "SELECT distinctcountmv(dimIntMV) FROM testTable GROUP BY dimStr TOP 10",
+        "SELECT distinctcount(dimLong) FROM testTable WHERE dimInt > 400 GROUP BY dimStr TOP 10",
+    ]:
+        req = optimize_request(parse_pql(pql))
+        req2 = optimize_request(parse_pql(pql))
+        got = reduce_to_response(req, [EXECUTOR.execute(segs, req)])
+        want = oracle.execute(req2)
+        gj, wj = got.to_json(), want.to_json()
+        for k in ("timeUsedMs", "numEntriesScannedInFilter", "numEntriesScannedPostFilter",
+                  "numSegmentsQueried", "numServersQueried", "numServersResponded"):
+            gj.pop(k, None)
+            wj.pop(k, None)
+        assert _values_close(gj, wj), (pql, gj, wj)
