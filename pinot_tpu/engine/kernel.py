@@ -257,15 +257,29 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
 
     if agg.kind == "hll":
         bucket, rho = aux["bucket"], aux["rho"]
-        regs = jnp.zeros(config.HLL_M, dtype=jnp.int32)
         if agg.is_mv:
             mv = seg[f"{agg.column}.mv"]
-            m = _mv_valid(seg, agg.column) & mask[:, None]
-            return regs.at[bucket[mv]].max(
-                jnp.where(m, rho[mv], 0), mode="drop"
-            )
-        b_rows, r_rows = _hll_rows(agg, seg, bucket, rho)
-        return regs.at[b_rows].max(jnp.where(mask, r_rows, 0), mode="drop")
+            m = (_mv_valid(seg, agg.column) & mask[:, None]).reshape(-1)
+            b_rows = bucket[mv].reshape(-1)
+            r_rows = rho[mv].reshape(-1)
+        else:
+            m = mask
+            b_rows, r_rows = _hll_rows(agg, seg, bucket, rho)
+        K = config.HLL_M * 64  # rho < 64 always (64-bit hash)
+        if _use_matmul_groupby() and K <= _MATMUL_VALUE_CAP:
+            # register max via a (bucket, rho) occupancy contraction on
+            # the MXU + argmax-by-iota — replaces the serialized
+            # scatter-max
+            combined = jnp.where(
+                m, b_rows.astype(jnp.int32) * 64 + r_rows.astype(jnp.int32), K
+            ).astype(jnp.int32)
+            counts = _segment_add_matmul_multi(
+                combined, m.astype(config.float_dtype())[None, :], K
+            )[0].reshape(config.HLL_M, 64)
+            rho_iota = jax.lax.broadcasted_iota(jnp.int32, (config.HLL_M, 64), 1)
+            return jnp.max(jnp.where(counts > 0, rho_iota, 0), axis=1)
+        regs = jnp.zeros(config.HLL_M, dtype=jnp.int32)
+        return regs.at[b_rows].max(jnp.where(m, r_rows, 0), mode="drop")
 
     raise AssertionError(agg)
 
