@@ -475,6 +475,27 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
             pair_b = per_entry(b_rows)
             pair_r = per_entry(r_rows)
             pair_v = fvalid
+        K = capacity * config.HLL_M * 64
+        if _use_matmul_groupby() and K <= _MATMUL_VALUE_CAP:
+            # small group spaces: (group, bucket, rho) occupancy on the
+            # MXU + argmax-by-iota, like the scalar HLL path
+            combined = jnp.where(
+                pair_v,
+                (
+                    pair_k.astype(jnp.int32) * config.HLL_M
+                    + pair_b.astype(jnp.int32)
+                )
+                * 64
+                + pair_r.astype(jnp.int32),
+                K,
+            ).astype(jnp.int32)
+            counts = _segment_add_matmul_multi(
+                combined, pair_v.astype(config.float_dtype())[None, :], K
+            )[0].reshape(capacity, config.HLL_M, 64)
+            rho_iota = jax.lax.broadcasted_iota(
+                jnp.int32, (capacity, config.HLL_M, 64), 2
+            )
+            return jnp.max(jnp.where(counts > 0, rho_iota, 0), axis=2)
         holder = jnp.zeros((capacity, config.HLL_M), dtype=jnp.int32)
         return holder.at[pair_k, pair_b].max(
             jnp.where(pair_v, pair_r, 0), mode="drop"

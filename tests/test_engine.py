@@ -283,6 +283,8 @@ def test_matmul_holder_paths_forced(monkeypatch):
         "SELECT distinctcount(dimLong) FROM testTable WHERE dimInt > 400 GROUP BY dimStr TOP 10",
         "SELECT distinctcounthll(dimLong), fasthll(dimInt) FROM testTable",
         "SELECT distinctcounthllmv(dimIntMV) FROM testTable WHERE dimInt <= 700",
+        "SELECT distinctcounthll(dimLong) FROM testTable GROUP BY dimStr TOP 10",
+        "SELECT fasthllmv(dimIntMV), count(*) FROM testTable GROUP BY dimStr TOP 10",
     ]:
         req = optimize_request(parse_pql(pql))
         req2 = optimize_request(parse_pql(pql))
