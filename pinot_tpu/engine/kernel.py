@@ -46,6 +46,10 @@ _MATMUL_CHUNK = int(_os.environ.get("PINOT_TPU_MATMUL_CHUNK", str(1 << 15)))
 # dense presence/hist holders ride the same contraction with a combined
 # (group, valueId) key while capacity * gcard_pad stays under this
 _MATMUL_VALUE_CAP = int(_os.environ.get("PINOT_TPU_MATMUL_VALUE_CAP", str(1 << 16)))
+# grouped HLL: contraction FLOPs grow with capacity*16384, crossing the
+# ~12.5ns/element scatter cost near capacity ~19 on v5e — so the
+# dedicated gate admits capacity <= 16
+_MATMUL_HLL_CAP = int(_os.environ.get("PINOT_TPU_MATMUL_HLL_CAP", str(1 << 18)))
 
 
 def _use_matmul_groupby() -> bool:
@@ -476,7 +480,7 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
             pair_r = per_entry(r_rows)
             pair_v = fvalid
         K = capacity * config.HLL_M * 64
-        if _use_matmul_groupby() and K <= _MATMUL_VALUE_CAP:
+        if _use_matmul_groupby() and K <= _MATMUL_HLL_CAP:
             # small group spaces: (group, bucket, rho) occupancy on the
             # MXU + argmax-by-iota, like the scalar HLL path
             combined = jnp.where(

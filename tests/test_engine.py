@@ -283,8 +283,6 @@ def test_matmul_holder_paths_forced(monkeypatch):
         "SELECT distinctcount(dimLong) FROM testTable WHERE dimInt > 400 GROUP BY dimStr TOP 10",
         "SELECT distinctcounthll(dimLong), fasthll(dimInt) FROM testTable",
         "SELECT distinctcounthllmv(dimIntMV) FROM testTable WHERE dimInt <= 700",
-        "SELECT distinctcounthll(dimLong) FROM testTable GROUP BY dimStr TOP 10",
-        "SELECT fasthllmv(dimIntMV), count(*) FROM testTable GROUP BY dimStr TOP 10",
     ]:
         req = optimize_request(parse_pql(pql))
         req2 = optimize_request(parse_pql(pql))
@@ -296,3 +294,43 @@ def test_matmul_holder_paths_forced(monkeypatch):
             gj.pop(k, None)
             wj.pop(k, None)
         assert _values_close(gj, wj), (pql, gj, wj)
+
+
+def test_grouped_hll_mxu_contraction(monkeypatch):
+    """The grouped-HLL occupancy contraction (small group spaces) vs
+    the oracle — the cap is raised and kernel caches cleared so the
+    branch PROVABLY executes (the default gate admits capacity <= 16)."""
+    from pinot_tpu.engine import kernel as kernel_mod
+
+    monkeypatch.setenv("PINOT_TPU_GROUPBY_MATMUL", "1")
+    monkeypatch.setattr(kernel_mod, "_MATMUL_HLL_CAP", 1 << 24)
+    kernel_mod.make_table_kernel.cache_clear()
+    kernel_mod.make_packed_table_kernel.cache_clear()
+    try:
+        schema = make_test_schema(with_mv=True)
+        rows = random_rows(schema, 600, seed=66, cardinality=5)
+        segs = [
+            build_segment(schema, rows[:300], "testTable", "hm0"),
+            build_segment(schema, rows[300:], "testTable", "hm1"),
+        ]
+        oracle = ScanQueryProcessor(schema, rows)
+        for pql in [
+            "SELECT distinctcounthll(dimLong) FROM testTable GROUP BY dimStr TOP 15",
+            "SELECT fasthllmv(dimIntMV), count(*) FROM testTable GROUP BY dimStr TOP 15",
+        ]:
+            req = optimize_request(parse_pql(pql))
+            req2 = optimize_request(parse_pql(pql))
+            got = reduce_to_response(req, [EXECUTOR.execute(segs, req)])
+            want = oracle.execute(req2)
+            gj, wj = got.to_json(), want.to_json()
+            for k in ("timeUsedMs", "numEntriesScannedInFilter", "numEntriesScannedPostFilter",
+                      "numSegmentsQueried", "numServersQueried", "numServersResponded"):
+                gj.pop(k, None)
+                wj.pop(k, None)
+            assert _values_close(gj, wj), (pql, gj, wj)
+    finally:
+        kernel_mod.make_table_kernel.cache_clear()
+        kernel_mod.make_packed_table_kernel.cache_clear()
+        from pinot_tpu.engine.device import clear_staging_cache
+
+        clear_staging_cache()
