@@ -89,6 +89,16 @@ class _PairsState:
         return out
 
 
+def _regs_from_gids(gids: np.ndarray) -> np.ndarray:
+    """Decode packed (bucket*64 + rho) pair gids into HLL registers
+    (uint8[HLL_M], max rho per bucket) — the one place the gid packing
+    is interpreted on host."""
+    regs = np.zeros(config.HLL_M, dtype=np.uint8)
+    g = gids.astype(np.int64)
+    np.maximum.at(regs, g >> 6, (g & 63).astype(np.uint8))
+    return regs
+
+
 def _hist_partial(gdict, gids, cnts, p: int) -> "HistogramPartial":
     counts = {
         float(gdict.get(int(g))): int(c)
@@ -627,7 +637,24 @@ class QueryExecutor:
         if agg.kind == "hll":
             from pinot_tpu.engine import hll as hll_mod
 
-            ests = hll_mod.estimate_from_registers(np.asarray(state)[keys])
+            if agg.sort_pairs:
+                # vectorized over ALL requested keys: one maximum.at over
+                # the concatenated per-slot gid slices (slots are sorted)
+                lo = state._bounds[keys]
+                hi = state._bounds[keys + 1]
+                counts = hi - lo
+                take = np.concatenate(
+                    [np.arange(a, b) for a, b in zip(lo, hi)]
+                ) if keys.size else np.zeros(0, dtype=np.int64)
+                gids = state._gids_sorted[take].astype(np.int64)
+                rows = np.repeat(np.arange(keys.size), counts)
+                regs = np.zeros((keys.size, config.HLL_M), dtype=np.uint8)
+                np.maximum.at(
+                    regs, (rows, gids >> 6), (gids & 63).astype(np.uint8)
+                )
+                ests = hll_mod.estimate_from_registers(regs)
+            else:
+                ests = hll_mod.estimate_from_registers(np.asarray(state)[keys])
             return np.asarray(ests, dtype=np.float64)
         raise AssertionError(agg)
 
@@ -663,6 +690,8 @@ class QueryExecutor:
             counts = {float(gdict.get(int(i))): int(row[i]) for i in ids if i < gdict.cardinality}
             return HistogramPartial(counts, percentile=p)
         if agg.kind == "hll":
+            if agg.sort_pairs:
+                return HllPartial(_regs_from_gids(state.gids_for(key)))
             return HllPartial(np.asarray(state)[key].astype(np.uint8))
         raise AssertionError(agg)
 

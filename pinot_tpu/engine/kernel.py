@@ -479,6 +479,16 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
             pair_b = per_entry(b_rows)
             pair_r = per_entry(r_rows)
             pair_v = fvalid
+        if agg.sort_pairs:
+            # big group spaces: (slot, bucket*64+rho) pairs through the
+            # generic sort-dedup reduce; finalize max-reduces rho per
+            # (slot, bucket) into registers
+            sent = _PAIR_SENTINEL
+            gid = pair_b.astype(jnp.int32) * 64 + pair_r.astype(jnp.int32)
+            return (
+                jnp.where(pair_v, pair_k.astype(jnp.int32), sent),
+                jnp.where(pair_v, gid, sent),
+            )
         K = capacity * config.HLL_M * 64
         if _use_matmul_groupby() and K <= _MATMUL_HLL_CAP:
             # small group spaces: (group, bucket, rho) occupancy on the
@@ -670,7 +680,7 @@ def _state_reduce(agg: StaticAgg) -> str:
     if agg.kind == "hist":
         return "distinct_pairs" if agg.sort_pairs else "sum"
     if agg.kind == "hll":
-        return "max"
+        return "distinct_pairs" if agg.sort_pairs else "max"
     raise AssertionError(agg)
 
 

@@ -325,10 +325,10 @@ def build_static_plan(
             if a.kind in ("presence", "hist", "hll"):
                 state = a.gcard_pad if a.kind != "hll" else config.HLL_M
                 if cap * state > config.MAX_VALUE_STATE * 4:
-                    if a.kind in ("presence", "hist"):
-                        aggs[ai] = replace(a, sort_pairs=True)
-                    else:
-                        on_device = False
+                    # every value-state kind sorts instead of leaving
+                    # the device: presence dedups, hist counts runs,
+                    # hll packs (bucket, rho) into the pair gid
+                    aggs[ai] = replace(a, sort_pairs=True)
         group_by = StaticGroupBy(
             columns=cols,
             col_is_mv=col_is_mv,

@@ -247,3 +247,20 @@ def test_mesh_overflow_forces_host_fallback(cluster, monkeypatch):
         kernel_mod.make_table_kernel.cache_clear()
         kernel_mod.make_packed_table_kernel.cache_clear()
         clear_staging_cache()
+
+
+def test_grouped_hll_sort_pairs(cluster, monkeypatch):
+    """Grouped HLL past the dense budget rides the same pair-sort
+    machinery ((slot, bucket*64+rho) gids) instead of host-falling-back;
+    registers reconstruct exactly at finalize so estimates match the
+    oracle bit for bit."""
+    segs, oracle = cluster
+    monkeypatch.setattr(config, "MAX_VALUE_STATE", 1)  # force sort for HLL too
+    for q in (
+        "SELECT distinctcounthll(l_extendedprice) FROM lineitem GROUP BY l_returnflag TOP 10",
+        "SELECT fasthll(l_shipdate), count(*) FROM lineitem GROUP BY l_shipdate TOP 5",
+    ):
+        req = optimize_request(parse_pql(q))
+        got = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
+        want = oracle.execute(optimize_request(parse_pql(q)))
+        assert _norm(got) == _norm(want), q
