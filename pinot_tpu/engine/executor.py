@@ -74,6 +74,19 @@ class _PairsState:
         a, b = self._bounds[key], self._bounds[key + 1]
         return self._gids_sorted[a:b], self._pair_counts[a:b]
 
+    def gids_rows_for(self, keys: np.ndarray):
+        """Batched slice gather: (gids, rows) where ``rows[i]`` is the
+        position in ``keys`` whose slot owns ``gids[i]`` — the input
+        shape ``_regs_from_gids`` batch-decodes."""
+        lo, hi = self._bounds[keys], self._bounds[keys + 1]
+        counts = hi - lo
+        total = int(counts.sum())
+        # vectorized ragged gather: per-element position minus its own
+        # slice's cumulative start, plus the slice's lo
+        offs = np.concatenate(([0], np.cumsum(counts)[:-1])).astype(np.int64)
+        take = np.arange(total) - np.repeat(offs, counts) + np.repeat(lo, counts)
+        return self._gids_sorted[take], np.repeat(np.arange(keys.size), counts)
+
     def percentiles_for(self, keys: np.ndarray, p: int, vals: np.ndarray) -> np.ndarray:
         """Vectorized exact percentile per requested group slot from the
         sparse (gid, count) runs — mirrors the dense-histogram math."""
@@ -89,13 +102,21 @@ class _PairsState:
         return out
 
 
-def _regs_from_gids(gids: np.ndarray) -> np.ndarray:
+def _regs_from_gids(
+    gids: np.ndarray, rows: np.ndarray | None = None, n_rows: int = 0
+) -> np.ndarray:
     """Decode packed (bucket*64 + rho) pair gids into HLL registers
-    (uint8[HLL_M], max rho per bucket) — the one place the gid packing
-    is interpreted on host."""
-    regs = np.zeros(config.HLL_M, dtype=np.uint8)
+    (max rho per bucket) — the one place the gid packing is interpreted
+    on host.  Without ``rows``: one uint8[HLL_M] register array.  With
+    ``rows`` (same shape as ``gids``) and ``n_rows``: a batched
+    uint8[n_rows, HLL_M] decode, one register array per row."""
     g = gids.astype(np.int64)
-    np.maximum.at(regs, g >> 6, (g & 63).astype(np.uint8))
+    if rows is None:
+        regs = np.zeros(config.HLL_M, dtype=np.uint8)
+        np.maximum.at(regs, g >> 6, (g & 63).astype(np.uint8))
+        return regs
+    regs = np.zeros((n_rows, config.HLL_M), dtype=np.uint8)
+    np.maximum.at(regs, (rows, g >> 6), (g & 63).astype(np.uint8))
     return regs
 
 
@@ -638,20 +659,10 @@ class QueryExecutor:
             from pinot_tpu.engine import hll as hll_mod
 
             if agg.sort_pairs:
-                # vectorized over ALL requested keys: one maximum.at over
-                # the concatenated per-slot gid slices (slots are sorted)
-                lo = state._bounds[keys]
-                hi = state._bounds[keys + 1]
-                counts = hi - lo
-                take = np.concatenate(
-                    [np.arange(a, b) for a, b in zip(lo, hi)]
-                ) if keys.size else np.zeros(0, dtype=np.int64)
-                gids = state._gids_sorted[take].astype(np.int64)
-                rows = np.repeat(np.arange(keys.size), counts)
-                regs = np.zeros((keys.size, config.HLL_M), dtype=np.uint8)
-                np.maximum.at(
-                    regs, (rows, gids >> 6), (gids & 63).astype(np.uint8)
-                )
+                # vectorized over ALL requested keys: one batched decode
+                # over the concatenated per-slot gid slices
+                gids, rows = state.gids_rows_for(keys)
+                regs = _regs_from_gids(gids, rows, keys.size)
                 ests = hll_mod.estimate_from_registers(regs)
             else:
                 ests = hll_mod.estimate_from_registers(np.asarray(state)[keys])

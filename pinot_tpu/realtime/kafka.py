@@ -162,13 +162,16 @@ def decode_message_set(data: bytes) -> List[Tuple[int, Optional[bytes], bytes]]:
             from pinot_tpu.utils.snappy import decompress as snappy_decompress
 
             out.extend(decode_message_set(snappy_decompress(value or b"")))
+        elif codec == 3:  # lz4 frame (incl. KAFKA-3160 header tolerance)
+            from pinot_tpu.utils.lz4 import decompress as lz4_decompress
+
+            out.extend(decode_message_set(lz4_decompress(value or b"")))
         else:
-            # lz4 (kafka's pre-0.10 framing was nonstandard anyway):
             # fail loudly instead of handing compressed bytes to the
             # row decoder
             raise ValueError(
                 f"unsupported message compression codec {codec} at offset "
-                f"{offset} (gzip=1 and snappy=2 are supported)"
+                f"{offset} (gzip=1, snappy=2, lz4=3 are supported)"
             )
         pos += 12 + size
     return out

@@ -30,6 +30,7 @@ class InProcessCluster:
         data_dir: Optional[str] = None,
         mesh=None,
         http: bool = False,
+        timeout_ms: float = 15_000.0,
     ) -> None:
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="pinot_tpu_cluster_")
         self.controller = Controller(self.data_dir)
@@ -48,7 +49,9 @@ class InProcessCluster:
             self.servers.append(server)
             self.server_starters.append(starter)
 
-        self.broker = BrokerRequestHandler(self.transport, addresses, name="broker0")
+        self.broker = BrokerRequestHandler(
+            self.transport, addresses, name="broker0", timeout_ms=timeout_ms
+        )
         self.http: Optional[BrokerHttpServer] = None
         broker_url = None
         if http:

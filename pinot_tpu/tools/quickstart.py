@@ -16,6 +16,10 @@ from pinot_tpu.startree.builder import StarTreeBuilderConfig
 from pinot_tpu.tools.cluster_harness import InProcessCluster
 from pinot_tpu.tools.datagen import baseball_rows, baseball_schema
 
+# demo clusters serve interactively after the samples print, so the
+# timeout only caps the worst case; it must cover a cold-chip compile
+_COLD_TIMEOUT_MS = 300_000.0
+
 OFFLINE_SAMPLE_QUERIES = [
     "SELECT count(*) FROM baseballStats",
     "SELECT sum(runs) FROM baseballStats GROUP BY playerName TOP 5",
@@ -36,7 +40,10 @@ def run_offline_quickstart(
     cluster -> PQL over HTTP (the minimum end-to-end slice, SURVEY §7)."""
     schema = baseball_schema()
     rows = baseball_rows(num_rows)
-    cluster = InProcessCluster(num_servers=2, http=http)
+    # each demo query is a fresh plan shape: on a cold accelerator the
+    # first compile takes 20-40s, so the serving default (15s) would
+    # time out every sample query (the bench path does the same)
+    cluster = InProcessCluster(num_servers=2, http=http, timeout_ms=_COLD_TIMEOUT_MS)
     physical = cluster.add_offline_table(schema)
 
     chunk = max(1, len(rows) // num_segments)
@@ -80,7 +87,7 @@ def run_realtime_quickstart(
 
     rng = random.Random(1)
     schema = meetup_schema()
-    cluster = InProcessCluster(num_servers=1, http=http)
+    cluster = InProcessCluster(num_servers=1, http=http, timeout_ms=_COLD_TIMEOUT_MS)
     stream = MemoryStreamProvider(num_partitions=1)
     physical = cluster.add_realtime_table(schema, stream, rows_per_segment=500)
 

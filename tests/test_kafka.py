@@ -207,9 +207,9 @@ def test_gzip_compressed_message_set():
     assert json.loads(out[2][2]) == {"i": 2}
 
     # unsupported codecs fail loudly, not with a row-decoder crash
-    body2 = struct.pack(">bb", 0, 3) + struct.pack(">i", -1) + struct.pack(">i", 1) + b"x"
+    body2 = struct.pack(">bb", 0, 4) + struct.pack(">i", -1) + struct.pack(">i", 1) + b"x"
     msg2 = struct.pack(">i", _signed_crc(body2)) + body2
-    with pytest.raises(ValueError, match="compression codec 3"):
+    with pytest.raises(ValueError, match="compression codec 4"):
         decode_message_set(struct.pack(">qi", 0, len(msg2)) + msg2)
 
 
@@ -320,3 +320,108 @@ def test_snappy_codec_round_trip():
         out = decode_message_set(struct.pack(">qi", 3, len(msg)) + msg)
         assert [o for o, _, _ in out] == [0, 1, 2, 3]
         assert json.loads(out[3][2]) == {"i": 3}
+
+
+def test_lz4_codec_round_trip():
+    """LZ4 wrapper messages (codec=3, standard frame format incl. the
+    KAFKA-3160 unverifiable header checksum) decode to inner messages."""
+    import struct
+
+    from pinot_tpu.realtime.kafka import _signed_crc
+    from pinot_tpu.utils import lz4
+
+    # block round trips through the greedy compressor: empty, short
+    # (literal-only), RLE (overlapping match), structured repeats, and
+    # incompressible bytes
+    rng = __import__("random").Random(7)
+    payloads = [
+        b"",
+        b"abc",
+        b"x" * 100000,
+        bytes(range(256)) * 300,
+        b"the quick brown fox " * 4000,
+        bytes(rng.randrange(256) for _ in range(5000)),
+    ]
+    for payload in payloads:
+        assert lz4.decompress_block(lz4.compress_block(payload)) == payload
+        assert lz4.decompress(lz4.compress_frame(payload)) == payload
+
+    # hand-built block with a known shape: 4 literals then an
+    # overlapping offset-4 match of length 8 -> "abcd" * 3, ending in a
+    # >=5-byte literal tail per the spec's end conditions
+    blob = bytes([0x44]) + b"abcd" + bytes([0x04, 0x00]) + bytes([0x50]) + b"abcde"
+    assert lz4.decompress_block(blob) == b"abcd" * 3 + b"abcde"
+
+    # corrupt inputs fail loudly
+    with pytest.raises(ValueError, match="zero match offset"):
+        lz4.decompress_block(bytes([0x14]) + b"a" + bytes([0x00, 0x00]))
+    with pytest.raises(ValueError, match="outside window"):
+        lz4.decompress_block(bytes([0x14]) + b"a" + bytes([0x09, 0x00]))
+    with pytest.raises(ValueError, match="bad frame magic"):
+        lz4.decompress_frame(b"\x00\x00\x00\x00rest")
+
+    # a skippable frame before the real one is skipped
+    skip = struct.pack("<II", 0x184D2A50, 3) + b"pad"
+    assert lz4.decompress(skip + lz4.compress_frame(b"hello world!" * 10)) == b"hello world!" * 10
+
+    # wrapper MessageSet through the Kafka decoder
+    inner = b"".join(encode_message(i, json.dumps({"i": i}).encode()) for i in range(4))
+    wire = lz4.compress_frame(inner)
+    body = struct.pack(">bb", 0, 3) + struct.pack(">i", -1) + struct.pack(">i", len(wire)) + wire
+    msg = struct.pack(">i", _signed_crc(body)) + body
+    out = decode_message_set(struct.pack(">qi", 3, len(msg)) + msg)
+    assert [o for o, _, _ in out] == [0, 1, 2, 3]
+    assert json.loads(out[3][2]) == {"i": 3}
+
+
+def test_lz4_xxh32_and_header_checksum():
+    """xxh32 matches the published reference vectors, and emitted
+    frames carry the spec-correct header checksum byte."""
+    import struct
+
+    from pinot_tpu.utils import lz4
+
+    assert lz4.xxh32(b"") == 0x02CC5D05
+    assert lz4.xxh32(b"a") == 0x550D7456
+    assert lz4.xxh32(b"abc") == 0x32D153FF
+    assert lz4.xxh32(b"a" * 100) == lz4.xxh32(b"a" * 100)  # deterministic
+    assert lz4.xxh32(b"abc", seed=1) != lz4.xxh32(b"abc")
+
+    frame = lz4.compress_frame(b"payload bytes " * 50)
+    flg = frame[4]
+    hdr_len = 2 + (8 if flg & 0x08 else 0)
+    descriptor = frame[4 : 4 + hdr_len]
+    assert frame[4 + hdr_len] == (lz4.xxh32(descriptor) >> 8) & 0xFF
+
+
+def test_lz4_linked_blocks_and_bounds():
+    """Linked-block frames (librdkafka's LZ4F default) back-reference
+    prior blocks' output; bounds trip BEFORE any oversized copy runs."""
+    import struct
+
+    from pinot_tpu.utils import lz4
+
+    # hand-built 2-block frame: block 2's first match reaches 8 bytes
+    # back into block 1's output (legal only in linked mode)
+    blk2 = bytes([0x04, 0x08, 0x00, 0x20]) + b"XY"
+    body = (
+        struct.pack("<I", 0x80000008) + b"abcdefgh"
+        + struct.pack("<I", len(blk2)) + blk2
+        + struct.pack("<I", 0)
+    )
+
+    def frame(flg):
+        return struct.pack("<I", lz4.FRAME_MAGIC) + bytes([flg, 0x40, 0]) + body
+
+    assert lz4.decompress_frame(frame(0x40)) == b"abcdefghabcdefghXY"  # linked
+    with pytest.raises(ValueError, match="outside window"):
+        lz4.decompress_frame(frame(0x60))  # independent: offset invalid
+
+    # a declared 2GB overlapping match trips the bound before copying
+    ext = b"\xff" * 8000 + b"\x00"  # ~2M extra match length
+    bomb = bytes([0x1F]) + b"a" + bytes([0x01, 0x00]) + ext
+    with pytest.raises(ValueError, match="exceeds declared size"):
+        lz4.decompress_block(bomb, max_output=1000)
+    # same shape without the cap decodes (offset-1 RLE), sized right
+    n = 4 + 15 + 255 * 8000
+    assert lz4.decompress_block(bomb) == b"a" * (1 + n)
