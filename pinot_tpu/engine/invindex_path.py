@@ -26,7 +26,7 @@ import numpy as np
 
 from pinot_tpu.common.request import BrokerRequest, FilterOperator, FilterQueryTree
 from pinot_tpu.engine.context import TableContext
-from pinot_tpu.engine.plan import match_table
+from pinot_tpu.engine.plan import cached_match_table
 from pinot_tpu.engine.results import IntermediateResult
 from pinot_tpu.segment.immutable import ImmutableSegment
 from pinot_tpu.segment.invindex import inverted_index
@@ -77,7 +77,10 @@ def _subset_mask(
     if tree.is_leaf:
         col = seg.column(tree.column)
         d = col.dictionary
-        table = match_table(tree, d, d.cardinality if d.cardinality else 1)
+        table = cached_match_table(
+            tree, d, d.cardinality if d.cardinality else 1,
+            cache_key=(seg.segment_name, seg.metadata.crc, tree.column),
+        )
         negative = tree.operator in (FilterOperator.NOT, FilterOperator.NOT_IN)
         if col.is_single_value:
             m = table[np.asarray(col.fwd)[rows]]
@@ -142,7 +145,10 @@ def try_index_path(
                 ok = False
                 break
             d = col.dictionary
-            t = match_table(leaf, d, d.cardinality)
+            t = cached_match_table(
+                leaf, d, d.cardinality,
+                cache_key=(seg.segment_name, seg.metadata.crc, leaf.column),
+            )
             tables.append(t)
             frac = max(frac, float(t.sum()) / d.cardinality)
         if ok and (best_frac is None or frac < best_frac):

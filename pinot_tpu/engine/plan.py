@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass, replace
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -146,16 +147,61 @@ _MAX_POINTS = 16  # IN lists up to this size evaluate as compares
 _MAX_RUNS = 64  # match tables with <= this many dictId runs evaluate as interval unions
 
 
-def _effective_table(leaf_node, mode: str, d: Dictionary, card_pad: int, true_card: int) -> np.ndarray:
+# regex tables are the one plan-time cost that SCANS a dictionary (re
+# over every value); identical regex leaves across queries hit this
+# LRU instead, keyed by segment identity so reloads can't alias
+_regex_tables: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+
+
+def cached_match_table(
+    leaf_node, d: Dictionary, card_pad: int, cache_key: Optional[tuple]
+) -> np.ndarray:
+    """``match_table`` with the regex LRU in front — regex is the only
+    operator whose table costs a full dictionary scan.  Raw (pre-
+    complement) tables key under a distinct tag so they can never alias
+    ``_effective_table`` entries."""
+    if cache_key is None or leaf_node.operator != FilterOperator.REGEX:
+        return match_table(leaf_node, d, card_pad)
+    key = ("raw", cache_key, card_pad, tuple(leaf_node.values))
+    cached = _regex_tables.get(key)
+    if cached is not None:
+        _regex_tables.move_to_end(key)
+        return cached
+    t = match_table(leaf_node, d, card_pad)
+    _regex_tables[key] = t
+    if len(_regex_tables) > 256:
+        _regex_tables.popitem(last=False)
+    return t
+
+
+def _effective_table(
+    leaf_node,
+    mode: str,
+    d: Dictionary,
+    card_pad: int,
+    true_card: int,
+    cache_key: Optional[tuple] = None,
+) -> np.ndarray:
     """The table the kernel would read for this leaf: SV NOT/NOT_IN
     bakes the complement (kernel negates MV_NONE after the
     any-reduce).  Shared by plan-time run counting and input build so
     they can never disagree."""
+    key = None
+    if cache_key is not None and leaf_node.operator == FilterOperator.REGEX:
+        key = (cache_key, mode, card_pad, true_card, tuple(leaf_node.values))
+        cached = _regex_tables.get(key)
+        if cached is not None:
+            _regex_tables.move_to_end(key)
+            return cached
     t = match_table(leaf_node, d, card_pad)
     if mode == SV and leaf_node.operator in (FilterOperator.NOT, FilterOperator.NOT_IN):
         flipped = np.zeros(card_pad, dtype=bool)
         flipped[:true_card] = ~t[:true_card]
         t = flipped
+    if key is not None:
+        _regex_tables[key] = t
+        if len(_regex_tables) > 256:
+            _regex_tables.popitem(last=False)
     return t
 
 
@@ -234,7 +280,8 @@ def build_static_plan(
                         scol = seg.column(node.column)
                         stg = staged.column(node.column)
                         t = _effective_table(
-                            node, mode, scol.dictionary, stg.card_pad, stg.cards[si]
+                            node, mode, scol.dictionary, stg.card_pad, stg.cards[si],
+                            cache_key=(seg.segment_name, seg.metadata.crc, node.column),
                         )
                         if scratch is not None:
                             scratch[(id(node), si)] = t
@@ -535,7 +582,8 @@ def build_query_inputs(
                     if t is None:
                         stg = staged.column(leaf_static.column)
                         t = _effective_table(
-                            leaf_node, leaf_static.mode, d, stg.card_pad, stg.cards[i]
+                            leaf_node, leaf_static.mode, d, stg.card_pad, stg.cards[i],
+                            cache_key=(seg.segment_name, seg.metadata.crc, leaf_static.column),
                         )
                     for ri, (lo, hi) in enumerate(_table_runs(t)):
                         runs_e[i, ri] = (lo, hi)
@@ -560,7 +608,8 @@ def build_query_inputs(
                     t = None if scratch is None else scratch.get((id(leaf_node), i))
                     if t is None:
                         t = _effective_table(
-                            leaf_node, leaf_static.mode, d, col.card_pad, col.cards[i]
+                            leaf_node, leaf_static.mode, d, col.card_pad, col.cards[i],
+                            cache_key=(seg.segment_name, seg.metadata.crc, leaf_static.column),
                         )
                     table_e[i] = t
             tables.append(table_e)

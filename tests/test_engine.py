@@ -334,3 +334,33 @@ def test_grouped_hll_mxu_contraction(monkeypatch):
         from pinot_tpu.engine.device import clear_staging_cache
 
         clear_staging_cache()
+
+
+def test_regex_table_cache_and_qinput_cache(monkeypatch):
+    """Repeated regex queries scan the dictionary once (plan._regex_tables
+    LRU) and repeated identical queries reuse device-resident inputs
+    (executor query-input cache) — both per-query upload/scan costs are
+    paid once on a served workload."""
+    from pinot_tpu.engine import plan as plan_mod
+
+    plan_mod._regex_tables.clear()
+    calls = {"n": 0}
+    real = plan_mod.match_table
+
+    def counting(leaf, d, card_pad):
+        calls["n"] += 1
+        return real(leaf, d, card_pad)
+
+    monkeypatch.setattr(plan_mod, "match_table", counting)
+    ex = QueryExecutor()
+    req = optimize_request(parse_pql("SELECT count(*) FROM t WHERE regexp_like(city, '^s')"))
+    r1 = ex.execute([SEGMENT], req)
+    first = calls["n"]
+    assert first >= 1
+    r2 = ex.execute([SEGMENT], req)
+    assert calls["n"] == first  # second query: all regex tables cached
+    assert reduce_to_response(req, [r1]).aggregation_results[0].value == \
+        reduce_to_response(req, [r2]).aggregation_results[0].value == 2
+
+    # the device-input cache is populated and keyed by plan+content
+    assert len(ex._qinput_cache) >= 1
