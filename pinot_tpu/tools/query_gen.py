@@ -8,6 +8,7 @@ speak PQL directly, so only PQL is generated; the oracle plays H2's role.
 from __future__ import annotations
 
 import random
+import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Sequence
 
@@ -52,7 +53,16 @@ class QueryGenerator:
 
     def _predicate(self) -> str:
         col = self.rng.choice(self._predicate_columns())
-        kind = self.rng.randrange(6)
+        kind = self.rng.randrange(7)
+        if kind == 6:
+            v = self._sample_value(col)
+            if isinstance(v, str) and v:
+                # prefix regex from a live value: exercises the runs
+                # eval kind and the regex-table caches
+                pat = "^" + re.escape(v[: self.rng.randint(1, len(v))]) + ".*"
+                escaped = pat.replace("'", "''")
+                return f"regexp_like({col}, '{escaped}')"
+            kind = self.rng.randrange(6)
         if kind == 0:
             return f"{col} = {self._literal(col)}"
         if kind == 1:
@@ -92,7 +102,12 @@ class QueryGenerator:
             if f == "count" and self.rng.random() < 0.5:
                 aggs.append("count(*)")
             elif f == "distinctcount":
-                aggs.append(f"distinctcount({self.rng.choice(self.all_sv)})")
+                # the MV variant (countmv/distinctcountmv naming, the
+                # reference's *MVAggregationFunction family) sometimes
+                if self.mv_dims and self.rng.random() < 0.3:
+                    aggs.append(f"distinctcountmv({self.rng.choice(self.mv_dims)})")
+                else:
+                    aggs.append(f"distinctcount({self.rng.choice(self.all_sv)})")
             else:
                 aggs.append(f"{f}({self.rng.choice(self.metrics)})")
         return f"SELECT {', '.join(aggs)} FROM {self.table}{self._where()}"

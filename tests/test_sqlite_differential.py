@@ -52,6 +52,14 @@ def _sqlite_type(dt: DataType) -> str:
 
 def _load_sqlite(schema, rows):
     conn = sqlite3.connect(":memory:")
+    # regexp_like(col, pat) with the ENGINE's exact semantics
+    # (re.search over str(value) — plan.py match_table REGEX), so
+    # generated where-clauses run verbatim in both dialects
+    import re as _re
+
+    conn.create_function(
+        "regexp_like", 2, lambda v, p: _re.search(p, str(v)) is not None
+    )
     fields = [s for s in schema.all_fields() if s.single_value]
     cols = ", ".join(f"{s.name} {_sqlite_type(s.data_type)}" for s in fields)
     conn.execute(f"CREATE TABLE testTable ({cols})")
