@@ -86,6 +86,36 @@ class FileBasedStreamProvider(StreamProvider):
         return len(self._read(partition))
 
 
+class FlakyStreamProvider(StreamProvider):
+    """Wraps a provider with injected failures: a seeded fraction of
+    ``fetch`` calls raise, and successful ones may return truncated
+    batches.  The ``FlakyConsumerRealtimeClusterIntegrationTest``
+    analog — consumers built on the retrying consume loops must still
+    ingest exactly once."""
+
+    def __init__(self, inner: StreamProvider, fail_rate: float = 0.5, seed: int = 0) -> None:
+        import random
+
+        self.inner = inner
+        self.fail_rate = fail_rate
+        self._rng = random.Random(seed)
+        self.failures = 0
+
+    def partition_count(self) -> int:
+        return self.inner.partition_count()
+
+    def latest_offset(self, partition: int) -> int:
+        return self.inner.latest_offset(partition)
+
+    def fetch(self, partition: int, offset: int, max_rows: int) -> Tuple[List[Row], int]:
+        if self._rng.random() < self.fail_rate:
+            self.failures += 1
+            raise RuntimeError("injected stream failure")
+        if max_rows > 1 and self._rng.random() < 0.5:
+            max_rows = self._rng.randint(1, max_rows)  # short read
+        return self.inner.fetch(partition, offset, max_rows)
+
+
 def stream_provider_from_config(stream_config) -> StreamProvider:
     """Build a provider from a table's StreamConfig (the
     KafkaStreamProviderConfig -> consumer factory analog), so REALTIME

@@ -4,6 +4,7 @@ commands).  Usage: ``python -m pinot_tpu.tools.admin <command> [args]``.
 Commands:
   Quickstart            offline baseballStats demo (Quickstart.java:33)
   RealtimeQuickstart    streaming meetupRsvp demo
+  HybridQuickstart      offline history + live stream, one logical table
   NetworkRealtimeQuickstart  same, across real processes + TCP stream broker
   StartCluster          in-process cluster with HTTP broker+controller
   StartController       standalone controller process (networked cluster)
@@ -66,6 +67,23 @@ def cmd_realtime_quickstart(args) -> None:
     from pinot_tpu.tools.quickstart import run_realtime_quickstart
 
     cluster = run_realtime_quickstart(num_events=args.events, http=not args.no_http)
+    if not args.no_http:
+        print("Ctrl-C to exit.")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            cluster.stop()
+
+
+def cmd_hybrid_quickstart(args) -> None:
+    from pinot_tpu.tools.quickstart import run_hybrid_quickstart
+
+    cluster = run_hybrid_quickstart(
+        num_offline=args.offline_rows,
+        num_realtime=args.realtime_rows,
+        http=not args.no_http,
+    )
     if not args.no_http:
         print("Ctrl-C to exit.")
         try:
@@ -345,6 +363,12 @@ def main(argv=None) -> None:
     rq.add_argument("-events", type=int, default=2000)
     rq.add_argument("-no-http", action="store_true")
     rq.set_defaults(fn=cmd_realtime_quickstart)
+
+    hq = sub.add_parser("HybridQuickstart")
+    hq.add_argument("-offline-rows", type=int, default=1500, dest="offline_rows")
+    hq.add_argument("-realtime-rows", type=int, default=800, dest="realtime_rows")
+    hq.add_argument("-no-http", action="store_true")
+    hq.set_defaults(fn=cmd_hybrid_quickstart)
 
     nrq = sub.add_parser("NetworkRealtimeQuickstart")
     nrq.add_argument("-events", type=int, default=2000)

@@ -16,7 +16,10 @@ from pinot_tpu.common.schema import DataType, FieldType, Schema
 
 Row = Dict[str, Any]
 
-_SV_AGGS = ["count", "sum", "min", "max", "avg", "minmaxrange", "distinctcount", "percentile50", "percentile90"]
+_SV_AGGS = [
+    "count", "sum", "min", "max", "avg", "minmaxrange", "distinctcount",
+    "percentile50", "percentile90", "percentileest50", "percentileest95",
+]
 
 
 class QueryGenerator:

@@ -133,6 +133,76 @@ def run_realtime_quickstart(
     return cluster
 
 
+def run_hybrid_quickstart(
+    num_offline: int = 1500, num_realtime: int = 800, http: bool = False, verbose: bool = True
+) -> InProcessCluster:
+    """Hybrid quickstart (``HybridQuickstart.java`` analog): the SAME
+    logical table served by an OFFLINE side (historical segments) and a
+    REALTIME side (live stream), federated at query time by the offline
+    max-time boundary — offline answers <= boundary, realtime answers
+    the fresh tail, each row counted exactly once."""
+    import random
+
+    rng = random.Random(3)
+    schema = meetup_schema()
+    cluster = InProcessCluster(num_servers=2, http=http, timeout_ms=_COLD_TIMEOUT_MS)
+    cities = ["sf", "nyc", "seattle", "austin", "chicago"]
+    base = int(time.time() * 1000) - 86_400_000  # yesterday
+
+    def event(i: int) -> dict:
+        return {
+            "venue_name": f"venue{rng.randrange(20)}",
+            "event_name": f"event{rng.randrange(8)}",
+            "group_city": rng.choice(cities),
+            "rsvp_count": rng.randint(1, 5),
+            "mtime": base + i * 1000,
+        }
+
+    # offline side: two historical segments
+    offline = cluster.add_offline_table(schema, table_name="meetupRsvp")
+    rows = [event(i) for i in range(num_offline)]
+    half = num_offline // 2
+    for name, part in (("hist0", rows[:half]), ("hist1", rows[half:])):
+        cluster.upload(offline, build_segment(schema, part, offline, name))
+
+    # realtime side: the live tail STARTS BEFORE the boundary to prove
+    # overlap dedup, then extends past it
+    stream = MemoryStreamProvider(num_partitions=1)
+    rt_physical = cluster.add_realtime_table(schema, stream, rows_per_segment=10_000)
+    for i in range(num_offline - 100, num_offline + num_realtime):
+        stream.produce(event(i))
+    from pinot_tpu.realtime.llc import make_segment_name
+
+    # consume/seal/roll until the stream is dry, so row counts past one
+    # segment's budget still land (same loop as the realtime quickstart)
+    seq = 0
+    while True:
+        seg = make_segment_name(rt_physical, 0, seq)
+        dms = cluster.controller.realtime_manager.consumers_of(seg)
+        if not dms:
+            break
+        dm = dms[0]
+        consumed = dm.consume_step(max_rows=1_000_000)
+        if dm.threshold_reached:
+            dm.try_commit()
+            seq += 1
+        elif consumed == 0:
+            break
+
+    if verbose:
+        for pql in [
+            "SELECT count(*) FROM meetupRsvp",
+            "SELECT max(mtime) FROM meetupRsvp",
+            "SELECT sum(rsvp_count) FROM meetupRsvp GROUP BY group_city TOP 5",
+        ]:
+            resp = cluster.query(pql)
+            print(f"\n>>> {pql}")
+            print(json.dumps(resp.to_json(), indent=2)[:900])
+        if http:
+            print(f"\nbroker listening on http://127.0.0.1:{cluster.http.port}/query")
+    return cluster
+
+
 def run_network_realtime_quickstart(
     num_events: int = 2000,
     verbose: bool = True,

@@ -272,3 +272,58 @@ def test_index_batch_dirty_row_is_atomic():
     row = snap.row(11)
     assert row["rsvp_count"] == make_row(11)["rsvp_count"]
     assert row["venue_name"] == make_row(11)["venue_name"]
+
+
+def test_flaky_consumer_ingests_exactly_once(tmp_path):
+    """A stream provider that fails 60% of fetches and returns short
+    batches must not lose or duplicate rows: the consume/commit cycle
+    retries until every segment seals at exact offsets (the
+    FlakyConsumerRealtimeClusterIntegrationTest analog)."""
+    from pinot_tpu.realtime.stream import FlakyStreamProvider
+
+    cluster = InProcessCluster(num_servers=1, data_dir=str(tmp_path))
+    schema = rsvp_schema()
+    inner = MemoryStreamProvider(num_partitions=1)
+    stream = FlakyStreamProvider(inner, fail_rate=0.6, seed=42)
+    physical = cluster.add_realtime_table(schema, stream, rows_per_segment=50)
+
+    total = 173
+    for i in range(total):
+        inner.produce(make_row(i))
+
+    # drive consumption with retry-on-failure, as the production
+    # network consume loop does (server/network_starter.py _run)
+    seq = 0
+    attempts = 0
+    while attempts < 4000:
+        attempts += 1
+        seg = make_segment_name(physical, 0, seq)
+        dms = cluster.controller.realtime_manager.consumers_of(seg)
+        if not dms:
+            break
+        dm = dms[0]
+        try:
+            got = dm.consume_step(max_rows=64)
+        except RuntimeError:
+            continue  # injected failure: retry, offsets unchanged
+        if dm.threshold_reached:
+            dm.try_commit()
+            seq += 1
+        elif got == 0:
+            break
+    assert stream.failures > 5  # the injection actually engaged
+
+    # exactly-once: every row present once, sealed offsets contiguous
+    resp = cluster.query("SELECT count(*) FROM meetupRsvp")
+    assert resp.num_docs_scanned == total
+    got = cluster.query("SELECT sum(rsvp_count) FROM meetupRsvp")
+    oracle = ScanQueryProcessor(schema, [make_row(i) for i in range(total)])
+    want = oracle.execute(parse_pql("SELECT sum(rsvp_count) FROM meetupRsvp"))
+    assert got.to_json()["aggregationResults"] == want.to_json()["aggregationResults"]
+    end = 0
+    for s in range(seq):
+        info = cluster.controller.resources.get_segment_metadata(
+            physical, make_segment_name(physical, 0, s)
+        )
+        assert info["metadata"].custom["startOffset"] == end
+        end = info["metadata"].custom["endOffset"]

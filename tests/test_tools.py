@@ -212,3 +212,19 @@ def test_admin_convert_and_generate(tmp_path, capsys):
     ])
     assert "exported 120 rows" in capsys.readouterr().out
     assert len(out_csv.read_text().splitlines()) == 121  # header + rows
+
+
+def test_hybrid_quickstart():
+    """Offline history + realtime tail on ONE logical table: the time
+    boundary federates so overlap rows count exactly once
+    (HybridQuickstart.java analog)."""
+    from pinot_tpu.tools.quickstart import run_hybrid_quickstart
+
+    cluster = run_hybrid_quickstart(num_offline=600, num_realtime=300, verbose=False)
+    resp = cluster.query("SELECT count(*) FROM meetupRsvp")
+    assert not resp.exceptions
+    # 600 offline + 300 realtime past the boundary; the 100-row overlap
+    # ingested on the realtime side is excluded by the boundary filter
+    assert resp.num_docs_scanned == 900
+    resp = cluster.query("SELECT sum(rsvp_count) FROM meetupRsvp GROUP BY group_city TOP 3")
+    assert not resp.exceptions and resp.to_json()["aggregationResults"][0]["groupByResult"]
