@@ -20,6 +20,27 @@ from pinot_tpu.tools.datagen import baseball_rows, baseball_schema
 # timeout only caps the worst case; it must cover a cold-chip compile
 _COLD_TIMEOUT_MS = 300_000.0
 
+
+def drain_stream(cluster: InProcessCluster, physical: str, max_rows: int = 10_000) -> int:
+    """Consume/seal/roll partition 0 until the stream is dry (the
+    background consume loop a deployment runs); returns sealed count."""
+    from pinot_tpu.realtime.llc import make_segment_name
+
+    seq = 0
+    while True:
+        seg = make_segment_name(physical, 0, seq)
+        dms = cluster.controller.realtime_manager.consumers_of(seg)
+        if not dms:
+            break
+        dm = dms[0]
+        consumed = dm.consume_step(max_rows=max_rows)
+        if dm.threshold_reached:
+            dm.try_commit()
+            seq += 1
+        elif consumed == 0:
+            break
+    return seq
+
 OFFLINE_SAMPLE_QUERIES = [
     "SELECT count(*) FROM baseballStats",
     "SELECT sum(runs) FROM baseballStats GROUP BY playerName TOP 5",
@@ -104,22 +125,7 @@ def run_realtime_quickstart(
             }
         )
 
-    # drive consumption + commits (a background loop in a deployment)
-    from pinot_tpu.realtime.llc import make_segment_name
-
-    seq = 0
-    while True:
-        seg = make_segment_name(physical, 0, seq)
-        dms = cluster.controller.realtime_manager.consumers_of(seg)
-        if not dms:
-            break
-        dm = dms[0]
-        consumed = dm.consume_step(max_rows=10_000)
-        if dm.threshold_reached:
-            dm.try_commit()
-            seq += 1
-        elif consumed == 0:
-            break
+    drain_stream(cluster, physical)
 
     if verbose:
         for pql in [
@@ -171,23 +177,9 @@ def run_hybrid_quickstart(
     rt_physical = cluster.add_realtime_table(schema, stream, rows_per_segment=10_000)
     for i in range(num_offline - 100, num_offline + num_realtime):
         stream.produce(event(i))
-    from pinot_tpu.realtime.llc import make_segment_name
-
-    # consume/seal/roll until the stream is dry, so row counts past one
-    # segment's budget still land (same loop as the realtime quickstart)
-    seq = 0
-    while True:
-        seg = make_segment_name(rt_physical, 0, seq)
-        dms = cluster.controller.realtime_manager.consumers_of(seg)
-        if not dms:
-            break
-        dm = dms[0]
-        consumed = dm.consume_step(max_rows=1_000_000)
-        if dm.threshold_reached:
-            dm.try_commit()
-            seq += 1
-        elif consumed == 0:
-            break
+    # consume/seal/roll until dry, so row counts past one segment's
+    # budget still land
+    drain_stream(cluster, rt_physical, max_rows=1_000_000)
 
     if verbose:
         for pql in [

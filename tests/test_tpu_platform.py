@@ -222,3 +222,25 @@ def test_sort_pairs_distinct_on_device(cluster, monkeypatch):
     finally:
         kernel_mod.make_table_kernel.cache_clear()
         kernel_mod.make_packed_table_kernel.cache_clear()
+
+
+def test_repeated_query_uses_input_cache_on_device(cluster):
+    """A repeated identical query reuses device-resident inputs (the
+    q-input LRU) and MUST return bit-identical results — validates the
+    cache keying on the real chip where the upload it skips is a full
+    tunnel round trip."""
+    segs, _ = cluster
+    ex = QueryExecutor()
+    pql = (
+        "SELECT sum(l_quantity), count(*) FROM lineitem "
+        "WHERE l_shipdate <= '1998-09-02' GROUP BY l_returnflag TOP 10"
+    )
+    req = optimize_request(parse_pql(pql))
+    first = reduce_to_response(req, [ex.execute(segs, req)]).to_json()
+    assert len(ex._qinput_cache) >= 1  # populated by the first run
+    second = reduce_to_response(req, [ex.execute(segs, req)]).to_json()
+    assert first["aggregationResults"] == second["aggregationResults"]
+    # a DIFFERENT literal must miss the cache and answer differently
+    req3 = optimize_request(parse_pql(pql.replace("1998-09-02", "1994-01-01")))
+    third = reduce_to_response(req3, [ex.execute(segs, req3)]).to_json()
+    assert third["aggregationResults"] != first["aggregationResults"]
