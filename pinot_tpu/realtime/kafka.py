@@ -123,10 +123,31 @@ class _Reader:
 # -- MessageSet v0 -----------------------------------------------------
 
 
-def encode_message(offset: int, value: bytes, key: Optional[bytes] = None) -> bytes:
-    body = _i8(0) + _i8(0) + _bytes(key) + _bytes(value)  # magic 0, attrs 0
+def encode_message(
+    offset: int, value: bytes, key: Optional[bytes] = None, codec: int = 0
+) -> bytes:
+    body = _i8(0) + _i8(codec & 0x07) + _bytes(key) + _bytes(value)  # magic 0
     msg = _i32(_signed_crc(body)) + body
     return _i64(offset) + _i32(len(msg)) + msg
+
+
+_CODEC_IDS = {"gzip": 1, "snappy": 2, "lz4": 3}
+
+
+def compress_message_set(data: bytes, codec_name: str) -> bytes:
+    """Compress an inner MessageSet with the named codec, producing the
+    bytes a producer puts in the wrapper message's value."""
+    if codec_name == "gzip":
+        return gzip.compress(data)
+    if codec_name == "snappy":
+        from pinot_tpu.utils.snappy import compress as snappy_compress
+
+        return snappy_compress(data)
+    if codec_name == "lz4":
+        from pinot_tpu.utils.lz4 import compress_frame
+
+        return compress_frame(data)
+    raise ValueError(f"unknown codec {codec_name!r}")
 
 
 def _signed_crc(b: bytes) -> int:
@@ -402,9 +423,18 @@ class KafkaProtocolShim:
     topic logs: the integration seam that lets the wire client run
     against real sockets without a Kafka deployment."""
 
-    def __init__(self, stream_broker, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(
+        self,
+        stream_broker,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        compression: Optional[str] = None,
+    ) -> None:
         from pinot_tpu.realtime.kafka_group import GroupCoordinator
 
+        if compression is not None and compression not in _CODEC_IDS:
+            raise ValueError(f"unknown compression {compression!r}")
+        self.compression = compression  # fetch batches ship compressed
         self.broker = stream_broker
         self.coordinator = GroupCoordinator()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -554,6 +584,7 @@ class KafkaProtocolShim:
                     body += _i32(pid) + _i16(ERR_OFFSET_OUT_OF_RANGE) + _i64(hw) + _i32(0)
                     continue
                 msgs = b""
+                parts = []  # complete encodings, reused by the wrapper
                 o = offset
                 while o < hw:
                     m = encode_message(o, json.dumps(log[o]).encode())
@@ -565,6 +596,20 @@ class KafkaProtocolShim:
                         msgs += m[: max(0, max_bytes - len(msgs))]
                         break
                     msgs += m
+                    parts.append(m)
                     o += 1
+                if self.compression is not None and o > offset:
+                    # producer-style wrapper: inner set compressed, the
+                    # wrapper carries the LAST inner offset (the 0.8/0.9
+                    # convention) and the codec bits in attrs; like the
+                    # raw path, an over-budget wrapper is CUT at
+                    # max_bytes (the stored-compressed-log behavior) so
+                    # the client's grow+retry handling still engages
+                    wrapper = encode_message(
+                        o - 1,
+                        compress_message_set(b"".join(parts), self.compression),
+                        codec=_CODEC_IDS[self.compression],
+                    )
+                    msgs = wrapper[:max_bytes]
                 body += _i32(pid) + _i16(ERR_NONE) + _i64(hw) + _i32(len(msgs)) + msgs
         return body
