@@ -370,7 +370,13 @@ class KafkaWireClient:
                 if err != ERR_NONE:
                     raise IOError(f"Fetch error {err} for {topic}/{partition}")
                 raw_len += len(data)
-                msgs.extend(decode_message_set(data))
+                # a REAL broker serves stored compressed wrappers whose
+                # inner set may start BEFORE the requested offset (the
+                # wrapper is the log unit); skip the below-offset inner
+                # messages or they would re-ingest as duplicates
+                msgs.extend(
+                    m for m in decode_message_set(data) if m[0] >= offset
+                )
         return msgs, raw_len
 
 
@@ -583,28 +589,33 @@ class KafkaProtocolShim:
                 if offset > hw:
                     body += _i32(pid) + _i16(ERR_OFFSET_OUT_OF_RANGE) + _i64(hw) + _i32(0)
                     continue
-                msgs = b""
-                parts = []  # complete encodings, reused by the wrapper
+                parts = []  # complete encodings, shared by both paths
+                size = 0
+                tail = b""  # truncated partial message (raw path only)
                 o = offset
                 while o < hw:
                     m = encode_message(o, json.dumps(log[o]).encode())
-                    if len(msgs) + len(m) > max_bytes:
+                    if size + len(m) > max_bytes:
                         # real-broker behavior: cut the MessageSet at
                         # max_bytes, leaving a truncated partial message
                         # the client must drop (and grow+retry when it
                         # was the FIRST message)
-                        msgs += m[: max(0, max_bytes - len(msgs))]
+                        tail = m[: max(0, max_bytes - size)]
                         break
-                    msgs += m
                     parts.append(m)
+                    size += len(m)
                     o += 1
+                msgs = b"".join(parts) + tail
                 if self.compression is not None and o > offset:
                     # producer-style wrapper: inner set compressed, the
                     # wrapper carries the LAST inner offset (the 0.8/0.9
                     # convention) and the codec bits in attrs; like the
                     # raw path, an over-budget wrapper is CUT at
                     # max_bytes (the stored-compressed-log behavior) so
-                    # the client's grow+retry handling still engages
+                    # the client's grow+retry handling still engages.
+                    # (A real broker's stored wrapper may also START
+                    # below the requested offset — the client filters
+                    # below-offset inner messages, _fetch_once.)
                     wrapper = encode_message(
                         o - 1,
                         compress_message_set(b"".join(parts), self.compression),

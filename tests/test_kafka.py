@@ -485,3 +485,33 @@ def test_compressed_wrapper_respects_max_bytes():
             shim.stop()
     finally:
         sb.stop()
+
+
+def test_real_broker_wrapper_below_offset_filtered():
+    """A REAL 0.8/0.9 broker serves stored compressed wrappers whose
+    inner set can start BEFORE the requested offset; the client must
+    skip those inner messages or they re-ingest as duplicates."""
+    import struct
+
+    from pinot_tpu.realtime.kafka import _Reader, compress_message_set
+
+    inner = b"".join(encode_message(i, json.dumps({"i": i}).encode()) for i in range(5))
+    wrapper = encode_message(4, compress_message_set(inner, "gzip"), codec=1)
+
+    class FakeClient(KafkaWireClient):
+        def _roundtrip(self, api, body):
+            resp = (
+                struct.pack(">i", 1)
+                + struct.pack(">h", len(b"wtopic")) + b"wtopic"
+                + struct.pack(">i", 1)
+                + struct.pack(">i", 0)       # partition
+                + struct.pack(">h", 0)       # err
+                + struct.pack(">q", 5)       # high watermark
+                + struct.pack(">i", len(wrapper)) + wrapper
+            )
+            return _Reader(resp)
+
+    c = FakeClient("nohost", 0)
+    msgs, raw_len = c._fetch_once("wtopic", 0, 2, 1 << 20)
+    assert [o for o, _, _ in msgs] == [2, 3, 4]  # 0 and 1 filtered
+    assert raw_len == len(wrapper)

@@ -1,0 +1,170 @@
+"""Direct unit tests for the server-side primitives that the cluster
+tests only exercise indirectly: refcounted data managers (swap/drop
+under a running query — ``AbstractTableDataManager.java:42`` semantics),
+the bounded FCFS scheduler, and segment pruners."""
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.common.schema import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.engine.pruner import prune_segments
+from pinot_tpu.pql import optimize_request, parse_pql
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.server.datamanager import InstanceDataManager, TableDataManager
+from pinot_tpu.server.scheduler import QueryScheduler
+
+SCHEMA = Schema(
+    "t",
+    dimensions=[FieldSpec("d", DataType.STRING)],
+    metrics=[FieldSpec("m", DataType.INT, FieldType.METRIC)],
+)
+
+
+def _seg(name: str, lo: int = 0, n: int = 4):
+    return build_segment(
+        SCHEMA, [{"d": f"v{i}", "m": lo + i} for i in range(n)], "t", name
+    )
+
+
+# ---------------------------------------------------------------- datamanager
+def test_swap_keeps_old_segment_alive_for_running_query():
+    tdm = TableDataManager("t")
+    old = _seg("s0")
+    tdm.add_segment(old)
+    held = tdm.acquire_segments(["s0"])
+    assert [h.segment for h in held] == [old]
+
+    new = _seg("s0", lo=100)  # refresh under the same name
+    tdm.add_segment(new)
+    # the running query still reads the OLD object it acquired
+    assert held[0].segment is old
+    # new queries see the replacement
+    fresh = tdm.acquire_segments(["s0"])
+    assert fresh[0].segment is new
+    tdm.release_segments(fresh)
+    # old's owner ref dropped at swap: the reader's release is the LAST
+    assert held[0].release() == 0
+
+
+def test_remove_segment_defers_death_to_last_release():
+    tdm = TableDataManager("t")
+    tdm.add_segment(_seg("s0"))
+    held = tdm.acquire_segments(None)
+    tdm.remove_segment("s0")
+    assert tdm.segment_names() == []
+    # acquire after drop fails (refcount reached reader-only)
+    assert tdm.acquire_segments(["s0"]) == []
+    assert held[0].release() == 0  # reader's release is the last
+
+
+def test_acquire_dead_segment_refused():
+    tdm = TableDataManager("t")
+    tdm.add_segment(_seg("s0"))
+    sdm = tdm.acquire_segments(None)[0]
+    tdm.remove_segment("s0")
+    sdm.release()  # refcount 0: dead
+    assert sdm.acquire() is False
+
+
+def test_acquire_skips_missing_names():
+    tdm = TableDataManager("t")
+    tdm.add_segment(_seg("s0"))
+    got = tdm.acquire_segments(["s0", "ghost"])
+    assert [g.name for g in got] == ["s0"]
+    tdm.release_segments(got)
+
+
+def test_instance_hierarchy():
+    idm = InstanceDataManager()
+    assert idm.table("t") is None
+    idm.add_segment("t", _seg("s0"))
+    assert idm.table_names() == ["t"]
+    assert idm.table("t").segment_names() == ["s0"]
+
+
+# ----------------------------------------------------------------- scheduler
+def test_scheduler_fcfs_order_single_worker():
+    sched = QueryScheduler(num_workers=1)
+    order = []
+    gate = threading.Event()
+
+    def job(i):
+        def run():
+            gate.wait(5)
+            order.append(i)
+            return i
+
+        return run
+
+    futs = [sched.submit(job(i)) for i in range(4)]
+    gate.set()
+    assert [f.result(timeout=5) for f in futs] == [0, 1, 2, 3]
+    assert order == [0, 1, 2, 3]
+    sched.shutdown()
+
+
+def test_scheduler_run_timeout():
+    sched = QueryScheduler(num_workers=1)
+    with pytest.raises(TimeoutError):
+        sched.run(lambda: time.sleep(2), timeout_s=0.05)
+    sched.shutdown()
+
+
+def test_scheduler_shutdown_cancels_pending():
+    sched = QueryScheduler(num_workers=1)
+    gate = threading.Event()
+    first = sched.submit(lambda: gate.wait(5))
+    pending = sched.submit(lambda: 42)
+    sched.shutdown()
+    gate.set()
+    first.result(timeout=5)
+    with pytest.raises(Exception):
+        pending.result(timeout=1)  # cancelled, never ran
+
+
+# ------------------------------------------------------------------- pruner
+def _time_schema():
+    from pinot_tpu.common.schema import TimeFieldSpec
+
+    return Schema(
+        "tt",
+        dimensions=[FieldSpec("d", DataType.STRING)],
+        metrics=[FieldSpec("m", DataType.INT, FieldType.METRIC)],
+        time_field=TimeFieldSpec("ts", DataType.LONG, time_unit="MILLISECONDS"),
+    )
+
+
+def test_time_pruner_drops_disjoint_segments():
+    schema = _time_schema()
+    segs = [
+        build_segment(
+            schema,
+            [{"d": "a", "m": i, "ts": base + i} for i in range(4)],
+            "tt",
+            f"seg{base}",
+        )
+        for base in (1000, 2000, 3000)
+    ]
+    req = optimize_request(
+        parse_pql("SELECT count(*) FROM tt WHERE ts BETWEEN 2000 AND 2003")
+    )
+    live = prune_segments(segs, req)
+    assert [s.segment_name for s in live] == ["seg2000"]
+
+    # no time predicate: nothing pruned
+    req2 = optimize_request(parse_pql("SELECT count(*) FROM tt"))
+    assert len(prune_segments(segs, req2)) == 3
+
+
+def test_schema_pruner_drops_missing_column_segments():
+    other = Schema(
+        "t",
+        dimensions=[FieldSpec("other", DataType.STRING)],
+        metrics=[FieldSpec("m", DataType.INT, FieldType.METRIC)],
+    )
+    seg_ok = _seg("has")
+    seg_no = build_segment(other, [{"other": "x", "m": 1}], "t", "lacks")
+    req = optimize_request(parse_pql("SELECT count(*) FROM t WHERE d = 'v1'"))
+    live = prune_segments([seg_ok, seg_no], req)
+    assert [s.segment_name for s in live] == ["has"]
