@@ -326,23 +326,33 @@ class KafkaWireClient:
         self, topic: str, partition: int, offset: int, max_bytes: int = 1 << 20
     ) -> List[Tuple[int, Optional[bytes], bytes]]:
         while True:
-            msgs, raw_len = self._fetch_once(topic, partition, offset, max_bytes)
+            msgs, raw_len, decoded_any = self._fetch_once(
+                topic, partition, offset, max_bytes
+            )
             if msgs or raw_len == 0:
                 return msgs
-            # bytes came back but no message fit: a single message larger
-            # than max_bytes (the broker sends a truncated one).  Grow and
-            # retry or the consumer livelocks at this offset forever —
-            # the reference SimpleConsumer loop does the same.
+            # bytes came back but nothing usable decoded.  Two cases,
+            # both cured by growing max_bytes (the reference
+            # SimpleConsumer loop does the same): a single message
+            # larger than max_bytes (truncated by the broker), or a
+            # stored compressed wrapper wholly below the requested
+            # offset with the NEXT wrapper cut off (decoded_any) —
+            # growing lets that next wrapper fit.
             if max_bytes >= self.MAX_FETCH_BYTES:
+                why = (
+                    "below-offset wrapper region"
+                    if decoded_any
+                    else "message"
+                )
                 raise IOError(
-                    f"message at {topic}/{partition}@{offset} exceeds "
+                    f"{why} at {topic}/{partition}@{offset} exceeds "
                     f"{self.MAX_FETCH_BYTES} bytes"
                 )
             max_bytes = min(max_bytes * 2, self.MAX_FETCH_BYTES)
 
     def _fetch_once(
         self, topic: str, partition: int, offset: int, max_bytes: int
-    ) -> Tuple[List[Tuple[int, Optional[bytes], bytes]], int]:
+    ) -> Tuple[List[Tuple[int, Optional[bytes], bytes]], int, bool]:
         body = (
             _i32(-1)  # replica_id
             + _i32(100)  # max_wait_ms
@@ -357,6 +367,7 @@ class KafkaWireClient:
         r = self._roundtrip(API_FETCH, body)
         msgs: List[Tuple[int, Optional[bytes], bytes]] = []
         raw_len = 0
+        decoded_any = False
         for _ in range(r.i32()):
             r.string()  # topic
             for _ in range(r.i32()):
@@ -374,10 +385,10 @@ class KafkaWireClient:
                 # inner set may start BEFORE the requested offset (the
                 # wrapper is the log unit); skip the below-offset inner
                 # messages or they would re-ingest as duplicates
-                msgs.extend(
-                    m for m in decode_message_set(data) if m[0] >= offset
-                )
-        return msgs, raw_len
+                decoded = decode_message_set(data)
+                decoded_any = decoded_any or bool(decoded)
+                msgs.extend(m for m in decoded if m[0] >= offset)
+        return msgs, raw_len, decoded_any
 
 
 class KafkaStreamProvider(StreamProvider):

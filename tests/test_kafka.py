@@ -475,7 +475,7 @@ def test_compressed_wrapper_respects_max_bytes():
             c = KafkaWireClient(k_host, k_port)
             # tiny budget: one roundtrip returns only cut bytes, no
             # decodable message — the grow trigger
-            msgs, raw_len = c._fetch_once("btopic", 0, 0, 40)
+            msgs, raw_len, _ = c._fetch_once("btopic", 0, 0, 40)
             assert msgs == [] and 0 < raw_len <= 40
             # the provider's grow+retry still lands every row
             sp = KafkaStreamProvider(k_host, k_port, "btopic")
@@ -512,6 +512,6 @@ def test_real_broker_wrapper_below_offset_filtered():
             return _Reader(resp)
 
     c = FakeClient("nohost", 0)
-    msgs, raw_len = c._fetch_once("wtopic", 0, 2, 1 << 20)
+    msgs, raw_len, decoded_any = c._fetch_once("wtopic", 0, 2, 1 << 20)
     assert [o for o, _, _ in msgs] == [2, 3, 4]  # 0 and 1 filtered
-    assert raw_len == len(wrapper)
+    assert raw_len == len(wrapper) and decoded_any
