@@ -396,5 +396,67 @@ def bench_pallas_ab(rows: int) -> Dict:
 BENCHES["pallas_ab"] = bench_pallas_ab
 
 
+def bench_qinput_cache_ab(rows: int) -> Dict:
+    """Per-query serving cost with vs without the device-resident
+    query-input cache (executor._qinput_cache): on a tunneled chip the
+    upload it skips is a full host->device round trip per query.  Runs
+    the SAME Q1-shaped query through the executor repeatedly, once with
+    the cache cleared before every query and once warm."""
+    import time as _time
+
+    from pinot_tpu.engine.executor import QueryExecutor
+    from pinot_tpu.engine.reduce import reduce_to_response
+    from pinot_tpu.pql import optimize_request, parse_pql
+    from pinot_tpu.tools.datagen import synthetic_lineitem_segment
+
+    seg_rows = max(rows // 4, 1)
+    segments = [
+        synthetic_lineitem_segment(seg_rows, seed=61 + i, name=f"qc{i}")
+        for i in range(4)
+    ]
+    pql = (
+        "SELECT sum(l_quantity), sum(l_extendedprice), count(*) FROM lineitem "
+        "WHERE l_shipdate <= '1998-09-02' GROUP BY l_returnflag, l_linestatus TOP 10"
+    )
+    ex = QueryExecutor()
+
+    def one() -> None:
+        req = optimize_request(parse_pql(pql))
+        reduce_to_response(req, [ex.execute(segments, req)])
+
+    one()  # stage + compile
+    n = 15
+
+    # cold first, then warm, then a second cold pass — reporting the
+    # BEST cold so steady-state drift can't masquerade as cache effect
+    def cold_pass() -> float:
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            ex._qinput_cache.clear()
+            one()
+        return (_time.perf_counter() - t0) / n * 1000
+
+    c1 = cold_pass()
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        one()
+    warm_ms = (_time.perf_counter() - t0) / n * 1000
+    cold_ms = min(c1, cold_pass())
+
+    return {
+        "bench": "qinput_cache_ab",
+        "value": round(cold_ms - warm_ms, 3),
+        "unit": "ms saved/query",
+        "detail": {
+            "rows": seg_rows * 4,
+            "warm_ms_per_query": round(warm_ms, 3),
+            "cold_ms_per_query": round(cold_ms, 3),
+        },
+    }
+
+
+BENCHES["qinput_cache_ab"] = bench_qinput_cache_ab
+
+
 if __name__ == "__main__":
     main()
