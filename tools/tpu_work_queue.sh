@@ -1,0 +1,37 @@
+#!/bin/bash
+# Serial on-chip work queue: waits for the axon tunnel, then runs each
+# step once, logging to /tmp/tpu_runs/. Never uses kill -9 (a SIGKILL
+# mid-transfer wedges the tunnel lease for hours).
+cd /root/repo
+LOG=/tmp/tpu_runs
+probe() { timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; }
+echo "$(date +%T) queue start" > $LOG/status.txt
+for i in $(seq 1 400); do
+  if probe; then echo "$(date +%T) tunnel UP (probe $i)" >> $LOG/status.txt; break; fi
+  echo "$(date +%T) probe $i down" >> $LOG/status.txt
+  sleep 45
+done
+if ! probe; then echo "$(date +%T) GAVE UP" >> $LOG/status.txt; exit 1; fi
+
+echo "$(date +%T) step1 tpu gate" >> $LOG/status.txt
+PINOT_TPU_TESTS=tpu timeout 2400 python -m pytest tests/test_tpu_platform.py -m tpu -q > $LOG/step1_gate.log 2>&1
+echo "$(date +%T) step1 exit=$?" >> $LOG/status.txt
+
+echo "$(date +%T) step2 two-server quickstart repro" >> $LOG/status.txt
+PYTHONPATH=/root/repo timeout 900 python -u /tmp/repro2srv.py > $LOG/step2_repro.log 2>&1
+echo "$(date +%T) step2 exit=$?" >> $LOG/status.txt
+
+echo "$(date +%T) step3 bench" >> $LOG/status.txt
+timeout 3600 python bench.py > $LOG/step3_bench.log 2> $LOG/step3_bench.err
+echo "$(date +%T) step3 exit=$?" >> $LOG/status.txt
+
+echo "$(date +%T) step4 pallas microbench" >> $LOG/status.txt
+timeout 1800 python -m pinot_tpu.tools.microbench pallas_ab -rows 8388608 > $LOG/step4_pallas.log 2>&1
+echo "$(date +%T) step4 exit=$?" >> $LOG/status.txt
+echo "$(date +%T) ALL DONE" >> $LOG/status.txt
+
+# Provenance: used in round 3 to serialize all on-chip validation
+# (gate -> demo repro -> bench capture -> pallas A/B) behind a tunnel-
+# recovery probe. Chip work MUST be serialized: the tunnel is single-
+# client, and SIGKILLing a client mid-transfer wedges the lease for
+# hours (see .claude/skills/verify/SKILL.md).
