@@ -233,3 +233,61 @@ def test_sqlite_differential_single_server_exact():
     """One server sees every segment, so even huge group key spaces are
     exact (the regime the reference's H2 cluster tests run in)."""
     _run(seed=404, num_queries=60, num_servers=1, num_segments=4)
+
+
+def test_having_matches_sqlite():
+    """HAVING (broker-reduce group filter, beyond-reference PQL
+    feature) vs SQLite's HAVING on single-agg group-bys, where the
+    semantics map one-to-one. Single server so trims are exact."""
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 600, seed=5)
+    cluster = InProcessCluster(num_servers=1)
+    physical = cluster.add_offline_table(schema)
+    cluster.upload(physical, build_segment(schema, rows, physical, "hav0"))
+    conn = _load_sqlite(schema, rows)
+    cases = [
+        ("SELECT sum(metInt) FROM testTable GROUP BY dimStr HAVING sum(metInt) > {t} TOP 500",
+         "SELECT dimStr, SUM(metInt) FROM testTable GROUP BY dimStr HAVING SUM(metInt) > {t}"),
+        ("SELECT count(*) FROM testTable GROUP BY dimStr HAVING count(*) >= {t} TOP 500",
+         "SELECT dimStr, COUNT(*) FROM testTable GROUP BY dimStr HAVING COUNT(*) >= {t}"),
+        ("SELECT avg(metDouble) FROM testTable WHERE metInt > 0 GROUP BY dimStr "
+         "HAVING avg(metDouble) < {t} TOP 500",
+         "SELECT dimStr, AVG(metDouble) FROM testTable WHERE metInt > 0 GROUP BY dimStr "
+         "HAVING AVG(metDouble) < {t}"),
+    ]
+    errs = []
+    try:
+        # thresholds sit at the MIDPOINT between two adjacent distinct
+        # aggregate values so no group's membership hinges on bitwise
+        # float equality between engines, and each case provably
+        # filters some groups and keeps some
+        for pql_t, sql_t in cases:
+            base_sql = sql_t.split(" HAVING")[0]
+            vals = sorted({r[1] for r in conn.execute(base_sql).fetchall()})
+            assert len(vals) >= 2, f"degenerate distribution for {base_sql}"
+            mid = len(vals) // 2
+            t = (vals[mid - 1] + vals[mid]) / 2
+            want = {
+                str(r[0]): r[1] for r in conn.execute(sql_t.format(t=t)).fetchall()
+            }
+            assert want, f"threshold {t} filtered everything: bad case"
+            n_groups = conn.execute(
+                f"SELECT COUNT(*) FROM ({base_sql})"
+            ).fetchone()[0]
+            assert len(want) < n_groups, f"threshold {t} filtered nothing: bad case"
+            resp = cluster.query(pql_t.format(t=t))
+            assert not resp.exceptions, resp.exceptions
+            got = {
+                g.group[0]: g.value
+                for g in resp.aggregation_results[0].group_by_result
+            }
+            if set(got) != set(want):
+                errs.append((pql_t.format(t=t), sorted(set(got) ^ set(want))[:5]))
+                continue
+            for k, v in got.items():
+                if not _close(v, want[k]):
+                    errs.append((pql_t.format(t=t), k, v, want[k]))
+    finally:
+        conn.close()
+        cluster.stop()
+    assert not errs, errs
