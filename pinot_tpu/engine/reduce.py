@@ -82,12 +82,35 @@ def _reduce_group_by(request: BrokerRequest, merged: IntermediateResult):
     groups = merged.groups or {}
     out: List[AggregationResult] = []
     gb = request.group_by
-    for i, agg in enumerate(request.aggregations):
-        pairs = [(key, partials[i].finalize()) for key, partials in groups.items()]
-        if request.having is not None:
-            h = request.having
+
+    # SQL semantics: HAVING filters GROUPS, so a group failing the
+    # predicate disappears from EVERY aggregation's result list, not
+    # just the one the predicate mentions.  (optimize_request rejects a
+    # predicate naming an unselected aggregation up front.)
+    passing = None
+    having_idx = -1
+    having_vals = {}
+    if request.having is not None:
+        h = request.having
+        for i, agg in enumerate(request.aggregations):
             if h.function == agg.function and (h.column == agg.column or h.column == "*"):
-                pairs = [kv for kv in pairs if _having_ok(kv[1], h.operator, h.value)]
+                having_idx = i
+                having_vals = {
+                    key: partials[i].finalize() for key, partials in groups.items()
+                }
+                passing = {
+                    key
+                    for key, v in having_vals.items()
+                    if _having_ok(v, h.operator, h.value)
+                }
+                break
+
+    for i, agg in enumerate(request.aggregations):
+        pairs = [
+            (key, having_vals[key] if i == having_idx else partials[i].finalize())
+            for key, partials in groups.items()
+            if passing is None or key in passing
+        ]
         asc = group_sort_ascending(agg.function)
         pairs.sort(key=lambda kv: (kv[1], kv[0]) if asc else (-_num(kv[1]), kv[0]))
         trimmed = pairs[: gb.top_n]

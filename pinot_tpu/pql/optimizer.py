@@ -126,6 +126,20 @@ def optimize_filter(
 
 
 def optimize_request(request: BrokerRequest) -> BrokerRequest:
+    if request.having is not None:
+        # HAVING must name a selected aggregation — silently ignoring
+        # an unmatched predicate would return unfiltered groups
+        h = request.having
+        if not any(
+            h.function == a.function and (h.column == a.column or h.column == "*")
+            for a in request.aggregations
+        ):
+            from pinot_tpu.pql.parser import PqlParseError
+
+            raise PqlParseError(
+                f"HAVING references {h.function}({h.column}), which is not "
+                "in the SELECT aggregation list"
+            )
     flags = OptimizationFlags.from_debug_options(request.debug_options)
     request.filter = optimize_filter(request.filter, flags)
     return request

@@ -193,3 +193,16 @@ def _leaves(tree):
         return
     for c in tree.children:
         yield from _leaves(c)
+
+
+def test_having_must_name_selected_aggregation():
+    import pytest
+
+    from pinot_tpu.pql import PqlParseError, optimize_request, parse_pql
+
+    with pytest.raises(PqlParseError, match="not\\s+in the SELECT"):
+        optimize_request(
+            parse_pql("SELECT sum(a) FROM t GROUP BY b HAVING count(*) > 5")
+        )
+    # matching spec passes through
+    optimize_request(parse_pql("SELECT sum(a) FROM t GROUP BY b HAVING sum(a) > 5"))

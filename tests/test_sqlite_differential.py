@@ -291,3 +291,41 @@ def test_having_matches_sqlite():
         conn.close()
         cluster.stop()
     assert not errs, errs
+
+
+def test_having_filters_all_agg_lists():
+    """SQL semantics: a group failing HAVING disappears from EVERY
+    aggregation's result list, not only the one the predicate names."""
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 400, seed=9)
+    cluster = InProcessCluster(num_servers=1)
+    physical = cluster.add_offline_table(schema)
+    cluster.upload(physical, build_segment(schema, rows, physical, "hav1"))
+    conn = _load_sqlite(schema, rows)
+    try:
+        base = "SELECT dimStr, SUM(metInt), COUNT(*) FROM testTable GROUP BY dimStr"
+        vals = sorted({r[1] for r in conn.execute(base).fetchall()})
+        assert len(vals) >= 2, "degenerate SUM distribution: bad seed"
+        t = (vals[len(vals) // 2 - 1] + vals[len(vals) // 2]) / 2
+        want = {
+            str(r[0]): (r[1], r[2])
+            for r in conn.execute(base + f" HAVING SUM(metInt) > {t}").fetchall()
+        }
+        n_groups = conn.execute(f"SELECT COUNT(*) FROM ({base})").fetchone()[0]
+        assert 0 < len(want) < n_groups, "threshold must split the groups"
+        resp = cluster.query(
+            f"SELECT sum(metInt), count(*) FROM testTable GROUP BY dimStr "
+            f"HAVING sum(metInt) > {t} TOP 500"
+        )
+        assert not resp.exceptions, resp.exceptions
+        for i in range(2):  # BOTH agg lists carry only passing groups
+            got = {
+                g.group[0]: g.value
+                for g in resp.aggregation_results[i].group_by_result
+            }
+            assert set(got) == set(want), (i, sorted(set(got) ^ set(want)))
+            for k, v in got.items():
+                assert _close(v, want[k][i]), (i, k, v, want[k][i])
+    finally:
+        conn.close()
+        cluster.stop()
