@@ -364,3 +364,23 @@ def test_regex_table_cache_and_qinput_cache(monkeypatch):
 
     # the device-input cache is populated and keyed by plan+content
     assert len(ex._qinput_cache) >= 1
+
+
+def test_having_engine_sentinel():
+    """Direct engine+reduce HAVING: groups failing the predicate drop
+    from every agg list (SQL semantics), exact sentinel values."""
+    resp = run_engine(
+        "SELECT sum(sales), count(*) FROM t GROUP BY city HAVING sum(sales) > 35 TOP 10"
+    )
+    by_city = {
+        tuple(g.group)[0]: (g.value, None)
+        for g in resp.aggregation_results[0].group_by_result
+    }
+    # sums: sf=30, ny=80, la=40 -> only ny and la pass
+    assert set(by_city) == {"ny", "la"}
+    counts = {
+        tuple(g.group)[0]: g.value
+        for g in resp.aggregation_results[1].group_by_result
+    }
+    assert set(counts) == {"ny", "la"}  # count list filtered too
+    assert float(counts["ny"]) == 2 and float(counts["la"]) == 1
