@@ -78,6 +78,7 @@ class BrokerRequestHandler:
         pql: str,
         trace: bool = False,
         debug_options: Optional[Dict[str, str]] = None,
+        timeout_ms: Optional[float] = None,
     ) -> BrokerResponse:
         t0 = time.perf_counter()
         self.metrics.meter("queries").mark()
@@ -96,12 +97,24 @@ class BrokerRequestHandler:
             resp.time_used_ms = (time.perf_counter() - t0) * 1000
             return resp
         request.enable_trace = trace
-        resp = self.handle_request(request, pql)
+        resp = self.handle_request(request, pql, timeout_ms=timeout_ms)
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
         self.metrics.timer("queryTotal").update(resp.time_used_ms)
         return resp
 
-    def handle_request(self, request: BrokerRequest, pql: str) -> BrokerResponse:
+    def handle_request(
+        self,
+        request: BrokerRequest,
+        pql: str,
+        timeout_ms: Optional[float] = None,
+    ) -> BrokerResponse:
+        # per-query override (reference: timeoutMs request parameter,
+        # InstanceRequest carries it); the broker's configured timeout
+        # is the CEILING so a client can shorten but never extend
+        if timeout_ms is not None and timeout_ms > 0:
+            timeout_ms = min(float(timeout_ms), self.timeout_ms)
+        else:
+            timeout_ms = self.timeout_ms
         table = request.table_name
         if not self.quota.allow(table):
             self.metrics.meter("queriesDropped").mark()
@@ -152,15 +165,19 @@ class BrokerRequestHandler:
                             segments,
                             request.enable_trace,
                             request.debug_options or None,
+                            timeout_ms,
                         ),
                     )
                 )
 
         t_sg = time.perf_counter()
-        deadline = t_sg + self.timeout_ms / 1000.0
+        deadline = t_sg + timeout_ms / 1000.0
         for server, fut in futures:
             try:
-                remaining = max(0.05, deadline - time.perf_counter())
+                # no per-future floor: once the shared deadline passes,
+                # remaining futures fail immediately instead of each
+                # adding another grace period to a short budget
+                remaining = max(0.0, deadline - time.perf_counter())
                 parts.append(fut.result(timeout=remaining))
             except Exception as e:
                 logger.warning("server %s failed: %s", server, e)
@@ -244,26 +261,41 @@ class BrokerRequestHandler:
         table: str,
         pql: str,
         segments: List[str],
-        trace: bool = False,
-        debug_options: Optional[Dict[str, str]] = None,
+        trace: bool,
+        debug_options: Optional[Dict[str, str]],
+        timeout_ms: float,
     ) -> IntermediateResult:
+        # timeout_ms arrives already clamped by handle_request — the
+        # one place the "shorten but never extend" ceiling lives
         address = self.server_addresses[server]
         payload = serialize_instance_request(
             self._next_id(),
             pql,
             table,
             segments,
-            self.timeout_ms,
+            timeout_ms,
             trace=trace,
             debug_options=debug_options,
         )
-        reply = self.transport.request(address, payload, timeout=self.timeout_ms / 1000.0)
+        reply = self.transport.request(address, payload, timeout=timeout_ms / 1000.0)
         return deserialize_result(reply)
 
 
 # ---------------------------------------------------------------------------
 # HTTP front (PinotClientRequestServlet analog)
 # ---------------------------------------------------------------------------
+
+
+def _parse_timeout(v) -> Optional[float]:
+    """Lenient per-query timeoutMs: numbers/number-strings pass, junk
+    is ignored (never crash a query over a malformed option)."""
+    if isinstance(v, bool):  # float(True) == 1.0 — a flag is junk here
+        return None
+    try:
+        t = float(v)
+        return t if t > 0 else None
+    except (TypeError, ValueError):
+        return None
 
 
 def _parse_debug_options(s: str) -> Optional[Dict[str, str]]:
@@ -312,7 +344,12 @@ class BrokerHttpServer:
                 pql = (qs.get("pql") or qs.get("bql") or [""])[0]
                 trace = (qs.get("trace") or ["false"])[0].lower() == "true"
                 debug = _parse_debug_options((qs.get("debugOptions") or [""])[0])
-                resp = broker.handle_pql(pql, trace=trace, debug_options=debug)
+                resp = broker.handle_pql(
+                    pql,
+                    trace=trace,
+                    debug_options=debug,
+                    timeout_ms=_parse_timeout((qs.get("timeoutMs") or [""])[0]),
+                )
                 self._respond(resp.to_json())
 
             def do_POST(self):
@@ -332,7 +369,10 @@ class BrokerHttpServer:
                     # JSON type is ignored rather than crashing the handler
                     debug = _parse_debug_options(debug if isinstance(debug, str) else "")
                 resp = broker.handle_pql(
-                    pql, trace=bool(body.get("trace")), debug_options=debug
+                    pql,
+                    trace=bool(body.get("trace")),
+                    debug_options=debug,
+                    timeout_ms=_parse_timeout(body.get("timeoutMs")),
                 )
                 self._respond(resp.to_json())
 

@@ -276,3 +276,63 @@ def test_debug_options_reach_servers():
         assert bad["exceptions"]
     finally:
         cluster.stop()
+
+
+def test_per_query_timeout_override():
+    """A client can SHORTEN the timeout per query (reference timeoutMs
+    request parameter) but never extend past the broker ceiling; a
+    too-short timeout yields a clean gather error, not a hang."""
+    import time as _time
+    import urllib.request
+
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 50, seed=4)
+    seg = build_segment(schema, rows, TABLE, "toseg")
+    server = ServerInstance("toServer")
+
+    slow_calls = {"n": 0}
+    real = server.handle_request
+
+    def slow(req_bytes):
+        slow_calls["n"] += 1
+        if slow_calls["n"] > 1:  # warm query passes, then delay
+            _time.sleep(0.8)
+        return real(req_bytes)
+
+    server.add_segment(TABLE, seg)
+    transport = LocalTransport()
+    transport.register(("toServer", 0), slow)
+    routing = RoutingTableProvider()
+    routing.update(TABLE, {"toseg": {"toServer": "ONLINE"}})
+    broker = BrokerRequestHandler(
+        transport, {"toServer": ("toServer", 0)}, routing=routing, timeout_ms=15_000
+    )
+    http = BrokerHttpServer(broker)
+    http.start()
+    try:
+        url = f"http://127.0.0.1:{http.port}/query"
+
+        def post(payload):
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read())
+
+        pql = "SELECT count(*) FROM testTable"
+        assert post({"pql": pql})["numDocsScanned"] == 50  # warm
+        t0 = _time.perf_counter()
+        out = post({"pql": pql, "timeoutMs": 100})
+        took = _time.perf_counter() - t0
+        assert out["exceptions"], "100ms budget must beat the 800ms server"
+        assert took < 5, f"short timeout honored, took {took:.2f}s"
+        # a huge request value clamps to the broker ceiling (and works)
+        out = post({"pql": pql, "timeoutMs": 10_000_000})
+        assert not out["exceptions"] and out["numDocsScanned"] == 50
+        # junk timeouts ignored (strings AND booleans: float(True)==1.0)
+        for junk in ("soon", True, -5, None):
+            out = post({"pql": pql, "timeoutMs": junk})
+            assert not out["exceptions"], junk
+    finally:
+        http.stop()
