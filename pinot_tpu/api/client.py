@@ -108,9 +108,16 @@ class Connection:
         self.timeout_s = timeout_s
         self._rng = random.Random()
 
-    def execute(self, pql: str, trace: bool = False) -> ResultSetGroup:
+    def execute(
+        self, pql: str, trace: bool = False, timeout_ms: Optional[float] = None
+    ) -> ResultSetGroup:
+        """``timeout_ms`` shortens this query's broker budget (clamped
+        server-side to the broker's configured ceiling)."""
         url = self._rng.choice(self.broker_urls) + "/query"
-        body = json.dumps({"pql": pql, "trace": trace}).encode("utf-8")
+        request_body: Dict[str, Any] = {"pql": pql, "trace": trace}
+        if timeout_ms is not None:
+            request_body["timeoutMs"] = timeout_ms
+        body = json.dumps(request_body).encode("utf-8")
         req = urllib.request.Request(url, data=body, headers={"Content-Type": "application/json"})
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:

@@ -180,6 +180,10 @@ class BrokerRequestHandler:
                 remaining = max(0.0, deadline - time.perf_counter())
                 parts.append(fut.result(timeout=remaining))
             except Exception as e:
+                # free queued zombies: a not-yet-started scatter task
+                # whose result nobody will read shouldn't occupy a pool
+                # worker (no-op for already-running tasks)
+                fut.cancel()
                 logger.warning("server %s failed: %s", server, e)
                 exceptions.append(
                     QueryException(
