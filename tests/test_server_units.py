@@ -168,3 +168,42 @@ def test_schema_pruner_drops_missing_column_segments():
     req = optimize_request(parse_pql("SELECT count(*) FROM t WHERE d = 'v1'"))
     live = prune_segments([seg_ok, seg_no], req)
     assert [s.segment_name for s in live] == ["has"]
+
+
+# ------------------------------------------------------------------ fileio
+def test_atomic_write_replaces_and_leaves_no_temps(tmp_path):
+    from pinot_tpu.utils.fileio import atomic_write
+
+    p = str(tmp_path / "state.json")
+    atomic_write(p, "v1")
+    assert open(p).read() == "v1"
+    atomic_write(p, "v2-longer-content")
+    assert open(p).read() == "v2-longer-content"
+    # no stray temp files: a crashed writer's temp never shadows state
+    leftovers = [f for f in tmp_path.iterdir() if f.name != "state.json"]
+    assert leftovers == []
+
+
+def test_atomic_write_failure_preserves_old_content(tmp_path, monkeypatch):
+    import os as _os
+
+    from pinot_tpu.utils import fileio
+
+    p = str(tmp_path / "state.json")
+    fileio.atomic_write(p, "original")
+
+    real_replace = _os.replace
+
+    def boom(src, dst):
+        raise OSError("disk pulled")
+
+    monkeypatch.setattr(fileio.os, "replace", boom)
+    import pytest as _pytest
+
+    with _pytest.raises(OSError):
+        fileio.atomic_write(p, "new")
+    monkeypatch.setattr(fileio.os, "replace", real_replace)
+    assert open(p).read() == "original"  # old content intact
+    # the failed writer's temp file is cleaned up, not left to shadow
+    leftovers = [f for f in tmp_path.iterdir() if f.name != "state.json"]
+    assert leftovers == []
