@@ -224,22 +224,11 @@ def _broker_latencies(segments, queries_per_round: int = 40):
 
 
 def _probe_tpu(timeout_s: float = 180.0) -> bool:
-    """True when the TPU backend initializes in a SUBPROCESS within the
-    timeout.  The axon tunnel can wedge so hard that jax.devices()
-    blocks forever in-process; probing out-of-process keeps this
-    process clean to fall back to CPU."""
-    import subprocess
-    import sys
+    """Subprocess backend probe (pinot_tpu.utils.platform.probe_device,
+    the one shared implementation)."""
+    from pinot_tpu.utils.platform import probe_device
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return r.returncode == 0
-    except Exception:
-        return False
+    return probe_device(timeout_s)
 
 
 def _arm_deadline():

@@ -46,3 +46,23 @@ def force_cpu_mesh(n_devices: int) -> bool:
     # names known); this only keeps its PJRT client from being dialed.
     jax.config.update("jax_platforms", "cpu")
     return True
+
+
+def probe_device(timeout_s: float = 120.0) -> bool:
+    """True when the default backend initializes in a SUBPROCESS within
+    the timeout.  The axon tunnel can wedge so hard that the first
+    device op blocks forever in-process; probing out-of-process keeps
+    the caller clean to fall back to CPU (bench.py and
+    ``__graft_entry__.entry`` both gate on this)."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except Exception:
+        return False
