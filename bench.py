@@ -32,7 +32,7 @@ import time
 import numpy as np
 
 BASELINE_ROWS_PER_SEC = 14_200_000.0  # BASELINE.md: 6,001,215 rows / 0.422 s
-TPU_CAPTURE_REF = "BENCH_TPU_CAPTURES_r3.json"  # committed on-chip record
+TPU_CAPTURE_REF = "BENCH_TPU_CAPTURES_r4.json"  # committed on-chip record
 
 Q1_PQL = (
     "SELECT sum(l_quantity), sum(l_extendedprice), sum(l_discount), count(*) "
@@ -261,7 +261,14 @@ def _arm_deadline():
             ),
             flush=True,
         )
-        os._exit(0)
+        # nonzero so return-code automation can tell a wedged run from a
+        # clean one (ADVICE r3); configurable for drivers that discard
+        # stdout of nonzero-exit runs
+        try:
+            code = int(os.environ.get("PINOT_TPU_BENCH_DEGRADED_EXIT", "3"))
+        except ValueError:
+            code = 3  # a junk env value must not disarm the watchdog
+        os._exit(code)
 
     timer = threading.Timer(deadline_s, on_deadline)
     timer.daemon = True

@@ -127,7 +127,20 @@ class Controller:
 
     # -- CRUD -----------------------------------------------------------
     def add_schema(self, schema: Schema) -> None:
+        existing = self.resources.get_schema(schema.schema_name)
+        evolving = existing is not None and existing != schema
         self.resources.add_schema(schema)
+        if evolving:
+            # schema evolution: reload every table built on this schema
+            # so already-loaded segments pick up default columns for the
+            # added fields (reference operators call segment reload
+            # after a schema change; here it is automatic), and swap the
+            # realtime manager's stored schema so the next consuming
+            # segment rollover ingests new columns instead of dropping
+            # their streamed values
+            self.realtime_manager.update_schema(schema.schema_name, schema)
+            for physical in self.resources.tables_of_schema(schema.schema_name):
+                self.resources.reload_table(physical)
 
     def add_table(self, config: TableConfig) -> str:
         if self.resources.get_schema(config.raw_name) is None:

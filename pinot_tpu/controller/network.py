@@ -117,6 +117,10 @@ class RemoteParticipant(Participant):
             msg["downloadUri"] = uri
         if info.get("invertedIndexColumns"):
             msg["invertedIndexColumns"] = list(info["invertedIndexColumns"])
+        if info.get("schema") is not None:
+            # schema rides as JSON so the remote server can inject
+            # default columns for schema-evolved segments at load
+            msg["schemaJson"] = info["schema"].to_json()
         if target == CONSUMING:
             # ship the full consume spec so the remote process can run
             # the consumer + LLC completion protocol on its own

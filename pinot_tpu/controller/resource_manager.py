@@ -153,6 +153,28 @@ class ClusterResourceManager:
                     self._execute_transition(table, seg, name, replicas[name])
             self._notify_view(table)
 
+    def reload_table(self, physical: str) -> None:
+        """Re-execute every ONLINE transition for a table's current
+        ideal state (the reference's segment-reload API,
+        PinotSegmentRestletResource reload).  CRC-skip on the servers
+        makes this metadata-cheap; it is how schema evolution reaches
+        segments loaded before the schema grew."""
+        with self._lock:
+            ideal = dict(self.ideal_states.get(physical, {}))
+        for seg, replicas in ideal.items():
+            for server, state in replicas.items():
+                if state == ONLINE:
+                    self._execute_transition(physical, seg, server, ONLINE)
+        self._notify_view(physical)
+
+    def tables_of_schema(self, raw_name: str) -> List[str]:
+        with self._lock:
+            return [
+                phys
+                for phys, cfg in self.table_configs.items()
+                if cfg.raw_name == raw_name
+            ]
+
     # -- listeners ----------------------------------------------------
     def add_view_listener(self, fn: Callable[[str, Dict[str, Dict[str, str]]], None]) -> None:
         with self._lock:
@@ -483,6 +505,12 @@ class ClusterResourceManager:
                 cols = cfg.indexing.inverted_index_columns if cfg else []
                 if cols:
                     info["invertedIndexColumns"] = list(cols)
+                # current schema rides along so the server can patch
+                # schema-evolved segments with default columns at load
+                # (SegmentPreProcessor -> BaseDefaultColumnHandler)
+                schema = self.schemas.get(cfg.raw_name) if cfg else None
+                if schema is not None:
+                    info["schema"] = schema
             view = self.external_views.setdefault(table, {}).setdefault(segment, {})
         ok: Optional[bool] = False
         if participant is not None:

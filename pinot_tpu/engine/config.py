@@ -117,6 +117,27 @@ def raw_card_min() -> int:
     return _raw_card_min
 
 
+_qinput_budget: int | None = None
+
+
+def qinput_cache_budget_bytes() -> int:
+    """HBM byte budget for the device-resident query-input cache
+    (executor._to_device_inputs).  Sized so serving many distinct query
+    shapes over high-cardinality tables cannot pin unbounded HBM: the
+    v5e chip has 16 GB; segments + workspace dominate, so the input
+    cache defaults to 1 GiB.  Env-overridable
+    (PINOT_TPU_QINPUT_CACHE_BYTES); 0 disables caching entirely.
+    Parsed once — this sits on the query hot path, and a junk env value
+    must degrade to the default, not fail every query at serve time."""
+    global _qinput_budget
+    if _qinput_budget is None:
+        try:
+            _qinput_budget = int(_os.environ.get("PINOT_TPU_QINPUT_CACHE_BYTES", 1 << 30))
+        except ValueError:
+            _qinput_budget = 1 << 30
+    return _qinput_budget
+
+
 def index_dtype(max_exclusive: int):
     """np dtype for dictId arrays indexing tables of max_exclusive rows.
 

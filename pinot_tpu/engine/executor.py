@@ -150,6 +150,12 @@ class QueryExecutor:
         from collections import OrderedDict
 
         self._qinput_cache: "OrderedDict[Any, Any]" = OrderedDict()
+        self._qinput_cache_bytes = 0
+        # the QueryScheduler runs queries on a worker pool; byte
+        # accounting must not drift under concurrent misses/evictions
+        import threading
+
+        self._qinput_cache_lock = threading.Lock()
 
     def _phase(self, name: str, t0: float) -> float:
         """Record a ServerQueryPhase-style timer (SURVEY §5: pruning /
@@ -505,14 +511,37 @@ class QueryExecutor:
             h.update(len(part).to_bytes(8, "little"))
             h.update(part)
         key = (plan, h.hexdigest())
-        cached = self._qinput_cache.get(key)
-        if cached is not None:
-            self._qinput_cache.move_to_end(key)
-            return cached
+        with self._qinput_cache_lock:
+            cached = self._qinput_cache.get(key)
+            if cached is not None:
+                self._qinput_cache.move_to_end(key)
+                return cached[0]
         dev = to_device_inputs(inputs)
-        self._qinput_cache[key] = dev
-        if len(self._qinput_cache) > 128:
-            self._qinput_cache.popitem(last=False)
+        # Evict by HBM bytes, not entry count: one entry can hold
+        # per-segment match tables of S x card_pad, so 128 entries of a
+        # high-cardinality workload would pin multiple GB (ADVICE r3).
+        nbytes = sum(
+            getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree_util.tree_flatten(dev)[0]
+        )
+        from pinot_tpu.engine.config import qinput_cache_budget_bytes
+
+        budget = qinput_cache_budget_bytes()
+        if nbytes == 0 or nbytes > budget // 4:
+            # zero-byte entries would never be evicted by byte pressure;
+            # oversized ones would churn the whole cache for one query
+            return dev
+        with self._qinput_cache_lock:
+            if key not in self._qinput_cache:
+                self._qinput_cache[key] = (dev, nbytes)
+                self._qinput_cache_bytes += nbytes
+            # bytes bound HBM; the entry cap bounds per-entry host/device
+            # allocator overhead that logical nbytes doesn't see
+            while self._qinput_cache and (
+                self._qinput_cache_bytes > budget or len(self._qinput_cache) > 128
+            ):
+                _, (_, old_bytes) = self._qinput_cache.popitem(last=False)
+                self._qinput_cache_bytes -= old_bytes
         return dev
 
     def _empty_result(self, request: BrokerRequest, total_docs: int) -> IntermediateResult:

@@ -627,11 +627,22 @@ class KafkaProtocolShim:
                     # (A real broker's stored wrapper may also START
                     # below the requested offset — the client filters
                     # below-offset inner messages, _fetch_once.)
-                    wrapper = encode_message(
-                        o - 1,
-                        compress_message_set(b"".join(parts), self.compression),
-                        codec=_CODEC_IDS[self.compression],
-                    )
+                    # An incompressible payload can make the wrapper
+                    # exceed max_bytes even though the raw set fit — at
+                    # the client's MAX_FETCH_BYTES ceiling that would
+                    # turn a servable batch into a permanent truncation
+                    # (ADVICE r3).  Re-pack with fewer messages until
+                    # the wrapper fits; only a single message that still
+                    # doesn't fit gets cut (the grow+retry case).
+                    while True:
+                        wrapper = encode_message(
+                            offset + len(parts) - 1,
+                            compress_message_set(b"".join(parts), self.compression),
+                            codec=_CODEC_IDS[self.compression],
+                        )
+                        if len(wrapper) <= max_bytes or len(parts) <= 1:
+                            break
+                        parts.pop()
                     msgs = wrapper[:max_bytes]
                 body += _i32(pid) + _i16(ERR_NONE) + _i64(hw) + _i32(len(msgs)) + msgs
         return body

@@ -214,6 +214,21 @@ class RealtimeSegmentManager:
                 self._create_consuming_segment(physical, partition, seq=0, start_offset=0)
         return physical
 
+    def update_schema(self, raw_name: str, schema: Schema) -> List[str]:
+        """Schema evolution for realtime tables: swap the stored schema
+        so the NEXT segment rollover consumes with the grown schema
+        (CONSUMING transitions serialize it as schemaJson).  The
+        currently-consuming segment keeps its frozen schema — its rows
+        get default columns when it seals, matching the reference's
+        apply-at-rollover behavior."""
+        updated = []
+        with self._lock:
+            for physical, tinfo in self._tables.items():
+                if tinfo["config"].raw_name == raw_name:
+                    tinfo["schema"] = schema
+                    updated.append(physical)
+        return updated
+
     def _is_hlc(self, physical: str) -> bool:
         with self._lock:
             tinfo = self._tables.get(physical)

@@ -42,7 +42,14 @@ class SegmentDataManager:
     def release(self) -> int:
         with self._lock:
             self._refcount -= 1
-            return self._refcount
+            rc = self._refcount
+        if rc == 0:
+            # last reference gone: return postings bytes to the
+            # process-wide inverted-index budget
+            from pinot_tpu.segment.invindex import release_postings
+
+            release_postings(self.segment)
+        return rc
 
 
 class TableDataManager:

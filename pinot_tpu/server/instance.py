@@ -38,9 +38,52 @@ class ServerInstance:
         self.metrics = ServerMetrics(name)
         self.executor = QueryExecutor(mesh=mesh, metrics=self.metrics)
         self.scheduler = QueryScheduler(num_workers=num_workers)
+        self._table_schemas: dict = {}  # raw table name -> Schema
 
     # -- segment lifecycle -------------------------------------------
+    @staticmethod
+    def _raw_table(table: str) -> str:
+        for suffix in ("_OFFLINE", "_REALTIME"):
+            if table.endswith(suffix):
+                return table[: -len(suffix)]
+        return table
+
+    def set_table_schema(self, table: str, schema) -> None:
+        """Register (or evolve) the table schema.  Existing segments are
+        patched with default columns for any schema-added fields, so old
+        rows keep answering after schema growth instead of being pruned
+        (reference: SegmentPreProcessor -> BaseDefaultColumnHandler)."""
+        from pinot_tpu.segment.default_column import inject_default_columns
+
+        raw = self._raw_table(table)
+        if self._table_schemas.get(raw) == schema:
+            return  # unchanged: skip the retro-patch loop (reload CRC-skip path)
+        self._table_schemas[raw] = schema
+        for tname in self.data_manager.table_names():
+            if self._raw_table(tname) != raw:
+                continue
+            tdm = self.data_manager.table(tname)
+            acquired = tdm.acquire_segments()
+            try:
+                for sdm in acquired:
+                    # only sealed segments: a consuming MutableSegment's
+                    # query_view() is a throwaway snapshot rebuilt from
+                    # its own schema on the next row batch — patching it
+                    # would silently un-patch; it keeps being pruned for
+                    # queries on the new column until it seals (the
+                    # reference likewise applies schema changes to
+                    # consuming segments only at the next rollover)
+                    if isinstance(sdm.segment, ImmutableSegment):
+                        inject_default_columns(sdm.segment, schema)
+            finally:
+                tdm.release_segments(acquired)
+
     def add_segment(self, table: str, segment: ImmutableSegment) -> None:
+        schema = self._table_schemas.get(self._raw_table(table))
+        if schema is not None and isinstance(segment, ImmutableSegment):
+            from pinot_tpu.segment.default_column import inject_default_columns
+
+            inject_default_columns(segment, schema)
         self.data_manager.add_segment(table, segment)
 
     def remove_segment(self, table: str, name: str) -> None:

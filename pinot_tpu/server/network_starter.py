@@ -467,6 +467,7 @@ class NetworkedServerStarter:
                     msg.get("crc"),
                     msg.get("downloadUri"),
                     msg.get("invertedIndexColumns"),
+                    msg.get("schemaJson"),
                 )
             elif target == CONSUMING:
                 ok = self._start_consumer(table, segment, msg)
@@ -530,7 +531,10 @@ class NetworkedServerStarter:
         crc: Optional[int],
         download_uri: Optional[str] = None,
         inv_columns=None,
+        schema_json=None,
     ) -> bool:
+        if schema_json is not None:
+            self.server.set_table_schema(table, Schema.from_json(schema_json))
         tdm = self.server.data_manager.table(table)
         loaded = tdm is not None and segment in tdm.segment_names()
         if loaded and crc is not None and self._local_crcs.get(segment) == crc:

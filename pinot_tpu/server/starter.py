@@ -64,6 +64,12 @@ class ServerStarter:
     def _load(self, table: str, segment: str, info: Dict[str, Any]) -> bool:
         meta = info.get("metadata")
         crc = meta.crc if meta is not None else None
+        # schema applies even on the CRC-skip path: a reload broadcast
+        # after schema evolution must patch already-loaded segments with
+        # default columns without re-reading any bytes
+        schema = info.get("schema")
+        if schema is not None:
+            self.server.set_table_schema(table, schema)
         tdm = self.server.data_manager.table(table)
         actually_loaded = tdm is not None and segment in tdm.segment_names()
         if actually_loaded and crc is not None and self._local_crcs.get(segment) == crc:

@@ -433,6 +433,7 @@ def bench_qinput_cache_ab(rows: int) -> Dict:
         t0 = _time.perf_counter()
         for _ in range(n):
             ex._qinput_cache.clear()
+            ex._qinput_cache_bytes = 0
             one()
         return (_time.perf_counter() - t0) / n * 1000
 
