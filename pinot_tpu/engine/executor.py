@@ -124,6 +124,40 @@ def _regs_from_gids(
     return regs
 
 
+def _global_hll_tables(ctx, column: str):
+    """(bucket, rho) uint8 tables for a column's GLOBAL dictionary,
+    cached on the table-context column (built once per table/column —
+    finalize for hll_from_presence aggs maps present global ids through
+    these)."""
+    gcol = ctx.column(column)
+    tables = getattr(gcol, "_hll_tables", None)
+    if tables is None:
+        from pinot_tpu.engine import hll as hll_mod
+
+        tables = hll_mod.dictionary_tables(gcol.global_dict)
+        object.__setattr__(gcol, "_hll_tables", tables)
+    return tables
+
+
+def _regs_from_value_gids(
+    ctx, column: str, gids: np.ndarray, rows: np.ndarray | None = None, n_rows: int = 0
+) -> np.ndarray:
+    """HLL registers from GLOBAL dictionary value ids (the
+    hll_from_presence finalize: registers depend only on the distinct
+    value set).  Batched like ``_regs_from_gids`` when ``rows`` given."""
+    bt, rt = _global_hll_tables(ctx, column)
+    g = np.asarray(gids, dtype=np.int64)
+    ok = g < bt.size  # padded/overflow slots carry no value
+    g = g[ok]
+    if rows is None:
+        regs = np.zeros(config.HLL_M, dtype=np.uint8)
+        np.maximum.at(regs, bt[g], rt[g])
+        return regs
+    regs = np.zeros((n_rows, config.HLL_M), dtype=np.uint8)
+    np.maximum.at(regs, (np.asarray(rows)[ok], bt[g]), rt[g])
+    return regs
+
+
 def _hist_partial(gdict, gids, cnts, p: int) -> "HistogramPartial":
     counts = {
         float(gdict.get(int(g))): int(c)
@@ -479,12 +513,19 @@ class QueryExecutor:
             for a in request.aggregations
             if _agg_kind(a.base_function) in ("presence", "hist") and sv(a.column)
         )
-        # HLL aggs likewise stream host-computed (register, rank) pairs
-        hll_cols = {
-            a.column
-            for a in request.aggregations
-            if _agg_kind(a.base_function) == "hll" and sv(a.column)
-        }
+        # HLL aggs: modest-cardinality SV columns lower to a presence
+        # contraction over gfwd streams (plan.hll_lowers_to_presence —
+        # registers depend only on the distinct value set); the rest
+        # stream host-computed (register, rank) pairs
+        from pinot_tpu.engine.plan import hll_lowers_to_presence
+
+        hll_cols = set()
+        for a in request.aggregations:
+            if _agg_kind(a.base_function) == "hll" and sv(a.column):
+                if hll_lowers_to_presence(request, ctx, a.column):
+                    gfwd_cols.add(a.column)
+                else:
+                    hll_cols.add(a.column)
         return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols)), tuple(sorted(hll_cols))
 
     def _to_device_inputs(self, inputs: Dict[str, Any], plan=None) -> Dict[str, Any]:
@@ -611,6 +652,8 @@ class QueryExecutor:
                 ids = np.asarray(state[1])[: int(state[3])]
             else:
                 ids = np.nonzero(np.asarray(state))[0]
+            if agg.hll_from_presence:
+                return HllPartial(_regs_from_value_gids(ctx, agg.column, ids))
             return DistinctPartial({gdict.get(int(i)) for i in ids if i < gdict.cardinality})
         if agg.kind == "hist":
             gdict = ctx.column(agg.column).global_dict
@@ -702,6 +745,17 @@ class QueryExecutor:
         if base == "minmaxrange":
             return np.asarray(state[1])[keys] - np.asarray(state[0])[keys]
         if agg.kind == "presence":
+            if agg.hll_from_presence:
+                # never sort_pairs: hll_lowers_to_presence admits only
+                # shapes whose dense holder fits (plan.py asserts this)
+                from pinot_tpu.engine import hll as hll_mod
+
+                occ = np.asarray(state)[keys]  # [K, gcard_pad]
+                r, c = np.nonzero(occ)
+                regs = _regs_from_value_gids(ctx, agg.column, c, r, keys.size)
+                return np.asarray(
+                    hll_mod.estimate_from_registers(regs), dtype=np.float64
+                )
             if agg.sort_pairs:
                 return state.counts[keys]
             return np.asarray(state)[keys].sum(axis=1).astype(float)
@@ -755,6 +809,8 @@ class QueryExecutor:
             else:
                 row = np.asarray(state)[key]
                 ids = np.nonzero(row)[0]
+            if agg.hll_from_presence:
+                return HllPartial(_regs_from_value_gids(ctx, agg.column, ids))
             return DistinctPartial({gdict.get(int(i)) for i in ids if i < gdict.cardinality})
         if agg.kind == "hist":
             gdict = ctx.column(agg.column).global_dict

@@ -91,6 +91,14 @@ class StaticAgg:
     # reference switches to map-based storage
     # (DefaultGroupKeyGenerator.java:60-63)
     sort_pairs: bool = False
+    # distinctcounthll lowered to a presence contraction: HLL registers
+    # depend only on the DISTINCT value set, so for dictionary columns
+    # with modest global cardinality the device computes per-(group,
+    # globalDictId) occupancy (K = cap * gcard_pad) and finalize maps
+    # present ids -> registers via the global dict's (bucket, rho)
+    # tables — bit-identical registers at a fraction of the FLOPs of
+    # the direct (group, bucket, rho) contraction (K = cap * 16384)
+    hll_from_presence: bool = False
 
 
 @dataclass(frozen=True)
@@ -127,6 +135,29 @@ class StaticPlan:
     group_by: Optional[StaticGroupBy]
     selection: Optional[StaticSelection]
     on_device: bool  # False -> host (numpy) fallback path
+
+
+def hll_lowers_to_presence(request, ctx, column: str) -> bool:
+    """Whether an SV distinctcounthll lowers to a presence contraction
+    (see StaticAgg.hll_from_presence).  Shared by the planner and the
+    executor's staging-role decision (gfwd stream vs per-row HLL
+    streams) — the two MUST agree or the kernel reads missing arrays.
+
+    Presence wins when the per-group value state (gcard_pad) is smaller
+    than the direct register state (HLL_M * 64 rho lanes); the dense
+    holder must also fit the same cap the presence guard applies."""
+    import os
+
+    if os.environ.get("PINOT_TPU_HLL_PRESENCE", "1") == "0":
+        return False  # A/B kill switch: force the per-row register streams
+    gcard_pad = config.pad_card(ctx.column(column).global_cardinality)
+    if gcard_pad > config.HLL_M * 64:
+        return False
+    cap = 1
+    if request.is_group_by:
+        for c in request.group_by.columns:
+            cap *= max(ctx.column(c).global_cardinality, 1)
+    return cap * gcard_pad <= config.MAX_VALUE_STATE * 4
 
 
 def _agg_kind(base: str) -> str:
@@ -323,6 +354,15 @@ def build_static_plan(
         kind = _agg_kind(base)
         gcard_pad = 0
         sort_pairs = False
+        hll_from_presence = False
+        if (
+            kind == "hll"
+            and a.column != "*"
+            and staged.column(a.column).single_value
+            and hll_lowers_to_presence(request, ctx, a.column)
+        ):
+            kind = "presence"
+            hll_from_presence = True
         if kind in ("presence", "hist"):
             gcol = ctx.column(a.column)
             gcard_pad = config.pad_card(gcol.global_cardinality)
@@ -349,6 +389,7 @@ def build_static_plan(
                 gcard_pad=gcard_pad,
                 use_raw=use_raw,
                 sort_pairs=sort_pairs,
+                hll_from_presence=hll_from_presence,
             )
         )
 
@@ -376,6 +417,11 @@ def build_static_plan(
                     # the device: presence dedups, hist counts runs,
                     # hll packs (bucket, rho) into the pair gid
                     aggs[ai] = replace(a, sort_pairs=True)
+        for a in aggs:
+            # the finalize paths for hll_from_presence handle only the
+            # dense holder (hll_lowers_to_presence admits exactly the
+            # shapes the presence guards keep dense)
+            assert not (a.hll_from_presence and a.sort_pairs), a
         group_by = StaticGroupBy(
             columns=cols,
             col_is_mv=col_is_mv,

@@ -1,0 +1,145 @@
+"""Multi-host serving topology: broker PQL answered by a (hosts, chips)
+mesh (VERDICT r3 #7 — the single-program ICI+DCN path wired into the
+serving stack, not just the SPMD harness).
+
+The reference scales serving across machines only by scatter-gather
+over TCP (``ScatterGatherImpl.java:80``): every server computes its own
+partial and the broker merges.  A TPU pod slice offers a second,
+stronger topology: all hosts of the slice run ONE sharded program, XLA
+merges partials over ICI within a host and DCN across hosts, and the
+broker talks to a single endpoint.  This module is that server mode:
+
+- every host process builds the global (hosts, chips) mesh via
+  ``jax.distributed`` (``parallel/multihost.py``) and owns the SAME
+  table/segment view (each device holds its shard of the stacked
+  segment axis — XLA partitions the arrays, so per-host HBM holds only
+  its slice);
+- the LEAD host (process 0) serves the framework's length-framed
+  query protocol to brokers, so it drops into ``BrokerRequestHandler``
+  routing like any scatter-gather server;
+- because the program is SPMD, every process must enter the kernel for
+  its collectives to complete: the lead forwards each InstanceRequest
+  to the followers over the data-plane TCP transport *before* running
+  it locally, and a per-process FIFO (one in-flight query, matching
+  arrival order) keeps collective ordering identical everywhere —
+  jax.distributed requires identical program order across processes.
+
+The lead's reply alone carries the answer (psum leaves the reduced
+value on every process; the followers' copies are dropped), so the
+broker sees an ordinary single-server response with the whole mesh's
+throughput behind it.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence, Tuple
+
+from pinot_tpu.segment.immutable import ImmutableSegment
+from pinot_tpu.server.instance import ServerInstance
+from pinot_tpu.transport.tcp import TcpServer, TcpTransport
+
+logger = logging.getLogger(__name__)
+
+
+class MultihostQueryServer:
+    """One host process of a mesh-serving group.
+
+    Call :meth:`connect_followers` on the lead (process 0) once every
+    follower's TCP address is known; then point a broker at
+    ``lead.address``.
+    """
+
+    def __init__(
+        self,
+        table: str,
+        segments: Sequence[ImmutableSegment],
+        coordinator_address: Optional[str],
+        num_processes: int,
+        process_id: int,
+        name: Optional[str] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        from pinot_tpu.parallel.multihost import (
+            flatten_to_segment_mesh,
+            initialize_distributed,
+            make_multihost_mesh,
+        )
+
+        initialize_distributed(coordinator_address, num_processes, process_id)
+        mesh = flatten_to_segment_mesh(make_multihost_mesh())
+        self.process_id = process_id
+        self.is_lead = process_id == 0
+        self.name = name or f"meshhost{process_id}"
+        # num_workers=1: queries execute strictly in arrival order —
+        # the SPMD contract (identical collective order on every
+        # process) forbids concurrent kernels
+        self.server = ServerInstance(self.name, mesh=mesh, num_workers=1)
+        for seg in segments:
+            self.server.add_segment(table, seg)
+        self._followers: List[Tuple[str, int]] = []
+        self._transport = TcpTransport()
+        self._fanout = ThreadPoolExecutor(max_workers=8)
+        self._order_lock = threading.Lock()
+        self.tcp = TcpServer(self._handle, host=host, port=port)
+        self.tcp.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.tcp.address
+
+    def connect_followers(self, addresses: Sequence[Tuple[str, int]]) -> None:
+        self._followers = [tuple(a) for a in addresses]
+
+    # -- query path ----------------------------------------------------
+    def _handle(self, payload: bytes) -> bytes:
+        with self._order_lock:
+            # forward FIRST (followers enter the collective while the
+            # lead executes — awaiting their replies before running
+            # locally would deadlock the psum), then run locally
+            futures = [
+                self._fanout.submit(
+                    self._transport.request, addr, payload, 600.0
+                )
+                for addr in self._followers
+            ]
+            # fail FAST on dead followers: a connection-refused forward
+            # errors within milliseconds, and entering the collective
+            # without that process would block in the psum barrier
+            # forever while holding the order lock (wedging every later
+            # query).  A follower dying mid-collective is left to
+            # jax.distributed's own failure detection.
+            time.sleep(0.05)
+            down = [
+                (addr, f.exception())
+                for addr, f in zip(self._followers, futures)
+                if f.done() and f.exception() is not None
+            ]
+            if down:
+                from pinot_tpu.common.datatable import serialize_result
+                from pinot_tpu.common.response import ErrorCode
+                from pinot_tpu.engine.results import IntermediateResult
+
+                msg = "; ".join(f"{a}: {e}" for a, e in down)
+                logger.error("mesh followers unreachable: %s", msg)
+                return serialize_result(
+                    IntermediateResult(
+                        exceptions=[
+                            (ErrorCode.QUERY_EXECUTION, f"mesh followers unreachable: {msg}")
+                        ]
+                    )
+                )
+            reply = self.server.handle_request(payload)
+            for f in futures:
+                try:
+                    f.result(timeout=600.0)
+                except Exception:
+                    logger.exception("follower fan-out failed")
+            return reply
+
+    def stop(self) -> None:
+        self.tcp.stop()
+        self._fanout.shutdown(wait=False)

@@ -399,6 +399,24 @@ class NetworkedServerStarter:
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
+        # Initialize the jax backend on the MAIN thread before any
+        # query can arrive: the accelerator plugin may fail to register
+        # when its first initialization happens inside a scheduler
+        # worker thread ("Backend 'axon' is not in the list of known
+        # backends", observed on-chip).  Probed in a subprocess first so
+        # a wedged device tunnel degrades to lazy init instead of
+        # hanging server startup.
+        from pinot_tpu.utils.platform import probe_device
+
+        if os.environ.get("JAX_PLATFORMS") == "cpu" or probe_device(60.0):
+            import jax
+
+            jax.devices()
+        else:
+            logger.warning(
+                "device backend probe failed; backend will initialize "
+                "lazily on the first query"
+            )
         self.tcp.start()
         self._post(
             "/instances",

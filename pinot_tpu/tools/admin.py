@@ -228,8 +228,44 @@ def cmd_batch_create_segments(args) -> None:
         startree=args.startree,
         segment_name_prefix=args.segment_name_prefix,
     )
-    for r in run_batch_build(spec, workers=args.workers):
+    if args.remote_workers:
+        from pinot_tpu.tools.batch_build import run_distributed_build
+
+        addrs = []
+        for part in args.remote_workers.split(","):
+            part = part.strip()
+            if not part:
+                continue  # tolerate trailing commas
+            host, sep, port = part.rpartition(":")
+            if not sep or not host or not port.isdigit():
+                raise SystemExit(
+                    f"-remote-workers: {part!r} is not host:port "
+                    "(expected e.g. 10.0.0.5:9600,10.0.0.6:9600)"
+                )
+            addrs.append((host, int(port)))
+        if not addrs:
+            raise SystemExit("-remote-workers: no worker addresses given")
+        results = run_distributed_build(spec, addrs)
+    else:
+        results = run_batch_build(spec, workers=args.workers)
+    for r in results:
         print(json.dumps(r))
+
+
+def cmd_start_build_worker(args) -> None:
+    """Serve segment-build jobs over TCP (SegmentCreationJob mapper
+    analog) until interrupted."""
+    import time as _time
+
+    from pinot_tpu.tools.batch_build import serve_build_worker
+
+    server = serve_build_worker(host=args.host, port=args.port)
+    print(f"build worker listening on {server.host}:{server.port}")
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
 
 
 def cmd_upload_segment(args) -> None:
@@ -425,9 +461,24 @@ def main(argv=None) -> None:
     bcs.add_argument("-out-dir", required=True, dest="out_dir")
     bcs.add_argument("-controller", default=None, help="push built segments here when set")
     bcs.add_argument("-workers", type=int, default=0)
+    bcs.add_argument(
+        "-remote-workers",
+        default=None,
+        dest="remote_workers",
+        help="comma-separated host:port build workers (StartBuildWorker); "
+        "fans shards out over TCP instead of the local process pool",
+    )
     bcs.add_argument("-startree", action="store_true")
     bcs.add_argument("-segment-name-prefix", default=None, dest="segment_name_prefix")
     bcs.set_defaults(fn=cmd_batch_create_segments)
+
+    sbw = sub.add_parser(
+        "StartBuildWorker",
+        help="long-lived remote segment-build worker (Hadoop-mapper analog)",
+    )
+    sbw.add_argument("-host", default="0.0.0.0")
+    sbw.add_argument("-port", type=int, default=9600)
+    sbw.set_defaults(fn=cmd_start_build_worker)
 
     us = sub.add_parser("UploadSegment")
     us.add_argument("-controller", default="http://127.0.0.1:9000")

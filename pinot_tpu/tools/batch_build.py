@@ -100,3 +100,110 @@ def run_batch_build(spec: BatchBuildSpec, workers: int = 0) -> List[dict]:
     ctx = mp.get_context("spawn")
     with ctx.Pool(workers) as pool:
         return pool.map(_build_one, jobs)
+
+
+# ---------------------------------------------------------------------------
+# Cross-machine build fan-out (VERDICT r3 #2 / pinot-hadoop parity)
+# ---------------------------------------------------------------------------
+# Reference: SegmentCreationJob.java distributes one segment build per
+# input file across Hadoop mappers; SegmentTarPushJob.java pushes the
+# results.  Here remote BUILD WORKERS are long-lived OS processes
+# serving length-framed JSON jobs over the framework's own TCP
+# transport (transport/tcp.py); the coordinator shards inputs across
+# workers and retries failed shards on surviving workers.  Workers
+# push finished segments to the controller themselves (the mapper-side
+# push), so segment bytes never funnel through the coordinator.
+
+
+def _worker_handle(payload: bytes) -> bytes:
+    """One build job frame -> one result frame (runs inside a worker)."""
+    job = json.loads(payload.decode("utf-8"))
+    try:
+        result = _build_one(
+            (
+                job["schemaFile"],
+                job["table"],
+                job["inputFile"],
+                job["outDir"],
+                job["segmentName"],
+                bool(job.get("startree")),
+                job.get("controller"),
+            )
+        )
+        return json.dumps({"ok": True, "result": result}).encode("utf-8")
+    except Exception as e:  # report, don't kill the worker
+        return json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"}).encode(
+            "utf-8"
+        )
+
+
+def serve_build_worker(host: str = "127.0.0.1", port: int = 0):
+    """Start a build worker; returns the TcpServer (its .address is the
+    (host, port) the coordinator needs)."""
+    from pinot_tpu.transport.tcp import TcpServer
+
+    server = TcpServer(_worker_handle, host=host, port=port)
+    server.start()
+    return server
+
+
+def run_distributed_build(
+    spec: BatchBuildSpec,
+    worker_addresses: Sequence[Tuple[str, int]],
+    retries: int = 2,
+    timeout_s: float = 600.0,
+) -> List[dict]:
+    """Fan one build job per input file out to remote build workers.
+
+    Shards are dealt round-robin; a shard whose worker fails (connection
+    refused, worker crash mid-build, error reply) is retried on the
+    next worker, up to ``retries`` extra attempts — the Hadoop-mapper
+    re-execution analog.  Raises RuntimeError when a shard exhausts its
+    attempts; per-shard results come back in input order."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pinot_tpu.transport.tcp import TcpTransport, TransportError
+
+    if not spec.input_files:
+        return []
+    os.makedirs(spec.out_dir, exist_ok=True)
+    prefix = spec.segment_name_prefix or spec.table
+    with open(spec.schema_file):  # fail fast on a bad schema path
+        pass
+    transport = TcpTransport()
+    n_workers = len(worker_addresses)
+
+    def run_shard(i_path):
+        i, path = i_path
+        job = json.dumps(
+            {
+                "schemaFile": spec.schema_file,
+                "table": spec.table,
+                "inputFile": path,
+                "outDir": spec.out_dir,
+                "segmentName": f"{prefix}_{i}",
+                "startree": spec.startree,
+                "controller": spec.controller,
+            }
+        ).encode("utf-8")
+        errors = []
+        for attempt in range(retries + 1):
+            addr = tuple(worker_addresses[(i + attempt) % n_workers])
+            try:
+                reply = json.loads(
+                    transport.request(addr, job, timeout=timeout_s).decode("utf-8")
+                )
+            except (TransportError, OSError) as e:
+                # OSError covers pool checkout (fresh connect) to a dead
+                # worker — connection refused must retry like any failure
+                errors.append(f"{addr}: {e}")
+                continue
+            if reply.get("ok"):
+                return reply["result"]
+            errors.append(f"{addr}: {reply.get('error')}")
+        raise RuntimeError(
+            f"shard {i} ({path}) failed on all attempts: {'; '.join(errors)}"
+        )
+
+    with ThreadPoolExecutor(max_workers=min(len(spec.input_files), 16)) as pool:
+        return list(pool.map(run_shard, enumerate(spec.input_files)))

@@ -1,0 +1,58 @@
+"""Worker for the multi-host SERVING test
+(tests/test_multihost_process.py::test_broker_pql_through_multihost_mesh):
+each OS process is one host of a 2-host mesh-serving group
+(server/mesh_server.py).  The lead (pid 0) serves the framework's query
+protocol; the test process points a real BrokerRequestHandler at it.
+
+Run as: python tests/multihost_serve_worker.py <coordinator> <nprocs>
+        <pid> <serve_port> [<follower_port>...]
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+os.environ.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    coordinator, num_procs, pid, serve_port = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        int(sys.argv[4]),
+    )
+    follower_ports = [int(p) for p in sys.argv[5:]]
+
+    from pinot_tpu.server.mesh_server import MultihostQueryServer
+    from pinot_tpu.tools.datagen import synthetic_lineitem_segment
+
+    # deterministic seeds: every host builds the same global segment
+    # view (XLA partitions the stacked arrays across the mesh)
+    segments = [
+        synthetic_lineitem_segment(512, seed=100 + i, name=f"mh{i}") for i in range(8)
+    ]
+    server = MultihostQueryServer(
+        "lineitem",
+        segments,
+        coordinator_address=coordinator,
+        num_processes=num_procs,
+        process_id=pid,
+        port=serve_port,
+    )
+    if server.is_lead:
+        server.connect_followers([("127.0.0.1", p) for p in follower_ports])
+    print(f"SERVING pid={pid} port={server.address[1]}", flush=True)
+
+    import time
+
+    time.sleep(600)  # the test kills us when done
+
+
+if __name__ == "__main__":
+    main()

@@ -74,3 +74,110 @@ def test_two_process_distributed_mesh():
     assert len(results) == 2, results
     vals = {line.split("num_docs=")[1] for line in results}
     assert vals == {"4096.0"}, results
+
+
+SERVE_WORKER = os.path.join(os.path.dirname(__file__), "multihost_serve_worker.py")
+
+
+@pytest.mark.slow
+def test_broker_pql_through_multihost_mesh():
+    """End-to-end PQL answered by a multi-host mesh (VERDICT r3 #7):
+    a real BrokerRequestHandler scatter-gathers to the LEAD host of a
+    2-process (hosts, chips) mesh-serving group; the lead fans the
+    query to the follower so both enter the sharded kernel's
+    cross-process collectives, and the broker merges the one reply."""
+    import time
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    lead_port, follower_port = _free_port(), _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PINOT_TPU_TESTS"] = ""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(SERVE_WORKER)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    args = {
+        0: [coordinator, "2", "0", str(lead_port), str(follower_port)],
+        1: [coordinator, "2", "1", str(follower_port)],
+    }
+    # stdout/stderr go to FILES: a chatty worker blocking on a full
+    # stderr pipe would deadlock the readiness loop below
+    import tempfile
+
+    logdir = tempfile.mkdtemp(prefix="meshserve_")
+    outs = [open(os.path.join(logdir, f"w{pid}.out"), "w+") for pid in (0, 1)]
+    errs = [open(os.path.join(logdir, f"w{pid}.err"), "w+") for pid in (0, 1)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, SERVE_WORKER, *args[pid]],
+            stdout=outs[pid],
+            stderr=errs[pid],
+            text=True,
+            env=env,
+            cwd=repo_root,
+        )
+        for pid in (0, 1)
+    ]
+
+    def read(f):
+        f.flush()
+        f.seek(0)
+        return f.read()
+
+    try:
+        # wait for both hosts to report SERVING (coordinator + mesh up)
+        deadline = time.time() + 240
+        serving = set()
+        while len(serving) < 2 and time.time() < deadline:
+            for i, p in enumerate(procs):
+                if i in serving:
+                    continue
+                if p.poll() is not None:
+                    err = read(errs[i])
+                    low = err.lower()
+                    if "gloo" in low or "collectives" in low or "unimplemented" in low:
+                        pytest.skip(f"CPU cross-process collectives unavailable: {err[-300:]}")
+                    pytest.fail(f"worker {i} died rc={p.returncode}\n{err[-2000:]}")
+                if "SERVING" in read(outs[i]):
+                    serving.add(i)
+            time.sleep(0.2)
+        assert len(serving) == 2, "mesh hosts did not come up in time"
+
+        from pinot_tpu.broker.broker import BrokerRequestHandler
+        from pinot_tpu.broker.routing import RoutingTableProvider
+        from pinot_tpu.transport.tcp import TcpTransport
+
+        routing = RoutingTableProvider()
+        routing.update(
+            "lineitem", {f"mh{i}": {"meshhost0": "ONLINE"} for i in range(8)}
+        )
+        broker = BrokerRequestHandler(
+            TcpTransport(),
+            {"meshhost0": ("127.0.0.1", lead_port)},
+            routing=routing,
+            timeout_ms=240_000.0,
+        )
+        resp = broker.handle_pql(
+            "SELECT sum(l_quantity), count(*) FROM lineitem "
+            "WHERE l_shipdate <= '1998-09-02' GROUP BY l_returnflag TOP 10"
+        )
+        assert not resp.exceptions, resp.exceptions
+        assert resp.num_docs_scanned == 4096  # all 8 x 512 rows, via the mesh
+        counts = {
+            tuple(g.group): g.value
+            for g in resp.aggregation_results[1].group_by_result
+        }
+        assert sum(counts.values()) == 4096
+        # second query exercises steady-state ordering across processes
+        resp2 = broker.handle_pql("SELECT count(*) FROM lineitem")
+        assert not resp2.exceptions, resp2.exceptions
+        assert resp2.aggregation_results[0].value == 4096.0
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in outs + errs:
+            f.close()

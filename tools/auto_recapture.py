@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Auto-recapture: keep trying to land a non-degraded on-chip bench
+record (VERDICT r3 weak #6 / next #1 — "a wedge can never again leave
+only a degraded committed record").
+
+Loop: probe the device tunnel out-of-process; when it answers, run the
+full ``bench.py`` (serialized — this script is the only chip client it
+starts) and, if the result is on-chip and non-degraded, append it to
+the captures file and exit 0.  While the tunnel is down, sleep and
+re-probe, up to ``--max-hours``.
+
+Run it in the background near round end:
+    nohup python tools/auto_recapture.py --out BENCH_TPU_CAPTURES_r4.json &
+It is safe to leave running: one capture, then exit.  Exit codes:
+0 = capture landed, 2 = gave up (tunnel never healthy), 3 = bench kept
+failing while the tunnel probed healthy.
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def probe(timeout_s: float = 90.0) -> bool:
+    sys.path.insert(0, REPO)
+    from pinot_tpu.utils.platform import probe_device  # the ONE probe impl
+
+    return probe_device(timeout_s)
+
+
+def run_bench(deadline_s: int) -> dict | None:
+    env = dict(os.environ)
+    env["PINOT_TPU_BENCH_DEADLINE_S"] = str(deadline_s)
+    try:
+        r = subprocess.run(
+            [sys.executable, "bench.py"],
+            timeout=deadline_s + 600,
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in reversed((r.stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_TPU_CAPTURES_r4.json")
+    ap.add_argument("--probe-interval-s", type=int, default=300)
+    ap.add_argument("--max-hours", type=float, default=10.0)
+    ap.add_argument("--bench-deadline-s", type=int, default=3000)
+    args = ap.parse_args()
+
+    out_path = os.path.join(REPO, args.out)
+    stop_at = time.time() + args.max_hours * 3600
+    bench_failures = 0
+    while time.time() < stop_at:
+        if not probe():
+            print(f"{datetime.datetime.now():%H:%M:%S} tunnel down; sleeping", flush=True)
+            time.sleep(args.probe_interval_s)
+            continue
+        print(f"{datetime.datetime.now():%H:%M:%S} tunnel up; running bench", flush=True)
+        result = run_bench(args.bench_deadline_s)
+        if result and not result.get("degraded"):
+            caps = {"note": "auto-recaptured on-chip bench runs", "runs": []}
+            if os.path.exists(out_path):
+                with open(out_path) as f:
+                    caps = json.load(f)
+            caps["runs"].append(
+                {
+                    "when": f"{datetime.datetime.now():%Y-%m-%d %H:%M:%S} (auto_recapture)",
+                    "result": result,
+                }
+            )
+            tmp = out_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(caps, f, indent=1)
+            os.replace(tmp, out_path)
+            print(f"capture landed: {result.get('value')} {result.get('unit')}", flush=True)
+            return 0
+        bench_failures += 1
+        print(
+            f"{datetime.datetime.now():%H:%M:%S} bench degraded/failed "
+            f"({bench_failures}); re-probing",
+            flush=True,
+        )
+        if bench_failures >= 5:
+            return 3
+        time.sleep(args.probe_interval_s)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
