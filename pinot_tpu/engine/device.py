@@ -121,6 +121,7 @@ def stage_segments(
     gfwd_columns: Sequence[str] = (),
     hll_columns: Sequence[str] = (),
     ctx=None,
+    skip_base_columns: Sequence[str] = (),
 ) -> StagedTable:
     """Stack + pad + transfer the given columns of the segments.
 
@@ -134,6 +135,13 @@ def stage_segments(
     HLL (register, rank) uint8 streams. All are host-side numpy
     gathers done once at staging so query kernels stream instead of
     gathering.
+
+    ``skip_base_columns``: SV columns whose base ``fwd``/``dict_vals``
+    arrays are NOT uploaded — for columns the kernel reads only through
+    a role stream (agg input / group key / HLL), the dictId stream is
+    dead HBM weight; at 1B rows it decides whether the table fits on
+    one chip at all.  The caller must guarantee no filter leaf,
+    selection output, or dict-gather path touches these columns.
     """
     S = max(len(segments), pad_segments_to)
     n_pad = config.pad_docs(max(seg.num_docs for seg in segments))
@@ -168,11 +176,12 @@ def stage_segments(
             mv_pad=0,
             cards=cards,
         )
+        skip_base = name in skip_base_columns and meta0.single_value
         if meta0.single_value:
-            fwd = np.zeros((S, n_pad), dtype=idt)
-            for i, c in enumerate(cols):
-                fwd[i, : c.fwd.size] = c.fwd
-            sc.fwd = put(fwd)
+            if not skip_base:
+                # the stacked copy is built only when it uploads — at
+                # 1B rows the transient alone is multiple GB of host RAM
+                sc.fwd = put(_stack_fwd(cols, S, n_pad, idt))
             if name in raw_columns and sc.is_numeric:
                 raw = np.zeros((S, n_pad), dtype=fdt)
                 for i, c in enumerate(cols):
@@ -213,13 +222,26 @@ def stage_segments(
             sc.mv_counts = put(mvc)
             if mvr is not None:
                 sc.mv_raw = put(mvr)
-        if sc.is_numeric:
-            dv = np.zeros((S, card_pad), dtype=fdt)
-            for i, c in enumerate(cols):
-                dv[i, : cards[i]] = np.asarray(c.dictionary.values, dtype=fdt)
-            sc.dict_vals = put(dv)
+        if sc.is_numeric and not skip_base:
+            sc.dict_vals = put(_stack_dict_vals(cols, S, card_pad, fdt))
         staged.columns[name] = sc
     return staged
+
+
+def _stack_fwd(cols, S: int, n_pad: int, idt) -> np.ndarray:
+    """Stacked (S, n_pad) dictId forward array — the ONE layout shared
+    by staging and the later-query backfill (_augment_staged)."""
+    fwd = np.zeros((S, n_pad), dtype=idt)
+    for i, c in enumerate(cols):
+        fwd[i, : c.fwd.size] = c.fwd
+    return fwd
+
+
+def _stack_dict_vals(cols, S: int, card_pad: int, fdt) -> np.ndarray:
+    dv = np.zeros((S, card_pad), dtype=fdt)
+    for i, c in enumerate(cols):
+        dv[i, : c.dictionary.cardinality] = np.asarray(c.dictionary.values, dtype=fdt)
+    return dv
 
 
 # ---------------------------------------------------------------------------
@@ -256,11 +278,15 @@ def get_staged(
     gfwd_columns: Sequence[str] = (),
     hll_columns: Sequence[str] = (),
     ctx=None,
+    skip_base_columns: Sequence[str] = (),
 ) -> StagedTable:
     """Cached staging. The cache key covers only the base arrays; role
     arrays (raw/gfwd/hll streams) are attached to the cached
     StagedTable on demand, so queries differing only in roles share one
-    HBM copy of the base columns."""
+    HBM copy of the base columns.  A column staged stream-only
+    (skip_base_columns) gets its base arrays backfilled if a later
+    query needs them (e.g. a filter arrives on a former agg-only
+    column)."""
     key = (
         tuple(f"{s.segment_name}:{s.metadata.crc}" for s in segments),
         tuple(sorted(column_names)),
@@ -277,12 +303,23 @@ def get_staged(
                 gfwd_columns=gfwd_columns,
                 hll_columns=hll_columns,
                 ctx=ctx,
+                skip_base_columns=skip_base_columns,
             )
             if len(_stage_cache) > 32:
                 _stage_cache.clear()
             _stage_cache[key] = st
         else:
-            _augment_staged(st, segments, raw_columns, gfwd_columns, hll_columns, ctx)
+            _augment_staged(
+                st,
+                segments,
+                raw_columns,
+                gfwd_columns,
+                hll_columns,
+                ctx,
+                base_columns=[
+                    c for c in column_names if c not in set(skip_base_columns)
+                ],
+            )
     return st
 
 
@@ -293,10 +330,24 @@ def _augment_staged(
     gfwd_columns: Sequence[str],
     hll_columns: Sequence[str],
     ctx,
+    base_columns: Sequence[str] = (),
 ) -> None:
     """Attach missing role arrays to an already-staged table."""
     fdt = config.np_float_dtype()
     S, n_pad = st.num_segments, st.n_pad
+    for name in base_columns:
+        # backfill base arrays a stream-only staging skipped
+        sc = st.columns.get(name)
+        if sc is None or not sc.single_value or sc.fwd is not None:
+            continue
+        cols = [seg.column(name) for seg in segments]
+        sc.fwd = jnp.asarray(
+            _stack_fwd(cols, S, n_pad, config.index_dtype(sc.card_pad))
+        )
+        if sc.is_numeric and sc.dict_vals is None:
+            sc.dict_vals = jnp.asarray(
+                _stack_dict_vals(cols, S, sc.card_pad, fdt)
+            )
     for name in raw_columns:
         sc = st.columns.get(name)
         if sc is None or sc.raw is not None or not sc.is_numeric or not sc.single_value:

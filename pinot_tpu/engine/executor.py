@@ -125,18 +125,11 @@ def _regs_from_gids(
 
 
 def _global_hll_tables(ctx, column: str):
-    """(bucket, rho) uint8 tables for a column's GLOBAL dictionary,
-    cached on the table-context column (built once per table/column —
-    finalize for hll_from_presence aggs maps present global ids through
-    these)."""
-    gcol = ctx.column(column)
-    tables = getattr(gcol, "_hll_tables", None)
-    if tables is None:
-        from pinot_tpu.engine import hll as hll_mod
+    """(bucket, rho) uint8 tables for a column's GLOBAL dictionary
+    (dictionary_tables caches on the dictionary itself)."""
+    from pinot_tpu.engine import hll as hll_mod
 
-        tables = hll_mod.dictionary_tables(gcol.global_dict)
-        object.__setattr__(gcol, "_hll_tables", tables)
-    return tables
+    return hll_mod.dictionary_tables(ctx.column(column).global_dict)
 
 
 def _regs_from_value_gids(
@@ -261,6 +254,37 @@ class QueryExecutor:
             self._phase("indexPath", t0)
             return ires
         raw_cols, gfwd_cols, hll_cols = self._role_columns(request, live, ctx)
+        # Columns the kernel reads ONLY through a role stream skip their
+        # base fwd/dict arrays: at 1B rows the dictId stream is the
+        # difference between fitting in HBM and not.  Filter leaves and
+        # selection outputs read base arrays, so those columns keep them.
+        skip_base: set = set()
+        if not request.is_selection:
+            filter_cols = set()
+
+            def _walk(t):
+                if t is None:
+                    return
+                if t.is_leaf:
+                    filter_cols.add(t.column)
+                else:
+                    for c in t.children:
+                        _walk(c)
+
+            _walk(request.filter)
+            from pinot_tpu.engine.plan import _agg_kind
+
+            # scalar/pair agg inputs OUTSIDE raw_cols (small dictionaries)
+            # read dict[fwd] on device — their base arrays must stay
+            gather_agg_cols = {
+                a.column
+                for a in request.aggregations
+                if _agg_kind(a.base_function) in ("scalar", "pair")
+                and a.column not in raw_cols
+            }
+            skip_base = (
+                set(raw_cols) | set(gfwd_cols) | set(hll_cols)
+            ) - filter_cols - gather_agg_cols
         staged = get_staged(
             live,
             sorted(needed),
@@ -269,6 +293,7 @@ class QueryExecutor:
             gfwd_columns=gfwd_cols,
             hll_columns=hll_cols,
             ctx=ctx,
+            skip_base_columns=skip_base,
         )
         t0 = self._phase("staging", t0)
         scratch: Dict[Any, Any] = {}  # plan->inputs table cache (regex)

@@ -111,7 +111,13 @@ def dictionary_tables(dictionary):
     """Per-dictId (register index, rank) uint8 tables for a column
     dictionary — the ONE place the per-entry HLL hashing loop lives
     (shared by the staging stream builder and the planner's table
-    fallback, which must agree bit-for-bit)."""
+    fallback, which must agree bit-for-bit).  Cached on the dictionary:
+    the hashing loop is Python-speed, and high-cardinality dictionaries
+    (millions of entries at north-star scale) are re-staged per role
+    augmentation."""
+    cached = getattr(dictionary, "_hll_tables", None)
+    if cached is not None:
+        return cached
     card = max(dictionary.cardinality, 1)
     bt = np.zeros(card, dtype=np.uint8)
     rt = np.zeros(card, dtype=np.uint8)
@@ -119,4 +125,5 @@ def dictionary_tables(dictionary):
         b, r = bucket_and_rho(value_hash64(dictionary.get(j)))
         bt[j] = b
         rt[j] = r
+    dictionary._hll_tables = (bt, rt)
     return bt, rt

@@ -33,7 +33,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
 
@@ -94,44 +93,52 @@ class MultihostQueryServer:
     def connect_followers(self, addresses: Sequence[Tuple[str, int]]) -> None:
         self._followers = [tuple(a) for a in addresses]
 
+    PING = b"\x00MESHPING"
+    PONG = b"\x00MESHPONG"
+
+    def _error_reply(self, msg: str) -> bytes:
+        from pinot_tpu.common.datatable import serialize_result
+        from pinot_tpu.common.response import ErrorCode
+        from pinot_tpu.engine.results import IntermediateResult
+
+        logger.error("%s", msg)
+        return serialize_result(
+            IntermediateResult(exceptions=[(ErrorCode.QUERY_EXECUTION, msg)])
+        )
+
     # -- query path ----------------------------------------------------
     def _handle(self, payload: bytes) -> bytes:
+        if payload == self.PING:
+            return self.PONG
         with self._order_lock:
-            # forward FIRST (followers enter the collective while the
-            # lead executes — awaiting their replies before running
-            # locally would deadlock the psum), then run locally
-            futures = [
-                self._fanout.submit(
-                    self._transport.request, addr, payload, 600.0
-                )
+            # Liveness preflight BEFORE forwarding anything: once any
+            # follower holds the query it will enter the collective, so
+            # discovering a dead peer after forwarding would wedge the
+            # survivors in the psum barrier.  The short ping timeout
+            # also catches network-partitioned hosts whose connects
+            # hang rather than refuse.  A follower dying between ping
+            # and kernel entry is left to jax.distributed's own
+            # failure detection.
+            ping_futs = [
+                self._fanout.submit(self._transport.request, addr, self.PING, 5.0)
                 for addr in self._followers
             ]
-            # fail FAST on dead followers: a connection-refused forward
-            # errors within milliseconds, and entering the collective
-            # without that process would block in the psum barrier
-            # forever while holding the order lock (wedging every later
-            # query).  A follower dying mid-collective is left to
-            # jax.distributed's own failure detection.
-            time.sleep(0.05)
-            down = [
-                (addr, f.exception())
-                for addr, f in zip(self._followers, futures)
-                if f.done() and f.exception() is not None
-            ]
+            down = []
+            for addr, f in zip(self._followers, ping_futs):
+                try:
+                    if f.result(timeout=6.0) != self.PONG:
+                        down.append((addr, "bad ping reply"))
+                except Exception as e:
+                    down.append((addr, e))
             if down:
-                from pinot_tpu.common.datatable import serialize_result
-                from pinot_tpu.common.response import ErrorCode
-                from pinot_tpu.engine.results import IntermediateResult
-
                 msg = "; ".join(f"{a}: {e}" for a, e in down)
-                logger.error("mesh followers unreachable: %s", msg)
-                return serialize_result(
-                    IntermediateResult(
-                        exceptions=[
-                            (ErrorCode.QUERY_EXECUTION, f"mesh followers unreachable: {msg}")
-                        ]
-                    )
-                )
+                return self._error_reply(f"mesh followers unreachable: {msg}")
+            # forward, then run locally (awaiting follower replies
+            # before running would deadlock the collective)
+            futures = [
+                self._fanout.submit(self._transport.request, addr, payload, 600.0)
+                for addr in self._followers
+            ]
             reply = self.server.handle_request(payload)
             for f in futures:
                 try:

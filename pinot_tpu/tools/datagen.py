@@ -257,3 +257,127 @@ def lineitem_rows(num_rows: int, seed: int = 7) -> List[Row]:
             }
         )
     return rows
+
+
+# ---------------------------------------------------------------------------
+# Synthetic ad-events (the BASELINE.json north-star config: "Synthetic
+# ad-events 1B rows: high-cardinality distinctCountHLL group-by")
+# ---------------------------------------------------------------------------
+
+ADEVENTS_TABLE = "adevents"
+
+
+def adevents_schema() -> Schema:
+    return Schema(
+        ADEVENTS_TABLE,
+        dimensions=[
+            FieldSpec("campaign_id", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("site_id", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("user_id", DataType.LONG, FieldType.DIMENSION),
+        ],
+        metrics=[FieldSpec("clicks", DataType.INT, FieldType.METRIC)],
+        time_field=TimeFieldSpec("event_time", DataType.LONG, time_unit="MILLISECONDS"),
+    )
+
+
+def synthetic_adevents_segment(
+    num_rows: int,
+    seed: int = 7,
+    name: str = "ad0",
+    campaign_card: int = 1024,
+    site_card: int = 128,
+    user_card: int = 1 << 20,
+    user_universe: int = 1 << 26,
+):
+    """Fast numpy-path ad-events segment: the high-cardinality HLL
+    workload.  ``user_id`` draws ``user_card`` distinct users per
+    segment from a ``user_universe``-wide population, so segments
+    overlap partially (the realistic dedup case) and the GLOBAL
+    dictionary grows toward the universe size across segments."""
+    import numpy as np
+
+    from pinot_tpu.common.schema import DataType
+    from pinot_tpu.segment.dictionary import Dictionary
+    from pinot_tpu.segment.immutable import (
+        ColumnData,
+        ColumnMetadata,
+        ImmutableSegment,
+        SegmentMetadata,
+    )
+
+    rng = np.random.default_rng(seed)
+    schema = adevents_schema()
+
+    users = np.unique(
+        rng.integers(0, user_universe, size=int(user_card * 1.05), dtype=np.int64)
+    )
+    t0 = 1_700_000_000_000 + seed * 3_600_000
+    dict_values = {
+        "campaign_id": np.arange(campaign_card, dtype=np.int64),
+        "site_id": np.arange(site_card, dtype=np.int64),
+        "user_id": users,
+        "clicks": np.arange(16, dtype=np.int64),
+        # clustered: events arrive in time order (zone-map fodder)
+        "event_time": t0 + np.arange(4096, dtype=np.int64) * 1000,
+    }
+    columns = {}
+    for spec in schema.all_fields():
+        vals = np.asarray(dict_values[spec.name])
+        d = Dictionary(spec.stored_type, np.unique(vals))
+        card = d.cardinality
+        fwd = rng.integers(0, card, size=num_rows, dtype=np.int64).astype(np.int32)
+        if spec.name == "event_time":
+            fwd.sort()
+        meta = ColumnMetadata(
+            name=spec.name,
+            data_type=spec.data_type,
+            field_type=spec.field_type,
+            single_value=True,
+            cardinality=card,
+            total_docs=num_rows,
+            is_sorted=bool(num_rows == 0 or np.all(fwd[1:] >= fwd[:-1])),
+            total_number_of_entries=num_rows,
+            min_value=d.min_value,
+            max_value=d.max_value,
+        )
+        columns[spec.name] = ColumnData(metadata=meta, dictionary=d, fwd=fwd)
+    smeta = SegmentMetadata(
+        segment_name=name,
+        table_name=ADEVENTS_TABLE,
+        num_docs=num_rows,
+        columns={c.metadata.name: c.metadata for c in columns.values()},
+        time_column="event_time",
+    )
+    seg = ImmutableSegment(metadata=smeta, columns=columns)
+    smeta.crc = hash((name, num_rows, seed)) & 0xFFFFFFFF
+    return seg
+
+
+def tile_segments(distinct_segments, total: int):
+    """Replicate ``distinct_segments`` round-robin up to ``total``
+    segments under fresh names.  The clones SHARE the originals' numpy
+    arrays (host RAM stays O(distinct)), but stage and execute as
+    independent segments — the standard trick for benchmarking at row
+    counts datagen can't build in reasonable time.  Results are those
+    of the tiled data (e.g. distinct counts don't grow past the
+    distinct set); throughput numbers are unaffected, which is what
+    the tiling is for."""
+    from pinot_tpu.segment.immutable import ImmutableSegment, SegmentMetadata
+
+    out = []
+    for i in range(total):
+        base = distinct_segments[i % len(distinct_segments)]
+        if i < len(distinct_segments):
+            out.append(base)
+            continue
+        m = base.metadata
+        smeta = SegmentMetadata(
+            segment_name=f"{m.segment_name}_t{i}",
+            table_name=m.table_name,
+            num_docs=m.num_docs,
+            columns=dict(m.columns),
+            time_column=m.time_column,
+        )
+        smeta.crc = hash((smeta.segment_name, m.num_docs)) & 0xFFFFFFFF
+        out.append(ImmutableSegment(metadata=smeta, columns=base.columns))
+    return out

@@ -1,0 +1,202 @@
+"""North-star workload: high-cardinality distinctCountHLL group-by on
+synthetic ad-events (BASELINE.json config 4; VERDICT r3 #4).
+
+Measures, at a requested total row count:
+- kernel-marginal rows/s (bench.py methodology: fixed dispatch RTT
+  subtracted via marginal-batch timing);
+- broker-path p50 over the full parse->route->kernel->reduce path;
+- staged HBM bytes (the capacity accounting that locates the cliff);
+- the >=2^20-group host-fallback path and the device sort-pairs exact
+  distinct path, timed at the same scale.
+
+Scale mechanics: ``distinct`` full segments are generated (high-card
+user_id, partially overlapping across segments) and tiled to the
+requested row count — host RAM stays O(distinct segments) while the
+device sees the full stacked table.  Run sizes upward until staging or
+the workspace exhausts HBM; the last fitting size plus the failure is
+the documented capacity cliff.
+
+Usage:
+  python -m pinot_tpu.tools.hll_northstar -rows 536870912
+  python -m pinot_tpu.tools.hll_northstar -rows 33554432 -paths  # aux paths too
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+HLL_PQL = (
+    "SELECT distinctcounthll(user_id) FROM adevents "
+    "GROUP BY campaign_id TOP 10"
+)
+
+
+def staged_nbytes(staged) -> int:
+    import jax
+
+    total = 0
+    for sc in staged.columns.values():
+        for arr in (sc.fwd, sc.mv, sc.mv_counts, sc.dict_vals, sc.raw, sc.gfwd,
+                    sc.hll_bucket, sc.hll_rho, sc.mv_raw):
+            if arr is not None:
+                total += arr.nbytes
+    return total
+
+
+def _log(msg: str) -> None:
+    import sys
+
+    print(f"# {time.strftime('%H:%M:%S')} {msg}", file=sys.stderr, flush=True)
+
+
+def run(total_rows: int, rows_per_segment: int, distinct: int, iters: int,
+        aux_paths: bool) -> dict:
+    from pinot_tpu.engine.context import get_table_context
+    from pinot_tpu.engine.device import segment_arrays, stage_segments, to_device_inputs
+    from pinot_tpu.engine.executor import QueryExecutor
+    from pinot_tpu.engine.kernel import make_table_kernel
+    from pinot_tpu.engine.plan import build_query_inputs, build_static_plan
+    from pinot_tpu.engine.reduce import reduce_to_response
+    from pinot_tpu.pql import optimize_request, parse_pql
+    from pinot_tpu.tools.datagen import synthetic_adevents_segment, tile_segments
+
+    n_segments = max(1, total_rows // rows_per_segment)
+    t0 = time.perf_counter()
+    distinct_segs = [
+        synthetic_adevents_segment(rows_per_segment, seed=23 + i, name=f"ad{i}")
+        for i in range(min(distinct, n_segments))
+    ]
+    segments = tile_segments(distinct_segs, n_segments)
+    gen_s = time.perf_counter() - t0
+    total_rows = sum(s.num_docs for s in segments)
+    _log(f"datagen done ({gen_s:.0f}s, {n_segments} segments)")
+
+    request = optimize_request(parse_pql(HLL_PQL))
+    ctx = get_table_context(segments)
+    needed = sorted(set(request.referenced_columns()))
+    t0 = time.perf_counter()
+    staged = stage_segments(
+        segments,
+        needed,
+        gfwd_columns=("campaign_id",),
+        hll_columns=("user_id",),
+        ctx=ctx,
+        skip_base_columns=("campaign_id", "user_id"),
+    )
+    stage_s = time.perf_counter() - t0
+    hbm_bytes = staged_nbytes(staged)
+    _log(f"staged ({stage_s:.0f}s, {hbm_bytes/(1<<30):.2f} GiB)")
+    plan = build_static_plan(request, ctx, staged)
+    assert plan.on_device, "north-star HLL group-by must stay on device"
+    q_inputs = to_device_inputs(build_query_inputs(request, plan, ctx, staged))
+    seg_arrays = segment_arrays(staged, needed)
+    kernel = make_table_kernel(plan)
+
+    def fetch(outs):
+        leaf = next(iter(outs.values()))
+        while isinstance(leaf, (tuple, list)):
+            leaf = leaf[0]
+        np.asarray(leaf)
+
+    def run_batch(m: int) -> float:
+        t0 = time.perf_counter()
+        outs = None
+        for _ in range(m):
+            outs = kernel(seg_arrays, q_inputs)
+        fetch(outs)
+        return time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    fetch(kernel(seg_arrays, q_inputs))  # compile
+    compile_s = time.perf_counter() - t0
+    _log(f"compiled ({compile_s:.0f}s); timing")
+    run_batch(3)
+    m_small, m_large = 3, 3 + iters
+    diffs = []
+    for _ in range(3):
+        t_large = run_batch(m_large)
+        t_small = run_batch(m_small)
+        diffs.append((t_large - t_small) / (m_large - m_small))
+    per_query_s = max(sorted(diffs)[len(diffs) // 2], 1e-9)
+
+    out = {
+        "workload": "adevents_hll_groupby",
+        "pql": HLL_PQL,
+        "total_rows": total_rows,
+        "num_segments": n_segments,
+        "distinct_segments": len(distinct_segs),
+        "global_user_card": ctx.column("user_id").global_cardinality,
+        "rows_per_sec": round(total_rows / per_query_s, 1),
+        "per_query_ms": round(per_query_s * 1000, 3),
+        "staged_hbm_bytes": hbm_bytes,
+        "staged_hbm_gib": round(hbm_bytes / (1 << 30), 3),
+        "datagen_s": round(gen_s, 1),
+        "stage_s": round(stage_s, 1),
+        "compile_s": round(compile_s, 1),
+    }
+
+    _log(f"kernel phase done: {out['rows_per_sec']:,.0f} rows/s")
+    if aux_paths:
+        # broker-path p50 on the same table (executor path end to end)
+        ex = QueryExecutor()
+        req = optimize_request(parse_pql(HLL_PQL))
+
+        def one(r):
+            return reduce_to_response(r, [ex.execute(segments, r)])
+
+        one(req)
+        times = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            one(req)
+            times.append((time.perf_counter() - t0) * 1000)
+        out["executor_p50_ms"] = round(sorted(times)[len(times) // 2], 1)
+        _log(f"executor p50 {out['executor_p50_ms']}ms; host-fallback next")
+
+        # >=2^20-group HOST-FALLBACK path: group by the high-card column
+        # itself (cap = global user card > MAX_GROUP_CAPACITY)
+        req_hf = optimize_request(
+            parse_pql(
+                "SELECT count(*) FROM adevents GROUP BY user_id TOP 10"
+            )
+        )
+        t0 = time.perf_counter()
+        resp = one(req_hf)
+        out["host_fallback_groups_s"] = round(time.perf_counter() - t0, 1)
+        out["host_fallback_ok"] = not resp.exceptions
+        _log(f"host fallback done ({out['host_fallback_groups_s']}s); sort-pairs next")
+
+        # device SORT-PAIRS exact distinct at north-star cardinality
+        req_sp = optimize_request(
+            parse_pql(
+                "SELECT distinctcount(user_id) FROM adevents "
+                "GROUP BY site_id TOP 10"
+            )
+        )
+        t0 = time.perf_counter()
+        resp = one(req_sp)
+        out["sort_pairs_distinct_s"] = round(time.perf_counter() - t0, 1)
+        out["sort_pairs_ok"] = not resp.exceptions
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-rows", type=int, default=134_217_728)
+    ap.add_argument("-rows-per-segment", type=int, default=8_388_608, dest="rps")
+    ap.add_argument("-distinct", type=int, default=4)
+    ap.add_argument("-iters", type=int, default=10)
+    ap.add_argument("-paths", action="store_true", help="also time host-fallback + sort-pairs + executor p50")
+    args = ap.parse_args()
+    import jax
+
+    result = run(args.rows, args.rps, args.distinct, args.iters, args.paths)
+    result["platform"] = jax.devices()[0].platform
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -91,6 +91,8 @@ def _spawn_worker(tmp_path, name):
     import sys
     import time
 
+    import os
+
     script = tmp_path / f"{name}.py"
     port_file = tmp_path / f"{name}.port"
     script.write_text(
@@ -100,11 +102,12 @@ def _spawn_worker(tmp_path, name):
         f"open({str(port_file)!r}, 'w').write(str(srv.port))\n"
         "time.sleep(600)\n"
     )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.Popen(
         [sys.executable, str(script)],
         env={
-            **__import__("os").environ,
-            "PYTHONPATH": "/root/repo",
+            **os.environ,
+            "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
             "JAX_PLATFORMS": "cpu",
             "PALLAS_AXON_POOL_IPS": "",
         },
