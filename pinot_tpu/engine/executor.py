@@ -325,7 +325,7 @@ class QueryExecutor:
                 kernel = self._block_kernel(plan, block)
             outs = kernel(seg_arrays, q_inputs, jnp.asarray(block_ids))
         else:
-            kernel = self._kernel(plan)
+            kernel = self._kernel(plan, staged)
             outs = kernel(seg_arrays, q_inputs)
         outs = {k: np.asarray(v) if not isinstance(v, tuple) else tuple(np.asarray(x) for x in v) for k, v in outs.items()}
         t0 = self._phase("planExec", t0)
@@ -461,10 +461,30 @@ class QueryExecutor:
             ),
         )
 
-    def _kernel(self, plan: StaticPlan):
+    def _kernel(self, plan: StaticPlan, staged=None):
         if self.mesh is None:
-            from pinot_tpu.engine.kernel import make_packed_table_kernel
+            from pinot_tpu.engine.kernel import (
+                chunk_rows_limit,
+                make_chunked_table_kernel,
+                make_packed_table_kernel,
+                plan_chunkable,
+            )
 
+            limit = chunk_rows_limit()
+            if (
+                staged is not None
+                and limit
+                and staged.num_segments * staged.n_pad > limit
+                and plan_chunkable(plan)
+            ):
+                # beyond the per-dispatch row budget the kernel's
+                # per-row temporaries exceed HBM at compile time: run
+                # segment-axis chunks and combine the reduced outputs.
+                # Outputs are holder-sized (small), so the single-
+                # transfer packing wrapper isn't needed here.
+                return make_chunked_table_kernel(
+                    plan, staged.num_segments, staged.n_pad
+                )
             return make_packed_table_kernel(plan)
         from pinot_tpu.engine.packing import make_packed_kernel
         from pinot_tpu.parallel.multichip import make_sharded_table_kernel

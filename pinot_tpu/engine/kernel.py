@@ -282,8 +282,10 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
             )[0].reshape(config.HLL_M, 64)
             rho_iota = jax.lax.broadcasted_iota(jnp.int32, (config.HLL_M, 64), 1)
             return jnp.max(jnp.where(counts > 0, rho_iota, 0), axis=1)
-        regs = jnp.zeros(config.HLL_M, dtype=jnp.int32)
-        return regs.at[b_rows].max(jnp.where(m, r_rows, 0), mode="drop")
+        regs = jnp.zeros(config.HLL_M, dtype=jnp.uint8)
+        return regs.at[b_rows.astype(jnp.int32)].max(
+            jnp.where(m, r_rows, 0).astype(jnp.uint8), mode="drop"
+        )
 
     raise AssertionError(agg)
 
@@ -510,10 +512,21 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
                 jnp.int32, (capacity, config.HLL_M, 64), 2
             )
             return jnp.max(jnp.where(counts > 0, rho_iota, 0), axis=2)
-        holder = jnp.zeros((capacity, config.HLL_M), dtype=jnp.int32)
-        return holder.at[pair_k, pair_b].max(
-            jnp.where(pair_v, pair_r, 0), mode="drop"
+        # one FLAT scatter index instead of (k, b) pairs: at 1B rows the
+        # per-row int32 temporaries are what blow HBM (three 4 B/row
+        # arrays = 12 GB); a single fused index plus the mask-select
+        # halves that, which moves the single-chip capacity cliff from
+        # ~600M to past 1B rows for this workload
+        flat = jnp.where(
+            pair_v,
+            pair_k * config.HLL_M + pair_b.astype(jnp.int32),
+            capacity * config.HLL_M,
         )
+        # uint8 holder + values: rho < 64 always, and the int32 value
+        # temporary alone is 4 GB at 1B rows
+        holder = jnp.zeros(capacity * config.HLL_M, dtype=jnp.uint8)
+        regs = holder.at[flat].max(pair_r.astype(jnp.uint8), mode="drop")
+        return regs.reshape(capacity, config.HLL_M)
 
     raise AssertionError(agg)
 
@@ -691,10 +704,13 @@ _PAIR_SENTINEL = np.iinfo(np.int32).max
 
 def _hll_rows(agg: StaticAgg, seg, bucket, rho):
     """Per-row (register index, rank) for an SV HLL agg: prefer the
-    host-staged uint8 streams over on-device table gathers."""
+    host-staged uint8 streams over on-device table gathers.  Returned
+    in their NATIVE dtype (uint8 streams) — consumers cast only where
+    the op needs it, because a blanket int32 cast materializes 4 B/row
+    temporaries that dominate HBM at 1B rows."""
     hb = seg.get(f"{agg.column}.hllb")
     if hb is not None:
-        return hb.astype(jnp.int32), seg[f"{agg.column}.hllr"].astype(jnp.int32)
+        return hb, seg[f"{agg.column}.hllr"]
     fwd = seg[f"{agg.column}.fwd"]
     return bucket[fwd], rho[fwd]
 
@@ -879,6 +895,94 @@ def make_table_kernel(plan: StaticPlan) -> Callable:
         return {k: apply_reduce(reducers[k], v) for k, v in outs.items()}
 
     return jax.jit(table_fn)
+
+
+# Per-row kernel temporaries scale with S * n_pad: beyond ~2^28 rows the
+# int32 intermediates alone reach several GB and a 1B-row table blows
+# the 16 GB HBM at compile time.  Chunking the segment axis bounds the
+# working set; chunk outputs (already segment-reduced) combine with the
+# same elementwise ops the in-kernel reduce uses.  Env-overridable
+# (PINOT_TPU_CHUNK_ROWS = max rows per dispatch; 0 disables).
+_ELEMENTWISE_REDUCERS = ("sum", "min", "max", "sum_pair", "minmax_pair")
+
+
+def chunk_rows_limit() -> int:
+    import os
+
+    try:
+        return int(os.environ.get("PINOT_TPU_CHUNK_ROWS", str(1 << 28)))
+    except ValueError:
+        return 1 << 28
+
+
+def plan_chunkable(plan: StaticPlan) -> bool:
+    """Chunk-combinable: every output reduces elementwise.  The
+    distinct_pairs sort-dedup buffers and per-segment selection outputs
+    need their full segment axis in one program."""
+    return all(op in _ELEMENTWISE_REDUCERS for op in output_reducers(plan).values())
+
+
+def combine_reduced(op: str, a, b):
+    if op == "sum":
+        return a + b
+    if op == "max":
+        return jnp.maximum(a, b)
+    if op == "min":
+        return jnp.minimum(a, b)
+    if op == "sum_pair":
+        return (a[0] + b[0], a[1] + b[1])
+    if op == "minmax_pair":
+        return (jnp.minimum(a[0], b[0]), jnp.maximum(a[1], b[1]))
+    raise ValueError(op)
+
+
+def make_chunked_table_kernel(plan: StaticPlan, num_segments: int, n_pad: int) -> Callable:
+    """The table kernel, dispatched over segment-axis chunks when the
+    table exceeds the per-dispatch row budget.  Falls back to the plain
+    kernel when chunking is off, unnecessary, or the plan isn't
+    chunk-combinable."""
+    # the resolved limit is part of the cache key: a kernel built under
+    # one PINOT_TPU_CHUNK_ROWS value must not be reused after it changes
+    return _chunked_table_kernel(plan, num_segments, n_pad, chunk_rows_limit())
+
+
+@functools.lru_cache(maxsize=64)
+def _chunked_table_kernel(
+    plan: StaticPlan, num_segments: int, n_pad: int, limit: int
+) -> Callable:
+    chunk = max(1, limit // max(n_pad, 1)) if limit else num_segments
+    # round DOWN to a divisor of num_segments: every dispatch then
+    # shares one shape, so the table kernel compiles exactly once
+    # (a remainder-shaped trailing chunk would force a second full
+    # XLA compile, which dominates at these sizes)
+    while chunk > 1 and num_segments % chunk:
+        chunk -= 1
+    if not limit or num_segments <= chunk or not plan_chunkable(plan):
+        return make_table_kernel(plan)
+    table = make_table_kernel(plan)
+    reducers = output_reducers(plan)
+    from pinot_tpu.engine.packing import make_packed_kernel
+
+    # the combined outputs still fetch via ONE packed D2H transfer —
+    # per-leaf fetches pay a tunnel RTT each (engine/packing.py)
+    pack = make_packed_kernel(lambda o: o)
+
+    def sliced(tree, s, e):
+        return jax.tree_util.tree_map(lambda x: x[s:e], tree)
+
+    def run(segs: Dict[str, Any], q: Dict[str, Any]) -> Dict[str, Any]:
+        outs = None
+        for s in range(0, num_segments, chunk):
+            e = min(s + chunk, num_segments)
+            o = table(sliced(segs, s, e), sliced(q, s, e))
+            outs = (
+                o
+                if outs is None
+                else {k: combine_reduced(reducers[k], outs[k], o[k]) for k in o}
+            )
+        return pack(outs)
+
+    return run
 
 @functools.lru_cache(maxsize=256)
 def make_packed_table_kernel(plan: StaticPlan) -> Callable:

@@ -57,7 +57,7 @@ def run(total_rows: int, rows_per_segment: int, distinct: int, iters: int,
     from pinot_tpu.engine.context import get_table_context
     from pinot_tpu.engine.device import segment_arrays, stage_segments, to_device_inputs
     from pinot_tpu.engine.executor import QueryExecutor
-    from pinot_tpu.engine.kernel import make_table_kernel
+    from pinot_tpu.engine.kernel import make_chunked_table_kernel
     from pinot_tpu.engine.plan import build_query_inputs, build_static_plan
     from pinot_tpu.engine.reduce import reduce_to_response
     from pinot_tpu.pql import optimize_request, parse_pql
@@ -93,7 +93,7 @@ def run(total_rows: int, rows_per_segment: int, distinct: int, iters: int,
     assert plan.on_device, "north-star HLL group-by must stay on device"
     q_inputs = to_device_inputs(build_query_inputs(request, plan, ctx, staged))
     seg_arrays = segment_arrays(staged, needed)
-    kernel = make_table_kernel(plan)
+    kernel = make_chunked_table_kernel(plan, n_segments, staged.n_pad)
 
     def fetch(outs):
         leaf = next(iter(outs.values()))

@@ -240,3 +240,33 @@ def test_host_fallback_vectorized_scale():
     top = resp.to_json()["aggregationResults"][0]["groupByResult"]
     assert len(top) == 10
     assert took < 10.0, f"vectorized fallback too slow: {took:.1f}s"
+
+
+def test_chunked_kernel_matches_unchunked(monkeypatch):
+    """Segment-axis chunking (PINOT_TPU_CHUNK_ROWS) combines chunk
+    outputs into bit-identical results — the capacity path for tables
+    whose per-row kernel temporaries exceed HBM in one dispatch."""
+    import json
+
+    from pinot_tpu.engine.executor import QueryExecutor
+    from pinot_tpu.engine.reduce import reduce_to_response
+    from pinot_tpu.pql import optimize_request, parse_pql
+    from pinot_tpu.tools.datagen import synthetic_lineitem_segment
+
+    segs = [synthetic_lineitem_segment(4096, seed=41 + i, name=f"ck{i}") for i in range(6)]
+    queries = [
+        "SELECT sum(l_quantity), count(*), min(l_discount), max(l_tax) FROM lineitem "
+        "WHERE l_shipdate <= '1998-09-02' GROUP BY l_returnflag TOP 10",
+        "SELECT avg(l_extendedprice) FROM lineitem",
+        "SELECT distinctcounthll(l_shipdate) FROM lineitem GROUP BY l_linestatus TOP 10",
+    ]
+    for pql in queries:
+        req = optimize_request(parse_pql(pql))
+        outs = {}
+        for chunk_rows in ("0", "8192"):  # off vs 2-segment chunks
+            monkeypatch.setenv("PINOT_TPU_CHUNK_ROWS", chunk_rows)
+            r = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
+            outs[chunk_rows] = json.dumps(
+                r.to_json()["aggregationResults"], sort_keys=True
+            )
+        assert outs["0"] == outs["8192"], pql
