@@ -35,6 +35,8 @@ from pinot_tpu.engine.results import (
     AggPartial,
     AvgPartial,
     CountPartial,
+    DistinctPartial,
+    HllPartial,
     IntermediateResult,
     MaxPartial,
     MinMaxRangePartial,
@@ -72,6 +74,12 @@ def _segment_mask(seg: ImmutableSegment, tree: Optional[FilterQueryTree]) -> np.
 
 
 _VECTOR_AGGS = {"count", "sum", "min", "max", "avg", "minmaxrange"}
+# distinct aggs vectorize in the GROUP-BY path via (group, gid) pair
+# dedup (np.unique); they only touch global dict ids, so strings are
+# fine.  Without this, a beyond-capacity group-by with distinctcount
+# fell to the per-row Python loop — ~30 min at 134M rows vs ~80 s
+# vectorized (NORTHSTAR_HLL.json aux paths).
+_DISTINCT_AGGS = {"distinctcount", "distinctcounthll", "fasthll"}
 
 
 def _vectorizable_groupby(request: BrokerRequest, segments, ctx: TableContext) -> bool:
@@ -87,7 +95,7 @@ def _vectorizable_groupby(request: BrokerRequest, segments, ctx: TableContext) -
         space *= max(ctx.column(c).global_cardinality, 1)
         if space >= (1 << 62):
             return False
-    return _vectorizable_aggs(request, segments)
+    return _vectorizable_aggs(request, segments, allow_distinct=True)
 
 
 def _default_matched_rows(request: BrokerRequest):
@@ -101,20 +109,29 @@ def _default_matched_rows(request: BrokerRequest):
     return resolve
 
 
-def _vectorizable_aggs(request: BrokerRequest, segments) -> bool:
+def _vectorizable_aggs(
+    request: BrokerRequest, segments, allow_distinct: bool = False
+) -> bool:
     """True when every aggregation fits the numpy fast paths:
     scalar/pair functions over SV numeric columns (shared check of the
-    group-by and aggregation-only vectorized paths)."""
+    group-by and aggregation-only vectorized paths); with
+    ``allow_distinct``, SV distinct/HLL aggs of any stored type too."""
     seg = segments[0]
     for a in request.aggregations:
-        if a.base_function not in _VECTOR_AGGS:
+        base = a.base_function
+        is_distinct = base in _DISTINCT_AGGS
+        if base not in _VECTOR_AGGS and not (allow_distinct and is_distinct):
             return False
         if a.column == "*":
+            if is_distinct:
+                return False  # distinctcount(*) has no gid column: per-row path
             continue
         if a.column not in seg.columns:
             return False
         col = seg.column(a.column)
-        if not col.is_single_value or col.dictionary.stored_type.name == "STRING":
+        if not col.is_single_value:
+            return False
+        if not is_distinct and col.dictionary.stored_type.name == "STRING":
             return False
     return True
 
@@ -191,13 +208,21 @@ def _groupby_vectorized(
     val_columns = {
         a.column
         for a in request.aggregations
-        if a.base_function != "count" and a.column != "*"
+        if a.base_function != "count"
+        and a.column != "*"
+        and a.base_function not in _DISTINCT_AGGS
+    }
+    gid_columns = {
+        a.column
+        for a in request.aggregations
+        if a.base_function in _DISTINCT_AGGS
     }
 
     if matched_rows is None:
         matched_rows = _default_matched_rows(request)
     all_keys: List[np.ndarray] = []
     col_vals: Dict[str, List[np.ndarray]] = {c: [] for c in val_columns}
+    col_gids: Dict[str, List[np.ndarray]] = {c: [] for c in gid_columns}
     for si, seg in enumerate(segments):
         matched = matched_rows(si, seg)
         res.num_docs_scanned += int(matched.size)
@@ -214,6 +239,9 @@ def _groupby_vectorized(
             col_vals[c].append(
                 np.asarray(col.dictionary.values, dtype=np.float64)[col.fwd[matched]]
             )
+        for c in gid_columns:
+            col = seg.column(c)
+            col_gids[c].append(ctx.column(c).remaps[si][col.fwd[matched]])
 
     if not all_keys:
         return
@@ -240,6 +268,22 @@ def _groupby_vectorized(
     cat_vals = {c: np.concatenate(v) for c, v in col_vals.items()}
     minmax_cache: Dict[str, tuple] = {}
 
+    # distinct/HLL: one (group, gid) pair dedup per column — sorted, so
+    # each group's distinct gids are one contiguous slice
+    distinct_cache: Dict[str, tuple] = {}
+
+    def distinct_pairs(c: str):
+        if c not in distinct_cache:
+            gc = max(ctx.column(c).global_cardinality, 1)
+            gid = np.concatenate(col_gids[c]).astype(np.int64)
+            pair = np.unique(inv.astype(np.int64) * gc + gid)
+            pg = (pair // gc).astype(np.int64)  # sorted: per-group slices
+            pgid = pair % gc
+            dcounts = np.bincount(pg, minlength=k).astype(np.float64)
+            bounds = np.searchsorted(pg, np.arange(k + 1))
+            distinct_cache[c] = (pgid, bounds, dcounts)
+        return distinct_cache[c]
+
     states: List[tuple] = []  # (kind, arrays...)
     order_vals: List[np.ndarray] = []
     for a in request.aggregations:
@@ -247,6 +291,20 @@ def _groupby_vectorized(
         if base == "count":
             states.append(("count", counts))
             order_vals.append(counts)
+            continue
+        if base in _DISTINCT_AGGS:
+            pgid, bounds, dcounts = distinct_pairs(a.column)
+            if base == "distinctcount":
+                states.append(("distinct", a.column, pgid, bounds))
+                order_vals.append(dcounts)
+            else:
+                # distinctcounthll: ORDER/TRIM by the exact per-group
+                # distinct count (monotone proxy for the estimate —
+                # dense registers for all k >= 2^20 groups would cost
+                # k*256 bytes + a per-group Python estimator before the
+                # trim); registers are built per KEPT group in partial()
+                states.append(("hll", a.column, pgid, bounds))
+                order_vals.append(dcounts)
             continue
         vals = cat_vals[a.column]
         if base == "sum":
@@ -300,6 +358,24 @@ def _groupby_vectorized(
             return MaxPartial(float(state[1][i]))
         if kind == "avg":
             return AvgPartial(float(state[1][i]), float(state[2][i]))
+        if kind == "distinct":
+            _, c, pgid, bounds = state
+            gdict = ctx.column(c).global_dict
+            ids = pgid[bounds[i] : bounds[i + 1]]
+            if gdict.is_string:
+                vals = {gdict.get(int(g)) for g in ids}
+            else:
+                vals = set(np.asarray(gdict.values)[ids].tolist())
+            return DistinctPartial(vals)
+        if kind == "hll":
+            from pinot_tpu.engine import hll as hll_mod
+
+            _, c, pgid, bounds = state
+            bt, rt = hll_mod.dictionary_tables(ctx.column(c).global_dict)
+            ids = pgid[bounds[i] : bounds[i + 1]]
+            regs = np.zeros(hll_mod.M, dtype=np.uint8)
+            np.maximum.at(regs, bt[ids], rt[ids])
+            return HllPartial(regs)
         return MinMaxRangePartial(float(state[1][i]), float(state[2][i]))
 
     for row, i in enumerate(keep):

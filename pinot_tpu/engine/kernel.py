@@ -951,12 +951,16 @@ def _chunked_table_kernel(
     plan: StaticPlan, num_segments: int, n_pad: int, limit: int
 ) -> Callable:
     chunk = max(1, limit // max(n_pad, 1)) if limit else num_segments
-    # round DOWN to a divisor of num_segments: every dispatch then
-    # shares one shape, so the table kernel compiles exactly once
-    # (a remainder-shaped trailing chunk would force a second full
-    # XLA compile, which dominates at these sizes)
-    while chunk > 1 and num_segments % chunk:
-        chunk -= 1
+    # Prefer a divisor of num_segments: every dispatch then shares one
+    # shape and the table kernel compiles exactly once.  But never
+    # shrink below half the budget chasing a divisor (prime segment
+    # counts would collapse to 1-segment dispatches) — a remainder-
+    # shaped trailing chunk costing one extra compile is cheaper.
+    divisor = chunk
+    while divisor > max(1, chunk // 2) and num_segments % divisor:
+        divisor -= 1
+    if num_segments % divisor == 0:
+        chunk = divisor
     if not limit or num_segments <= chunk or not plan_chunkable(plan):
         return make_table_kernel(plan)
     table = make_table_kernel(plan)

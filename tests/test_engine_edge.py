@@ -270,3 +270,38 @@ def test_chunked_kernel_matches_unchunked(monkeypatch):
                 r.to_json()["aggregationResults"], sort_keys=True
             )
         assert outs["0"] == outs["8192"], pql
+
+
+def test_host_fallback_vectorized_distinct_matches_oracle():
+    """Beyond-capacity group-bys with distinctcount/distinctcounthll
+    take the vectorized (group, gid) pair-dedup host path (the per-row
+    Python loop took ~30 min at 134M rows); results must match the
+    scan oracle exactly."""
+    import json
+
+    from pinot_tpu.engine import config as _config
+    from pinot_tpu.engine.executor import QueryExecutor
+    from pinot_tpu.engine.reduce import reduce_to_response
+    from pinot_tpu.pql import optimize_request, parse_pql
+    from pinot_tpu.tools.datagen import lineitem_schema, synthetic_lineitem_segment
+    from pinot_tpu.tools.scan_engine import ScanQueryProcessor
+
+    segs = [synthetic_lineitem_segment(6000, seed=61 + i, name=f"hf{i}") for i in range(3)]
+    oracle = ScanQueryProcessor(lineitem_schema(), [r for s in segs for r in s.rows()])
+    queries = [
+        "SELECT distinctcount(l_shipdate), count(*) FROM lineitem GROUP BY l_extendedprice TOP 10",
+        "SELECT distinctcounthll(l_quantity) FROM lineitem GROUP BY l_extendedprice TOP 10",
+        "SELECT distinctcount(l_shipmode) FROM lineitem "
+        "WHERE l_returnflag = 'R' GROUP BY l_extendedprice TOP 5",
+    ]
+    saved = _config.MAX_GROUP_CAPACITY
+    _config.MAX_GROUP_CAPACITY = 64  # force the host fallback
+    try:
+        for pql in queries:
+            req = optimize_request(parse_pql(pql))
+            got = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
+            want = oracle.execute(parse_pql(pql))
+            assert json.dumps(got.to_json()["aggregationResults"], sort_keys=True) == \
+                json.dumps(want.to_json()["aggregationResults"], sort_keys=True), pql
+    finally:
+        _config.MAX_GROUP_CAPACITY = saved

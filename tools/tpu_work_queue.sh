@@ -18,25 +18,21 @@ echo "$(date +%T) step1 tpu gate" >> $LOG/status.txt
 PINOT_TPU_TESTS=tpu timeout 2400 python -m pytest tests/test_tpu_platform.py -m tpu -q > $LOG/step1_gate.log 2>&1
 echo "$(date +%T) step1 exit=$?" >> $LOG/status.txt
 
-echo "$(date +%T) step2 two-server quickstart repro" >> $LOG/status.txt
-if [ -f /tmp/repro2srv.py ]; then
-  PYTHONPATH=/root/repo timeout 900 python -u /tmp/repro2srv.py > $LOG/step2_repro.log 2>&1
-  echo "$(date +%T) step2 exit=$?" >> $LOG/status.txt
-else
-  echo "$(date +%T) step2 SKIPPED (/tmp/repro2srv.py not present)" >> $LOG/status.txt
-fi
+echo "$(date +%T) step2 bench" >> $LOG/status.txt
+timeout 3600 python bench.py > $LOG/step2_bench.log 2> $LOG/step2_bench.err
+echo "$(date +%T) step2 exit=$?" >> $LOG/status.txt
 
-echo "$(date +%T) step3 bench" >> $LOG/status.txt
-timeout 3600 python bench.py > $LOG/step3_bench.log 2> $LOG/step3_bench.err
+echo "$(date +%T) step3 hll northstar 536M" >> $LOG/status.txt
+timeout 3000 python -m pinot_tpu.tools.hll_northstar -rows 536870912 -iters 3 > $LOG/step3_ns.log 2>&1
 echo "$(date +%T) step3 exit=$?" >> $LOG/status.txt
 
-echo "$(date +%T) step4 pallas microbench" >> $LOG/status.txt
-timeout 1800 python -m pinot_tpu.tools.microbench pallas_ab -rows 8388608 > $LOG/step4_pallas.log 2>&1
+echo "$(date +%T) step4 auto-recapture insurance (foreground: chip work stays serialized)" >> $LOG/status.txt
+python tools/auto_recapture.py --out BENCH_TPU_CAPTURES_r4.json --max-hours 2 > $LOG/step4_recapture.log 2>&1
 echo "$(date +%T) step4 exit=$?" >> $LOG/status.txt
 echo "$(date +%T) ALL DONE" >> $LOG/status.txt
 
-# Provenance: used in round 3 to serialize all on-chip validation
-# (gate -> demo repro -> bench capture -> pallas A/B) behind a tunnel-
-# recovery probe. Chip work MUST be serialized: the tunnel is single-
-# client, and SIGKILLing a client mid-transfer wedges the lease for
-# hours (see .claude/skills/verify/SKILL.md).
+# Provenance: round-4 serialization of on-chip validation (gate ->
+# bench -> north-star -> recapture insurance) behind a tunnel-recovery
+# probe. Chip work MUST be serialized: the tunnel is single-client, and
+# SIGKILLing a client mid-transfer wedges the lease for hours (see
+# .claude/skills/verify/SKILL.md).
