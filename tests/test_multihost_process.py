@@ -171,6 +171,20 @@ def test_broker_pql_through_multihost_mesh():
         resp2 = broker.handle_pql("SELECT count(*) FROM lineitem")
         assert not resp2.exceptions, resp2.exceptions
         assert resp2.aggregation_results[0].value == 4096.0
+
+        # follower death: the lead's liveness preflight must fail the
+        # query fast (error response) instead of wedging the collective
+        procs[1].terminate()
+        try:
+            procs[1].wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            procs[1].kill()  # CPU-only worker: SIGKILL is safe
+            procs[1].wait(timeout=10)
+        t0 = time.time()
+        resp3 = broker.handle_pql("SELECT count(*) FROM lineitem")
+        assert resp3.exceptions, "dead follower must surface as a query error"
+        assert "unreachable" in resp3.exceptions[0].message
+        assert time.time() - t0 < 60, "follower-down detection took too long"
     finally:
         for p in procs:
             p.terminate()
