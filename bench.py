@@ -126,29 +126,13 @@ def _kernel_rows_per_sec(segments, iters: int):
 def _broker_latencies(segments, queries_per_round: int = 40):
     """p50/p99 of the Q1 query through the full broker path (parse ->
     route -> scatter -> vmapped kernel -> reduce), client-observed."""
-    from pinot_tpu.broker.broker import BrokerRequestHandler
-    from pinot_tpu.broker.routing import RoutingTableProvider
-    from pinot_tpu.server.instance import ServerInstance
+    from pinot_tpu.tools.cluster_harness import single_server_broker
     from pinot_tpu.tools.query_runner import QueryRunner
-    from pinot_tpu.transport.local import LocalTransport
 
-    server = ServerInstance("benchServer")
-    for seg in segments:
-        server.add_segment("lineitem", seg)
-    transport = LocalTransport()
-    transport.register(("benchServer", 0), server.handle_request)
-    routing = RoutingTableProvider()
-    routing.update(
-        "lineitem", {s.segment_name: {"benchServer": "ONLINE"} for s in segments}
-    )
-    broker = BrokerRequestHandler(
-        transport,
-        {"benchServer": ("benchServer", 0)},
-        routing=routing,
-        # first broker-path query pays staging ~1GB of columns over the
-        # tunnel + compile; the serving default (15s) is for steady state
-        timeout_ms=600_000.0,
-    )
+    # the 600s default timeout covers the first broker-path query's
+    # ~1GB column staging over the tunnel + compile; the serving
+    # default (15s) is for steady state
+    broker = single_server_broker("lineitem", segments)
 
     def run(pql: str) -> None:
         resp = broker.handle_pql(pql)

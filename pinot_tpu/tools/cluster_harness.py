@@ -116,3 +116,26 @@ class InProcessCluster:
         if self.http is not None:
             self.http.stop()
         self.controller.stop()
+
+
+def single_server_broker(table: str, segments, timeout_ms: float = 600_000.0):
+    """One in-process server + broker over LocalTransport — the
+    minimal serving topology every bench uses (bench.py,
+    tools/config_bench.py).  The generous default timeout covers the
+    first query's staging + compile on a tunneled chip."""
+    from pinot_tpu.broker.broker import BrokerRequestHandler
+    from pinot_tpu.broker.routing import RoutingTableProvider
+
+    server = ServerInstance("benchServer")
+    for seg in segments:
+        server.add_segment(table, seg)
+    transport = LocalTransport()
+    transport.register(("benchServer", 0), server.handle_request)
+    routing = RoutingTableProvider()
+    routing.update(table, {s.segment_name: {"benchServer": "ONLINE"} for s in segments})
+    return BrokerRequestHandler(
+        transport,
+        {"benchServer": ("benchServer", 0)},
+        routing=routing,
+        timeout_ms=timeout_ms,
+    )

@@ -1,0 +1,185 @@
+"""BASELINE.json workload-config benchmarks 1, 2, and 5 — the three
+configs without committed on-chip numbers (Q1/Q6 live in bench.py,
+the 1B HLL ladder in tools/hll_northstar.py):
+
+1. baseballStats offline group-by: SUM(runs) GROUP BY playerName
+   (quick-start-offline shape at bench scale).
+2. baseballStats star-tree cube: the same aggregations answered from
+   the pre-aggregated cube (startree/operator.py) vs the raw scan —
+   the reference's StarTreeIndexOperator speedup, re-measured here.
+3. meetupRsvp realtime: ingest rate into a mutable segment plus a
+   windowed COUNT group-by over the live consuming snapshot.
+
+Prints one JSON object; run on-chip via tools/tpu_work_queue.sh or
+directly.  Reference harness analog: PerfBenchmarkDriver +
+BenchmarkQueryEngine (pinot-perf).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _broker_for(table: str, segments):
+    from pinot_tpu.tools.cluster_harness import single_server_broker
+
+    return single_server_broker(table, segments)
+
+
+def _p50(broker, pql: str, warm: int = 3, n: int = 15) -> float:
+    for _ in range(warm):
+        resp = broker.handle_pql(pql)
+        assert not resp.exceptions, resp.exceptions
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        resp = broker.handle_pql(pql)
+        times.append((time.perf_counter() - t0) * 1000)
+        # a failed timed run returns fast and would publish a bogus
+        # (low) p50 — errors must fail the bench, not flatter it
+        assert not resp.exceptions, resp.exceptions
+    times.sort()
+    return round(times[len(times) // 2], 2)
+
+
+def baseball_groupby(num_segments: int, rows_per_segment: int) -> dict:
+    from pinot_tpu.tools.datagen import synthetic_baseball_segment
+
+    segs = [
+        synthetic_baseball_segment(rows_per_segment, seed=71 + i, name=f"bb{i}")
+        for i in range(num_segments)
+    ]
+    broker = _broker_for("baseballStats", segs)
+    total = num_segments * rows_per_segment
+    pql = "SELECT sum(runs) FROM baseballStats GROUP BY playerName TOP 10"
+    p50 = _p50(broker, pql)
+    return {
+        "config": "baseballStats_offline_groupby",
+        "pql": pql,
+        "total_rows": total,
+        "p50_ms": p50,
+        "rows_per_sec_p50": round(total / (p50 / 1000.0), 1),
+        "multi_agg_p50_ms": _p50(
+            broker,
+            "SELECT sum(runs), sum(hits), sum(homeRuns), avg(atBats) "
+            "FROM baseballStats GROUP BY playerName, league TOP 10",
+        ),
+    }
+
+
+def startree_cube(rows: int) -> dict:
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.startree.builder import StarTreeBuilderConfig, build_star_tree
+    from pinot_tpu.tools.datagen import baseball_rows, baseball_schema
+
+    schema = baseball_schema()
+    data = baseball_rows(rows, seed=9)
+    seg = build_segment(schema, data, "baseballStats", "st0")
+    t0 = time.perf_counter()
+    build_star_tree(seg, schema, StarTreeBuilderConfig())
+    build_s = round(time.perf_counter() - t0, 1)
+    broker = _broker_for("baseballStats", [seg])
+    pql = "SELECT sum(runs), count(*) FROM baseballStats GROUP BY teamID TOP 20"
+    # routing is automatic when the tree exists (executor star routing);
+    # the A/B detaches the tree for the scan side
+    tree = seg.star_tree
+    tree_p50 = _p50(broker, pql)
+    seg.star_tree = None
+    scan_p50 = _p50(broker, pql)
+    seg.star_tree = tree
+    return {
+        "config": "baseballStats_startree_cube",
+        "pql": pql,
+        "rows": rows,
+        "tree_build_s": build_s,
+        "startree_p50_ms": tree_p50,
+        "scan_p50_ms": scan_p50,
+        "speedup": round(scan_p50 / max(tree_p50, 1e-3), 1),
+    }
+
+
+def realtime_windowed(rows: int) -> dict:
+    from pinot_tpu.realtime.mutable import MutableSegment
+    from pinot_tpu.tools.datagen import Row
+
+    from pinot_tpu.common.schema import (
+        DataType,
+        FieldSpec,
+        FieldType,
+        Schema,
+        TimeFieldSpec,
+    )
+
+    schema = Schema(
+        "meetupRsvp",
+        dimensions=[
+            FieldSpec("venue_name", DataType.STRING),
+            FieldSpec("event_name", DataType.STRING),
+        ],
+        metrics=[FieldSpec("rsvp_count", DataType.INT, FieldType.METRIC)],
+        time_field=TimeFieldSpec("mtime", DataType.LONG, time_unit="MILLISECONDS"),
+    )
+    rng = np.random.default_rng(3)
+    venues = [f"venue{i}" for i in range(50)]
+    events = [f"event{i}" for i in range(20)]
+    t_base = 1_700_000_000_000
+    data: list[Row] = [
+        {
+            "venue_name": venues[int(v)],
+            "event_name": events[int(e)],
+            "rsvp_count": int(c),
+            "mtime": t_base + int(i) * 100,
+        }
+        for i, (v, e, c) in enumerate(
+            zip(
+                rng.integers(0, 50, rows),
+                rng.integers(0, 20, rows),
+                rng.integers(1, 8, rows),
+            )
+        )
+    ]
+    seg = MutableSegment(schema, "rt0", "meetupRsvp")
+    t0 = time.perf_counter()
+    for i in range(0, rows, 2000):
+        seg.index_batch(data[i : i + 2000])
+    ingest_s = time.perf_counter() - t0
+
+    broker = _broker_for("meetupRsvp", [seg])
+    lo, hi = t_base + rows * 25, t_base + rows * 75  # middle half window
+    pql = (
+        f"SELECT count(*), sum(rsvp_count) FROM meetupRsvp "
+        f"WHERE mtime BETWEEN {lo} AND {hi} GROUP BY venue_name TOP 10"
+    )
+    return {
+        "config": "meetupRsvp_realtime_windowed_count",
+        "pql": pql,
+        "rows": rows,
+        "ingest_rows_per_sec": round(rows / ingest_s, 1),
+        "windowed_groupby_p50_ms": _p50(broker, pql),
+    }
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-bb-segments", type=int, default=8, dest="bb_segments")
+    ap.add_argument("-bb-rows", type=int, default=8_388_608, dest="bb_rows")
+    ap.add_argument("-st-rows", type=int, default=500_000, dest="st_rows")
+    ap.add_argument("-rt-rows", type=int, default=2_000_000, dest="rt_rows")
+    args = ap.parse_args()
+    import jax
+
+    out = {
+        "platform": jax.devices()[0].platform,
+        "baseball": baseball_groupby(args.bb_segments, args.bb_rows),
+        "startree": startree_cube(args.st_rows),
+        "realtime": realtime_windowed(args.rt_rows),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

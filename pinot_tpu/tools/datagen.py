@@ -157,10 +157,25 @@ def _rand_date(rng: random.Random, lo_year: int = 1992, hi_year: int = 1998) -> 
     return f"{y:04d}-{m:02d}-{d:02d}"
 
 
-def synthetic_lineitem_segment(num_rows: int, seed: int = 7, name: str = "li0"):
-    """Fast numpy-path lineitem segment for benchmarks: builds ColumnData
-    directly (dictIds drawn uniformly) instead of the two-pass row builder,
-    so 10M+ row segments construct in seconds."""
+def _synthetic_columnar_segment(
+    schema: Schema,
+    table_name: str,
+    dict_values: Dict[str, Any],
+    num_rows: int,
+    seed: int,
+    name: str,
+    clustered_column: Optional[str] = None,
+    time_column: Optional[str] = None,
+    rng=None,
+):
+    """Shared fast-path builder behind every synthetic_*_segment:
+    ColumnData built directly from per-column value pools (dictIds drawn
+    uniformly) instead of the two-pass row builder, so 10M+ row segments
+    construct in seconds.  ``clustered_column`` is sorted after draw
+    (arrival-ordered data: zone maps / docrange fast paths have
+    something to prune, as a sorted Pinot column does).  Callers whose
+    value pools consumed random state pass their ``rng`` so the draw
+    sequence (and thus seeded data) stays reproducible."""
     import numpy as np
 
     from pinot_tpu.common.schema import DataType
@@ -172,8 +187,55 @@ def synthetic_lineitem_segment(num_rows: int, seed: int = 7, name: str = "li0"):
         SegmentMetadata,
     )
 
+    rng = rng if rng is not None else np.random.default_rng(seed)
+    columns = {}
+    for spec in schema.all_fields():
+        vals = dict_values[spec.name]
+        if spec.stored_type == DataType.STRING:
+            d = Dictionary(DataType.STRING, sorted(set(vals)))
+        else:
+            d = Dictionary(spec.stored_type, np.unique(np.asarray(vals)))
+        card = d.cardinality
+        fwd = rng.integers(0, card, size=num_rows, dtype=np.int64).astype(np.int32)
+        if spec.name == clustered_column:
+            fwd.sort()
+        columns[spec.name] = ColumnData(
+            metadata=ColumnMetadata(
+                name=spec.name,
+                data_type=spec.data_type,
+                field_type=spec.field_type,
+                single_value=True,
+                cardinality=card,
+                total_docs=num_rows,
+                # true sortedness: a clustered column qualifies for the
+                # docrange fast path (plan.py), as a sorted Pinot column
+                # does for SortedInvertedIndexBasedFilterOperator
+                is_sorted=bool(num_rows == 0 or np.all(fwd[1:] >= fwd[:-1])),
+                total_number_of_entries=num_rows,
+                min_value=d.min_value,
+                max_value=d.max_value,
+            ),
+            dictionary=d,
+            fwd=fwd,
+        )
+    smeta = SegmentMetadata(
+        segment_name=name,
+        table_name=table_name,
+        num_docs=num_rows,
+        columns={c.metadata.name: c.metadata for c in columns.values()},
+        time_column=time_column,
+    )
+    seg = ImmutableSegment(metadata=smeta, columns=columns)
+    smeta.crc = hash((name, num_rows, seed)) & 0xFFFFFFFF  # cheap identity
+    return seg
+
+
+def synthetic_lineitem_segment(num_rows: int, seed: int = 7, name: str = "li0"):
+    """Fast numpy-path lineitem segment for benchmarks (see
+    ``_synthetic_columnar_segment``)."""
+    import numpy as np
+
     rng = np.random.default_rng(seed)
-    schema = lineitem_schema()
 
     def dates(n: int) -> List[str]:
         out = []
@@ -196,47 +258,10 @@ def synthetic_lineitem_segment(num_rows: int, seed: int = 7, name: str = "li0"):
         "l_discount": np.round(np.arange(0.0, 0.11, 0.01), 2),
         "l_tax": np.round(np.arange(0.0, 0.09, 0.01), 2),
     }
-
-    columns = {}
-    for spec in schema.all_fields():
-        vals = dict_values[spec.name]
-        if spec.stored_type == DataType.STRING:
-            d = Dictionary(DataType.STRING, list(vals))
-        else:
-            d = Dictionary(spec.stored_type, np.unique(np.asarray(vals)))
-        card = d.cardinality
-        fwd = rng.integers(0, card, size=num_rows, dtype=np.int64).astype(np.int32)
-        if spec.name == "l_shipdate":
-            # realtime tables arrive in time order: keep the date column
-            # clustered so zone maps (engine/zonemap.py) have something
-            # to prune, as in the reference's sorted-column fast path
-            fwd.sort()
-        meta = ColumnMetadata(
-            name=spec.name,
-            data_type=spec.data_type,
-            field_type=spec.field_type,
-            single_value=True,
-            cardinality=card,
-            total_docs=num_rows,
-            # true sortedness: the clustered date column qualifies for
-            # the docrange fast path (plan.py), as a sorted Pinot
-            # column does for SortedInvertedIndexBasedFilterOperator
-            is_sorted=bool(num_rows == 0 or np.all(fwd[1:] >= fwd[:-1])),
-            total_number_of_entries=num_rows,
-            min_value=d.min_value,
-            max_value=d.max_value,
-        )
-        columns[spec.name] = ColumnData(metadata=meta, dictionary=d, fwd=fwd)
-
-    smeta = SegmentMetadata(
-        segment_name=name,
-        table_name="lineitem",
-        num_docs=num_rows,
-        columns={c.metadata.name: c.metadata for c in columns.values()},
+    return _synthetic_columnar_segment(
+        lineitem_schema(), "lineitem", dict_values, num_rows, seed, name,
+        clustered_column="l_shipdate", rng=rng,
     )
-    seg = ImmutableSegment(metadata=smeta, columns=columns)
-    smeta.crc = hash((name, num_rows, seed)) & 0xFFFFFFFF  # cheap identity
-    return seg
 
 
 def lineitem_rows(num_rows: int, seed: int = 7) -> List[Row]:
@@ -296,18 +321,7 @@ def synthetic_adevents_segment(
     dictionary grows toward the universe size across segments."""
     import numpy as np
 
-    from pinot_tpu.common.schema import DataType
-    from pinot_tpu.segment.dictionary import Dictionary
-    from pinot_tpu.segment.immutable import (
-        ColumnData,
-        ColumnMetadata,
-        ImmutableSegment,
-        SegmentMetadata,
-    )
-
     rng = np.random.default_rng(seed)
-    schema = adevents_schema()
-
     users = np.unique(
         rng.integers(0, user_universe, size=int(user_card * 1.05), dtype=np.int64)
     )
@@ -320,37 +334,10 @@ def synthetic_adevents_segment(
         # clustered: events arrive in time order (zone-map fodder)
         "event_time": t0 + np.arange(4096, dtype=np.int64) * 1000,
     }
-    columns = {}
-    for spec in schema.all_fields():
-        vals = np.asarray(dict_values[spec.name])
-        d = Dictionary(spec.stored_type, np.unique(vals))
-        card = d.cardinality
-        fwd = rng.integers(0, card, size=num_rows, dtype=np.int64).astype(np.int32)
-        if spec.name == "event_time":
-            fwd.sort()
-        meta = ColumnMetadata(
-            name=spec.name,
-            data_type=spec.data_type,
-            field_type=spec.field_type,
-            single_value=True,
-            cardinality=card,
-            total_docs=num_rows,
-            is_sorted=bool(num_rows == 0 or np.all(fwd[1:] >= fwd[:-1])),
-            total_number_of_entries=num_rows,
-            min_value=d.min_value,
-            max_value=d.max_value,
-        )
-        columns[spec.name] = ColumnData(metadata=meta, dictionary=d, fwd=fwd)
-    smeta = SegmentMetadata(
-        segment_name=name,
-        table_name=ADEVENTS_TABLE,
-        num_docs=num_rows,
-        columns={c.metadata.name: c.metadata for c in columns.values()},
-        time_column="event_time",
+    return _synthetic_columnar_segment(
+        adevents_schema(), ADEVENTS_TABLE, dict_values, num_rows, seed, name,
+        clustered_column="event_time", time_column="event_time", rng=rng,
     )
-    seg = ImmutableSegment(metadata=smeta, columns=columns)
-    smeta.crc = hash((name, num_rows, seed)) & 0xFFFFFFFF
-    return seg
 
 
 def tile_segments(distinct_segments, total: int):
@@ -381,3 +368,24 @@ def tile_segments(distinct_segments, total: int):
         smeta.crc = hash((smeta.segment_name, m.num_docs)) & 0xFFFFFFFF
         out.append(ImmutableSegment(metadata=smeta, columns=base.columns))
     return out
+
+
+def synthetic_baseball_segment(num_rows: int, seed: int = 7, name: str = "bb0"):
+    """Fast numpy-path baseballStats segment (quickstart config at bench
+    scale): same schema/cardinalities as ``baseball_rows``, built
+    columnar so 10M+ row segments construct in seconds."""
+    import numpy as np
+
+    dict_values = {
+        "playerName": sorted(f"{f} {l}" for f in _FIRST for l in _LAST),
+        "teamID": sorted(_TEAMS),
+        "league": sorted(_LEAGUES),
+        "yearID": np.arange(1980, 2016, dtype=np.int64),
+        "runs": np.arange(0, 141, dtype=np.int64),
+        "hits": np.arange(0, 326, dtype=np.int64),
+        "homeRuns": np.arange(0, 61, dtype=np.int64),
+        "atBats": np.arange(50, 651, dtype=np.int64),
+    }
+    return _synthetic_columnar_segment(
+        baseball_schema(), "baseballStats", dict_values, num_rows, seed, name
+    )
