@@ -486,6 +486,18 @@ class QueryExecutor:
                     plan, staged.num_segments, staged.n_pad
                 )
             return make_packed_table_kernel(plan)
+        from pinot_tpu.engine.kernel import chunk_rows_limit, make_chunked_sharded_kernel
+
+        if staged is not None:
+            # the per-DEVICE row budget binds on a mesh too; the factory
+            # falls back to the plain packed sharded kernel when
+            # chunking is off or unnecessary
+            return self._cached_sharded(
+                (plan, "mesh", staged.num_segments, staged.n_pad, chunk_rows_limit()),
+                lambda: make_chunked_sharded_kernel(
+                    plan, self.mesh, staged.num_segments, staged.n_pad
+                ),
+            )
         from pinot_tpu.engine.packing import make_packed_kernel
         from pinot_tpu.parallel.multichip import make_sharded_table_kernel
 

@@ -948,25 +948,30 @@ def make_chunked_table_kernel(plan: StaticPlan, num_segments: int, n_pad: int) -
     return _chunked_table_kernel(plan, num_segments, n_pad, chunk_rows_limit())
 
 
-@functools.lru_cache(maxsize=64)
-def _chunked_table_kernel(
-    plan: StaticPlan, num_segments: int, n_pad: int, limit: int
-) -> Callable:
+def _pick_chunk(num_segments: int, n_pad: int, limit: int, granularity: int = 1) -> int:
+    """Segments per dispatch under the row budget, in multiples of
+    ``granularity`` (the mesh device count on sharded paths).  Prefers
+    a divisor of num_segments (every dispatch then shares one compiled
+    shape) but never shrinks below half the budget chasing one — a
+    remainder-shaped trailing chunk costing one extra compile is
+    cheaper than collapsing to tiny dispatches on prime counts."""
     chunk = max(1, limit // max(n_pad, 1)) if limit else num_segments
-    # Prefer a divisor of num_segments: every dispatch then shares one
-    # shape and the table kernel compiles exactly once.  But never
-    # shrink below half the budget chasing a divisor (prime segment
-    # counts would collapse to 1-segment dispatches) — a remainder-
-    # shaped trailing chunk costing one extra compile is cheaper.
+    chunk = max(granularity, (chunk // granularity) * granularity)
     divisor = chunk
-    while divisor > max(1, chunk // 2) and num_segments % divisor:
-        divisor -= 1
-    if num_segments % divisor == 0:
+    while divisor > max(granularity, chunk // 2) and (
+        num_segments % divisor or divisor % granularity
+    ):
+        divisor -= granularity
+    if (
+        divisor >= max(granularity, chunk // 2)
+        and num_segments % divisor == 0
+        and divisor % granularity == 0
+    ):
         chunk = divisor
-    if not limit or num_segments <= chunk or not plan_chunkable(plan):
-        return make_table_kernel(plan)
-    table = make_table_kernel(plan)
-    reducers = output_reducers(plan)
+    return chunk
+
+
+def _chunked_run(table: Callable, reducers: Dict[str, str], num_segments: int, chunk: int) -> Callable:
     from pinot_tpu.engine.packing import make_packed_kernel
 
     # the combined outputs still fetch via ONE packed D2H transfer —
@@ -989,6 +994,42 @@ def _chunked_table_kernel(
         return pack(outs)
 
     return run
+
+
+@functools.lru_cache(maxsize=64)
+def _chunked_table_kernel(
+    plan: StaticPlan, num_segments: int, n_pad: int, limit: int
+) -> Callable:
+    chunk = _pick_chunk(num_segments, n_pad, limit)
+    if not limit or num_segments <= chunk or not plan_chunkable(plan):
+        return make_table_kernel(plan)
+    return _chunked_run(make_table_kernel(plan), output_reducers(plan), num_segments, chunk)
+
+
+def make_chunked_sharded_kernel(plan: StaticPlan, mesh, num_segments: int, n_pad: int):
+    """Mesh analog of ``make_chunked_table_kernel``: chunks the GLOBAL
+    segment axis in device-count multiples when the per-device row
+    share exceeds the dispatch budget, so pod-scale tables hit the same
+    capacity path the single chip does.  Returns the plain packed
+    sharded kernel when chunking is off or unnecessary."""
+    from pinot_tpu.engine.packing import make_packed_kernel
+    from pinot_tpu.parallel.multichip import make_sharded_table_kernel
+
+    limit = chunk_rows_limit()
+    n_dev = int(mesh.devices.size)
+    chunk = (
+        _pick_chunk(num_segments, n_pad, limit * n_dev, granularity=n_dev)
+        if limit
+        else num_segments
+    )
+    if not limit or num_segments <= chunk or not plan_chunkable(plan):
+        return make_packed_kernel(make_sharded_table_kernel(plan, mesh))
+    return _chunked_run(
+        make_sharded_table_kernel(plan, mesh),
+        output_reducers(plan),
+        num_segments,
+        chunk,
+    )
 
 @functools.lru_cache(maxsize=256)
 def make_packed_table_kernel(plan: StaticPlan) -> Callable:
