@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -113,10 +114,15 @@ def run(total_rows: int, rows_per_segment: int, distinct: int, iters: int,
     fetch(kernel(seg_arrays, q_inputs))  # compile
     compile_s = time.perf_counter() - t0
     _log(f"compiled ({compile_s:.0f}s); timing")
-    run_batch(3)
-    m_small, m_large = 3, 3 + iters
+    # beyond ~10s/query the 3-repeat marginal-batch protocol outlasts
+    # practical windows; one repeat of a leaner batch pair still
+    # subtracts the fixed dispatch RTT (PINOT_TPU_NS_FAST=1)
+    fast = os.environ.get("PINOT_TPU_NS_FAST") == "1"
+    repeats, warm = (1, 1) if fast else (3, 3)
+    run_batch(warm)
+    m_small, m_large = (1, 1 + max(iters, 1)) if fast else (3, 3 + iters)
     diffs = []
-    for _ in range(3):
+    for _ in range(repeats):
         t_large = run_batch(m_large)
         t_small = run_batch(m_small)
         diffs.append((t_large - t_small) / (m_large - m_small))
