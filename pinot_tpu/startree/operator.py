@@ -217,19 +217,55 @@ def execute_star_tree(segment: ImmutableSegment, request: BrokerRequest) -> Inte
         return res
 
     # group-by: keys from the dims matrix (real values — traversal never
-    # stars group-by dims), rendered via the segment dictionaries
+    # stars group-by dims), rendered via the segment dictionaries.
+    # States build VECTORIZED over the inverse index — a per-group
+    # boolean mask would re-scan all pre-agg rows per group (O(R x G),
+    # ~0.4 ms/group in Python at cube scale)
     glevels = [level_of[c] for c in group_cols]
     gdicts = [segment.column(c).dictionary for c in group_cols]
     key_matrix = tree.dims[rows][:, glevels] if rows.size else np.zeros((0, len(glevels)), np.int32)
     groups: Dict[Tuple[str, ...], list] = {}
     if rows.size:
         uniq, inverse = np.unique(key_matrix, axis=0, return_inverse=True)
-        for gi in range(uniq.shape[0]):
-            sel = inverse == gi
+        G = uniq.shape[0]
+        cnt_g = np.bincount(inverse, weights=counts, minlength=G)
+        order = boundaries = None  # lazily built for register merges
+        agg_states = []
+        for a in request.aggregations:
+            base = a.base_function
+            if base == "count":
+                agg_states.append(("count",))
+            elif base in ("distinctcounthll", "fasthll"):
+                if order is None:
+                    order = np.argsort(inverse, kind="stable")
+                    boundaries = np.searchsorted(inverse[order], np.arange(G))
+                # sorted reduceat, NOT ufunc.at (element-wise Python-loop
+                # speed — 3x slower than the per-group mask it replaced)
+                regs_g = np.maximum.reduceat(
+                    tree.hll_registers[a.column][rows][order], boundaries, axis=0
+                )
+                agg_states.append(("hll", regs_g))
+            else:
+                mi = tree.metric_columns.index(a.column)
+                sums_g = np.bincount(
+                    inverse, weights=tree.sums[rows, mi], minlength=G
+                )
+                agg_states.append(("sum" if base == "sum" else "avg", sums_g))
+        for gi in range(G):
             key = tuple(
                 render_value(gdicts[j].stored_type, gdicts[j].get(int(uniq[gi, j])))
                 for j in range(len(group_cols))
             )
-            groups[key] = [scalar_partial(a, sel) for a in request.aggregations]
+            parts = []
+            for st in agg_states:
+                if st[0] == "count":
+                    parts.append(CountPartial(float(cnt_g[gi])))
+                elif st[0] == "hll":
+                    parts.append(HllPartial(st[1][gi]))
+                elif st[0] == "sum":
+                    parts.append(SumPartial(float(st[1][gi])))
+                else:
+                    parts.append(AvgPartial(float(st[1][gi]), float(cnt_g[gi])))
+            groups[key] = parts
     res.groups = groups
     return res

@@ -234,3 +234,39 @@ def test_hll_in_star_tree(tmp_path):
     a = reduce_to_response(req, [execute_star_tree(loaded, req)]).to_json()
     b = oracle.execute(parse_pql("SELECT distinctcounthll(member) FROM sth")).to_json()
     assert a["aggregationResults"] == b["aggregationResults"]
+
+
+def test_adevents_hll_cube_groupby_matches_engine():
+    """The north-star HLL group-by answered from the star-tree cube
+    (campaign split, HLL(user_id) pre-agg): identical to the engine
+    path, independent of row count (NORTHSTAR_HLL.json startree
+    entry)."""
+    import json
+
+    from pinot_tpu.startree.builder import StarTreeBuilderConfig, build_star_tree
+    from pinot_tpu.tools.cluster_harness import single_server_broker
+    from pinot_tpu.tools.datagen import adevents_schema, synthetic_adevents_segment
+
+    segs = [
+        synthetic_adevents_segment(
+            60_000, seed=23 + i, name=f"ad{i}", user_card=5000, campaign_card=32
+        )
+        for i in range(2)
+    ]
+    cfg = StarTreeBuilderConfig(
+        split_order=["campaign_id", "site_id"],
+        hll_columns=["user_id"],
+        max_leaf_records=16,
+    )
+    for s in segs:
+        build_star_tree(s, adevents_schema(), cfg)
+    broker = single_server_broker("adevents", segs)
+    pql = "SELECT distinctcounthll(user_id), count(*) FROM adevents GROUP BY campaign_id TOP 5"
+    with_tree = broker.handle_pql(pql)
+    assert not with_tree.exceptions, with_tree.exceptions
+    assert with_tree.num_docs_scanned < 120_000  # pre-agg rows, not raw rows
+    for s in segs:
+        s.star_tree = None
+    engine = broker.handle_pql(pql)
+    assert json.dumps(with_tree.to_json()["aggregationResults"], sort_keys=True) == \
+        json.dumps(engine.to_json()["aggregationResults"], sort_keys=True)
