@@ -45,6 +45,34 @@ class StarTreeBuilderConfig:
     hll_columns: List[str] = field(default_factory=list)
 
 
+def group_max_rows(inverse: np.ndarray, num_groups: int, values: np.ndarray) -> np.ndarray:
+    """Per-group elementwise max of [R, M] ``values`` -> [G, M], via
+    sorted ``maximum.reduceat`` — ``np.maximum.at`` runs an elementwise
+    Python-speed loop, ~3x slower even at cube scale and far worse over
+    raw rows.  Shared by the tree build and the traversal operator."""
+    order = np.argsort(inverse, kind="stable")
+    bounds = np.searchsorted(inverse[order], np.arange(num_groups))
+    return np.maximum.reduceat(values[order], bounds, axis=0)
+
+
+def scatter_max_2d(
+    inverse: np.ndarray, num_groups: int, cols: np.ndarray, vals: np.ndarray, m: int
+) -> np.ndarray:
+    """out[g, cols[i]] = max(vals[i]) over rows with inverse[i] == g —
+    the raw-row register build (one (group, bucket) cell per row),
+    again via sort + reduceat instead of ``np.maximum.at``."""
+    keys = inverse.astype(np.int64) * m + cols
+    order = np.argsort(keys, kind="stable")
+    ks = keys[order]
+    vs = vals[order]
+    starts = np.nonzero(np.concatenate(([True], ks[1:] != ks[:-1])))[0]
+    maxes = np.maximum.reduceat(vs, starts)
+    uk = ks[starts]
+    out = np.zeros((num_groups, m), dtype=vals.dtype)
+    out[uk // m, uk % m] = maxes
+    return out
+
+
 def _aggregate(
     dims: np.ndarray, sums: np.ndarray, counts: np.ndarray, regs: Optional[Regs]
 ):
@@ -59,11 +87,9 @@ def _aggregate(
     agg_counts = np.bincount(inverse, weights=counts, minlength=uniq.shape[0]).astype(np.int64)
     agg_regs: Optional[Regs] = None
     if regs is not None:
-        agg_regs = {}
-        for col, r in regs.items():
-            out = np.zeros((uniq.shape[0], r.shape[1]), dtype=np.uint8)
-            np.maximum.at(out, inverse, r)
-            agg_regs[col] = out
+        agg_regs = {
+            col: group_max_rows(inverse, uniq.shape[0], r) for col, r in regs.items()
+        }
     return uniq.astype(np.int32), agg_sums, agg_counts, agg_regs
 
 
@@ -191,9 +217,9 @@ def build_star_tree(
                 b, r = hll_mod.bucket_and_rho(hll_mod.value_hash64(d.get(i)))
                 bucket[i], rho[i] = b, r
             fwd = segment.column(hcol).fwd
-            out = np.zeros((uniq.shape[0], hll_mod.M), dtype=np.uint8)
-            np.maximum.at(out, (inverse, bucket[fwd]), rho[fwd])
-            regs[hcol] = out
+            regs[hcol] = scatter_max_2d(
+                inverse, uniq.shape[0], bucket[fwd], rho[fwd], hll_mod.M
+            )
 
     dims, sums, counts = uniq.astype(np.int32), agg_sums, agg_counts
     dims, sums, counts, regs = _sort_lex(dims, sums, counts, regs, 0)
