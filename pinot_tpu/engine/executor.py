@@ -114,22 +114,13 @@ def _regs_from_gids(
     on host.  Without ``rows``: one uint8[HLL_M] register array.  With
     ``rows`` (same shape as ``gids``) and ``n_rows``: a batched
     uint8[n_rows, HLL_M] decode, one register array per row."""
+    from pinot_tpu.utils.npgroup import scatter_max_2d
+
     g = gids.astype(np.int64)
+    rho = (g & 63).astype(np.uint8)
     if rows is None:
-        regs = np.zeros(config.HLL_M, dtype=np.uint8)
-        np.maximum.at(regs, g >> 6, (g & 63).astype(np.uint8))
-        return regs
-    regs = np.zeros((n_rows, config.HLL_M), dtype=np.uint8)
-    np.maximum.at(regs, (rows, g >> 6), (g & 63).astype(np.uint8))
-    return regs
-
-
-def _global_hll_tables(ctx, column: str):
-    """(bucket, rho) uint8 tables for a column's GLOBAL dictionary
-    (dictionary_tables caches on the dictionary itself)."""
-    from pinot_tpu.engine import hll as hll_mod
-
-    return hll_mod.dictionary_tables(ctx.column(column).global_dict)
+        return scatter_max_2d(np.zeros(g.size, np.int64), 1, g >> 6, rho, config.HLL_M)[0]
+    return scatter_max_2d(rows, n_rows, g >> 6, rho, config.HLL_M)
 
 
 def _regs_from_value_gids(
@@ -138,17 +129,16 @@ def _regs_from_value_gids(
     """HLL registers from GLOBAL dictionary value ids (the
     hll_from_presence finalize: registers depend only on the distinct
     value set).  Batched like ``_regs_from_gids`` when ``rows`` given."""
-    bt, rt = _global_hll_tables(ctx, column)
+    from pinot_tpu.engine import hll as hll_mod
+    from pinot_tpu.utils.npgroup import scatter_max_2d
+
+    bt, rt = hll_mod.dictionary_tables(ctx.column(column).global_dict)
     g = np.asarray(gids, dtype=np.int64)
     ok = g < bt.size  # padded/overflow slots carry no value
     g = g[ok]
     if rows is None:
-        regs = np.zeros(config.HLL_M, dtype=np.uint8)
-        np.maximum.at(regs, bt[g], rt[g])
-        return regs
-    regs = np.zeros((n_rows, config.HLL_M), dtype=np.uint8)
-    np.maximum.at(regs, (np.asarray(rows)[ok], bt[g]), rt[g])
-    return regs
+        return scatter_max_2d(np.zeros(g.size, np.int64), 1, bt[g], rt[g], config.HLL_M)[0]
+    return scatter_max_2d(np.asarray(rows)[ok], n_rows, bt[g], rt[g], config.HLL_M)
 
 
 def _hist_partial(gdict, gids, cnts, p: int) -> "HistogramPartial":
@@ -461,7 +451,7 @@ class QueryExecutor:
             ),
         )
 
-    def _kernel(self, plan: StaticPlan, staged=None):
+    def _kernel(self, plan: StaticPlan, staged):
         if self.mesh is None:
             from pinot_tpu.engine.kernel import (
                 chunk_rows_limit,
@@ -472,8 +462,7 @@ class QueryExecutor:
 
             limit = chunk_rows_limit()
             if (
-                staged is not None
-                and limit
+                limit
                 and staged.num_segments * staged.n_pad > limit
                 and plan_chunkable(plan)
             ):
@@ -488,22 +477,14 @@ class QueryExecutor:
             return make_packed_table_kernel(plan)
         from pinot_tpu.engine.kernel import chunk_rows_limit, make_chunked_sharded_kernel
 
-        if staged is not None:
-            # the per-DEVICE row budget binds on a mesh too; the factory
-            # falls back to the plain packed sharded kernel when
-            # chunking is off or unnecessary
-            return self._cached_sharded(
-                (plan, "mesh", staged.num_segments, staged.n_pad, chunk_rows_limit()),
-                lambda: make_chunked_sharded_kernel(
-                    plan, self.mesh, staged.num_segments, staged.n_pad
-                ),
-            )
-        from pinot_tpu.engine.packing import make_packed_kernel
-        from pinot_tpu.parallel.multichip import make_sharded_table_kernel
-
+        # the per-DEVICE row budget binds on a mesh too; the factory
+        # falls back to the plain packed sharded kernel when chunking
+        # is off or unnecessary
         return self._cached_sharded(
-            plan,
-            lambda: make_packed_kernel(make_sharded_table_kernel(plan, self.mesh)),
+            (plan, "mesh", staged.num_segments, staged.n_pad, chunk_rows_limit()),
+            lambda: make_chunked_sharded_kernel(
+                plan, self.mesh, staged.num_segments, staged.n_pad
+            ),
         )
 
     # ------------------------------------------------------------------

@@ -30,6 +30,7 @@ from pinot_tpu.common.schema import FieldType, Schema
 from pinot_tpu.engine import hll as hll_mod
 from pinot_tpu.segment.immutable import ImmutableSegment
 from pinot_tpu.startree.index import STAR, StarTreeIndex, StarTreeNode
+from pinot_tpu.utils.npgroup import group_max_rows, scatter_max_2d
 
 Regs = Dict[str, np.ndarray]  # column -> uint8 [n, 256]
 
@@ -43,34 +44,6 @@ class StarTreeBuilderConfig:
     max_leaf_records: int = 10_000
     skip_star_for_dims: List[str] = field(default_factory=list)
     hll_columns: List[str] = field(default_factory=list)
-
-
-def group_max_rows(inverse: np.ndarray, num_groups: int, values: np.ndarray) -> np.ndarray:
-    """Per-group elementwise max of [R, M] ``values`` -> [G, M], via
-    sorted ``maximum.reduceat`` — ``np.maximum.at`` runs an elementwise
-    Python-speed loop, ~3x slower even at cube scale and far worse over
-    raw rows.  Shared by the tree build and the traversal operator."""
-    order = np.argsort(inverse, kind="stable")
-    bounds = np.searchsorted(inverse[order], np.arange(num_groups))
-    return np.maximum.reduceat(values[order], bounds, axis=0)
-
-
-def scatter_max_2d(
-    inverse: np.ndarray, num_groups: int, cols: np.ndarray, vals: np.ndarray, m: int
-) -> np.ndarray:
-    """out[g, cols[i]] = max(vals[i]) over rows with inverse[i] == g —
-    the raw-row register build (one (group, bucket) cell per row),
-    again via sort + reduceat instead of ``np.maximum.at``."""
-    keys = inverse.astype(np.int64) * m + cols
-    order = np.argsort(keys, kind="stable")
-    ks = keys[order]
-    vs = vals[order]
-    starts = np.nonzero(np.concatenate(([True], ks[1:] != ks[:-1])))[0]
-    maxes = np.maximum.reduceat(vs, starts)
-    uk = ks[starts]
-    out = np.zeros((num_groups, m), dtype=vals.dtype)
-    out[uk // m, uk % m] = maxes
-    return out
 
 
 def _aggregate(

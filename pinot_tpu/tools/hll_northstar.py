@@ -36,8 +36,6 @@ HLL_PQL = (
 
 
 def staged_nbytes(staged) -> int:
-    import jax
-
     total = 0
     for sc in staged.columns.values():
         for arr in (sc.fwd, sc.mv, sc.mv_counts, sc.dict_vals, sc.raw, sc.gfwd,
