@@ -11,7 +11,13 @@ import numpy as np
 
 
 def group_max_rows(inverse: np.ndarray, num_groups: int, values: np.ndarray) -> np.ndarray:
-    """Per-group elementwise max of [R, M] ``values`` -> [G, M]."""
+    """Per-group elementwise max of [R, M] ``values`` -> [G, M].
+
+    Contract: every group in [0, num_groups) has >= 1 row (callers pass
+    ``inverse`` from ``np.unique(..., return_inverse=True)``, which
+    guarantees it) — ``reduceat`` over an empty segment would return
+    the boundary element, not an identity.  ``scatter_max_2d`` below
+    has no such restriction."""
     order = np.argsort(inverse, kind="stable")
     bounds = np.searchsorted(inverse[order], np.arange(num_groups))
     return np.maximum.reduceat(values[order], bounds, axis=0)

@@ -63,3 +63,35 @@ def test_packed_f64_under_x64():
     got = make_packed_kernel(fn)(jnp.asarray(x))
     assert got["d"].dtype == np.float64
     np.testing.assert_allclose(got["d"], x / 3.0)
+
+
+def test_npgroup_matches_ufunc_at():
+    """utils/npgroup sorted-reduceat primitives are drop-in equivalents
+    of np.maximum.at (property check over random shapes)."""
+    import numpy as np
+
+    from pinot_tpu.utils.npgroup import group_max_rows, scatter_max_2d
+
+    rng = np.random.default_rng(7)
+    for _ in range(5):
+        R, G, M = int(rng.integers(1, 400)), int(rng.integers(1, 12)), 16
+        inverse = rng.integers(0, G, R)
+        vals2d = rng.integers(0, 60, (R, M)).astype(np.uint8)
+        want = np.zeros((G, M), np.uint8)
+        np.maximum.at(want, inverse, vals2d)
+        # group_max_rows only defined for groups with >=1 row: compare
+        # on non-empty groups
+        got = group_max_rows(inverse, G, vals2d)
+        present = np.unique(inverse)
+        np.testing.assert_array_equal(got[present], want[present])
+
+        cols = rng.integers(0, M, R)
+        vals = rng.integers(0, 60, R).astype(np.uint8)
+        want2 = np.zeros((G, M), np.uint8)
+        np.maximum.at(want2, (inverse, cols), vals)
+        np.testing.assert_array_equal(scatter_max_2d(inverse, G, cols, vals, M), want2)
+    # empty input
+    np.testing.assert_array_equal(
+        scatter_max_2d(np.zeros(0, np.int64), 3, np.zeros(0, np.int64), np.zeros(0, np.uint8), 4),
+        np.zeros((3, 4), np.uint8),
+    )
