@@ -42,31 +42,32 @@ class DataType(str, Enum):
     DOUBLE_ARRAY = "DOUBLE_ARRAY"
     STRING_ARRAY = "STRING_ARRAY"
 
+    # These derivations are pure functions of the member, but as plain
+    # properties they re-run string/enum machinery on EVERY call — and
+    # the ingest path calls them per row-column (~14 calls/row), where
+    # they dominated the profile.  Computed once per member below the
+    # class body and served from per-member attributes.
     @property
     def is_single_value(self) -> bool:
-        return not self.name.endswith("_ARRAY")
+        return self._is_sv
 
     @property
     def element_type(self) -> "DataType":
         """The scalar type of this (possibly multi-value) type."""
-        if self.is_single_value:
-            return self
-        return DataType(self.name[: -len("_ARRAY")])
+        return self._elem
 
     @property
     def is_numeric(self) -> bool:
-        return self.element_type in (DataType.INT, DataType.LONG, DataType.FLOAT, DataType.DOUBLE)
+        return self._is_num
 
     @property
     def is_integer(self) -> bool:
-        return self.element_type in (DataType.INT, DataType.LONG)
+        return self._is_int
 
     @property
     def stored_type(self) -> "DataType":
         """BOOLEAN is stored as STRING (FieldSpec.java:210)."""
-        if self.element_type == DataType.BOOLEAN:
-            return DataType.STRING
-        return self.element_type
+        return self._stored
 
     def to_numpy(self) -> np.dtype:
         return {
@@ -98,6 +99,14 @@ class DataType(str, Enum):
         if t == DataType.FLOAT:
             return float(np.float32(v))
         return v
+
+
+for _m in DataType:
+    _m._is_sv = not _m.name.endswith("_ARRAY")
+    _m._elem = _m if _m._is_sv else DataType(_m.name[: -len("_ARRAY")])
+    _m._stored = DataType.STRING if _m._elem == DataType.BOOLEAN else _m._elem
+    _m._is_num = _m._elem in (DataType.INT, DataType.LONG, DataType.FLOAT, DataType.DOUBLE)
+    _m._is_int = _m._elem in (DataType.INT, DataType.LONG)
 
 
 class FieldType(str, Enum):

@@ -72,9 +72,45 @@ class _MutableColumn:
         id_of = self._id_of
         default_id = None
         if self.single:
+            vals = [row.get(name) for row in rows]
+            if st.is_numeric and None not in vals:
+                # Vectorized fast path (the ingest hot loop): one numpy
+                # conversion + unique, then one id_of per UNIQUE value.
+                # np.asarray enforces the same semantics as convert()
+                # (int truncation, float32 rounding for FLOAT) and
+                # raises on junk BEFORE any dictionary mutation; mixed/
+                # stringy payloads fall back to the per-value loop.
+                try:
+                    arr = np.asarray(vals, dtype=st.to_numpy())
+                except (TypeError, ValueError, OverflowError):
+                    arr = None
+                if arr is not None and arr.ndim != 1:
+                    # nested-list values build a 2-D array that would
+                    # pass encode and blow up in commit_batch AFTER
+                    # other columns committed — the per-value loop
+                    # raises in the safe encode phase instead
+                    arr = None
+                if arr is not None and arr.dtype.kind == "f" and np.isnan(arr).any():
+                    # np.unique collapses NaNs to one dictId while the
+                    # fallback's dict keying gives each NaN its own —
+                    # keep one (the historical) behavior regardless of
+                    # which path a batch happens to take
+                    arr = None
+                if arr is not None:
+                    uniq, inverse = np.unique(arr, return_inverse=True)
+                    lut = np.empty(uniq.size, dtype=np.int32)
+                    for ui in range(uniq.size):
+                        lut[ui] = id_of(uniq[ui].item())
+                    return lut[inverse].astype(np.int32)
+            elif all(type(v) is str for v in vals):
+                # STRING columns from JSON payloads arrive as str:
+                # convert() would be an identity per value — skip it
+                out = np.empty(len(vals), dtype=np.int32)
+                for j, v in enumerate(vals):
+                    out[j] = id_of(v)
+                return out
             out = np.empty(len(rows), dtype=np.int32)
-            for j, row in enumerate(rows):
-                v = row.get(name)
+            for j, v in enumerate(vals):
                 if v is None:
                     if default_id is None:
                         default_id = id_of(conv(self.spec.get_default_null_value()))

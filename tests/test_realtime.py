@@ -327,3 +327,47 @@ def test_flaky_consumer_ingests_exactly_once(tmp_path):
         )
         assert info["metadata"].custom["startOffset"] == end
         end = info["metadata"].custom["endOffset"]
+
+
+def test_index_batch_nested_list_sv_value_is_atomic():
+    """Regression: equal-length LIST values in an SV numeric column
+    build a 2-D array that must be rejected in the ENCODE phase (the
+    vectorized fast path), not explode in commit after other columns
+    already mutated."""
+    import pytest
+
+    from pinot_tpu.common.schema import DataType, FieldSpec, FieldType, Schema
+
+    schema = Schema(
+        "t",
+        dimensions=[
+            FieldSpec("mv", DataType.INT_ARRAY, single_value=False),
+            FieldSpec("a", DataType.INT),
+        ],
+    )
+    seg = MutableSegment(schema, "nested", "t")
+    with pytest.raises(Exception):
+        seg.index_batch([{"mv": [1], "a": [1, 2]}, {"mv": [2], "a": [3, 4]}])
+    assert seg.num_docs == 0
+    seg.index_batch([{"mv": [9], "a": 7}])
+    snap = seg.snapshot()
+    assert snap.row(0) == {"mv": [9], "a": 7}
+
+
+def test_index_batch_nan_dict_cardinality_stable():
+    """Regression: NaN ingest must key the dictionary identically
+    whether a batch takes the vectorized or the per-value path."""
+    from pinot_tpu.common.schema import DataType, FieldSpec, FieldType, Schema
+
+    schema = Schema(
+        "t", metrics=[FieldSpec("m", DataType.DOUBLE, FieldType.METRIC)]
+    )
+    nan = float("nan")
+    seg_fast = MutableSegment(schema, "f", "t")
+    seg_fast.index_batch([{"m": nan}, {"m": nan}])  # no None: fast path eligible
+    seg_slow = MutableSegment(schema, "s", "t")
+    seg_slow.index_batch([{"m": nan}, {"m": nan}, {"m": None}])  # fallback loop
+    card_fast = len(seg_fast._columns["m"].id_to_value)
+    ids_slow = seg_slow._columns["m"].ids[:2].tolist()
+    # both paths must key the two NaNs the same way
+    assert card_fast == len(set(ids_slow))
