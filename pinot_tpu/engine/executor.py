@@ -250,18 +250,14 @@ class QueryExecutor:
         # selection outputs read base arrays, so those columns keep them.
         skip_base: set = set()
         if not request.is_selection:
-            filter_cols = set()
-
-            def _walk(t):
-                if t is None:
-                    return
-                if t.is_leaf:
-                    filter_cols.add(t.column)
-                else:
-                    for c in t.children:
-                        _walk(c)
-
-            _walk(request.filter)
+            # filter leaves need base arrays on device — EXCEPT leaves
+            # whose every use classifies docrange (the kernel compares
+            # row ids against host-computed bounds, reading no column)
+            filter_cols = (
+                {n.column for n in request.filter.walk() if n.is_leaf}
+                if request.filter is not None
+                else set()
+            ) - self._docrange_qualifying_cols(request, live)
             from pinot_tpu.engine.plan import _agg_kind
 
             # scalar/pair agg inputs OUTSIDE raw_cols (small dictionaries)
@@ -300,6 +296,15 @@ class QueryExecutor:
         q_inputs = self._to_device_inputs(q_np, plan=plan)
         seg_arrays = segment_arrays(staged, needed)
         block_ids, scanned_rows = self._block_skip_ids(plan, q_np, live, staged)
+        from pinot_tpu.engine.kernel import chunk_rows_limit
+
+        _limit = chunk_rows_limit()
+        if block_ids is not None and _limit and staged.num_segments * staged.n_pad > _limit:
+            # the block kernel has no segment-chunked variant: beyond the
+            # per-dispatch row budget its single dispatch would exhaust
+            # HBM at compile time — fall through to the chunked full
+            # kernel instead (correctness over the block-skip win)
+            block_ids = None
         t0 = self._phase("planBuild", t0)
         # kernels return host numpy via ONE packed D2H transfer
         # (engine/packing.py): per-leaf fetches pay a tunnel RTT each
@@ -348,43 +353,47 @@ class QueryExecutor:
     ) -> set:
         """Filter columns whose every use qualifies for the docrange
         fast path (plan.py StaticLeaf) and which appear nowhere else in
-        the query.  MUST mirror build_static_plan's classification: a
-        dropped column whose leaf does NOT classify docrange would leave
-        the kernel without its arrays."""
-        if request.filter is None:
-            return set()
-        from pinot_tpu.common.request import FilterOperator
-
-        qualifies: Dict[str, bool] = {}
-
-        def walk(node) -> None:
-            if node.is_leaf:
-                col = node.column
-                ok = False
-                if live and live[0].has_column(col):
-                    meta0 = live[0].column(col).metadata
-                    shape_ok = node.operator == FilterOperator.RANGE or (
-                        node.operator == FilterOperator.EQUALITY
-                        and len(node.values) == 1
-                    )
-                    ok = (
-                        meta0.single_value
-                        and shape_ok
-                        and all(s.column(col).metadata.is_sorted for s in live)
-                    )
-                qualifies[col] = qualifies.get(col, True) and ok
-                return
-            for c in node.children:
-                walk(c)
-
-        walk(request.filter)
+        the query."""
+        qualifying = self._docrange_qualifying_cols(request, live)
         used_elsewhere = {a.column for a in request.aggregations}
         if request.is_group_by:
             used_elsewhere.update(request.group_by.columns)
         if request.is_selection:
             used_elsewhere.update(sel_columns or [])
             used_elsewhere.update(s.column for s in request.selection.sorts)
-        return {c for c, ok in qualifies.items() if ok and c not in used_elsewhere}
+        return qualifying - used_elsewhere
+
+    def _docrange_qualifying_cols(
+        self, request: BrokerRequest, live: List[ImmutableSegment]
+    ) -> set:
+        """Filter columns whose EVERY leaf use classifies docrange
+        (sorted in every segment, SV, RANGE or single-value EQ).  MUST
+        mirror build_static_plan's classification: a column dropped or
+        base-skipped on a wrong prediction would leave the kernel
+        without its arrays."""
+        if request.filter is None:
+            return set()
+        from pinot_tpu.common.request import FilterOperator
+
+        qualifies: Dict[str, bool] = {}
+        for node in request.filter.walk():
+            if not node.is_leaf:
+                continue
+            col = node.column
+            ok = False
+            if live and live[0].has_column(col):
+                meta0 = live[0].column(col).metadata
+                shape_ok = node.operator == FilterOperator.RANGE or (
+                    node.operator == FilterOperator.EQUALITY
+                    and len(node.values) == 1
+                )
+                ok = (
+                    meta0.single_value
+                    and shape_ok
+                    and all(s.column(col).metadata.is_sorted for s in live)
+                )
+            qualifies[col] = qualifies.get(col, True) and ok
+        return {c for c, ok in qualifies.items() if ok}
 
     def _block_skip_ids(
         self,

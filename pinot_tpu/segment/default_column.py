@@ -82,15 +82,22 @@ def inject_default_columns(
     # patch via copy + atomic swap: live queries may be iterating the
     # column dict on another thread (dict insert during iteration raises)
     columns = dict(segment.columns)
+    meta_columns = dict(segment.metadata.columns)
     for spec in schema.all_fields():
         if spec.name in columns:
             continue
         if spec.name == schema.time_column_name:
             continue
-        columns[spec.name] = make_default_column(spec, segment.num_docs)
+        col = make_default_column(spec, segment.num_docs)
+        columns[spec.name] = col
+        # metadata stays consistent with the live column set — the
+        # reference's handler updates metadata.properties the same way;
+        # converters/persistence iterate metadata.columns
+        meta_columns[spec.name] = col.metadata
         injected += 1
     if injected:
         segment.columns = columns
+        segment.metadata.columns = meta_columns
     if injected:
         logger.info(
             "injected %d default column(s) into %s", injected, segment.segment_name

@@ -242,7 +242,11 @@ class InvertedIndex:
 # ---------------------------------------------------------------- budget
 _budget_lock = threading.Lock()
 _postings_bytes = 0
-_REFUSED = object()  # cache sentinel: build refused, don't retry per query
+# Refusals are epoch-stamped, not permanent: a build refused during a
+# budget spike retries once bytes have been RELEASED since (each
+# release_postings bumps the epoch).  The cache stores ("refused",
+# epoch) tuples.
+_release_epoch = 0
 
 
 def _budget_bytes() -> int:
@@ -272,61 +276,73 @@ def inverted_index(seg: ImmutableSegment, column: str) -> Optional[InvertedIndex
     col = seg.columns.get(column)
     if col is None:
         return None
-    cache = getattr(seg, "_inv_cache", None)
-    if cache is None:
-        cache = {}
-        object.__setattr__(seg, "_inv_cache", cache)
-    idx = cache.get(column)
-    if idx is _REFUSED:
+    with _budget_lock:
+        cache = getattr(seg, "_inv_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(seg, "_inv_cache", cache)
+        idx = cache.get(column)
+        if isinstance(idx, tuple):  # ("refused", epoch)
+            if idx[1] == _release_epoch:
+                return None  # nothing released since: don't retry per query
+            cache.pop(column, None)
+            idx = None
+        if isinstance(idx, InvertedIndex):
+            return idx
+    card = col.dictionary.cardinality
+    if card <= 0:
         return None
-    if idx is None:
-        card = col.dictionary.cardinality
-        if card <= 0:
+    if col.metadata.single_value:
+        if col.fwd is None:
             return None
-        if col.metadata.single_value:
-            if col.fwd is None:
-                return None
-            idx = InvertedIndex.build_sv(
-                np.asarray(col.fwd), card, _compress_enabled()
+        built = InvertedIndex.build_sv(np.asarray(col.fwd), card, _compress_enabled())
+    else:
+        built = InvertedIndex.build_mv(
+            np.asarray(col.mv_values),
+            np.asarray(col.mv_offsets),
+            card,
+            _compress_enabled(),
+        )
+    with _budget_lock:
+        # re-check under the lock: a concurrent query may have built and
+        # ACCOUNTED the same index; double-accounting would permanently
+        # inflate the budget and eventually refuse all builds
+        existing = cache.get(column)
+        if isinstance(existing, InvertedIndex):
+            return existing
+        if _postings_bytes + built.nbytes > _budget_bytes():
+            cache[column] = ("refused", _release_epoch)
+            logger.warning(
+                "postings budget exhausted (%d + %d > %d bytes): %s.%s "
+                "falls back to zone-map/scan paths "
+                "(raise PINOT_TPU_INVINDEX_BUDGET_BYTES to index more)",
+                _postings_bytes,
+                built.nbytes,
+                _budget_bytes(),
+                seg.segment_name,
+                column,
             )
-        else:
-            idx = InvertedIndex.build_mv(
-                np.asarray(col.mv_values),
-                np.asarray(col.mv_offsets),
-                card,
-                _compress_enabled(),
-            )
-        with _budget_lock:
-            if _postings_bytes + idx.nbytes > _budget_bytes():
-                cache[column] = _REFUSED
-                logger.warning(
-                    "postings budget exhausted (%d + %d > %d bytes): %s.%s "
-                    "falls back to zone-map/scan paths "
-                    "(raise PINOT_TPU_INVINDEX_BUDGET_BYTES to index more)",
-                    _postings_bytes,
-                    idx.nbytes,
-                    _budget_bytes(),
-                    seg.segment_name,
-                    column,
-                )
-                return None
-            _postings_bytes += idx.nbytes
-        cache[column] = idx
-    return idx
+            return None
+        _postings_bytes += built.nbytes
+        cache[column] = built
+    return built
 
 
 def release_postings(seg: ImmutableSegment) -> None:
-    """Return a segment's postings bytes to the budget (segment unload)."""
-    global _postings_bytes
+    """Return a segment's postings bytes to the budget (segment unload).
+    Bumps the release epoch so budget refusals elsewhere re-evaluate."""
+    global _postings_bytes, _release_epoch
     cache = getattr(seg, "_inv_cache", None)
     if not cache:
         return
-    freed = sum(
-        idx.nbytes for idx in cache.values() if isinstance(idx, InvertedIndex)
-    )
-    cache.clear()
     with _budget_lock:
+        freed = sum(
+            idx.nbytes for idx in cache.values() if isinstance(idx, InvertedIndex)
+        )
+        cache.clear()
         _postings_bytes = max(0, _postings_bytes - freed)
+        if freed:
+            _release_epoch += 1
 
 
 def warm_inverted_indexes(seg: ImmutableSegment, columns) -> None:

@@ -62,6 +62,9 @@ def test_inject_skips_existing_and_time():
     grown = _grown_schema(schema)
     assert inject_default_columns(seg, grown) == 3
     assert seg.has_column("newDim") and seg.has_column("newMet")
+    # metadata stays consistent with the live column set (converters
+    # and persistence iterate metadata.columns)
+    assert "newDim" in seg.metadata.columns and "newMet" in seg.metadata.columns
     # idempotent; never resynthesizes present columns or the time column
     assert inject_default_columns(seg, grown) == 0
     # a schema whose time column is absent from the segment: not injected

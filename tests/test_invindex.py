@@ -261,7 +261,10 @@ def test_postings_budget_refusal_and_release(monkeypatch):
     monkeypatch.setenv("PINOT_TPU_INVINDEX_BUDGET_BYTES", "64")  # tiny
     assert inverted_index(seg, "l_extendedprice") is None
     cache = getattr(seg, "_inv_cache")
-    assert cache["l_extendedprice"] is ii._REFUSED  # no per-query rebuild
+    refusal = cache["l_extendedprice"]
+    assert refusal[0] == "refused"  # cached: no per-query rebuild
+    assert inverted_index(seg, "l_extendedprice") is None
+    assert cache["l_extendedprice"] is refusal  # same epoch: not retried
 
     seg2 = synthetic_lineitem_segment(3000, seed=32, name="bud1")
     monkeypatch.setenv("PINOT_TPU_INVINDEX_BUDGET_BYTES", str(64 << 20))
@@ -271,3 +274,7 @@ def test_postings_budget_refusal_and_release(monkeypatch):
     sdm = SegmentDataManager(seg2)
     assert sdm.release() == 0  # owner ref dropped -> postings freed
     assert ii.postings_bytes_in_use() == 0
+
+    # the release bumped the epoch: the earlier refusal re-evaluates and
+    # (budget is now ample) the index builds
+    assert inverted_index(seg, "l_extendedprice") is not None

@@ -82,7 +82,9 @@ def main() -> int:
             if os.path.exists(out_path):
                 with open(out_path) as f:
                     caps = json.load(f)
-            caps["runs"].append(
+            # an existing file without a runs list must not crash the
+            # append AFTER the expensive bench run succeeded
+            caps.setdefault("runs", []).append(
                 {
                     "when": f"{datetime.datetime.now():%Y-%m-%d %H:%M:%S} (auto_recapture)",
                     "result": result,
