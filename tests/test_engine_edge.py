@@ -305,3 +305,31 @@ def test_host_fallback_vectorized_distinct_matches_oracle():
                 json.dumps(want.to_json()["aggregationResults"], sort_keys=True), pql
     finally:
         _config.MAX_GROUP_CAPACITY = saved
+
+
+def test_docrange_filter_on_group_column_skips_base_correctly():
+    """Regression for the skip_base x docrange interplay: a sorted
+    column filtered by RANGE and ALSO used as the group key stages only
+    its gfwd stream (base fwd/dict skipped), the leaf resolves via doc
+    bounds, and results match the oracle."""
+    import json
+
+    from pinot_tpu.engine.executor import QueryExecutor
+    from pinot_tpu.engine.reduce import reduce_to_response
+    from pinot_tpu.pql import optimize_request, parse_pql
+    from pinot_tpu.tools.datagen import lineitem_schema, synthetic_lineitem_segment
+    from pinot_tpu.tools.scan_engine import ScanQueryProcessor
+
+    segs = [synthetic_lineitem_segment(5000, seed=81 + i, name=f"dr{i}") for i in range(2)]
+    oracle = ScanQueryProcessor(lineitem_schema(), [r for s in segs for r in s.rows()])
+    # l_shipdate is sorted in every synthetic segment -> docrange leaf;
+    # grouping by the same column forces the gfwd role stream
+    pql = (
+        "SELECT count(*), sum(l_quantity) FROM lineitem "
+        "WHERE l_shipdate >= '1995-01-01' GROUP BY l_shipdate TOP 7"
+    )
+    req = optimize_request(parse_pql(pql))
+    got = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
+    want = oracle.execute(parse_pql(pql))
+    assert json.dumps(got.to_json()["aggregationResults"], sort_keys=True) == \
+        json.dumps(want.to_json()["aggregationResults"], sort_keys=True)
