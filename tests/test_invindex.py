@@ -278,3 +278,32 @@ def test_postings_budget_refusal_and_release(monkeypatch):
     # the release bumped the epoch: the earlier refusal re-evaluates and
     # (budget is now ample) the index builds
     assert inverted_index(seg, "l_extendedprice") is not None
+
+
+def test_concurrent_index_builds_account_once(monkeypatch):
+    """Race regression: concurrent cold builds of the same (segment,
+    column) must account postings bytes exactly once — double-counting
+    would eventually refuse all future builds."""
+    import threading
+
+    from pinot_tpu.segment import invindex as ii
+
+    seg = synthetic_lineitem_segment(20000, seed=44, name="race0")
+    monkeypatch.setattr(ii, "_postings_bytes", 0)
+    monkeypatch.setenv("PINOT_TPU_INVINDEX_BUDGET_BYTES", str(64 << 20))
+    results = []
+    barrier = threading.Barrier(8)
+
+    def hit():
+        barrier.wait()
+        results.append(inverted_index(seg, "l_extendedprice"))
+
+    threads = [threading.Thread(target=hit) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r is not None for r in results)
+    cached = getattr(seg, "_inv_cache")["l_extendedprice"]
+    assert all(r is cached for r in results)  # one winning index
+    assert ii.postings_bytes_in_use() == cached.nbytes  # accounted ONCE
