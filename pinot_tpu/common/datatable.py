@@ -198,8 +198,8 @@ def _write_partial(w: _Writer, p: AggPartial) -> None:
         w.f64(p.mn)
         w.f64(p.mx)
     elif isinstance(p, DistinctPartial):
-        w.i64(len(p.values))
-        for v in sorted(p.values, key=repr):
+        w.i64(p.finalize())
+        for v in p.iter_sorted():
             w.value(v)
     elif isinstance(p, HllPartial):
         w.blob(p.registers.tobytes())

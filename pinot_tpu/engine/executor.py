@@ -701,7 +701,9 @@ class QueryExecutor:
                 ids = np.nonzero(np.asarray(state))[0]
             if agg.hll_from_presence:
                 return HllPartial(_regs_from_value_gids(ctx, agg.column, ids))
-            return DistinctPartial({gdict.get(int(i)) for i in ids if i < gdict.cardinality})
+            ids = np.asarray(ids, dtype=np.int64)
+            ids = ids[ids < gdict.cardinality]
+            return DistinctPartial(gdict.value_array()[ids])
         if agg.kind == "hist":
             gdict = ctx.column(agg.column).global_dict
             p = int(base[len("percentileest"):]) if base.startswith("percentileest") else int(base[len("percentile"):])
@@ -858,7 +860,9 @@ class QueryExecutor:
                 ids = np.nonzero(row)[0]
             if agg.hll_from_presence:
                 return HllPartial(_regs_from_value_gids(ctx, agg.column, ids))
-            return DistinctPartial({gdict.get(int(i)) for i in ids if i < gdict.cardinality})
+            ids = np.asarray(ids, dtype=np.int64)
+            ids = ids[ids < gdict.cardinality]
+            return DistinctPartial(gdict.value_array()[ids])
         if agg.kind == "hist":
             gdict = ctx.column(agg.column).global_dict
             p = int(base[len("percentileest"):]) if base.startswith("percentileest") else int(base[len("percentile"):])

@@ -246,9 +246,25 @@ def _groupby_vectorized(
     if not all_keys:
         return
     keys = np.concatenate(all_keys)
-    uniq, inv = np.unique(keys, return_inverse=True)
-    k = uniq.size
-    counts = np.bincount(inv, minlength=k).astype(np.float64)
+    space = 1
+    for g in gcards:
+        space *= g
+    if space <= (1 << 24):
+        # small key space (sort-pairs overflow fallbacks group by a
+        # low-card column): factorize with ONE bincount + rank gather
+        # instead of np.unique's 134M-row argsort + cumsum (~30s saved
+        # at north-star scale)
+        present = np.bincount(keys, minlength=space)
+        uniq = np.flatnonzero(present).astype(np.int64)
+        rank = np.zeros(space, dtype=np.int64)
+        rank[uniq] = np.arange(uniq.size, dtype=np.int64)
+        inv = rank[keys]
+        counts = present[uniq].astype(np.float64)
+        k = uniq.size
+    else:
+        uniq, inv = np.unique(keys, return_inverse=True)
+        k = uniq.size
+        counts = np.bincount(inv, minlength=k).astype(np.float64)
 
     # per-agg finalized state arrays, each [k]
     order = None  # lazily computed stable sort of inv, for reduceat
@@ -275,8 +291,14 @@ def _groupby_vectorized(
     def distinct_pairs(c: str):
         if c not in distinct_cache:
             gc = max(ctx.column(c).global_cardinality, 1)
-            gid = np.concatenate(col_gids[c]).astype(np.int64)
-            pair = np.unique(inv.astype(np.int64) * gc + gid)
+            gid = np.concatenate(col_gids[c])
+            if k * gc < (1 << 31):
+                # int32 packed pairs sort ~2x faster than int64
+                pair = np.unique(
+                    inv.astype(np.int32) * np.int32(gc) + gid.astype(np.int32)
+                ).astype(np.int64)
+            else:
+                pair = np.unique(inv.astype(np.int64) * gc + gid.astype(np.int64))
             pg = (pair // gc).astype(np.int64)  # sorted: per-group slices
             pgid = pair % gc
             dcounts = np.bincount(pg, minlength=k).astype(np.float64)
@@ -362,11 +384,10 @@ def _groupby_vectorized(
             _, c, pgid, bounds = state
             gdict = ctx.column(c).global_dict
             ids = pgid[bounds[i] : bounds[i + 1]]
-            if gdict.is_string:
-                vals = {gdict.get(int(g)) for g in ids}
-            else:
-                vals = set(np.asarray(gdict.values)[ids].tolist())
-            return DistinctPartial(vals)
+            # pair-dedup'd gids are already unique; one vectorized gather
+            # replaces the per-value Python set build (north-star groups
+            # carry millions of distinct values each)
+            return DistinctPartial(gdict.value_array()[ids])
         if kind == "hll":
             from pinot_tpu.engine import hll as hll_mod
 

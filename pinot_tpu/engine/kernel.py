@@ -45,13 +45,21 @@ MATMUL_GROUP_CAP = int(_os.environ.get("PINOT_TPU_MATMUL_GROUP_CAP", str(512)))
 # 2^18-row chunks: the on-chip sweep (r4_chunk_sweep) measured 14% off
 # the Q1 kernel vs 2^15 (fewer, fatter scan steps); flat beyond 2^18
 _MATMUL_CHUNK = int(_os.environ.get("PINOT_TPU_MATMUL_CHUNK", str(1 << 18)))
-# dense presence/hist holders ride the same contraction with a combined
-# (group, valueId) key while capacity * gcard_pad stays under this
-_MATMUL_VALUE_CAP = int(_os.environ.get("PINOT_TPU_MATMUL_VALUE_CAP", str(1 << 16)))
+# dense presence/hist holders ride the FACTORED contraction
+# (_value_state_counts) with a combined (group, valueId) key while
+# capacity * gcard_pad stays under this; the r5 on-chip sweep
+# (tools/probe_hll_sweep.py) measured 0.8ns/row at K=2^14 and
+# 3.4ns/row at K=2^18 — still 3.6x ahead of the serialized scatter —
+# so the r4 cap of 2^16 lifts to 2^18
+_MATMUL_VALUE_CAP = int(_os.environ.get("PINOT_TPU_MATMUL_VALUE_CAP", str(1 << 18)))
 # grouped HLL: contraction FLOPs grow with capacity*16384, crossing the
-# ~12.5ns/element scatter cost near capacity ~19 on v5e — so the
-# dedicated gate admits capacity <= 16
+# sort-lowering cost (~4.2ns/row) near capacity ~16 on v5e
 _MATMUL_HLL_CAP = int(_os.environ.get("PINOT_TPU_MATMUL_HLL_CAP", str(1 << 18)))
+# grouped HLL beyond the matmul gate lowers to ONE packed int32 sort +
+# searchsorted run-max extraction (bit-identical to scatter-max,
+# tools/probe_hll_e2e.py: 565ms vs 1665ms at 134M rows, cap 1024) while
+# (capacity * HLL_M * 64) fits int32; beyond that the flat scatter runs
+_HLL_SORT_CAP = int(_os.environ.get("PINOT_TPU_HLL_SORT_CAP", str(1 << 16)))
 
 
 def _use_matmul_groupby() -> bool:
@@ -61,6 +69,23 @@ def _use_matmul_groupby() -> bool:
     if force is not None:
         return force == "1"
     return jax.default_backend() != "cpu"
+
+
+def _grouped_hll_path(capacity: int) -> str:
+    """Which lowering a dense grouped-HLL agg takes — consulted by BOTH
+    the kernel builder (_group_state) and the reduce-spec builder
+    (_state_reduce); they must agree or the reduce misreads the state.
+
+    'matmul': (group, bucket, rho) occupancy contraction on the MXU.
+    'sort':   packed int32 keys, sort + run-max extraction in the reduce.
+    'scatter': flat serialized scatter-max (packed key would overflow).
+    """
+    K = capacity * config.HLL_M * 64
+    if _use_matmul_groupby() and K <= _MATMUL_HLL_CAP:
+        return "matmul"
+    if capacity <= _HLL_SORT_CAP:
+        return "sort"
+    return "scatter"
 
 
 def _segment_add_matmul_multi(flat_idx, W, capacity: int):
@@ -91,6 +116,46 @@ def _segment_add_matmul_multi(flat_idx, W, capacity: int):
         body, jnp.zeros((m, capacity), dtype=fdt), jnp.arange(nb)
     )
     return acc
+
+
+# block size for the factored contraction: the r5 on-chip sweep found
+# batched-dot cost flat from 2^15 to 2^18 blocks; smaller blocks keep
+# the per-block [K1, 128] partials cheap to tree-sum
+_FACTORED_CHUNK = int(_os.environ.get("PINOT_TPU_FACTORED_CHUNK", str(1 << 15)))
+
+
+def _value_state_counts(flat_idx, K: int):
+    """Occupancy counts over a combined value-state key space of size K
+    with a FACTORED one-hot contraction: split the key into (hi, lo)
+    radix-128 digits and contract two THIN one-hots as a real
+    [K1, block] @ [block, 128] matmul per block — full MXU tiles instead
+    of the M=1 degenerate matmul of the scan contraction (the r4 shape
+    that measured 31.5ns/row; this form measures 0.8ns/row at K=2^14,
+    tools/probe_hll_sweep.py).
+
+    Weights must be binary and FOLDED into the index: invalid entries
+    carry ``flat_idx == K`` and one-hot to a dropped row.  bf16 one-hots
+    are exact (values 0/1) and the f32 accumulate is exact for counts
+    below 2^24 per cell per segment.  Returns float counts [K].
+    """
+    fdt = config.float_dtype()
+    onehot_dt = jnp.bfloat16 if jax.default_backend() != "cpu" else fdt
+    n = flat_idx.shape[0]
+    chunk = min(_FACTORED_CHUNK, max(128, n))
+    pad = (-n) % chunk
+    if pad:
+        flat_idx = jnp.concatenate(
+            [flat_idx, jnp.full(pad, K, flat_idx.dtype)]
+        )
+    nb = flat_idx.shape[0] // chunk
+    K1 = -(-K // 128)  # sentinel K lands in the padded tail, sliced off
+    blocks = flat_idx.reshape(nb, chunk)
+    hi = jax.nn.one_hot(blocks // 128, K1, dtype=onehot_dt)
+    lo = jax.nn.one_hot(blocks % 128, 128, dtype=onehot_dt)
+    out = jax.lax.dot_general(
+        hi, lo, (((1,), (1,)), ((0,), (0,))), preferred_element_type=fdt
+    )
+    return jnp.sum(out, axis=0).reshape(-1)[:K]
 
 
 
@@ -251,7 +316,7 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
         K = agg.gcard_pad
         if _use_matmul_groupby() and K <= _MATMUL_VALUE_CAP:
             combined = jnp.where(m, gids.astype(jnp.int32), K).astype(jnp.int32)
-            flat = _segment_add_matmul_multi(combined, m.astype(fdt)[None, :], K)[0]
+            flat = _value_state_counts(combined, K)
             if agg.kind == "presence":
                 return (flat > 0).astype(jnp.int32)
             return flat
@@ -279,9 +344,7 @@ def _agg_state(agg: StaticAgg, i: int, seg, q, mask) -> Any:
             combined = jnp.where(
                 m, b_rows.astype(jnp.int32) * 64 + r_rows.astype(jnp.int32), K
             ).astype(jnp.int32)
-            counts = _segment_add_matmul_multi(
-                combined, m.astype(config.float_dtype())[None, :], K
-            )[0].reshape(config.HLL_M, 64)
+            counts = _value_state_counts(combined, K).reshape(config.HLL_M, 64)
             rho_iota = jax.lax.broadcasted_iota(jnp.int32, (config.HLL_M, 64), 1)
             return jnp.max(jnp.where(counts > 0, rho_iota, 0), axis=1)
         regs = jnp.zeros(config.HLL_M, dtype=jnp.uint8)
@@ -452,9 +515,7 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
             combined = jnp.where(
                 pair_v, pair_k.astype(jnp.int32) * agg.gcard_pad + pair_g, K
             ).astype(jnp.int32)
-            flat = _segment_add_matmul_multi(
-                combined, pair_v.astype(fdt)[None, :], K
-            )[0]
+            flat = _value_state_counts(combined, K)
             grid = flat.reshape(capacity, agg.gcard_pad)
             if agg.kind == "presence":
                 return (grid > 0).astype(jnp.int32)
@@ -493,8 +554,9 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
                 jnp.where(pair_v, pair_k.astype(jnp.int32), sent),
                 jnp.where(pair_v, gid, sent),
             )
+        path = _grouped_hll_path(capacity)
         K = capacity * config.HLL_M * 64
-        if _use_matmul_groupby() and K <= _MATMUL_HLL_CAP:
+        if path == "matmul":
             # small group spaces: (group, bucket, rho) occupancy on the
             # MXU + argmax-by-iota, like the scalar HLL path
             combined = jnp.where(
@@ -507,25 +569,34 @@ def _group_state(agg: StaticAgg, i: int, seg, q, mask, keys, kvalid, capacity) -
                 + pair_r.astype(jnp.int32),
                 K,
             ).astype(jnp.int32)
-            counts = _segment_add_matmul_multi(
-                combined, pair_v.astype(config.float_dtype())[None, :], K
-            )[0].reshape(capacity, config.HLL_M, 64)
+            counts = _value_state_counts(combined, K).reshape(
+                capacity, config.HLL_M, 64
+            )
             rho_iota = jax.lax.broadcasted_iota(
                 jnp.int32, (capacity, config.HLL_M, 64), 2
             )
             return jnp.max(jnp.where(counts > 0, rho_iota, 0), axis=2)
-        # one FLAT scatter index instead of (k, b) pairs: at 1B rows the
-        # per-row int32 temporaries are what blow HBM (three 4 B/row
-        # arrays = 12 GB); a single fused index plus the mask-select
-        # halves that, which moves the single-chip capacity cliff from
-        # ~600M to past 1B rows for this workload
+        if path == "sort":
+            # mid/large group spaces: pack (group, bucket, rho) into ONE
+            # int32 per entry (4 B/row — the leanest HBM footprint of
+            # the three paths) and let the cross-segment reduce sort the
+            # packed keys and run-max-extract registers (bit-identical
+            # to scatter-max; 3x faster on v5e, tools/probe_hll_e2e.py)
+            packed = jnp.where(
+                pair_v,
+                ((pair_k * config.HLL_M + pair_b.astype(jnp.int32)) << 6)
+                | pair_r.astype(jnp.int32),
+                _PAIR_SENTINEL,
+            )
+            return packed
+        # huge capacities (> _HLL_SORT_CAP: packed key overflows int32):
+        # one FLAT scatter index instead of (k, b) pairs — a single fused
+        # index plus uint8 values keeps per-row temporaries at 5 B/row
         flat = jnp.where(
             pair_v,
             pair_k * config.HLL_M + pair_b.astype(jnp.int32),
             capacity * config.HLL_M,
         )
-        # uint8 holder + values: rho < 64 always, and the int32 value
-        # temporary alone is 4 GB at 1B rows
         holder = jnp.zeros(capacity * config.HLL_M, dtype=jnp.uint8)
         regs = holder.at[flat].max(pair_r.astype(jnp.uint8), mode="drop")
         return regs.reshape(capacity, config.HLL_M)
@@ -668,7 +739,7 @@ def output_reducers(plan: StaticPlan) -> Dict[str, str]:
     if plan.group_by is not None:
         red["gb_presence"] = "max"
         for i, agg in enumerate(plan.aggs):
-            red[f"gb_{i}"] = _state_reduce(agg)
+            red[f"gb_{i}"] = _state_reduce(agg, plan.group_by.capacity)
     else:
         for i, agg in enumerate(plan.aggs):
             red[f"agg_{i}"] = _state_reduce(agg)
@@ -678,7 +749,7 @@ def output_reducers(plan: StaticPlan) -> Dict[str, str]:
     return red
 
 
-def _state_reduce(agg: StaticAgg) -> str:
+def _state_reduce(agg: StaticAgg, capacity: int = 0) -> str:
     base = agg.base
     if base in ("count", "sum"):
         return "sum"
@@ -695,7 +766,13 @@ def _state_reduce(agg: StaticAgg) -> str:
     if agg.kind == "hist":
         return "distinct_pairs" if agg.sort_pairs else "sum"
     if agg.kind == "hll":
-        return "distinct_pairs" if agg.sort_pairs else "max"
+        if agg.sort_pairs:
+            return "distinct_pairs"
+        if capacity and _grouped_hll_path(capacity) == "sort":
+            # packed-key states: the reduce itself sorts and extracts
+            # registers — the capacity rides in the op tag
+            return f"hll_sort:{capacity}"
+        return "max"
     raise AssertionError(agg)
 
 
@@ -797,7 +874,29 @@ def merge_pair_buffers(slots, gids, counts):
     return (s2[:k], g2[:k], e2[:k], n_unique, total_valid)
 
 
+def _reduce_hll_sort(value, capacity: int):
+    """Dense grouped-HLL registers from packed (group, bucket, rho)
+    int32 keys across all segments — ONE single-operand device sort
+    plus a searchsorted run-max extraction (bit-identical to the
+    scatter-max lowering: rho rides the low 6 bits, so the largest
+    packed key within a (group, bucket) cell prefix carries the cell's
+    max rho).  Replaces the serialized scatter for the north-star
+    high-cardinality HLL group-by (3x on v5e, tools/probe_hll_e2e.py).
+    """
+    s = jax.lax.sort(value.reshape(-1))
+    ncells = capacity * config.HLL_M
+    # the last packed key below (cell+1)<<6 is the cell's max-rho entry
+    bounds = (jnp.arange(ncells, dtype=jnp.int32) + 1) << 6
+    pos = jnp.searchsorted(s, bounds) - 1
+    v = s[jnp.maximum(pos, 0)]
+    cell_ids = jnp.arange(ncells, dtype=jnp.int32)
+    regs = jnp.where((pos >= 0) & ((v >> 6) == cell_ids), v & 63, 0)
+    return regs.reshape(capacity, config.HLL_M).astype(jnp.uint8)
+
+
 def apply_reduce(op: str, value: Any):
+    if op.startswith("hll_sort:"):
+        return _reduce_hll_sort(value, int(op.split(":", 1)[1]))
     if op == "sum":
         return jnp.sum(value, axis=0)
     if op == "min":
@@ -918,13 +1017,19 @@ def chunk_rows_limit() -> int:
 
 
 def plan_chunkable(plan: StaticPlan) -> bool:
-    """Chunk-combinable: every output reduces elementwise.  The
-    distinct_pairs sort-dedup buffers and per-segment selection outputs
-    need their full segment axis in one program."""
-    return all(op in _ELEMENTWISE_REDUCERS for op in output_reducers(plan).values())
+    """Chunk-combinable: every output reduces elementwise, or (hll_sort)
+    reduces to dense registers that merge elementwise across chunks.
+    The distinct_pairs sort-dedup buffers and per-segment selection
+    outputs need their full segment axis in one program."""
+    return all(
+        op in _ELEMENTWISE_REDUCERS or op.startswith("hll_sort:")
+        for op in output_reducers(plan).values()
+    )
 
 
 def combine_reduced(op: str, a, b):
+    if op.startswith("hll_sort:"):
+        return jnp.maximum(a, b)  # chunk-reduced register states
     if op == "sum":
         return a + b
     if op == "max":

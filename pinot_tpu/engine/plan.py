@@ -441,6 +441,22 @@ def build_static_plan(
         if expansion > 64:
             on_device = False
 
+    # Guaranteed sort-pairs overflow: the global dictionary holds only
+    # values PRESENT in the data, so with no filter every dict entry
+    # lands in >= 1 (group, valueId) pair — more unique pairs than the
+    # device compaction buffer can return.  Skip the doomed device sort
+    # (staging + compile + a 134M-row sort at north-star scale) and go
+    # straight to the host path the overflow would reach anyway.
+    if request.filter is None:
+        for a in aggs:
+            if (
+                a.sort_pairs
+                and a.kind in ("presence", "hist")
+                and ctx.column(a.column).global_cardinality
+                > config.DISTINCT_PAIR_CAP
+            ):
+                on_device = False
+
     # ---- selection --------------------------------------------------
     selection: Optional[StaticSelection] = None
     if request.is_selection:

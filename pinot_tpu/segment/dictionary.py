@@ -49,6 +49,12 @@ class Dictionary:
     def cardinality(self) -> int:
         return len(self.values)
 
+    def value_array(self) -> np.ndarray:
+        """Values as ONE reusable numpy array (object dtype for strings)
+        — the vectorized-gather alternative to per-id ``get`` loops on
+        the bulk distinct/partial-building paths."""
+        return self._np
+
     def get(self, dict_id: int) -> Any:
         v = self.values[dict_id]
         if self.is_string:

@@ -127,8 +127,17 @@ def test_overflow_falls_back_to_host(cluster, monkeypatch):
     kernel_mod.make_table_kernel.cache_clear()
     kernel_mod.make_packed_table_kernel.cache_clear()
     try:
-        q = "SELECT distinctcount(l_extendedprice) FROM lineitem GROUP BY l_returnflag TOP 10"
+        # the filter keeps the query off the plan-time guaranteed-
+        # overflow skip, so this exercises the RUNTIME overflow
+        # detection (device pairs buffer too small -> host re-run)
+        q = (
+            "SELECT distinctcount(l_extendedprice) FROM lineitem "
+            "WHERE l_shipdate > '1993-01-01' GROUP BY l_returnflag TOP 10"
+        )
         req = optimize_request(parse_pql(q))
+        ctx = get_table_context(segs)
+        staged = stage_segments(segs, sorted(req.referenced_columns()), ctx=ctx)
+        assert build_static_plan(req, ctx, staged).on_device
         got = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
         want = oracle.execute(optimize_request(parse_pql(q)))
         assert _norm(got) == _norm(want)
@@ -136,6 +145,25 @@ def test_overflow_falls_back_to_host(cluster, monkeypatch):
         kernel_mod.make_table_kernel.cache_clear()
         kernel_mod.make_packed_table_kernel.cache_clear()
         clear_staging_cache()
+
+
+def test_guaranteed_overflow_skips_device(cluster, monkeypatch):
+    """With no filter and global cardinality beyond the pair buffer,
+    every dictionary value lands in >= 1 pair — the device sort is
+    doomed, so the planner goes straight to the host path (the r4
+    north-star capture burned 32 minutes on the staged+compiled+sorted
+    device attempt before falling back)."""
+    segs, oracle = cluster
+    monkeypatch.setattr(config, "DISTINCT_PAIR_CAP", 64)
+    q = "SELECT distinctcount(l_extendedprice) FROM lineitem GROUP BY l_returnflag TOP 10"
+    req = optimize_request(parse_pql(q))
+    ctx = get_table_context(segs)
+    staged = stage_segments(segs, sorted(req.referenced_columns()), ctx=ctx)
+    plan = build_static_plan(req, ctx, staged)
+    assert not plan.on_device
+    got = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
+    want = oracle.execute(optimize_request(parse_pql(q)))
+    assert _norm(got) == _norm(want)
 
 
 def test_trim_path_uses_pair_counts(cluster):

@@ -1,0 +1,159 @@
+"""On-chip probe: candidate lowerings for grouped HLL / presence.
+
+Measures, at the round-4 bench and north-star shapes:
+  a. current M=1 one-hot contraction  [1,chunk]@[chunk,K]
+  b. factored outer-product           [K/128,chunk]@[chunk,128]
+  c. factored in bf16 (f32 accumulate)
+  d. masked scatter-max (all dropped) vs live scatter-max
+  e. jax.lax.sort throughput (1- and 2-operand)
+  f. int8 factored contraction (int32 accumulate)
+
+One JSON line per measurement on stdout.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 1 << 27  # 134M rows
+CHUNK = 1 << 18
+
+
+def _fetch(out):
+    leaf = out
+    while isinstance(leaf, (tuple, list)):
+        leaf = leaf[0]
+    np.asarray(leaf.ravel()[:1])
+
+
+def timeit(fn, *args, iters=3):
+    _fetch(fn(*args))
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _fetch(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def report(name, secs, rows=N):
+    print(
+        json.dumps(
+            {"probe": name, "ms": round(secs * 1e3, 2), "ns_per_row": round(secs / rows * 1e9, 3)}
+        ),
+        flush=True,
+    )
+
+
+def contraction_m1(idx, w, K):
+    nb = idx.shape[0] // CHUNK
+
+    def body(acc, b):
+        i_c = jax.lax.dynamic_slice_in_dim(idx, b * CHUNK, CHUNK)
+        w_c = jax.lax.dynamic_slice_in_dim(w, b * CHUNK, CHUNK)
+        onehot = jax.nn.one_hot(i_c, K, dtype=jnp.float32)
+        return acc + (w_c[None, :] @ onehot), None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((1, K), jnp.float32), jnp.arange(nb))
+    return acc
+
+
+def contraction_factored(idx, w, K, dtype=jnp.float32):
+    K1 = K // 128
+    nb = idx.shape[0] // CHUNK
+
+    def body(acc, b):
+        i_c = jax.lax.dynamic_slice_in_dim(idx, b * CHUNK, CHUNK)
+        w_c = jax.lax.dynamic_slice_in_dim(w, b * CHUNK, CHUNK).astype(dtype)
+        hi = jax.nn.one_hot(i_c // 128, K1, dtype=dtype)  # [chunk, K1]
+        lo = jax.nn.one_hot(i_c % 128, 128, dtype=dtype)  # [chunk, 128]
+        acc = acc + jax.lax.dot_general(
+            hi * w_c[:, None],
+            lo,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((K1, 128), jnp.float32), jnp.arange(nb))
+    return acc
+
+
+def contraction_int8(idx, w8, K):
+    K1 = K // 128
+    nb = idx.shape[0] // CHUNK
+
+    def body(acc, b):
+        i_c = jax.lax.dynamic_slice_in_dim(idx, b * CHUNK, CHUNK)
+        w_c = jax.lax.dynamic_slice_in_dim(w8, b * CHUNK, CHUNK)
+        hi = jax.nn.one_hot(i_c // 128, K1, dtype=jnp.int8)
+        lo = jax.nn.one_hot(i_c % 128, 128, dtype=jnp.int8)
+        acc = acc + jax.lax.dot_general(
+            hi * w_c[:, None],
+            lo,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((K1, 128), jnp.int32), jnp.arange(nb))
+    return acc
+
+
+def main():
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+    print(json.dumps({"probe": "platform", "dev": str(dev)}), flush=True)
+
+    idx_np = rng.integers(0, 16384, size=N).astype(np.int32)
+    idx = jax.device_put(jnp.asarray(idx_np), dev)
+    w = jax.device_put(jnp.ones(N, jnp.float32), dev)
+    w8 = jax.device_put(jnp.ones(N, jnp.int8), dev)
+
+    K = 16384  # bench shape: cap 4 x gcard_pad 4096
+    f_m1 = jax.jit(lambda i, ww: contraction_m1(i, ww, K))
+    report("m1_onehot_K16384", timeit(f_m1, idx, w))
+    f_fac = jax.jit(lambda i, ww: contraction_factored(i, ww, K))
+    report("factored_f32_K16384", timeit(f_fac, idx, w))
+    f_bf = jax.jit(lambda i, ww: contraction_factored(i, ww, K, jnp.bfloat16))
+    report("factored_bf16_K16384", timeit(f_bf, idx, w))
+    f_i8 = jax.jit(lambda i, ww: contraction_int8(i, ww, K))
+    report("factored_int8_K16384", timeit(f_i8, idx, w))
+
+    # north-star presence shape: K = 1024 groups x 256 buckets
+    K2 = 1024 * 256
+    f_fac2 = jax.jit(lambda i, ww: contraction_factored(i, ww, K2, jnp.bfloat16))
+    idx2 = jax.device_put(jnp.asarray(rng.integers(0, K2, size=N).astype(np.int32)), dev)
+    report("factored_bf16_K262144", timeit(f_fac2, idx2, w))
+    f_i82 = jax.jit(lambda i, ww: contraction_int8(i, ww, K2))
+    report("factored_int8_K262144", timeit(f_i82, idx2, w))
+
+    # scatter-max: live vs fully-dropped
+    rho = jax.device_put(jnp.asarray(rng.integers(1, 40, size=N).astype(np.uint8)), dev)
+
+    def scat(i, r):
+        holder = jnp.zeros(K2, jnp.uint8)
+        return holder.at[i].max(r, mode="drop")
+
+    f_scat = jax.jit(scat)
+    report("scatter_max_live", timeit(f_scat, idx2, rho))
+    idx_dropped = jax.device_put(jnp.full(N, K2, jnp.int32), dev)
+    report("scatter_max_all_dropped", timeit(f_scat, idx_dropped, rho))
+
+    # sort throughput
+    f_sort1 = jax.jit(lambda x: jax.lax.sort(x))
+    report("sort_1op_134M_int32", timeit(f_sort1, idx2))
+    f_sort2 = jax.jit(lambda x, y: jax.lax.sort((x, y), num_keys=1))
+    report("sort_2op_134M_int32", timeit(f_sort2, idx2, idx))
+
+    # cumsum
+    f_cum = jax.jit(lambda x: jnp.cumsum(x))
+    report("cumsum_134M_int32", timeit(f_cum, idx))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""Sweep: why is the factored one-hot contraction 25x off its FLOP floor?
+
+Variants at K=16384, N=134M:
+  - chunk size sweep
+  - unrolled scan
+  - vmap-then-sum instead of scan
+  - one_hot on the K1 axis directly (no transpose in dot)
+  - presence without weight multiply
+  - m1 contraction K sweep (512..16384) to find the cliff
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 1 << 27
+
+
+def _fetch(out):
+    leaf = out
+    while isinstance(leaf, (tuple, list)):
+        leaf = leaf[0]
+    np.asarray(leaf.ravel()[:1])
+
+
+def timeit(fn, *args, iters=3):
+    _fetch(fn(*args))
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _fetch(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def report(name, secs):
+    print(json.dumps({"probe": name, "ms": round(secs * 1e3, 2), "ns_per_row": round(secs / N * 1e9, 3)}), flush=True)
+
+
+def factored(idx, K, chunk, dtype, unroll=1):
+    K1 = K // 128
+    nb = idx.shape[0] // chunk
+
+    def body(acc, b):
+        i_c = jax.lax.dynamic_slice_in_dim(idx, b * chunk, chunk)
+        hi = jax.nn.one_hot(i_c // 128, K1, dtype=dtype)
+        lo = jax.nn.one_hot(i_c % 128, 128, dtype=dtype)
+        acc = acc + jax.lax.dot_general(
+            hi, lo, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((K1, 128), jnp.float32), jnp.arange(nb), unroll=unroll)
+    return acc
+
+
+def factored_vmap(idx, K, chunk, dtype):
+    K1 = K // 128
+    nb = idx.shape[0] // chunk
+    blocks = idx.reshape(nb, chunk)
+
+    def per_block(i_c):
+        hi = jax.nn.one_hot(i_c // 128, K1, dtype=dtype)
+        lo = jax.nn.one_hot(i_c % 128, 128, dtype=dtype)
+        return jax.lax.dot_general(
+            hi, lo, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    return jnp.sum(jax.vmap(per_block)(blocks), axis=0)
+
+
+def batched_dot(idx, K, chunk, dtype):
+    """One batched dot_general over the block axis: [nb,chunk,K1]x[nb,chunk,128]
+    -> [nb,K1,128], contraction over chunk, then sum over nb."""
+    K1 = K // 128
+    nb = idx.shape[0] // chunk
+    blocks = idx.reshape(nb, chunk)
+    hi = jax.nn.one_hot(blocks // 128, K1, dtype=dtype)
+    lo = jax.nn.one_hot(blocks % 128, 128, dtype=dtype)
+    out = jax.lax.dot_general(
+        hi, lo, (((1,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+    )
+    return jnp.sum(out, axis=0)
+
+
+def m1(idx, K, chunk):
+    nb = idx.shape[0] // chunk
+
+    def body(acc, b):
+        i_c = jax.lax.dynamic_slice_in_dim(idx, b * chunk, chunk)
+        onehot = jax.nn.one_hot(i_c, K, dtype=jnp.float32)
+        return acc + (jnp.ones((1, chunk), jnp.float32) @ onehot), None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((1, K), jnp.float32), jnp.arange(nb))
+    return acc
+
+
+def main():
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+    idx = jax.device_put(jnp.asarray(rng.integers(0, 16384, size=N).astype(np.int32)), dev)
+
+    for K in (512, 2048, 8192, 16384):
+        f = jax.jit(lambda i, K=K: m1(jnp.minimum(i, K - 1), K, 1 << 18))
+        report(f"m1_K{K}", timeit(f, idx))
+
+    for chunk_log in (18, 20, 22):
+        f = jax.jit(lambda i, c=1 << chunk_log: factored(i, 16384, c, jnp.bfloat16))
+        report(f"factored_bf16_chunk2e{chunk_log}", timeit(f, idx))
+
+    f = jax.jit(lambda i: factored(i, 16384, 1 << 18, jnp.bfloat16, unroll=4))
+    report("factored_bf16_unroll4", timeit(f, idx))
+
+    f = jax.jit(lambda i: factored_vmap(i, 16384, 1 << 18, jnp.bfloat16))
+    report("factored_vmap_bf16", timeit(f, idx))
+
+    f = jax.jit(lambda i: batched_dot(i, 16384, 1 << 18, jnp.bfloat16))
+    report("batched_dot_bf16", timeit(f, idx))
+
+    f = jax.jit(lambda i: batched_dot(i, 16384, 1 << 15, jnp.bfloat16))
+    report("batched_dot_bf16_chunk2e15", timeit(f, idx))
+
+
+if __name__ == "__main__":
+    main()
