@@ -10,6 +10,7 @@ reduces the healthy servers' partials —
 """
 from __future__ import annotations
 
+import concurrent.futures
 import logging
 import time
 from typing import Optional, Sequence, Tuple
@@ -24,7 +25,7 @@ from pinot_tpu.engine.results import IntermediateResult
 from pinot_tpu.pql import optimize_request, parse_pql
 from pinot_tpu.segment.immutable import ImmutableSegment
 from pinot_tpu.server.datamanager import InstanceDataManager
-from pinot_tpu.server.scheduler import QueryScheduler
+from pinot_tpu.server.scheduler import QueryScheduler, SchedulerSaturatedError
 from pinot_tpu.utils.metrics import ServerMetrics
 from pinot_tpu.utils.trace import TraceContext
 
@@ -32,12 +33,18 @@ logger = logging.getLogger(__name__)
 
 
 class ServerInstance:
-    def __init__(self, name: str = "server0", mesh=None, num_workers: int = 4) -> None:
+    def __init__(
+        self,
+        name: str = "server0",
+        mesh=None,
+        num_workers: int = 4,
+        max_pending: int = 64,
+    ) -> None:
         self.name = name
         self.data_manager = InstanceDataManager()
         self.metrics = ServerMetrics(name)
         self.executor = QueryExecutor(mesh=mesh, metrics=self.metrics)
-        self.scheduler = QueryScheduler(num_workers=num_workers)
+        self.scheduler = QueryScheduler(num_workers=num_workers, max_pending=max_pending)
         self._table_schemas: dict = {}  # raw table name -> Schema
 
     # -- segment lifecycle -------------------------------------------
@@ -100,7 +107,24 @@ class ServerInstance:
             result = self.scheduler.run(
                 lambda: self._process(req), timeout_s=req["timeoutMs"] / 1000.0
             )
-        except Exception as e:  # scheduler timeout / execution error
+        except SchedulerSaturatedError as e:
+            # overload shed: fast typed rejection, no stack spam — the
+            # broker surfaces it as a partial-failure server error
+            self.metrics.meter("queriesShed").mark()
+            result = IntermediateResult(
+                exceptions=[(ErrorCode.SERVER_SCHEDULER_DOWN, str(e))]
+            )
+        except concurrent.futures.TimeoutError:
+            logger.warning("query %s timed out", req.get("requestId"))
+            result = IntermediateResult(
+                exceptions=[
+                    (
+                        ErrorCode.EXECUTION_TIMEOUT,
+                        f"server {self.name}: exceeded {req['timeoutMs']}ms",
+                    )
+                ]
+            )
+        except Exception as e:  # execution error
             logger.exception("query %s failed", req.get("requestId"))
             result = IntermediateResult(
                 exceptions=[(ErrorCode.QUERY_EXECUTION, f"{type(e).__name__}: {e}")]

@@ -1,25 +1,79 @@
-"""Query scheduler: bounded FCFS pool in front of the executor.
+"""Query scheduler: bounded FCFS pool + bounded pending queue in front
+of the executor.
 
 The reference bounds query concurrency with runner/worker pools
-(``QueryScheduler.java:35``, ``FCFSQueryScheduler``).  Device execution
-is serialized per chip anyway, so the pool here mainly bounds host-side
-planning/finalize concurrency and provides the submit/timeout surface.
+(``QueryScheduler.java:35``, ``FCFSQueryScheduler``); queries beyond
+pool capacity wait FCFS, and the serving bar is what happens at
+saturation.  Device execution is serialized per chip anyway, so the
+pool here mainly bounds host-side planning/finalize concurrency and
+provides the submit/timeout surface.  The OVERLOAD POLICY (r5): at most
+``max_pending`` queries may be queued-or-running; beyond that submits
+are shed immediately with ``SchedulerSaturatedError`` rather than
+queued without bound — a fast 210-coded error reply beats a timeout
+that arrives after the client gave up, and bounds server memory under
+a flood (the reference's analog is its scheduler resource limits).
 """
 from __future__ import annotations
 
 import concurrent.futures
+import threading
 from typing import Any, Callable
 
 
+class SchedulerSaturatedError(RuntimeError):
+    """Raised on submit when the pending queue is at capacity (shed)."""
+
+
 class QueryScheduler:
-    def __init__(self, num_workers: int = 4) -> None:
+    def __init__(self, num_workers: int = 4, max_pending: int = 64) -> None:
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=num_workers)
+        self._max_pending = max_pending
+        self._pending = 0  # queued + running
+        self._shed = 0
+        self._lock = threading.Lock()
+
+    @property
+    def pending(self) -> int:
+        return self._pending
+
+    @property
+    def shed_count(self) -> int:
+        return self._shed
 
     def submit(self, fn: Callable[[], Any]) -> concurrent.futures.Future:
-        return self._pool.submit(fn)
+        with self._lock:
+            if self._pending >= self._max_pending:
+                self._shed += 1
+                raise SchedulerSaturatedError(
+                    f"scheduler saturated: {self._pending} pending >= "
+                    f"{self._max_pending} cap"
+                )
+            self._pending += 1
+        try:
+            fut = self._pool.submit(fn)
+        except BaseException:
+            with self._lock:
+                self._pending -= 1
+            raise
+
+        def _done(_f) -> None:
+            with self._lock:
+                self._pending -= 1
+
+        fut.add_done_callback(_done)
+        return fut
 
     def run(self, fn: Callable[[], Any], timeout_s: float) -> Any:
-        return self.submit(fn).result(timeout=timeout_s)
+        fut = self.submit(fn)
+        try:
+            return fut.result(timeout=timeout_s)
+        except concurrent.futures.TimeoutError:
+            # the client is gone: a still-QUEUED query cancels (its
+            # done-callback frees the pending slot immediately) so
+            # abandoned work cannot pin the scheduler at max_pending
+            # and shed live traffic; a RUNNING one must drain
+            fut.cancel()
+            raise
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)

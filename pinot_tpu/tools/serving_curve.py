@@ -1,0 +1,152 @@
+"""Serving curve under concurrent load: QPS vs latency + overload point.
+
+The reference measures serving capacity by replaying queries at a target
+QPS and recording latency percentiles (``QueryRunner.java:45-53``,
+PinotResponseTime methodology).  This tool drives the full in-process
+broker path (parse -> route -> scatter -> kernel -> reduce) with a MIXED
+workload at a rising QPS ladder and records, per step:
+
+  target QPS, achieved QPS, p50/p90/p99 ms, error count, shed count
+  (scheduler saturation replies, error code 210), scheduler shed total
+
+The saturation point is the first step where achieved QPS falls below
+90% of target or queries start shedding.  Output: one JSON document
+(stdout, and -out file) suitable for committing as the round's serving
+curve artifact.
+
+Usage:
+  python -m pinot_tpu.tools.serving_curve                       # on-chip shape
+  python -m pinot_tpu.tools.serving_curve -segments 2 \
+      -rows-per-segment 250000 -qps 2,4,8 -duration 5           # CPU smoke
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from typing import List
+
+from pinot_tpu.common.response import ErrorCode
+
+
+def mixed_workload(segments) -> List[str]:
+    """The four BASELINE.md query shapes: flagship group-by scan (Q1),
+    IN+range group-by (Q6-like), selective needle, HLL distinct."""
+    d_price = segments[0].column("l_extendedprice").dictionary
+    pv = d_price.get(d_price.cardinality // 2)
+    return [
+        "SELECT sum(l_quantity), sum(l_extendedprice), sum(l_discount), count(*) "
+        "FROM lineitem WHERE l_shipdate <= '1998-09-02' "
+        "GROUP BY l_returnflag, l_linestatus TOP 10",
+        "SELECT sum(l_extendedprice) FROM lineitem "
+        "WHERE l_shipmode IN ('RAIL','FOB') AND "
+        "l_receiptdate BETWEEN '1997-01-01' AND '1997-12-31' "
+        "GROUP BY l_shipmode TOP 10",
+        f"SELECT sum(l_quantity), count(*) FROM lineitem "
+        f"WHERE l_extendedprice = {pv!r}",
+        "SELECT distinctcounthll(l_shipdate) FROM lineitem "
+        "GROUP BY l_returnflag TOP 10",
+    ]
+
+
+def run_curve(
+    segments,
+    qps_ladder: List[float],
+    duration_s: float,
+) -> dict:
+    from pinot_tpu.tools.cluster_harness import single_server_broker
+    from pinot_tpu.tools.query_runner import QueryRunner
+
+    broker = single_server_broker("lineitem", segments)
+    queries = mixed_workload(segments)
+
+    counters = {"errors": 0, "shed": 0}
+    clock = threading.Lock()  # target_qps drives run() from worker threads
+
+    def run(pql: str) -> None:
+        resp = broker.handle_pql(pql)
+        if resp.exceptions:
+            codes = {e.error_code for e in resp.exceptions}
+            with clock:
+                if ErrorCode.SERVER_SCHEDULER_DOWN in codes:
+                    counters["shed"] += 1
+                else:
+                    counters["errors"] += 1
+
+    runner = QueryRunner(run)
+    # warm every shape: staging + per-shape compile
+    for q in queries:
+        runner.single_thread([q], rounds=2)
+
+    steps = []
+    saturation = None
+    for qps in qps_ladder:
+        counters["errors"] = counters["shed"] = 0
+        report = runner.target_qps(queries, qps=qps, duration_s=duration_s)
+        rj = report.to_json()
+        step = {
+            "target_qps": qps,
+            "achieved_qps": rj["qps"],
+            "p50_ms": rj["p50Ms"],
+            "p90_ms": rj["p90Ms"],
+            "p99_ms": rj["p99Ms"],
+            "queries": rj["numQueries"],
+            "errors": counters["errors"],
+            "shed": counters["shed"],
+        }
+        steps.append(step)
+        print(json.dumps({"step": step}), flush=True)
+        if saturation is None and (
+            rj["qps"] < 0.9 * qps or counters["shed"] > 0 or counters["errors"] > 0
+        ):
+            saturation = qps
+
+    return {
+        "workload": "mixed: Q1 groupby scan, Q6 IN+range, selection needle, HLL groupby",
+        "num_segments": len(segments),
+        "total_rows": sum(s.num_docs for s in segments),
+        "duration_s_per_step": duration_s,
+        "overload_policy": "bounded FCFS queue; submits beyond max_pending shed "
+        "immediately with error 210 (server/scheduler.py)",
+        "steps": steps,
+        "saturation_qps": saturation,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-segments", type=int, default=None)
+    ap.add_argument("-rows-per-segment", type=int, default=None, dest="rps")
+    ap.add_argument("-qps", type=str, default="2,4,8,16,32,64")
+    ap.add_argument("-duration", type=float, default=15.0)
+    ap.add_argument("-out", type=str, default="")
+    args = ap.parse_args()
+
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    n_seg = args.segments if args.segments is not None else (16 if on_tpu else 2)
+    rps = args.rps if args.rps is not None else (8_388_608 if on_tpu else 250_000)
+
+    from pinot_tpu.tools.datagen import synthetic_lineitem_segment
+
+    t0 = time.perf_counter()
+    segments = [
+        synthetic_lineitem_segment(rps, seed=11 + i, name=f"li{i}")
+        for i in range(n_seg)
+    ]
+    print(json.dumps({"datagen_s": round(time.perf_counter() - t0, 1)}), flush=True)
+
+    ladder = [float(x) for x in args.qps.split(",")]
+    doc = run_curve(segments, ladder, args.duration)
+    doc["platform"] = jax.devices()[0].platform
+    out = json.dumps(doc, indent=1)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+
+
+if __name__ == "__main__":
+    main()

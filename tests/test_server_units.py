@@ -111,6 +111,75 @@ def test_scheduler_run_timeout():
     sched.shutdown()
 
 
+def test_scheduler_saturation_sheds_then_recovers():
+    """Overload policy: beyond max_pending queued-or-running queries the
+    scheduler sheds with a typed error immediately (no unbounded queue,
+    no slow timeout), and accepts again once the backlog drains."""
+    from pinot_tpu.server.scheduler import SchedulerSaturatedError
+
+    sched = QueryScheduler(num_workers=1, max_pending=2)
+    gate = threading.Event()
+    futs = [sched.submit(lambda: gate.wait(5)) for _ in range(2)]
+    assert sched.pending == 2
+    with pytest.raises(SchedulerSaturatedError):
+        sched.submit(lambda: 1)
+    assert sched.shed_count == 1
+    gate.set()
+    for f in futs:
+        f.result(timeout=5)
+    # done-callbacks drain pending; new submits are accepted again
+    assert sched.submit(lambda: 99).result(timeout=5) == 99
+    sched.shutdown()
+
+
+def test_server_sheds_with_scheduler_down_code():
+    """A saturated server replies fast with SERVER_SCHEDULER_DOWN (210)
+    instead of queueing the request toward a timeout."""
+    from pinot_tpu.common.datatable import (
+        deserialize_result,
+        serialize_instance_request,
+    )
+    from pinot_tpu.common.response import ErrorCode
+    from pinot_tpu.server.instance import ServerInstance
+    from pinot_tpu.tools.datagen import make_test_schema, random_rows
+
+    schema = make_test_schema(with_mv=False)
+    seg = build_segment(schema, random_rows(schema, 50, seed=3), "tt", "s0")
+    inst = ServerInstance("satServer", num_workers=1, max_pending=1)
+    inst.set_table_schema("tt", schema)
+    inst.add_segment("tt", seg)
+    gate = threading.Event()
+    real_execute = inst.executor.execute
+
+    def slow_execute(segs, req):
+        gate.wait(5)
+        return real_execute(segs, req)
+
+    inst.executor.execute = slow_execute
+    payload = serialize_instance_request(
+        1, "SELECT count(*) FROM tt", "tt", ["s0"], 5000
+    )
+    results = {}
+
+    def first():
+        results["first"] = deserialize_result(inst.handle_request(payload))
+
+    t = threading.Thread(target=first)
+    t.start()
+    # wait until the slow query occupies the single pending slot
+    for _ in range(100):
+        if inst.scheduler.pending >= 1:
+            break
+        time.sleep(0.01)
+    shed = deserialize_result(inst.handle_request(payload))
+    assert shed.exceptions
+    assert shed.exceptions[0][0] == ErrorCode.SERVER_SCHEDULER_DOWN
+    gate.set()
+    t.join(timeout=10)
+    assert not results["first"].exceptions
+    inst.scheduler.shutdown()
+
+
 def test_scheduler_shutdown_cancels_pending():
     sched = QueryScheduler(num_workers=1)
     gate = threading.Event()

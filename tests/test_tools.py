@@ -83,6 +83,21 @@ def test_query_runner_modes():
     assert rep.to_json()["p99Ms"] >= 0
 
 
+def test_serving_curve_smoke():
+    """The QPS-ladder serving-curve tool runs the mixed workload through
+    a real broker and reports per-step latency + shed counts."""
+    from pinot_tpu.tools.datagen import synthetic_lineitem_segment
+    from pinot_tpu.tools.serving_curve import run_curve
+
+    segs = [synthetic_lineitem_segment(20000, seed=5, name="sc0")]
+    doc = run_curve(segs, [4.0], duration_s=1.5)
+    assert len(doc["steps"]) == 1
+    step = doc["steps"][0]
+    assert step["queries"] > 0
+    assert step["errors"] == 0
+    assert step["p99_ms"] >= step["p50_ms"] > 0
+
+
 def test_admin_create_and_show_segment(tmp_path, capsys):
     from pinot_tpu.tools.admin import main
 
