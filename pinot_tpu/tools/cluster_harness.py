@@ -118,7 +118,9 @@ class InProcessCluster:
         self.controller.stop()
 
 
-def single_server_broker(table: str, segments, timeout_ms: float = 600_000.0):
+def single_server_broker(
+    table: str, segments, timeout_ms: float = 600_000.0, max_pending: int = 64
+):
     """One in-process server + broker over LocalTransport — the
     minimal serving topology every bench uses (bench.py,
     tools/config_bench.py).  The generous default timeout covers the
@@ -126,7 +128,7 @@ def single_server_broker(table: str, segments, timeout_ms: float = 600_000.0):
     from pinot_tpu.broker.broker import BrokerRequestHandler
     from pinot_tpu.broker.routing import RoutingTableProvider
 
-    server = ServerInstance("benchServer")
+    server = ServerInstance("benchServer", max_pending=max_pending)
     for seg in segments:
         server.add_segment(table, seg)
     transport = LocalTransport()

@@ -54,11 +54,16 @@ def run_curve(
     segments,
     qps_ladder: List[float],
     duration_s: float,
+    max_pending: int = 24,
 ) -> dict:
     from pinot_tpu.tools.cluster_harness import single_server_broker
     from pinot_tpu.tools.query_runner import QueryRunner
 
-    broker = single_server_broker("lineitem", segments)
+    # max_pending BELOW the runner's 32-thread concurrency cap, so the
+    # scheduler's shed policy is actually observable at saturation
+    # (with the serving default of 64 the runner could never fill the
+    # pending queue and 'shed' would structurally read 0)
+    broker = single_server_broker("lineitem", segments, max_pending=max_pending)
     queries = mixed_workload(segments)
 
     counters = {"errors": 0, "shed": 0}

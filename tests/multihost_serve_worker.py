@@ -45,6 +45,12 @@ def main() -> None:
         process_id=pid,
         port=serve_port,
     )
+    if not server.is_lead and os.environ.get("PINOT_TPU_MESH_TEST_EXIT_ON_QUERY") == "1":
+        # failure injection for the mid-query death test: this follower
+        # answers liveness pings normally, then dies the moment it
+        # starts PROCESSING a forwarded query — after the lead's
+        # preflight, before collective entry
+        server.server.handle_request = lambda payload: os._exit(17)
     if server.is_lead:
         server.connect_followers([("127.0.0.1", p) for p in follower_ports])
     print(f"SERVING pid={pid} port={server.address[1]}", flush=True)

@@ -195,3 +195,111 @@ def test_broker_pql_through_multihost_mesh():
                 p.kill()
         for f in outs + errs:
             f.close()
+
+
+@pytest.mark.slow
+def test_mesh_follower_death_between_preflight_and_collective():
+    """The HARD failure window (r4 VERDICT #7): the follower answers the
+    lead's liveness ping, then dies on query receipt — after preflight,
+    before collective entry.  The lead's forward-grace watch must (1)
+    fail THIS query with a typed error instead of entering the doomed
+    psum barrier, and (2) mark the group degraded so every later query
+    errors fast until the group is restarted."""
+    import time
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    lead_port, follower_port = _free_port(), _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PINOT_TPU_TESTS"] = ""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(SERVE_WORKER)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    follower_env = dict(env)
+    follower_env["PINOT_TPU_MESH_TEST_EXIT_ON_QUERY"] = "1"
+    args = {
+        0: [coordinator, "2", "0", str(lead_port), str(follower_port)],
+        1: [coordinator, "2", "1", str(follower_port)],
+    }
+    import tempfile
+
+    logdir = tempfile.mkdtemp(prefix="meshdeath_")
+    outs = [open(os.path.join(logdir, f"w{pid}.out"), "w+") for pid in (0, 1)]
+    errs = [open(os.path.join(logdir, f"w{pid}.err"), "w+") for pid in (0, 1)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, SERVE_WORKER, *args[pid]],
+            stdout=outs[pid],
+            stderr=errs[pid],
+            text=True,
+            env=env if pid == 0 else follower_env,
+            cwd=repo_root,
+        )
+        for pid in (0, 1)
+    ]
+
+    def read(f):
+        f.flush()
+        f.seek(0)
+        return f.read()
+
+    try:
+        deadline = time.time() + 240
+        serving = set()
+        while len(serving) < 2 and time.time() < deadline:
+            for i, p in enumerate(procs):
+                if i in serving:
+                    continue
+                if p.poll() is not None:
+                    err = read(errs[i])
+                    low = err.lower()
+                    if "gloo" in low or "collectives" in low or "unimplemented" in low:
+                        pytest.skip(f"CPU cross-process collectives unavailable: {err[-300:]}")
+                    pytest.fail(f"worker {i} died rc={p.returncode}\n{err[-2000:]}")
+                if "SERVING" in read(outs[i]):
+                    serving.add(i)
+            time.sleep(0.2)
+        assert len(serving) == 2, "mesh hosts did not come up in time"
+
+        from pinot_tpu.broker.broker import BrokerRequestHandler
+        from pinot_tpu.broker.routing import RoutingTableProvider
+        from pinot_tpu.transport.tcp import TcpTransport
+
+        routing = RoutingTableProvider()
+        routing.update(
+            "lineitem", {f"mh{i}": {"meshhost0": "ONLINE"} for i in range(8)}
+        )
+        broker = BrokerRequestHandler(
+            TcpTransport(),
+            {"meshhost0": ("127.0.0.1", lead_port)},
+            routing=routing,
+            timeout_ms=240_000.0,
+        )
+        # the follower pings PONG (alive), then _exit(17)s on the query
+        t0 = time.time()
+        resp = broker.handle_pql("SELECT count(*) FROM lineitem")
+        took = time.time() - t0
+        assert resp.exceptions, "mid-query follower death must error, not hang"
+        assert "between preflight and collective entry" in resp.exceptions[0].message
+        assert took < 60, f"mid-query death detection took {took:.0f}s"
+        try:
+            rc = procs[1].wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            rc = None
+        assert rc == 17, f"follower should have exited via the hook (rc={rc})"
+
+        # the group is now degraded: every subsequent query errors FAST
+        t0 = time.time()
+        resp2 = broker.handle_pql("SELECT count(*) FROM lineitem")
+        assert resp2.exceptions
+        assert "degraded" in resp2.exceptions[0].message
+        assert time.time() - t0 < 15, "degraded replies must be immediate"
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in outs + errs:
+            f.close()
