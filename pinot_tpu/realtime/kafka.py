@@ -548,7 +548,7 @@ class KafkaProtocolShim:
             if t is None:
                 body += _i16(ERR_UNKNOWN_TOPIC) + _string(name) + _i32(0)
                 continue
-            nparts = len(t.rows)
+            nparts = len(t.raw)
             body += _i16(ERR_NONE) + _string(name) + _i32(nparts)
             for p in range(nparts):
                 body += (
@@ -570,10 +570,10 @@ class KafkaProtocolShim:
                 pid = r.i32()
                 time = r.i64()
                 r.i32()  # max_num_offsets
-                if t is None or pid >= len(t.rows):
+                if t is None or pid >= len(t.raw):
                     body += _i32(pid) + _i16(ERR_UNKNOWN_TOPIC) + _i32(0)
                     continue
-                off = 0 if time == EARLIEST else len(t.rows[pid])
+                off = 0 if time == EARLIEST else len(t.raw[pid])
                 body += _i32(pid) + _i16(ERR_NONE) + _i32(1) + _i64(off)
         return body
 
@@ -592,10 +592,10 @@ class KafkaProtocolShim:
                 pid = r.i32()
                 offset = r.i64()
                 max_bytes = r.i32()
-                if t is None or pid >= len(t.rows):
+                if t is None or pid >= len(t.raw):
                     body += _i32(pid) + _i16(ERR_UNKNOWN_TOPIC) + _i64(0) + _i32(0)
                     continue
-                log = t.rows[pid]
+                log = t.raw[pid]  # stored serialized bytes, verbatim
                 hw = len(log)
                 if offset > hw:
                     body += _i32(pid) + _i16(ERR_OFFSET_OUT_OF_RANGE) + _i64(hw) + _i32(0)
@@ -605,7 +605,7 @@ class KafkaProtocolShim:
                 tail = b""  # truncated partial message (raw path only)
                 o = offset
                 while o < hw:
-                    m = encode_message(o, json.dumps(log[o]).encode())
+                    m = encode_message(o, log[o])
                     if size + len(m) > max_bytes:
                         # real-broker behavior: cut the MessageSet at
                         # max_bytes, leaving a truncated partial message

@@ -177,6 +177,11 @@ def main() -> None:
         "baseball": baseball_groupby(args.bb_segments, args.bb_rows),
         "startree": startree_cube(args.st_rows),
         "realtime": realtime_windowed(args.rt_rows),
+        # parallel N-partition consumer ingest (+ query-during-ingest):
+        # tools/ingest_bench.py; the full-scale committed run lives in
+        # INGEST_r5.json (solo 1.15M rows/s single-core via the
+        # columnar stream path; aggregate is core-bound on this host)
+        "parallel_ingest_ref": "INGEST_r5.json",
     }
     print(json.dumps(out))
 

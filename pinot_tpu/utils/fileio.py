@@ -11,13 +11,13 @@ import os
 import tempfile
 
 
-def atomic_write(path: str, text: str) -> None:
+def atomic_write(path: str, text, binary: bool = False) -> None:
     """Write ``text`` to ``path`` via a same-directory temp file with
     fsync-before-rename (crash-durable whole-file replace)."""
     dirname = os.path.dirname(path) or "."
     fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
     try:
-        with os.fdopen(fd, "w") as f:
+        with os.fdopen(fd, "wb" if binary else "w") as f:
             f.write(text)
             f.flush()
             os.fsync(f.fileno())
