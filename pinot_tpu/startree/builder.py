@@ -46,13 +46,55 @@ class StarTreeBuilderConfig:
     hll_columns: List[str] = field(default_factory=list)
 
 
+def _pack_keys(dims: np.ndarray, radices: Optional[np.ndarray]) -> Optional[np.ndarray]:
+    """ONE mixed-radix int64 key per row (STAR=-1 offset in): sorting /
+    uniquing the packed key is identical in order and grouping to
+    lexicographic row operations, and a scalar int64 argsort is several
+    times faster than np.unique(axis=0)'s structured-view sort — the
+    dominant cost of large builds.  None when the radix product could
+    overflow (callers fall back to the row-wise path)."""
+    if radices is None:
+        return None
+    key = np.zeros(dims.shape[0], dtype=np.int64)
+    for j in range(dims.shape[1]):
+        key = key * int(radices[j]) + (dims[:, j].astype(np.int64) + 1)
+    return key
+
+
+def _dim_radices(cards: Sequence[int]) -> Optional[np.ndarray]:
+    radices = np.asarray([int(c) + 1 for c in cards], dtype=np.int64)
+    prod = 1.0
+    for r in radices:
+        prod *= float(r)
+    if prod >= 2.0**62:
+        return None
+    return radices
+
+
+def _unique_rows(dims: np.ndarray, radices: Optional[np.ndarray]):
+    """(unique rows lexicographically sorted, inverse) — packed-key
+    fast path when the radix product fits int64."""
+    key = _pack_keys(dims, radices)
+    if key is not None:
+        _, index, inverse = np.unique(key, return_index=True, return_inverse=True)
+        return dims[index], inverse
+    return np.unique(dims, axis=0, return_inverse=True)
+
+
 def _aggregate(
-    dims: np.ndarray, sums: np.ndarray, counts: np.ndarray, regs: Optional[Regs]
+    dims: np.ndarray,
+    sums: np.ndarray,
+    counts: np.ndarray,
+    regs: Optional[Regs],
+    radices: Optional[np.ndarray] = None,
 ):
-    """Group rows by all dim columns; sum metrics/counts, max registers."""
+    """Group rows by all dim columns; sum metrics/counts, max registers.
+    Output rows come back lexicographically SORTED (np.unique's order on
+    either path) — the invariant split_node's run detection relies on,
+    with no separate sort pass."""
     if dims.shape[0] == 0:
         return dims, sums, counts, regs
-    uniq, inverse = np.unique(dims, axis=0, return_inverse=True)
+    uniq, inverse = _unique_rows(dims, radices)
     m = sums.shape[1]
     agg_sums = np.zeros((uniq.shape[0], m), dtype=np.float64)
     for j in range(m):
@@ -64,15 +106,6 @@ def _aggregate(
             col: group_max_rows(inverse, uniq.shape[0], r) for col, r in regs.items()
         }
     return uniq.astype(np.int32), agg_sums, agg_counts, agg_regs
-
-
-def _sort_lex(dims, sums, counts, regs: Optional[Regs], from_level: int):
-    if dims.shape[0] == 0:
-        return dims, sums, counts, regs
-    keys = tuple(dims[:, j] for j in range(dims.shape[1] - 1, from_level - 1, -1))
-    order = np.lexsort(keys) if keys else np.arange(dims.shape[0])
-    regs_o = {c: r[order] for c, r in regs.items()} if regs is not None else None
-    return dims[order], sums[order], counts[order], regs_o
 
 
 class _Accum:
@@ -167,13 +200,14 @@ def build_star_tree(
     )
     counts = np.ones(n, dtype=np.int64)
 
+    radices = _dim_radices([segment.column(c).metadata.cardinality for c in split_order])
+
     # aggregate raw docs by all split dims; fold HLL registers in the
     # same pass via per-dictId (bucket, rho) tables
-    uniq, inverse = (
-        np.unique(dims, axis=0, return_inverse=True)
-        if n
-        else (np.zeros((0, k), np.int32), np.zeros(0, np.int64))
-    )
+    if n:
+        uniq, inverse = _unique_rows(dims, radices)
+    else:
+        uniq, inverse = np.zeros((0, k), np.int32), np.zeros(0, np.int64)
     agg_sums = np.zeros((uniq.shape[0], m), dtype=np.float64)
     for j in range(m):
         agg_sums[:, j] = np.bincount(inverse, weights=sums[:, j], minlength=uniq.shape[0])
@@ -184,18 +218,18 @@ def build_star_tree(
         regs = {}
         for hcol in config.hll_columns:
             d = segment.column(hcol).dictionary
-            bucket = np.zeros(d.cardinality, dtype=np.int64)
-            rho = np.zeros(d.cardinality, dtype=np.uint8)
-            for i in range(d.cardinality):
-                b, r = hll_mod.bucket_and_rho(hll_mod.value_hash64(d.get(i)))
-                bucket[i], rho[i] = b, r
+            # ONE shared per-dictId (bucket, rho) table build, cached on
+            # the dictionary (hll.dictionary_tables) — the same tables
+            # the staging/planner paths use, so repeated builds and
+            # queries over this segment never re-hash the dictionary
+            bucket, rho = hll_mod.dictionary_tables(d)
             fwd = segment.column(hcol).fwd
             regs[hcol] = scatter_max_2d(
-                inverse, uniq.shape[0], bucket[fwd], rho[fwd], hll_mod.M
+                inverse, uniq.shape[0], bucket[fwd].astype(np.int64), rho[fwd], hll_mod.M
             )
 
+    # rows are already lexicographically sorted (np.unique order)
     dims, sums, counts = uniq.astype(np.int32), agg_sums, agg_counts
-    dims, sums, counts, regs = _sort_lex(dims, sums, counts, regs, 0)
 
     acc = _Accum(k, m, config.hll_columns)
     skip = set(config.skip_star_for_dims)
@@ -219,8 +253,7 @@ def build_star_tree(
         if split_order[level] not in skip:
             star_dims = dims_b.copy()
             star_dims[:, level] = STAR
-            sd, ss, sc, sr = _aggregate(star_dims, sums_b, counts_b, regs_b)
-            sd, ss, sc, sr = _sort_lex(sd, ss, sc, sr, level + 1)
+            sd, ss, sc, sr = _aggregate(star_dims, sums_b, counts_b, regs_b, radices)
             sstart, _ = acc.append(sd, ss, sc, sr)
             node.star_child = split_node(sd, ss, sc, sr, level + 1, sstart)
         return node

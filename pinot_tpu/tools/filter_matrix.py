@@ -1,0 +1,177 @@
+"""Filter-tier A/B matrix: selectivity x clustering x path (r4 #3).
+
+The engine picks among three filter tiers (the reference's
+Bitmap/Sorted vs Scan operator choice, ``BitmapBasedFilterOperator.java:34``
+vs ``ScanBasedFilterOperator.java:38``):
+
+  invindex  host CSR postings, O(matches), doc-order independent
+  zonemap   per-64k-block pruning + device block gather (needs
+            clustered values)
+  fullscan  the device scan kernel, O(n)
+
+This tool measures broker-path p50 for each (selectivity, clustering,
+path) cell so the crossovers in the path-choice logic are set from
+data, and reports per-cell winners.  Selectivity is swept with date
+windows on the CLUSTERED l_shipdate column and value sets on the
+SHUFFLED high-cardinality l_extendedprice column.
+
+Usage:
+  python -m pinot_tpu.tools.filter_matrix                  # bench shape
+  python -m pinot_tpu.tools.filter_matrix -segments 2 -rows-per-segment 250000
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Dict, List
+
+
+PATHS = {  # label -> (PINOT_TPU_INVINDEX, PINOT_TPU_ZONEMAP)
+    "invindex": ("1", "0"),
+    "zonemap": ("0", "1"),
+    "fullscan": ("0", "0"),
+}
+
+
+def _shipdate_windows(segments) -> List[tuple]:
+    """(label, pql, approx_selectivity) for the clustered column."""
+    d = segments[0].column("l_shipdate").dictionary
+    card = d.cardinality
+    vals = [d.get(i) for i in range(card)]
+
+    def between(frac: float, label: str):
+        k = max(1, int(card * frac))
+        mid = card // 2
+        lo, hi = vals[mid - k // 2], vals[min(mid + k // 2, card - 1)]
+        return (
+            label,
+            f"SELECT sum(l_extendedprice), count(*) FROM lineitem "
+            f"WHERE l_shipdate BETWEEN {lo!r} AND {hi!r}",
+            frac,
+        )
+
+    return [
+        (
+            "eq_1day",
+            f"SELECT sum(l_extendedprice), count(*) FROM lineitem "
+            f"WHERE l_shipdate = {vals[card // 2]!r}",
+            1.0 / card,
+        ),
+        between(0.002, "win_0.2pct"),
+        between(0.01, "win_1pct"),
+        between(0.05, "win_5pct"),
+        between(0.25, "win_25pct"),
+    ]
+
+
+def _price_points(segments) -> List[tuple]:
+    """(label, pql, approx_selectivity) for the shuffled column."""
+    d = segments[0].column("l_extendedprice").dictionary
+    card = d.cardinality
+    step = max(1, card // 64)
+
+    def in_list(k: int, label: str):
+        pts = [d.get((i * step) % card) for i in range(k)]
+        lst = ", ".join(repr(p) for p in pts)
+        return (
+            label,
+            f"SELECT sum(l_quantity), count(*) FROM lineitem "
+            f"WHERE l_extendedprice IN ({lst})",
+            k / card,
+        )
+
+    return [
+        (
+            "eq_1val",
+            f"SELECT sum(l_quantity), count(*) FROM lineitem "
+            f"WHERE l_extendedprice = {d.get(card // 2)!r}",
+            1.0 / card,
+        ),
+        in_list(8, "in_8vals"),
+        in_list(16, "in_16vals"),
+    ]
+
+
+def run_matrix(segments, reps: int) -> dict:
+    from pinot_tpu.tools.cluster_harness import single_server_broker
+    from pinot_tpu.tools.query_runner import QueryRunner
+
+    broker = single_server_broker("lineitem", segments)
+
+    def run(pql: str) -> None:
+        resp = broker.handle_pql(pql)
+        assert not resp.exceptions, resp.exceptions
+
+    runner = QueryRunner(run)
+    cases = [("clustered", c) for c in _shipdate_windows(segments)] + [
+        ("shuffled", c) for c in _price_points(segments)
+    ]
+    flags = ("PINOT_TPU_INVINDEX", "PINOT_TPU_ZONEMAP")
+    saved = {k: os.environ.get(k) for k in flags}
+    cells: List[dict] = []
+    try:
+        for shape, (label, pql, sel) in cases:
+            row: Dict[str, object] = {
+                "shape": shape,
+                "case": label,
+                "selectivity": round(sel, 5),
+            }
+            for path, (inv, zm) in PATHS.items():
+                os.environ["PINOT_TPU_INVINDEX"] = inv
+                os.environ["PINOT_TPU_ZONEMAP"] = zm
+                runner.single_thread([pql], rounds=3)  # warm + compile
+                r = runner.single_thread([pql] * reps, rounds=1)
+                rj = r.to_json()
+                row[f"{path}_p50_ms"] = rj["p50Ms"]
+                row[f"{path}_p90_ms"] = rj["p90Ms"]
+            row["winner"] = min(PATHS, key=lambda p: row[f"{p}_p50_ms"])
+            cells.append(row)
+            print(json.dumps(row), flush=True)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {
+        "matrix": cells,
+        "total_rows": sum(s.num_docs for s in segments),
+        "reps": reps,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-segments", type=int, default=None)
+    ap.add_argument("-rows-per-segment", type=int, default=None, dest="rps")
+    ap.add_argument("-reps", type=int, default=15)
+    ap.add_argument("-out", type=str, default="")
+    args = ap.parse_args()
+
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    n_seg = args.segments if args.segments is not None else (16 if on_tpu else 2)
+    rps = args.rps if args.rps is not None else (8_388_608 if on_tpu else 250_000)
+
+    from pinot_tpu.tools.datagen import synthetic_lineitem_segment
+
+    t0 = time.perf_counter()
+    segments = [
+        synthetic_lineitem_segment(rps, seed=11 + i, name=f"li{i}")
+        for i in range(n_seg)
+    ]
+    print(json.dumps({"datagen_s": round(time.perf_counter() - t0, 1)}), flush=True)
+    doc = run_matrix(segments, args.reps)
+    doc["platform"] = jax.devices()[0].platform
+    text = json.dumps(doc, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()
