@@ -243,6 +243,18 @@ class QueryExecutor:
         if ires is not None:
             self._phase("indexPath", t0)
             return ires
+
+        # queries the planner can only send to the host (group space or
+        # guaranteed pair overflow) skip device staging entirely
+        from pinot_tpu.engine.plan import plan_forced_host
+
+        if plan_forced_host(request, ctx):
+            from pinot_tpu.engine.host_fallback import execute_host
+
+            res = execute_host(live, ctx, request, total_docs, sel_columns)
+            self._phase("hostPath", t0)
+            return res
+
         raw_cols, gfwd_cols, hll_cols = self._role_columns(request, live, ctx)
         # Columns the kernel reads ONLY through a role stream skip their
         # base fwd/dict arrays: at 1B rows the dictId stream is the

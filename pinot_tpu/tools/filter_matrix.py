@@ -99,16 +99,19 @@ def run_matrix(segments, reps: int) -> dict:
     from pinot_tpu.tools.query_runner import QueryRunner
 
     broker = single_server_broker("lineitem", segments)
+    total_rows = sum(s.num_docs for s in segments)
+    last = {}
 
     def run(pql: str) -> None:
         resp = broker.handle_pql(pql)
         assert not resp.exceptions, resp.exceptions
+        last["entries"] = resp.num_entries_scanned_in_filter
 
     runner = QueryRunner(run)
     cases = [("clustered", c) for c in _shipdate_windows(segments)] + [
         ("shuffled", c) for c in _price_points(segments)
     ]
-    flags = ("PINOT_TPU_INVINDEX", "PINOT_TPU_ZONEMAP")
+    flags = ("PINOT_TPU_INVINDEX", "PINOT_TPU_ZONEMAP", "PINOT_TPU_INDEX_MAX_MATCHES")
     saved = {k: os.environ.get(k) for k in flags}
     cells: List[dict] = []
     try:
@@ -121,12 +124,28 @@ def run_matrix(segments, reps: int) -> dict:
             for path, (inv, zm) in PATHS.items():
                 os.environ["PINOT_TPU_INVINDEX"] = inv
                 os.environ["PINOT_TPU_ZONEMAP"] = zm
+                # invindex cells FORCE the postings path past its
+                # selectivity bail so every cell measures its own path
+                # (the crossover is what the matrix exists to find)
+                if path == "invindex":
+                    os.environ["PINOT_TPU_INDEX_MAX_MATCHES"] = str(total_rows)
+                else:
+                    os.environ.pop("PINOT_TPU_INDEX_MAX_MATCHES", None)
                 runner.single_thread([pql], rounds=3)  # warm + compile
                 r = runner.single_thread([pql] * reps, rounds=1)
                 rj = r.to_json()
                 row[f"{path}_p50_ms"] = rj["p50Ms"]
                 row[f"{path}_p90_ms"] = rj["p90Ms"]
+                row[f"{path}_entries_scanned"] = last.get("entries")
+            # zonemap cannot be forced past its half-table bail: mark
+            # cells where it fell through to the scan (identical
+            # filter-entry count) so they are not read as zonemap wins
+            row["zonemap_engaged"] = (
+                row["zonemap_entries_scanned"] != row["fullscan_entries_scanned"]
+            )
             row["winner"] = min(PATHS, key=lambda p: row[f"{p}_p50_ms"])
+            if row["winner"] == "zonemap" and not row["zonemap_engaged"]:
+                row["winner"] = "fullscan"
             cells.append(row)
             print(json.dumps(row), flush=True)
     finally:
@@ -137,7 +156,7 @@ def run_matrix(segments, reps: int) -> dict:
                 os.environ[k] = v
     return {
         "matrix": cells,
-        "total_rows": sum(s.num_docs for s in segments),
+        "total_rows": total_rows,
         "reps": reps,
     }
 

@@ -76,14 +76,7 @@ def run_config(name, segments, schema, tree_config, table, pql, reps) -> dict:
     return doc
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("-segments", type=int, default=8)
-    ap.add_argument("-rows", type=int, default=8_388_608, help="rows per segment")
-    ap.add_argument("-reps", type=int, default=9)
-    ap.add_argument("-out", type=str, default="")
-    args = ap.parse_args()
-
+def run_one(config_name: str, segments_n: int, rows: int, reps: int) -> dict:
     from pinot_tpu.startree.builder import StarTreeBuilderConfig
     from pinot_tpu.tools.datagen import (
         adevents_schema,
@@ -92,52 +85,98 @@ def main() -> None:
         synthetic_baseball_segment,
     )
 
+    t0 = time.perf_counter()
+    if config_name == "adevents_hll_cube":
+        segs = [
+            synthetic_adevents_segment(rows, seed=100 + i, name=f"sta{i}")
+            for i in range(segments_n)
+        ]
+        gen_s = round(time.perf_counter() - t0, 1)
+        doc = run_config(
+            config_name,
+            segs,
+            adevents_schema(),
+            StarTreeBuilderConfig(
+                split_order=["campaign_id", "site_id"],
+                hll_columns=["user_id"],
+                max_leaf_records=64,
+            ),
+            "adevents",
+            "SELECT distinctcounthll(user_id) FROM adevents GROUP BY campaign_id TOP 10",
+            reps,
+        )
+    else:
+        segs = [
+            synthetic_baseball_segment(rows, seed=200 + i, name=f"stb{i}")
+            for i in range(segments_n)
+        ]
+        gen_s = round(time.perf_counter() - t0, 1)
+        doc = run_config(
+            config_name,
+            segs,
+            baseball_schema(),
+            StarTreeBuilderConfig(),
+            "baseballStats",
+            "SELECT sum(runs), count(*) FROM baseballStats GROUP BY teamID TOP 20",
+            reps,
+        )
+    doc["datagen_s"] = gen_s
+    return doc
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-segments", type=int, default=8)
+    ap.add_argument("-rows", type=int, default=8_388_608, help="rows per segment")
+    ap.add_argument("-reps", type=int, default=9)
+    ap.add_argument("-only", type=str, default="", help="(internal) run one config")
+    ap.add_argument("-out", type=str, default="")
+    args = ap.parse_args()
+
+    if args.only:
+        # subprocess mode: ru_maxrss is a process-lifetime high-water
+        # mark, so each config runs in its OWN process for an honest
+        # per-config peak
+        print("RESULT " + json.dumps(run_one(args.only, args.segments, args.rows, args.reps)))
+        return
+
+    import os
+    import subprocess
+    import sys
+
     import jax
 
-    t0 = time.perf_counter()
-    ad_segs = [
-        synthetic_adevents_segment(args.rows, seed=100 + i, name=f"sta{i}")
-        for i in range(args.segments)
-    ]
-    gen_ad = round(time.perf_counter() - t0, 1)
-    hll_doc = run_config(
-        "adevents_hll_cube",
-        ad_segs,
-        adevents_schema(),
-        StarTreeBuilderConfig(
-            split_order=["campaign_id", "site_id"],
-            hll_columns=["user_id"],
-            max_leaf_records=64,
-        ),
-        "adevents",
-        "SELECT distinctcounthll(user_id) FROM adevents GROUP BY campaign_id TOP 10",
-        args.reps,
-    )
-    del ad_segs
-
-    t0 = time.perf_counter()
-    bb_segs = [
-        synthetic_baseball_segment(args.rows, seed=200 + i, name=f"stb{i}")
-        for i in range(args.segments)
-    ]
-    gen_bb = round(time.perf_counter() - t0, 1)
-    bb_doc = run_config(
-        "baseball_cube",
-        bb_segs,
-        baseball_schema(),
-        StarTreeBuilderConfig(),
-        "baseballStats",
-        "SELECT sum(runs), count(*) FROM baseballStats GROUP BY teamID TOP 20",
-        args.reps,
-    )
+    docs = {}
+    for name in ("adevents_hll_cube", "baseball_cube"):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pinot_tpu.tools.startree_scale",
+                "-only",
+                name,
+                "-segments",
+                str(args.segments),
+                "-rows",
+                str(args.rows),
+                "-reps",
+                str(args.reps),
+            ],
+            capture_output=True,
+            text=True,
+            env={**os.environ, "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
+        )
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+        if proc.returncode != 0 or not lines:
+            raise RuntimeError(f"{name} failed: {proc.stderr[-1500:]}")
+        docs[name] = json.loads(lines[-1][len("RESULT ") :])
 
     out = {
         "platform": jax.devices()[0].platform,
-        "datagen_s": {"adevents": gen_ad, "baseball": gen_bb},
-        "adevents_hll_cube": hll_doc,
-        "baseball_cube": bb_doc,
+        **docs,
         "note": "per-segment builds bound peak RSS by one segment's working "
-        "set (streaming property); build wall scales linearly with segments",
+        "set (streaming property); build wall scales linearly with segments; "
+        "each config measured in its own process (honest per-config peak RSS)",
     }
     text = json.dumps(out, indent=1)
     print(text)
