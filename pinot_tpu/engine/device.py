@@ -21,7 +21,8 @@ stored: the kernel derives doc validity from ``iota < num_docs`` and MV
 entry validity from ``iota < mv_counts``, trading a free register
 compare for an HBM byte per row (or per MV slot).
 
-All shapes are bucketed (pow2 padding, ``config.pad_docs/pad_card``) so
+All shapes are bucketed (pow2 padding, ``config.pad_docs/pad_card``;
+value-state holder axes use quarter-pow2 ``config.pad_value_card``) so
 the jit cache stays bounded; padding docs carry dictId 0.
 """
 from __future__ import annotations

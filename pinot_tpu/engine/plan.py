@@ -190,7 +190,7 @@ def plan_forced_host(request, ctx) -> bool:
                 # cardinality is guaranteed to overflow the device
                 # buffer (the same condition build_static_plan applies)
                 if value_state_sort_pairs(
-                    _agg_kind(a.base_function), config.pad_card(gcard), cap
+                    _agg_kind(a.base_function), config.pad_value_card(gcard), cap
                 ):
                     return True
     except KeyError:
@@ -211,7 +211,7 @@ def hll_lowers_to_presence(request, ctx, column: str) -> bool:
 
     if os.environ.get("PINOT_TPU_HLL_PRESENCE", "1") == "0":
         return False  # A/B kill switch: force the per-row register streams
-    gcard_pad = config.pad_card(ctx.column(column).global_cardinality)
+    gcard_pad = config.pad_value_card(ctx.column(column).global_cardinality)
     if gcard_pad > config.HLL_M * 64:
         return False
     cap = 1
@@ -426,7 +426,7 @@ def build_static_plan(
             hll_from_presence = True
         if kind in ("presence", "hist"):
             gcol = ctx.column(a.column)
-            gcard_pad = config.pad_card(gcol.global_cardinality)
+            gcard_pad = config.pad_value_card(gcol.global_cardinality)
             if value_state_sort_pairs(kind, gcard_pad, None):
                 # dense state would not fit: sort the (group, valueId)
                 # pairs on device instead — dedup covers distinctcount,
