@@ -66,7 +66,7 @@ def run_curve(
     broker = single_server_broker("lineitem", segments, max_pending=max_pending)
     queries = mixed_workload(segments)
 
-    counters = {"errors": 0, "shed": 0}
+    counters = {"errors": 0, "shed": 0, "quota": 0}
     clock = threading.Lock()  # target_qps drives run() from worker threads
 
     def run(pql: str) -> None:
@@ -74,10 +74,15 @@ def run_curve(
         if resp.exceptions:
             codes = {e.error_code for e in resp.exceptions}
             with clock:
-                if ErrorCode.SERVER_SCHEDULER_DOWN in codes:
+                if ErrorCode.TOO_MANY_REQUESTS in codes:
+                    counters["quota"] += 1
+                elif ErrorCode.SERVER_SCHEDULER_DOWN in codes:
                     counters["shed"] += 1
                 else:
                     counters["errors"] += 1
+
+    def reset_counters() -> None:
+        counters.update(errors=0, shed=0, quota=0)
 
     runner = QueryRunner(run)
     # warm every shape: staging + per-shape compile
@@ -87,7 +92,7 @@ def run_curve(
     steps = []
     saturation = None
     for qps in qps_ladder:
-        counters["errors"] = counters["shed"] = 0
+        reset_counters()
         report = runner.target_qps(queries, qps=qps, duration_s=duration_s)
         rj = report.to_json()
         step = {
@@ -107,14 +112,43 @@ def run_curve(
         ):
             saturation = qps
 
+    # broker-tier overload demonstration: the per-table QPS quota is the
+    # front-door shed (reference: broker rate limiting) — drive well
+    # past a configured quota and record the 429-coded rejects
+    quota_step = None
+    if steps:
+        quota_qps = max(4.0, qps_ladder[0])
+        broker.quota.set_quota("lineitem", quota_qps)
+        try:
+            reset_counters()
+            report = runner.target_qps(
+                queries, qps=4 * quota_qps, duration_s=min(duration_s, 10.0)
+            )
+            rj = report.to_json()
+            quota_step = {
+                "quota_qps": quota_qps,
+                "offered_qps": 4 * quota_qps,
+                "answered_qps": round(
+                    rj["qps"] - counters["quota"] / rj["wallSeconds"], 1
+                ),
+                "quota_rejects": counters["quota"],
+                "shed": counters["shed"],
+                "errors": counters["errors"],
+            }
+        finally:
+            broker.quota.set_quota("lineitem", None)
+        print(json.dumps({"quota_step": quota_step}), flush=True)
+
     return {
         "workload": "mixed: Q1 groupby scan, Q6 IN+range, selection needle, HLL groupby",
         "num_segments": len(segments),
         "total_rows": sum(s.num_docs for s in segments),
         "duration_s_per_step": duration_s,
-        "overload_policy": "bounded FCFS queue; submits beyond max_pending shed "
-        "immediately with error 210 (server/scheduler.py)",
+        "overload_policy": "server tier: bounded FCFS queue, submits beyond "
+        "max_pending shed immediately with error 210 (server/scheduler.py); "
+        "broker tier: per-table QPS quota sheds with 429 (broker/quota.py)",
         "steps": steps,
+        "quota_step": quota_step,
         "saturation_qps": saturation,
     }
 
