@@ -384,3 +384,55 @@ def test_having_engine_sentinel():
     }
     assert set(counts) == {"ny", "la"}  # count list filtered too
     assert float(counts["ny"]) == 2 and float(counts["la"]) == 1
+
+
+def test_grouped_hll_three_lowerings_bit_identical(monkeypatch):
+    """The grouped-HLL matmul / packed-sort / scatter lowerings must be
+    interchangeable: same registers, same estimates, byte-identical
+    responses (the sort path's searchsorted run-max extraction is the
+    round-5 replacement for scatter-max; the matmul occupancy is the
+    small-capacity fast path)."""
+    from pinot_tpu.engine import kernel as kernel_mod
+    from pinot_tpu.engine.device import clear_staging_cache
+
+    schema = make_test_schema(with_mv=True)
+    rows = random_rows(schema, 3000, seed=77, cardinality=40)
+    segs = [
+        build_segment(schema, rows[:1500], "testTable", "hl0"),
+        build_segment(schema, rows[1500:], "testTable", "hl1"),
+    ]
+    pqls = [
+        "SELECT distinctcounthll(dimLong) FROM testTable GROUP BY dimStr TOP 10",
+        "SELECT fasthll(dimLong), count(*) FROM testTable "
+        "GROUP BY dimStr, dimInt TOP 12",
+    ]
+    variants = {
+        # (GROUPBY_MATMUL, _MATMUL_HLL_CAP, _HLL_SORT_CAP) -> path
+        # 1<<25 covers BOTH queries' K = capacity * 16384 (the two-dim
+        # group space is 40*39=1560 -> K ~= 25.6M) so the matmul
+        # variant genuinely takes the matmul lowering for each
+        "matmul": ("1", 1 << 25, 1 << 16),
+        "sort": ("0", 1 << 18, 1 << 16),
+        "scatter": ("0", 1 << 18, 0),
+    }
+    results = {}
+    try:
+        for name, (mm, hll_cap, sort_cap) in variants.items():
+            monkeypatch.setenv("PINOT_TPU_GROUPBY_MATMUL", mm)
+            monkeypatch.setattr(kernel_mod, "_MATMUL_HLL_CAP", hll_cap)
+            monkeypatch.setattr(kernel_mod, "_HLL_SORT_CAP", sort_cap)
+            kernel_mod.make_table_kernel.cache_clear()
+            kernel_mod.make_packed_table_kernel.cache_clear()
+            clear_staging_cache()
+            out = []
+            for q in pqls:
+                req = optimize_request(parse_pql(q))
+                resp = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
+                assert not resp.exceptions, (name, q, resp.exceptions)
+                out.append(_norm(resp))
+            results[name] = out
+    finally:
+        kernel_mod.make_table_kernel.cache_clear()
+        kernel_mod.make_packed_table_kernel.cache_clear()
+        clear_staging_cache()
+    assert results["matmul"] == results["sort"] == results["scatter"]
