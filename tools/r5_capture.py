@@ -1,0 +1,131 @@
+"""Round-5 on-chip capture sequence — run when the tunnel is healthy.
+
+Runs each pending on-chip measurement as its OWN subprocess (the
+single-client tunnel tolerates exactly one attached process at a time;
+a fresh process per phase also keeps one phase's wedge from losing the
+others), in priority order, committing artifacts as it goes:
+
+  1. bench.py                 -> BENCH line incl. hll_groupby_p50_ms
+  2. hll_northstar -paths     -> ladder rows/s (sort lowering) + aux
+  3. filter_matrix            -> FILTER_MATRIX_r5.json
+  4. serving_curve            -> SERVING_CURVE_TPU_r5.json
+
+Each phase gets a deadline; on timeout/failure the runner records the
+failure and moves on (a wedge mid-sequence still leaves the earlier
+artifacts on disk).  Usage:  python tools/r5_capture.py [--skip N ...]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PHASES = [
+    {
+        "name": "bench",
+        "cmd": [sys.executable, "bench.py"],
+        "deadline_s": 2500,
+        "log": "/tmp/r5cap_bench.log",
+    },
+    {
+        "name": "northstar",
+        "cmd": [
+            sys.executable,
+            "-m",
+            "pinot_tpu.tools.hll_northstar",
+            "-rows",
+            "134217728",
+            "-paths",
+        ],
+        "deadline_s": 3600,
+        "log": "/tmp/r5cap_northstar.log",
+    },
+    {
+        "name": "filter_matrix",
+        "cmd": [
+            sys.executable,
+            "-m",
+            "pinot_tpu.tools.filter_matrix",
+            "-out",
+            os.path.join(REPO, "FILTER_MATRIX_r5.json"),
+        ],
+        "deadline_s": 3600,
+        "log": "/tmp/r5cap_matrix.log",
+    },
+    {
+        "name": "serving_curve",
+        "cmd": [
+            sys.executable,
+            "-m",
+            "pinot_tpu.tools.serving_curve",
+            "-qps",
+            "1,2,4,8,16,32",
+            "-duration",
+            "20",
+            "-out",
+            os.path.join(REPO, "SERVING_CURVE_TPU_r5.json"),
+        ],
+        "deadline_s": 3600,
+        "log": "/tmp/r5cap_curve.log",
+    },
+]
+
+
+def main() -> None:
+    skip = set()
+    args = sys.argv[1:]
+    if args and args[0] == "--skip":
+        skip = set(args[1:])
+    manifest = []
+    for phase in PHASES:
+        if phase["name"] in skip:
+            continue
+        t0 = time.time()
+        print(f"== {phase['name']} (deadline {phase['deadline_s']}s)", flush=True)
+        with open(phase["log"], "w") as log:
+            proc = subprocess.Popen(
+                phase["cmd"], cwd=REPO, stdout=log, stderr=subprocess.STDOUT
+            )
+            try:
+                rc = proc.wait(timeout=phase["deadline_s"])
+            except subprocess.TimeoutExpired:
+                # NEVER SIGKILL a chip-attached process (a kill mid-
+                # transfer wedges the single-client tunnel lease for
+                # hours) — SIGTERM and wait patiently
+                proc.terminate()
+                try:
+                    rc = proc.wait(timeout=300)
+                except subprocess.TimeoutExpired:
+                    print(
+                        f"!! {phase['name']} ignored SIGTERM; leaving it to "
+                        "exit on its own (no SIGKILL near the tunnel)",
+                        flush=True,
+                    )
+                    rc = -2
+                else:
+                    rc = -1
+        entry = {
+            "phase": phase["name"],
+            "rc": rc,
+            "seconds": round(time.time() - t0, 1),
+            "log": phase["log"],
+        }
+        manifest.append(entry)
+        print(json.dumps(entry), flush=True)
+        if rc == -2:
+            # the stuck process may still hold the single-client tunnel;
+            # a next phase would silently fall back to CPU — stop here
+            print("!! aborting sequence: previous phase still running", flush=True)
+            break
+        if rc != 0:
+            print(f"!! {phase['name']} failed (rc={rc}); continuing", flush=True)
+    with open("/tmp/r5cap_manifest.json", "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
