@@ -32,7 +32,7 @@ import time
 import numpy as np
 
 BASELINE_ROWS_PER_SEC = 14_200_000.0  # BASELINE.md: 6,001,215 rows / 0.422 s
-TPU_CAPTURE_REF = "BENCH_TPU_CAPTURES_r4.json"  # committed on-chip record
+TPU_CAPTURE_REF = "BENCH_TPU_CAPTURES_r5.json"  # committed on-chip record
 
 Q1_PQL = (
     "SELECT sum(l_quantity), sum(l_extendedprice), sum(l_discount), count(*) "

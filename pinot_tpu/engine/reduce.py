@@ -95,9 +95,10 @@ def _reduce_group_by(request: BrokerRequest, merged: IntermediateResult):
         for i, agg in enumerate(request.aggregations):
             if h.function == agg.function and (h.column == agg.column or h.column == "*"):
                 having_idx = i
-                having_vals = {
-                    key: partials[i].finalize() for key, partials in groups.items()
-                }
+                hkeys = list(groups)
+                having_vals = dict(
+                    zip(hkeys, _batch_finalize([groups[k][i] for k in hkeys]))
+                )
                 passing = {
                     key
                     for key, v in having_vals.items()
@@ -105,12 +106,13 @@ def _reduce_group_by(request: BrokerRequest, merged: IntermediateResult):
                 }
                 break
 
+    keys = [k for k in groups if passing is None or k in passing]
     for i, agg in enumerate(request.aggregations):
-        pairs = [
-            (key, having_vals[key] if i == having_idx else partials[i].finalize())
-            for key, partials in groups.items()
-            if passing is None or key in passing
-        ]
+        if i == having_idx:
+            vals = [having_vals[k] for k in keys]
+        else:
+            vals = _batch_finalize([groups[k][i] for k in keys])
+        pairs = list(zip(keys, vals))
         asc = group_sort_ascending(agg.function)
         pairs.sort(key=lambda kv: (kv[1], kv[0]) if asc else (-_num(kv[1]), kv[0]))
         trimmed = pairs[: gb.top_n]
@@ -122,6 +124,24 @@ def _reduce_group_by(request: BrokerRequest, merged: IntermediateResult):
             )
         )
     return out
+
+
+def _batch_finalize(partials: List[Any]) -> List[Any]:
+    """Per-group finalize, vectorized where the partial type allows:
+    a wide HLL group-by pays ~25us of estimator per group when called
+    one-by-one; ONE stacked estimate over [G, 256] registers does the
+    same math in a single numpy pass (engine/hll.py batch support)."""
+    from pinot_tpu.engine import hll as hll_mod
+    from pinot_tpu.engine.results import HllPartial
+
+    if len(partials) > 8 and all(type(p) is HllPartial for p in partials):
+        import numpy as np
+
+        ests = hll_mod.estimate_from_registers(
+            np.stack([p.registers for p in partials])
+        )
+        return [int(e) for e in np.asarray(ests).ravel()]
+    return [p.finalize() for p in partials]
 
 
 def _num(v: Any) -> float:
