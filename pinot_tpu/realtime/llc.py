@@ -594,11 +594,66 @@ class RealtimeSegmentDataManager:
         self.mutable.start_offset = start_offset
         self._stopped = False
         self._thread: Optional[threading.Thread] = None
+        # None = untried; True/False once the stream's columnar support
+        # for this partition is known (columnar topics carry whole
+        # binary blocks; row-JSON topics raise on fetchc misuse)
+        self._columnar: Optional[bool] = None
 
     def stop(self) -> None:
         self._stopped = True
 
     # -- consumption ---------------------------------------------------
+    def _fetch_and_index(self, limit: int) -> int:
+        """One fetch + index against the stream, preferring the
+        columnar block path when the provider and partition support it
+        (netstream producec topics: np.frombuffer decode + vectorized
+        dictionary encode — the 5x ingest path, INGEST_r5.json).
+        Returns rows consumed and advances the offset."""
+        fetch_cols = getattr(self.stream, "fetch_columns", None)
+        if self._columnar is not False and fetch_cols is not None:
+            try:
+                cols, n, next_offset = fetch_cols(self.partition, self.offset)
+            except RuntimeError as e:
+                if "row-mode" not in str(e) and self._columnar is True:
+                    raise  # transient transport error on a KNOWN-columnar
+                    # partition must not latch the consumer onto the row
+                    # path (the broker rejects row fetches there forever)
+                self._columnar = False  # row-mode partition / no support
+            except Exception:
+                if self._columnar is True:
+                    raise
+                self._columnar = False
+            else:
+                self._columnar = True
+                if n <= 0:
+                    return 0
+                if n > limit:
+                    # blocks serve whole; respect the segment budget and
+                    # resume MID-block next step (the provider trims)
+                    cols = {c: a[:limit] for c, a in cols.items()}
+                    next_offset = next_offset - (n - limit)
+                    n = limit
+                try:
+                    self.mutable.index_columns(cols)
+                except ValueError:
+                    # MV schema / NaN payloads: decode to rows once and
+                    # take the row path for this batch
+                    names = list(cols)
+                    self.mutable.index_batch(
+                        [
+                            {c: cols[c][i].item() for c in names}
+                            for i in range(n)
+                        ]
+                    )
+                self.offset = next_offset
+                self.mutable.end_offset = next_offset
+                return n
+        rows, next_offset = self.stream.fetch(self.partition, self.offset, limit)
+        self.mutable.index_batch(rows)
+        self.offset = next_offset
+        self.mutable.end_offset = next_offset
+        return len(rows)
+
     def consume_step(self, max_rows: int = 1000) -> int:
         """Fetch + index one batch; returns rows consumed."""
         if self._stopped:
@@ -606,13 +661,7 @@ class RealtimeSegmentDataManager:
         budget = self.rows_per_segment - self.mutable.num_docs
         if budget <= 0:
             return 0
-        rows, next_offset = self.stream.fetch(
-            self.partition, self.offset, min(max_rows, budget)
-        )
-        self.mutable.index_batch(rows)
-        self.offset = next_offset
-        self.mutable.end_offset = next_offset
-        return len(rows)
+        return self._fetch_and_index(min(max_rows, budget))
 
     @property
     def threshold_reached(self) -> bool:
@@ -629,14 +678,8 @@ class RealtimeSegmentDataManager:
         )
         if resp == RESP_CATCH_UP and target is not None:
             while self.offset < target and not self._stopped:
-                got_rows, next_offset = self.stream.fetch(
-                    self.partition, self.offset, target - self.offset
-                )
-                if not got_rows:
+                if self._fetch_and_index(target - self.offset) == 0:
                     break
-                self.mutable.index_batch(got_rows)
-                self.offset = next_offset
-                self.mutable.end_offset = next_offset
             return resp
         if resp == RESP_COMMIT:
             committed = self.mutable.to_committed_segment()
