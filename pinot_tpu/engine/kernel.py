@@ -124,6 +124,64 @@ def _segment_add_matmul_multi(flat_idx, W, capacity: int):
 _FACTORED_CHUNK = int(_os.environ.get("PINOT_TPU_FACTORED_CHUNK", str(1 << 15)))
 
 
+_PALLAS_HIST_BLOCK = 2048
+
+
+def _value_state_counts_pallas(flat_idx, K: int):
+    """Pallas variant of the factored occupancy contraction: the two
+    thin one-hots are GENERATED in VMEM per block and contracted into a
+    VMEM-resident [K1, 128] accumulator, so HBM traffic is the index
+    stream alone (the XLA form streams both generated one-hots through
+    HBM, ~512 B/row at K=2^14).  Gated by PINOT_TPU_VALUE_STATE_PALLAS
+    pending the on-chip A/B (microbench hll_lowerings); semantics are
+    identical to _value_state_counts."""
+    from jax.experimental import pallas as pl
+
+    fdt = jnp.float32
+    n = flat_idx.shape[0]
+    if n == 0:
+        # grid (0,) would never run the i==0 init — return exact zeros
+        # like the XLA variant
+        return jnp.zeros(K, dtype=config.float_dtype())
+    blk = _PALLAS_HIST_BLOCK
+    pad = (-n) % blk
+    if pad:
+        flat_idx = jnp.concatenate([flat_idx, jnp.full(pad, K, flat_idx.dtype)])
+    nb = flat_idx.shape[0] // blk
+    K1 = -(-K // 128)
+    blocks = flat_idx.reshape(nb, blk)
+
+    def kernel(idx_ref, out_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        idx = idx_ref[0, :]  # [blk] int32
+        hi_iota = jax.lax.broadcasted_iota(jnp.int32, (blk, K1), 1)
+        lo_iota = jax.lax.broadcasted_iota(jnp.int32, (blk, 128), 1)
+        hi = ((idx[:, None] // 128) == hi_iota).astype(jnp.bfloat16)
+        lo = ((idx[:, None] % 128) == lo_iota).astype(jnp.bfloat16)
+        out_ref[...] += jax.lax.dot_general(
+            hi, lo, (((0,), (0,)), ((), ())), preferred_element_type=fdt
+        )
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((1, blk), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((K1, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((K1, 128), fdt),
+        interpret=jax.default_backend() == "cpu",
+    )(blocks)
+    return out.reshape(-1)[:K].astype(config.float_dtype())
+
+
+def _use_pallas_value_state() -> bool:
+    return _os.environ.get("PINOT_TPU_VALUE_STATE_PALLAS") == "1"
+
+
 def _value_state_counts(flat_idx, K: int):
     """Occupancy counts over a combined value-state key space of size K
     with a FACTORED one-hot contraction: split the key into (hi, lo)
@@ -138,6 +196,8 @@ def _value_state_counts(flat_idx, K: int):
     are exact (values 0/1) and the f32 accumulate is exact for counts
     below 2^24 per cell per segment.  Returns float counts [K].
     """
+    if _use_pallas_value_state():
+        return _value_state_counts_pallas(flat_idx, K)
     fdt = config.float_dtype()
     onehot_dt = jnp.bfloat16 if jax.default_backend() != "cpu" else fdt
     n = flat_idx.shape[0]

@@ -505,6 +505,17 @@ def bench_hll_lowerings(rows: int) -> Dict:
     f_fac = jax.jit(lambda i: _value_state_counts(i, K))
     fetch(f_fac(idx))
     t_fac = _time_best(lambda: fetch(f_fac(idx)))
+    try:
+        from pinot_tpu.engine.kernel import _value_state_counts_pallas
+
+        f_pal = jax.jit(lambda i: _value_state_counts_pallas(i, K))
+        fetch(f_pal(idx))
+        t_pal = _time_best(lambda: fetch(f_pal(idx)))
+        pallas_agrees = bool(
+            (np.asarray(f_pal(idx)) == np.asarray(f_fac(idx))).all()
+        )
+    except Exception as e:  # pallas lowering unavailable on this backend
+        t_pal, pallas_agrees = None, f"{type(e).__name__}: {e}"
 
     return {
         "bench": "hll_lowerings",
@@ -515,6 +526,10 @@ def bench_hll_lowerings(rows: int) -> Dict:
             "sort_ms": round(t_sort * 1e3, 2),
             "scatter_ms": round(t_scat * 1e3, 2),
             "factored_contraction_K16384_ms": round(t_fac * 1e3, 2),
+            "pallas_contraction_K16384_ms": (
+                round(t_pal * 1e3, 2) if isinstance(t_pal, float) else t_pal
+            ),
+            "pallas_agrees": pallas_agrees,
             "registers_bit_identical": identical,
             "platform": jax.devices()[0].platform,
         },
