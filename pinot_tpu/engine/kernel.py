@@ -173,16 +173,29 @@ def _value_state_counts_pallas(flat_idx, K: int):
         in_specs=[pl.BlockSpec((1, blk), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((K1, 128), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((K1, 128), fdt),
-        interpret=jax.default_backend() == "cpu",
+        # the sequential-grid accumulator idiom (i==0 init + +=) is
+        # only safe where grid steps run in order — i.e. compiled TPU;
+        # everywhere else run the interpreter
+        interpret=jax.default_backend() != "tpu",
     )(blocks)
     return out.reshape(-1)[:K].astype(config.float_dtype())
 
 
 def _use_pallas_value_state() -> bool:
-    return _os.environ.get("PINOT_TPU_VALUE_STATE_PALLAS") == "1"
+    from pinot_tpu.engine.pallas_kernels import PALLAS_AVAILABLE
+
+    return PALLAS_AVAILABLE and _os.environ.get("PINOT_TPU_VALUE_STATE_PALLAS") == "1"
 
 
 def _value_state_counts(flat_idx, K: int):
+    """Gated dispatch: the Pallas histogram when enabled and available,
+    else the XLA factored contraction."""
+    if _use_pallas_value_state():
+        return _value_state_counts_pallas(flat_idx, K)
+    return _value_state_counts_xla(flat_idx, K)
+
+
+def _value_state_counts_xla(flat_idx, K: int):
     """Occupancy counts over a combined value-state key space of size K
     with a FACTORED one-hot contraction: split the key into (hi, lo)
     radix-128 digits and contract two THIN one-hots as a real
@@ -196,8 +209,6 @@ def _value_state_counts(flat_idx, K: int):
     are exact (values 0/1) and the f32 accumulate is exact for counts
     below 2^24 per cell per segment.  Returns float counts [K].
     """
-    if _use_pallas_value_state():
-        return _value_state_counts_pallas(flat_idx, K)
     fdt = config.float_dtype()
     onehot_dt = jnp.bfloat16 if jax.default_backend() != "cpu" else fdt
     n = flat_idx.shape[0]

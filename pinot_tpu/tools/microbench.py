@@ -502,7 +502,11 @@ def bench_hll_lowerings(rows: int) -> Dict:
 
     K = 1 << 14  # bench presence shape
     idx = jnp.asarray(rng.integers(0, K, size=rows).astype(np.int32))
-    f_fac = jax.jit(lambda i: _value_state_counts(i, K))
+    # time the XLA body DIRECTLY (bypassing the env gate) so the A/B
+    # keeps its baseline even when PINOT_TPU_VALUE_STATE_PALLAS=1
+    from pinot_tpu.engine.kernel import _value_state_counts_xla
+
+    f_fac = jax.jit(lambda i: _value_state_counts_xla(i, K))
     fetch(f_fac(idx))
     t_fac = _time_best(lambda: fetch(f_fac(idx)))
     try:

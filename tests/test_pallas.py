@@ -70,6 +70,7 @@ def test_fused_groupby_multi_value_columns():
         np.testing.assert_allclose(np.asarray(sums[i]), want, rtol=1e-5)
 
 
+@pytest.mark.skipif(not PALLAS_AVAILABLE, reason="pallas not importable")
 def test_value_state_counts_pallas_matches_xla():
     """The Pallas occupancy histogram (VMEM-resident accumulator)
     matches the XLA factored contraction bit-for-bit, for K both a

@@ -243,3 +243,21 @@ def test_hybrid_quickstart():
     assert resp.num_docs_scanned == 900
     resp = cluster.query("SELECT sum(rsvp_count) FROM meetupRsvp GROUP BY group_city TOP 3")
     assert not resp.exceptions and resp.to_json()["aggregationResults"][0]["groupByResult"]
+
+
+def test_filter_matrix_smoke():
+    """The selectivity x clustering x path matrix runs all three tiers
+    per cell, forces the postings path, and labels zonemap fallthrough."""
+    from pinot_tpu.tools.datagen import synthetic_lineitem_segment
+    from pinot_tpu.tools.filter_matrix import run_matrix
+
+    segs = [synthetic_lineitem_segment(30000, seed=7, name="fm0")]
+    doc = run_matrix(segs, reps=3)
+    assert len(doc["matrix"]) == 8
+    for row in doc["matrix"]:
+        for path in ("invindex", "zonemap", "fullscan"):
+            assert row[f"{path}_p50_ms"] > 0
+        assert isinstance(row["zonemap_engaged"], bool)
+        assert row["winner"] in ("invindex", "zonemap", "fullscan")
+        if row["winner"] == "zonemap":
+            assert row["zonemap_engaged"]
