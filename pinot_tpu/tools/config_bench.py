@@ -179,7 +179,7 @@ def main() -> None:
         "realtime": realtime_windowed(args.rt_rows),
         # parallel N-partition consumer ingest (+ query-during-ingest):
         # tools/ingest_bench.py; the full-scale committed run lives in
-        # INGEST_r5.json (solo 1.15M rows/s single-core via the
+        # INGEST_r5.json (solo 1.22M rows/s single-core via the
         # columnar stream path; aggregate is core-bound on this host)
         "parallel_ingest_ref": "INGEST_r5.json",
     }
