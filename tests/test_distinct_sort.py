@@ -292,3 +292,48 @@ def test_grouped_hll_sort_pairs(cluster, monkeypatch):
         got = reduce_to_response(req, [QueryExecutor().execute(segs, req)])
         want = oracle.execute(optimize_request(parse_pql(q)))
         assert _norm(got) == _norm(want), q
+
+
+def test_forced_host_is_subset_of_plan_decision(cluster):
+    """plan_forced_host must NEVER claim host for a query the full plan
+    would run on device (it may be narrower — it sees less than the
+    planner — but a false positive silently degrades device queries to
+    the host path).  Swept over capacity/overflow/filter combinations
+    with shrunken caps so every branch fires."""
+    from pinot_tpu.engine.plan import plan_forced_host
+
+    segs, _ = cluster
+    ctx = get_table_context(segs)
+    queries = [
+        "SELECT count(*) FROM lineitem GROUP BY l_returnflag TOP 10",
+        "SELECT count(*) FROM lineitem GROUP BY l_extendedprice TOP 10",
+        "SELECT distinctcount(l_extendedprice) FROM lineitem GROUP BY l_returnflag TOP 10",
+        "SELECT distinctcount(l_extendedprice) FROM lineitem "
+        "WHERE l_shipdate > '1993-01-01' GROUP BY l_returnflag TOP 10",
+        "SELECT distinctcount(l_extendedprice) FROM lineitem",
+        "SELECT percentile50(l_extendedprice) FROM lineitem GROUP BY l_shipmode TOP 5",
+        "SELECT sum(l_quantity) FROM lineitem",
+    ]
+    for cap_name, cap_val in [
+        (None, None),
+        ("MAX_GROUP_CAPACITY", 100),
+        ("DISTINCT_PAIR_CAP", 64),
+        ("MAX_VALUE_STATE", 256),
+    ]:
+        # a PRIVATE patcher per case: the shared function-scoped
+        # monkeypatch also carries the module's autouse cap shrink,
+        # which an undo() would unwind
+        with pytest.MonkeyPatch.context() as mp:
+            if cap_name is not None:
+                mp.setattr(config, cap_name, cap_val)
+            forced_seen = 0
+            for q in queries:
+                req = optimize_request(parse_pql(q))
+                forced = plan_forced_host(req, ctx)
+                staged = stage_segments(segs, sorted(req.referenced_columns()), ctx=ctx)
+                plan = build_static_plan(req, ctx, staged)
+                if forced:
+                    forced_seen += 1
+                    assert not plan.on_device, (cap_name, q)
+        if cap_name in ("MAX_GROUP_CAPACITY", "DISTINCT_PAIR_CAP"):
+            assert forced_seen > 0, f"{cap_name} shrink should force some hosts"
