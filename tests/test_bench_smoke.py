@@ -49,3 +49,8 @@ def test_bench_end_to_end_smoke(tmp_path):
         "hll_groupby_p50_ms",
     ):
         assert key in d and d[key] > 0, key
+    # the degraded record must point at an EXISTING committed capture
+    # file (the judge follows this reference when the tunnel is down)
+    ref = j["tpu_capture_ref"]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(os.path.join(repo, ref)), ref
