@@ -194,12 +194,17 @@ def main() -> None:
     ap.add_argument("-distinct", type=int, default=4)
     ap.add_argument("-iters", type=int, default=10)
     ap.add_argument("-paths", action="store_true", help="also time host-fallback + sort-pairs + executor p50")
+    ap.add_argument("-out", type=str, default="", help="also write the JSON document here")
     args = ap.parse_args()
     import jax
 
     result = run(args.rows, args.rps, args.distinct, args.iters, args.paths)
     result["platform"] = jax.devices()[0].platform
-    print(json.dumps(result))
+    text = json.dumps(result)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
 
 
 if __name__ == "__main__":

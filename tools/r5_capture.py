@@ -40,6 +40,8 @@ PHASES = [
             "-rows",
             "134217728",
             "-paths",
+            "-out",
+            os.path.join(REPO, "NORTHSTAR_HLL_r5.json"),
         ],
         "deadline_s": 3600,
         "log": "/tmp/r5cap_northstar.log",
