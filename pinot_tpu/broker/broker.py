@@ -10,16 +10,34 @@ at the time boundary (:280-329; see ``pinot_tpu.broker.time_boundary``).
 Scatter-gather fans out on a thread pool with a per-request timeout
 (``ScatterGatherImpl.java:80``); replica choice already happened when
 the routing table was built.
+
+RESILIENCE LAYER (beyond the reference, which degrades a query on any
+server failure): the gather loop is an event loop over attempt futures
+that (a) **fails over** — a transport error, per-attempt timeout, or
+retryable server error (210 saturated / 220 shutting down) re-issues
+the failed attempt's segment set to an alternate replica with capped
+exponential backoff, under the query's total deadline; (b) **hedges** —
+when enabled, a straggling attempt's segment set is speculatively
+re-sent to a second replica after a percentile-based delay and the
+first reply wins; (c) feeds a per-server **circuit breaker**
+(``broker.health``) consulted by routing so repeat offenders drop out
+of covers before they fail queries; (d) propagates the **remaining**
+deadline into every (re-)issued InstanceRequest so servers shed work
+the broker has already given up on; and (e) reports **graceful
+degradation** honestly — segments still unserved after retries flip
+``partialResponse`` and count into ``numSegmentsUnserved`` instead of
+hiding inside exception strings.
 """
 from __future__ import annotations
 
 import concurrent.futures
 import json
 import logging
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from pinot_tpu.common.datatable import (
@@ -31,6 +49,7 @@ from pinot_tpu.common.response import BrokerResponse, ErrorCode, QueryException
 from pinot_tpu.engine.reduce import reduce_to_response
 from pinot_tpu.engine.results import IntermediateResult
 from pinot_tpu.pql import PqlParseError, optimize_request, parse_pql
+from pinot_tpu.broker.health import ServerHealthTracker
 from pinot_tpu.broker.routing import RoutingTableProvider
 from pinot_tpu.broker.time_boundary import TimeBoundaryService
 from pinot_tpu.utils.metrics import BrokerMetrics
@@ -39,6 +58,50 @@ logger = logging.getLogger(__name__)
 
 OFFLINE_SUFFIX = "_OFFLINE"
 REALTIME_SUFFIX = "_REALTIME"
+
+# server-reply error codes that mean "this replica cannot serve right
+# now, another may" — the attempt fails over instead of degrading the
+# query (fatal codes like QUERY_EXECUTION would fail identically on
+# every replica and do not retry)
+RETRYABLE_SERVER_CODES = frozenset(
+    {ErrorCode.SERVER_SCHEDULER_DOWN, ErrorCode.SERVER_SHUTTING_DOWN}
+)
+
+
+class _Batch:
+    """One segment set bound for one server: the unit of scatter,
+    failover, and hedging.  A failover spawns child batches (possibly
+    splitting segments across replicas); the parent is then superseded."""
+
+    __slots__ = (
+        "table", "pql", "segments", "server", "excluded",
+        "reissues", "errors", "done", "inflight",
+        "hedged", "first_sent", "order",
+    )
+
+    def __init__(
+        self,
+        table: str,
+        pql: str,
+        segments: List[str],
+        server: str,
+        excluded: Optional[Set[str]] = None,
+        reissues: int = 0,
+        errors: Optional[List[QueryException]] = None,
+        order: int = 0,
+    ) -> None:
+        self.table = table
+        self.pql = pql
+        self.segments = list(segments)
+        self.server = server
+        self.order = order
+        self.excluded: Set[str] = set(excluded or ()) | {server}
+        self.reissues = reissues
+        self.errors: List[QueryException] = list(errors or ())
+        self.done = False
+        self.inflight = 0
+        self.hedged = False
+        self.first_sent = 0.0
 
 
 class BrokerRequestHandler:
@@ -50,6 +113,13 @@ class BrokerRequestHandler:
         time_boundary: Optional[TimeBoundaryService] = None,
         timeout_ms: float = 15_000.0,
         name: str = "broker0",
+        retry_attempts: int = 2,
+        retry_backoff_ms: float = 25.0,
+        retry_backoff_cap_ms: float = 1_000.0,
+        hedge_delay_ms: float = 0.0,
+        hedge_latency_percentile: float = 95.0,
+        hedge_min_quota_headroom: float = 0.1,
+        health: Optional[ServerHealthTracker] = None,
     ) -> None:
         self.transport = transport
         self.server_addresses = dict(server_addresses)
@@ -57,12 +127,41 @@ class BrokerRequestHandler:
         self.time_boundary = time_boundary or TimeBoundaryService()
         self.timeout_ms = timeout_ms
         self.metrics = BrokerMetrics(name)
+        self.retry_attempts = max(0, retry_attempts)
+        self.retry_backoff_ms = retry_backoff_ms
+        self.retry_backoff_cap_ms = retry_backoff_cap_ms
+        self.hedge_delay_ms = hedge_delay_ms  # 0 disables hedging
+        self.hedge_latency_percentile = hedge_latency_percentile
+        self.hedge_min_quota_headroom = hedge_min_quota_headroom
+        self.health = health or ServerHealthTracker()
         from pinot_tpu.broker.quota import QueryQuotaManager
 
         self.quota = QueryQuotaManager()
         self._request_id = 0
         self._id_lock = threading.Lock()
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=16)
+
+    @classmethod
+    def from_conf(cls, transport, server_addresses, conf, **overrides) -> "BrokerRequestHandler":
+        """Build a handler from a ``BrokerConf`` (pinot.broker.* keys),
+        mapping the resilience knobs onto the scatter-gather layer."""
+        kwargs = dict(
+            timeout_ms=float(conf.timeout_ms),
+            name=conf.instance_id,
+            routing=RoutingTableProvider(num_tables=conf.routing_table_count),
+            retry_attempts=conf.retry_attempts,
+            retry_backoff_ms=conf.retry_backoff_ms,
+            retry_backoff_cap_ms=conf.retry_backoff_cap_ms,
+            hedge_delay_ms=conf.hedge_delay_ms,
+            hedge_latency_percentile=conf.hedge_latency_percentile,
+            hedge_min_quota_headroom=conf.hedge_min_quota_headroom,
+            health=ServerHealthTracker(
+                failure_threshold=conf.health_failure_threshold,
+                penalty_ms=conf.health_penalty_ms,
+            ),
+        )
+        kwargs.update(overrides)
+        return cls(transport, server_addresses, **kwargs)
 
     def set_server_address(self, server: str, address: Tuple[str, int]) -> None:
         self.server_addresses[server] = address
@@ -110,11 +209,19 @@ class BrokerRequestHandler:
     ) -> BrokerResponse:
         # per-query override (reference: timeoutMs request parameter,
         # InstanceRequest carries it); the broker's configured timeout
-        # is the CEILING so a client can shorten but never extend
-        if timeout_ms is not None and timeout_ms > 0:
-            timeout_ms = min(float(timeout_ms), self.timeout_ms)
-        else:
-            timeout_ms = self.timeout_ms
+        # is the CEILING so a client can shorten but never extend.  A
+        # present-but-invalid override is a client error, not something
+        # to silently replace with the default — same contract as the
+        # HTTP layer (ONE validator: _parse_timeout).
+        try:
+            timeout_ms = _parse_timeout(timeout_ms)
+        except InvalidTimeoutError as e:
+            return BrokerResponse(
+                exceptions=[QueryException(ErrorCode.QUERY_VALIDATION, str(e))]
+            )
+        timeout_ms = (
+            self.timeout_ms if timeout_ms is None else min(timeout_ms, self.timeout_ms)
+        )
         table = request.table_name
         if not self.quota.allow(table):
             self.metrics.meter("queriesDropped").mark()
@@ -136,16 +243,17 @@ class BrokerRequestHandler:
                 ]
             )
 
-        parts: List[IntermediateResult] = []
         exceptions: List[QueryException] = []
-        futures = []
+        batches: List[_Batch] = []
+        routing_gap = False
         for phys_table, sub_pql in physical:
-            routing = self.routing.find_servers(phys_table)
+            routing = self.routing.find_servers(phys_table, health=self.health)
             if not routing:
                 # None (table unknown) or {} (external view refilling
                 # after a restart): either way this physical table is
                 # currently unanswerable — surface a retriable error
                 # rather than silently dropping it from the result
+                routing_gap = True
                 exceptions.append(
                     QueryException(
                         ErrorCode.BROKER_RESOURCE_MISSING,
@@ -154,42 +262,13 @@ class BrokerRequestHandler:
                 )
                 continue
             for server, segments in routing.items():
-                futures.append(
-                    (
-                        server,
-                        self._pool.submit(
-                            self._send_one,
-                            server,
-                            phys_table,
-                            sub_pql,
-                            segments,
-                            request.enable_trace,
-                            request.debug_options or None,
-                            timeout_ms,
-                        ),
-                    )
+                batches.append(
+                    _Batch(phys_table, sub_pql, segments, server, order=len(batches))
                 )
 
         t_sg = time.perf_counter()
-        deadline = t_sg + timeout_ms / 1000.0
-        for server, fut in futures:
-            try:
-                # no per-future floor: once the shared deadline passes,
-                # remaining futures fail immediately instead of each
-                # adding another grace period to a short budget
-                remaining = max(0.0, deadline - time.perf_counter())
-                parts.append(fut.result(timeout=remaining))
-            except Exception as e:
-                # free queued zombies: a not-yet-started scatter task
-                # whose result nobody will read shouldn't occupy a pool
-                # worker (no-op for already-running tasks)
-                fut.cancel()
-                logger.warning("server %s failed: %s", server, e)
-                exceptions.append(
-                    QueryException(
-                        ErrorCode.BROKER_GATHER, f"server {server}: {type(e).__name__}: {e}"
-                    )
-                )
+        parts, sg = self._scatter_gather(request, batches, timeout_ms, table)
+        exceptions.extend(sg["exceptions"])
         self.metrics.timer("scatterGather").update((time.perf_counter() - t_sg) * 1000)
 
         t_red = time.perf_counter()
@@ -198,9 +277,298 @@ class BrokerRequestHandler:
                 exceptions.append(QueryException(code, msg))
         resp = reduce_to_response(request, parts, exceptions)
         self.metrics.timer("reduce").update((time.perf_counter() - t_red) * 1000)
-        resp.num_servers_queried = len(futures)
-        resp.num_servers_responded = len(parts)
+        resp.num_servers_queried = len(sg["servers_queried"])
+        resp.num_servers_responded = len(sg["servers_responded"])
+        resp.num_segments_unserved = len(sg["unserved"])
+        resp.partial_response = bool(sg["unserved"]) or routing_gap
+        resp.num_retries = sg["retries"]
+        resp.num_hedges = sg["hedges"]
         return resp
+
+    # ------------------------------------------------------------------
+    # resilient scatter-gather
+    # ------------------------------------------------------------------
+    def _hedge_delay_s(self) -> Optional[float]:
+        """Hedge trigger delay: the observed server-latency percentile
+        once enough samples exist, else the configured static floor.
+        ``hedge_delay_ms <= 0`` disables hedging entirely."""
+        if self.hedge_delay_ms <= 0:
+            return None
+        timer = self.metrics.timer("serverLatency")
+        if timer.count >= 20:
+            return max(timer.percentile(self.hedge_latency_percentile), 1.0) / 1000.0
+        return self.hedge_delay_ms / 1000.0
+
+    def _backoff_s(self, reissues: int) -> float:
+        return (
+            min(self.retry_backoff_ms * (2 ** max(0, reissues - 1)), self.retry_backoff_cap_ms)
+            / 1000.0
+        )
+
+    def _scatter_gather(
+        self,
+        request: BrokerRequest,
+        batches: List[_Batch],
+        timeout_ms: float,
+        logical_table: str,
+    ) -> Tuple[List[IntermediateResult], Dict[str, Any]]:
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        # (batch.order, result): parts merge in BATCH CREATION order, not
+        # completion order — ties in sort keys (and any other
+        # order-sensitive reduce step) must not depend on which server
+        # replied first
+        ordered_parts: List[Tuple[int, IntermediateResult]] = []
+        exceptions: List[QueryException] = []
+        unserved: List[str] = []
+        servers_queried: Set[str] = set()
+        servers_responded: Set[str] = set()
+        retries = 0
+        hedges = 0
+        hedge_delay_s = self._hedge_delay_s()
+        if hedge_delay_s is not None and (
+            self.quota.headroom(logical_table) < self.hedge_min_quota_headroom
+        ):
+            # hedging doubles this table's scatter traffic; near the QPS
+            # quota that amplification would starve first-try queries
+            hedge_delay_s = None
+
+        # future -> (batch, server, is_hedge, sent_at)
+        pending: Dict[concurrent.futures.Future, Tuple[_Batch, str, bool, float]] = {}
+        all_batches: List[_Batch] = list(batches)
+        delayed: List[Tuple[float, _Batch]] = []  # (fire_time, batch) backoff queue
+        open_lineages = len(batches)  # batches neither completed nor superseded
+
+        def submit(batch: _Batch, server: str, hedge: bool = False) -> None:
+            now = time.monotonic()
+            remaining_ms = max(1.0, (deadline - now) * 1000.0)
+            servers_queried.add(server)
+            # half-open probe claim: a penalty-boxed server chosen after
+            # its window gets exactly ONE probe marked inflight, so
+            # concurrent queries keep steering around it until the probe
+            # reports back (no thundering herd onto a sick server)
+            self.health.allow_request(server)
+            # with retries in reserve AND an untried replica to fail over
+            # to, wait only half the remaining budget on this attempt: a
+            # hung (not refusing) replica then surfaces as a transport
+            # timeout while there is still time to re-issue elsewhere.
+            # With no alternate (or on the last attempt) waiting less
+            # than the full budget could only turn a slow success into a
+            # guaranteed miss.
+            retries_left = self.retry_attempts - batch.reissues
+            attempt_ms = remaining_ms
+            if retries_left > 0 and not hedge and self.routing.has_alternate(
+                batch.table, batch.segments, batch.excluded
+            ):
+                attempt_ms = remaining_ms / 2.0
+            fut = self._pool.submit(
+                self._send_one,
+                server,
+                batch.table,
+                batch.pql,
+                batch.segments,
+                request.enable_trace,
+                request.debug_options or None,
+                remaining_ms,
+                attempt_ms,
+            )
+            batch.inflight += 1
+            if not hedge:
+                batch.first_sent = now
+            pending[fut] = (batch, server, hedge, now)
+
+        def fail_batch(batch: _Batch) -> None:
+            nonlocal open_lineages
+            unserved.extend(batch.segments)
+            exceptions.extend(batch.errors)
+            batch.done = True
+            open_lineages -= 1
+
+        def failover(batch: _Batch) -> None:
+            """All inflight attempts for this lineage failed: re-cover
+            its segments on untried replicas, or declare them unserved."""
+            nonlocal retries, open_lineages
+            if batch.reissues >= self.retry_attempts:
+                fail_batch(batch)
+                return
+            assignment, leftover = self.routing.alternates(
+                batch.table, batch.segments, batch.excluded, health=self.health
+            )
+            child_errors = batch.errors
+            if leftover:
+                exceptions.extend(batch.errors)
+                unserved.extend(leftover)
+                # already reported above: children start clean so a later
+                # child failure doesn't duplicate the ancestry in the
+                # response's exceptions
+                child_errors = []
+            if not assignment:
+                if not leftover:  # alternates() returned nothing at all
+                    fail_batch(batch)
+                else:
+                    batch.done = True
+                    open_lineages -= 1
+                return
+            batch.done = True  # superseded by its children
+            open_lineages -= 1
+            for server, segments in assignment.items():
+                child = _Batch(
+                    batch.table,
+                    batch.pql,
+                    segments,
+                    server,
+                    excluded=batch.excluded,
+                    reissues=batch.reissues + 1,
+                    errors=child_errors,
+                    order=batch.order,  # failover keeps the merge slot
+                )
+                all_batches.append(child)
+                open_lineages += 1
+                retries += 1
+                self.metrics.meter("failoverRetries").mark()
+                fire = time.monotonic() + self._backoff_s(child.reissues)
+                if fire >= deadline:
+                    # no budget left to back off AND run the query; try
+                    # immediately rather than guaranteeing a miss
+                    submit(child, server)
+                else:
+                    delayed.append((fire, child))
+
+        for batch in batches:
+            submit(batch, batch.server)
+
+        while open_lineages > 0 and (pending or delayed):
+            now = time.monotonic()
+            if now >= deadline:
+                break
+            # fire due backoff retries
+            due = [(f, b) for f, b in delayed if f <= now]
+            if due:
+                delayed = [(f, b) for f, b in delayed if f > now]
+                for _, batch in due:
+                    submit(batch, batch.server)
+            # arm hedges on stragglers
+            next_hedge = math.inf
+            if hedge_delay_s is not None:
+                for batch, server, hedge, _sent in list(pending.values()):
+                    if hedge or batch.done or batch.hedged:
+                        continue
+                    fire = batch.first_sent + hedge_delay_s
+                    if fire > now:
+                        next_hedge = min(next_hedge, fire)
+                        continue
+                    assignment, leftover = self.routing.alternates(
+                        batch.table, batch.segments, batch.excluded, health=self.health
+                    )
+                    batch.hedged = True  # one hedge round per lineage
+                    # a hedge reply REPLACES the primary's, so it must
+                    # cover the identical segment set: a replica holding
+                    # only part of it would win the race with silently
+                    # missing data.  Split coverage -> no hedge (failover
+                    # still handles an eventual primary failure).
+                    if len(assignment) == 1 and not leftover:
+                        alt_server = next(iter(assignment))
+                        batch.excluded.add(alt_server)
+                        hedges += 1
+                        self.metrics.meter("hedgesSent").mark()
+                        submit(batch, alt_server, hedge=True)
+            if not pending:
+                # nothing inflight: sleep until the next backoff fire
+                next_fire = min((f for f, _ in delayed), default=deadline)
+                time.sleep(max(0.0, min(next_fire, deadline) - time.monotonic()))
+                continue
+            next_event = min(deadline, next_hedge, *(f for f, _ in delayed)) \
+                if delayed else min(deadline, next_hedge)
+            done, _ = concurrent.futures.wait(
+                list(pending.keys()),
+                timeout=max(0.0, next_event - time.monotonic()),
+                return_when=concurrent.futures.FIRST_COMPLETED,
+            )
+            for fut in done:
+                batch, server, hedge, sent_at = pending.pop(fut)
+                batch.inflight -= 1
+                try:
+                    result = fut.result()
+                except concurrent.futures.CancelledError:
+                    # a queued twin we cancelled after its batch already
+                    # completed — not a server failure, not data
+                    continue
+                except Exception as e:
+                    self.health.record_failure(server)
+                    logger.warning("server %s failed: %s", server, e)
+                    batch.errors.append(
+                        QueryException(
+                            ErrorCode.BROKER_GATHER,
+                            f"server {server}: {type(e).__name__}: {e}",
+                        )
+                    )
+                    if not batch.done and batch.inflight == 0:
+                        failover(batch)
+                    continue
+                retryable = result.exceptions and all(
+                    code in RETRYABLE_SERVER_CODES for code, _ in result.exceptions
+                )
+                if retryable:
+                    # the server answered "not me, not now" (saturated /
+                    # draining): treat as failover-able, not as data
+                    self.health.record_failure(server)
+                    batch.errors.append(
+                        QueryException(result.exceptions[0][0], result.exceptions[0][1])
+                    )
+                    if not batch.done and batch.inflight == 0:
+                        failover(batch)
+                    continue
+                self.health.record_success(server)
+                # per-ATTEMPT latency (a winning hedge measures from its
+                # own send, not the primary's — else the percentile that
+                # arms future hedges inflates itself)
+                self.metrics.timer("serverLatency").update(
+                    (time.monotonic() - sent_at) * 1000.0
+                )
+                if batch.done:
+                    continue  # hedge race loser: first reply already merged
+                batch.done = True
+                open_lineages -= 1
+                servers_responded.add(server)
+                ordered_parts.append((batch.order, result))
+                # best effort: free the loser's queued twin if it never started
+                for other, (ob, _osrv, _oh, _osent) in list(pending.items()):
+                    if ob is batch:
+                        other.cancel()
+
+        # deadline expired (or queue drained): account every lineage that
+        # never completed
+        for fut, (pbatch, pserver, _h, _s) in pending.items():
+            if not pbatch.done and not fut.cancel():
+                # an attempt for a still-open lineage ran past the
+                # deadline: the circuit breaker must learn about hung
+                # servers too, or a blackholed replica would stay CLOSED
+                # (and keep being routed to) forever — no exception ever
+                # surfaces to the gather loop once the query returns.
+                # (Hedge losers of COMPLETED batches are just slower,
+                # not sick — they are skipped.)
+                self.health.record_failure(pserver)
+        for batch in all_batches:
+            if not batch.done and batch.inflight > 0:
+                batch.errors.append(
+                    QueryException(
+                        ErrorCode.BROKER_TIMEOUT,
+                        f"server {batch.server}: no reply within {timeout_ms:.0f}ms budget",
+                    )
+                )
+                fail_batch(batch)
+            elif not batch.done:
+                fail_batch(batch)
+
+        ordered_parts.sort(key=lambda pair: pair[0])  # stable: children keep arrival order
+        parts = [result for _, result in ordered_parts]
+        return parts, {
+            "exceptions": exceptions,
+            "unserved": unserved,
+            "servers_queried": servers_queried,
+            "servers_responded": servers_responded,
+            "retries": retries,
+            "hedges": hedges,
+        }
 
     # ------------------------------------------------------------------
     def _physical_tables(self, table: str, pql: str) -> List[Tuple[str, str]]:
@@ -268,9 +636,16 @@ class BrokerRequestHandler:
         trace: bool,
         debug_options: Optional[Dict[str, str]],
         timeout_ms: float,
+        attempt_timeout_ms: Optional[float] = None,
     ) -> IntermediateResult:
-        # timeout_ms arrives already clamped by handle_request — the
-        # one place the "shorten but never extend" ceiling lives
+        # timeout_ms is the REMAINING deadline budget at (re-)issue time,
+        # already clamped by handle_request — the server's scheduler pins
+        # it as its dequeue deadline (deadline propagation).
+        # attempt_timeout_ms caps how long the BROKER waits on this one
+        # attempt: when retries remain, it is a fraction of the budget so
+        # a hung replica surfaces as a transport timeout early enough to
+        # fail over (the server keeps the full budget — wasted work at
+        # worst, not an early server-side timeout).
         address = self.server_addresses[server]
         payload = serialize_instance_request(
             self._next_id(),
@@ -281,7 +656,8 @@ class BrokerRequestHandler:
             trace=trace,
             debug_options=debug_options,
         )
-        reply = self.transport.request(address, payload, timeout=timeout_ms / 1000.0)
+        wait_ms = timeout_ms if attempt_timeout_ms is None else attempt_timeout_ms
+        reply = self.transport.request(address, payload, timeout=wait_ms / 1000.0)
         return deserialize_result(reply)
 
 
@@ -290,16 +666,27 @@ class BrokerRequestHandler:
 # ---------------------------------------------------------------------------
 
 
+class InvalidTimeoutError(ValueError):
+    """A timeoutMs override was present but not a positive number."""
+
+
 def _parse_timeout(v) -> Optional[float]:
-    """Lenient per-query timeoutMs: numbers/number-strings pass, junk
-    is ignored (never crash a query over a malformed option)."""
-    if isinstance(v, bool):  # float(True) == 1.0 — a flag is junk here
+    """Strict per-query timeoutMs: absent (None/empty) means "use the
+    broker default"; anything present must be a positive finite number
+    or the query is rejected with a validation error — a silently
+    ignored override is worse than a loud one (the client believes a
+    budget it never got)."""
+    if v is None or v == "":
         return None
+    if isinstance(v, bool):  # float(True) == 1.0 — a flag is junk here
+        raise InvalidTimeoutError(f"timeoutMs must be a positive number, got {v!r}")
     try:
         t = float(v)
-        return t if t > 0 else None
     except (TypeError, ValueError):
-        return None
+        raise InvalidTimeoutError(f"timeoutMs must be a positive number, got {v!r}")
+    if math.isnan(t) or math.isinf(t) or t <= 0:
+        raise InvalidTimeoutError(f"timeoutMs must be a positive number, got {v!r}")
+    return t
 
 
 def _parse_debug_options(s: str) -> Optional[Dict[str, str]]:
@@ -336,6 +723,13 @@ class BrokerHttpServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _invalid_timeout(self, e: InvalidTimeoutError) -> None:
+                self._respond(
+                    BrokerResponse(
+                        exceptions=[QueryException(ErrorCode.QUERY_VALIDATION, str(e))]
+                    ).to_json()
+                )
+
             def do_GET(self):
                 url = urlparse(self.path)
                 if url.path not in ("/query", "/"):
@@ -343,16 +737,22 @@ class BrokerHttpServer:
                         return self._respond({"status": "ok"})
                     if url.path == "/metrics":
                         return self._respond(broker.metrics.snapshot())
+                    if url.path == "/serverhealth":
+                        return self._respond(broker.health.snapshot())
                     return self._respond({"error": "not found"}, 404)
                 qs = parse_qs(url.query)
                 pql = (qs.get("pql") or qs.get("bql") or [""])[0]
                 trace = (qs.get("trace") or ["false"])[0].lower() == "true"
                 debug = _parse_debug_options((qs.get("debugOptions") or [""])[0])
+                try:
+                    timeout_ms = _parse_timeout((qs.get("timeoutMs") or [""])[0])
+                except InvalidTimeoutError as e:
+                    return self._invalid_timeout(e)
                 resp = broker.handle_pql(
                     pql,
                     trace=trace,
                     debug_options=debug,
-                    timeout_ms=_parse_timeout((qs.get("timeoutMs") or [""])[0]),
+                    timeout_ms=timeout_ms,
                 )
                 self._respond(resp.to_json())
 
@@ -372,11 +772,15 @@ class BrokerHttpServer:
                     # the reference's "k=v;k2=v2" string form; any other
                     # JSON type is ignored rather than crashing the handler
                     debug = _parse_debug_options(debug if isinstance(debug, str) else "")
+                try:
+                    timeout_ms = _parse_timeout(body.get("timeoutMs"))
+                except InvalidTimeoutError as e:
+                    return self._invalid_timeout(e)
                 resp = broker.handle_pql(
                     pql,
                     trace=bool(body.get("trace")),
                     debug_options=debug,
-                    timeout_ms=_parse_timeout(body.get("timeoutMs")),
+                    timeout_ms=timeout_ms,
                 )
                 self._respond(resp.to_json())
 

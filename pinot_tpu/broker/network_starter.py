@@ -35,15 +35,23 @@ class NetworkedBrokerStarter:
         port: int = 0,
         heartbeat_interval_s: float = 1.0,
         poll_interval_s: float = 0.3,
+        conf=None,
     ) -> None:
         self.controller_url = controller_url.rstrip("/")
         self.name = name
-        self.handler = BrokerRequestHandler(TcpTransport(), {}, name=name)
+        if conf is not None:
+            # BrokerConf resilience knobs (retry/hedge/circuit-breaker)
+            self.handler = BrokerRequestHandler.from_conf(
+                TcpTransport(), {}, conf, name=name
+            )
+        else:
+            self.handler = BrokerRequestHandler(TcpTransport(), {}, name=name)
         self.http = BrokerHttpServer(self.handler, host=host, port=port)
         self.heartbeat_interval_s = heartbeat_interval_s
         self.poll_interval_s = poll_interval_s
         self._version = -1
         self._epoch = ""  # controller incarnation (see /clusterstate)
+        self._dead_servers: set = set()
         self._stop = threading.Event()
         self._threads: list = []
 
@@ -112,6 +120,15 @@ class NetworkedBrokerStarter:
         self._epoch = state.get("epoch", "")
         for server, addr in state["servers"].items():
             self.handler.set_server_address(server, (addr[0], int(addr[1])))
+        # controller-declared liveness TRANSITIONS feed the circuit
+        # breaker on the same versioned snapshot that rebuilds routing;
+        # steady-state polls must not touch data-plane-opened circuits
+        dead = set(state.get("deadServers", []))
+        for server in dead - self._dead_servers:
+            self.handler.health.mark_dead(server)
+        for server in self._dead_servers - dead:
+            self.handler.health.mark_alive(server)
+        self._dead_servers = dead
         known = set(self.handler.routing.tables())
         for table, view in state["tables"].items():
             self.handler.routing.update(table, view)

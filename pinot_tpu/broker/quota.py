@@ -16,15 +16,25 @@ class _TokenBucket:
         self.last = time.monotonic()
         self._lock = threading.Lock()
 
+    def _refill(self) -> None:
+        # caller holds self._lock
+        now = time.monotonic()
+        self.tokens = min(self.capacity, self.tokens + (now - self.last) * self.qps)
+        self.last = now
+
     def try_acquire(self) -> bool:
         with self._lock:
-            now = time.monotonic()
-            self.tokens = min(self.capacity, self.tokens + (now - self.last) * self.qps)
-            self.last = now
+            self._refill()
             if self.tokens >= 1.0:
                 self.tokens -= 1.0
                 return True
             return False
+
+    def headroom(self) -> float:
+        """Fraction of the bucket currently unspent (peek, no acquire)."""
+        with self._lock:
+            self._refill()
+            return self.tokens / self.capacity
 
 
 class QueryQuotaManager:
@@ -43,3 +53,12 @@ class QueryQuotaManager:
         with self._lock:
             bucket = self._buckets.get(table)
         return bucket.try_acquire() if bucket is not None else True
+
+    def headroom(self, table: str) -> float:
+        """Fraction of the table's rate budget currently unused (1.0 when
+        unlimited).  Hedged requests amplify server load, so the broker
+        only hedges while the table has quota headroom — a table already
+        brushing its QPS cap must not double its own traffic."""
+        with self._lock:
+            bucket = self._buckets.get(table)
+        return bucket.headroom() if bucket is not None else 1.0

@@ -29,9 +29,19 @@ class BrokerStarter:
             InstanceState(self.broker.metrics.scope, role="broker", url=self.url)
         )
         self.resources.add_view_listener(self.on_view_change)
+        # controller-declared liveness flips (heartbeat-miss -> dead,
+        # re-registration -> alive) feed the broker's circuit breaker on
+        # the same event that rebuilds routing — no polling race
+        self.resources.add_instance_listener(self.on_instance_change)
         # seed routing for any pre-existing tables
         for table in self.resources.tables():
             self.on_view_change(table, self.resources.get_external_view(table))
+
+    def on_instance_change(self, name: str, alive: bool) -> None:
+        if alive:
+            self.broker.health.mark_alive(name)
+        else:
+            self.broker.health.mark_dead(name)
 
     def on_view_change(self, table: str, view: Dict[str, Dict[str, str]]) -> None:
         if table not in self.resources.tables():

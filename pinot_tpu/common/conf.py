@@ -83,6 +83,15 @@ class BrokerConf(BaseConf):
     timeout_ms: int = 15_000
     routing_table_count: int = 10
     max_query_qps: float = 0.0  # 0 = unlimited (QuotaConfig enforcement)
+    # -- resilience knobs (scatter-gather retry / hedge / circuit breaker)
+    retry_attempts: int = 2  # failover re-issues per segment set beyond the first send
+    retry_backoff_ms: float = 25.0  # capped exponential base between re-issues
+    retry_backoff_cap_ms: float = 1_000.0
+    hedge_delay_ms: float = 0.0  # 0 disables hedged requests
+    hedge_latency_percentile: float = 95.0  # observed-latency percentile that arms a hedge
+    hedge_min_quota_headroom: float = 0.1  # skip hedging when the table is near its QPS quota
+    health_failure_threshold: int = 3  # consecutive failures before the penalty box
+    health_penalty_ms: float = 5_000.0  # circuit-open duration before a half-open probe
 
 
 @dataclass

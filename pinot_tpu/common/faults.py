@@ -1,0 +1,121 @@
+"""Fault-injection transport wrapper for deterministic chaos tests.
+
+Wraps any transport exposing ``request(address, payload, timeout)`` and
+injects per-address faults *at the call site*, so the same scenarios run
+against ``LocalTransport`` (in-process, deterministic) and
+``TcpTransport`` (real sockets) without touching server code — the
+ChaosMonkey analog, but seedable and replayable instead of killing OS
+processes with signals.
+
+Fault modes per address (composable):
+
+- ``down``        — every request raises ``TransportError`` immediately
+                    (dead server / connection refused).
+- ``fail_next=n`` — the next ``n`` requests raise ``TransportError``,
+                    then the address heals (transient blip).
+- ``error_rate``  — each request fails with probability p, drawn from a
+                    seeded RNG (flaky link; deterministic per seed).
+- ``delay_s``     — sleep before forwarding (slow server / stragglers;
+                    the hedged-request trigger).
+- ``blackhole``   — sleep out the caller's full timeout budget, then
+                    raise (packets dropped: no RST, just silence).
+
+Every call is appended to ``calls`` (address, mode-applied) so tests can
+assert exactly which replicas absorbed retries and hedges.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from pinot_tpu.transport.tcp import TransportError
+
+Address = Tuple[str, int]
+
+
+@dataclass
+class FaultSpec:
+    down: bool = False
+    fail_next: int = 0
+    error_rate: float = 0.0
+    delay_s: float = 0.0
+    blackhole: bool = False
+
+
+@dataclass
+class CallRecord:
+    address: Address
+    outcome: str  # "ok" | "down" | "fail_next" | "error_rate" | "blackhole" | "error"
+    latency_s: float = 0.0
+
+
+class FaultInjectingTransport:
+    """Decorator transport: same ``request`` interface as the inner one."""
+
+    def __init__(self, inner, seed: int = 0) -> None:
+        self.inner = inner
+        self._rng = random.Random(seed)
+        self._faults: Dict[Address, FaultSpec] = {}
+        self._lock = threading.Lock()
+        self.calls: List[CallRecord] = []
+
+    # -- fault programming --------------------------------------------
+    def set_fault(self, address: Address, **kwargs: Any) -> FaultSpec:
+        """Program faults for one address, e.g. ``set_fault(a, down=True)``
+        or ``set_fault(a, delay_s=0.5)``.  Unspecified modes reset."""
+        spec = FaultSpec(**kwargs)
+        with self._lock:
+            self._faults[address] = spec
+        return spec
+
+    def clear_fault(self, address: Address) -> None:
+        with self._lock:
+            self._faults.pop(address, None)
+
+    def clear_all(self) -> None:
+        with self._lock:
+            self._faults.clear()
+
+    def calls_to(self, address: Address) -> List[CallRecord]:
+        with self._lock:
+            return [c for c in self.calls if c.address == address]
+
+    # -- transport interface ------------------------------------------
+    def request(self, address: Address, payload: bytes, timeout: float = 15.0) -> bytes:
+        with self._lock:
+            spec = self._faults.get(address)
+            if spec is not None:
+                if spec.down:
+                    self.calls.append(CallRecord(address, "down"))
+                    raise TransportError(f"injected: server {address} down")
+                if spec.fail_next > 0:
+                    spec.fail_next -= 1
+                    self.calls.append(CallRecord(address, "fail_next"))
+                    raise TransportError(f"injected: transient failure at {address}")
+                if spec.error_rate > 0.0 and self._rng.random() < spec.error_rate:
+                    self.calls.append(CallRecord(address, "error_rate"))
+                    raise TransportError(f"injected: flaky link to {address}")
+            delay = spec.delay_s if spec is not None else 0.0
+            blackhole = spec.blackhole if spec is not None else False
+        if blackhole:
+            time.sleep(timeout)
+            with self._lock:
+                self.calls.append(CallRecord(address, "blackhole", timeout))
+            raise TransportError(f"injected: request to {address} blackholed")
+        if delay > 0.0:
+            time.sleep(delay)
+        t0 = time.perf_counter()
+        try:
+            reply = self.inner.request(address, payload, timeout=timeout)
+        except Exception:
+            with self._lock:
+                self.calls.append(
+                    CallRecord(address, "error", time.perf_counter() - t0 + delay)
+                )
+            raise
+        with self._lock:
+            self.calls.append(CallRecord(address, "ok", time.perf_counter() - t0 + delay))
+        return reply

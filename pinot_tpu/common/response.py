@@ -83,6 +83,7 @@ class QueryException:
 class ErrorCode:
     JSON_PARSING = 100
     PQL_PARSING = 150
+    QUERY_VALIDATION = 160
     QUERY_EXECUTION = 200
     SERVER_SCHEDULER_DOWN = 210
     SERVER_SHUTTING_DOWN = 220
@@ -108,6 +109,15 @@ class BrokerResponse:
     num_segments_queried: int = 0
     num_servers_queried: int = 0
     num_servers_responded: int = 0
+    # graceful-degradation contract: when retries/failover could not
+    # cover every routed segment, partial_response flips true and
+    # num_segments_unserved counts what is missing — clients must be
+    # able to distinguish a complete answer from a degraded one without
+    # parsing exception strings
+    partial_response: bool = False
+    num_segments_unserved: int = 0
+    num_retries: int = 0
+    num_hedges: int = 0
     time_used_ms: float = 0.0
     trace_info: Dict[str, Any] = field(default_factory=dict)
 
@@ -125,6 +135,12 @@ class BrokerResponse:
         d["numSegmentsQueried"] = self.num_segments_queried
         d["numServersQueried"] = self.num_servers_queried
         d["numServersResponded"] = self.num_servers_responded
+        d["partialResponse"] = self.partial_response
+        d["numSegmentsUnserved"] = self.num_segments_unserved
+        if self.num_retries:
+            d["numRetries"] = self.num_retries
+        if self.num_hedges:
+            d["numHedges"] = self.num_hedges
         d["timeUsedMs"] = round(self.time_used_ms, 3)
         if self.trace_info:
             d["traceInfo"] = self.trace_info

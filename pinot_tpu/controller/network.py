@@ -197,6 +197,11 @@ class ParticipantGateway:
                 if inst is not None and inst.alive:
                     logger.warning("instance %s missed heartbeats; marking dead", name)
                     self.board.clear(name)
+                    # one code path: this liveness flip rewrites external
+                    # views (version bump -> remote brokers refetch) AND
+                    # fires instance listeners (in-process broker health
+                    # trackers force the circuit open) — no separate
+                    # health poll that could race the routing update
                     self.resources.set_instance_alive(name, False)
 
     # -- instance API (called from HTTP handlers) ----------------------
@@ -323,11 +328,20 @@ class ParticipantGateway:
             for name, inst in instances.items()
             if inst.role == "server" and inst.alive and inst.addr is not None
         }
+        # declared-dead servers ride the same versioned snapshot that
+        # carries the routing rebuild, so a remote broker's health
+        # tracker and routing table update from ONE event, atomically
+        dead_servers = [
+            name
+            for name, inst in instances.items()
+            if inst.role == "server" and not inst.alive
+        ]
         return {
             "version": version,
             "epoch": out_epoch,
             "tables": tables,
             "servers": servers,
+            "deadServers": dead_servers,
             "quotas": quotas,
             "timeBoundaries": boundaries,
         }

@@ -87,6 +87,7 @@ class ClusterResourceManager:
         self.instances: Dict[str, InstanceState] = {}
         self._participants: Dict[str, Participant] = {}
         self._view_listeners: List[Callable[[str, Dict[str, Dict[str, str]]], None]] = []
+        self._instance_listeners: List[Callable[[str, bool], None]] = []
         self._assign_rr = 0
         # monotonically bumped on every view/instance change; remote
         # brokers poll it to decide when to rebuild routing
@@ -132,6 +133,10 @@ class ClusterResourceManager:
                         changed = True
             if changed or alive:
                 self._notify_view(table)
+        # the SAME liveness flip that rebuilt routing also reaches
+        # broker health trackers (heartbeat-miss -> penalty box, and
+        # recovery -> circuit closed) — one code path, no separate poll
+        self._notify_instance(name, alive)
         if alive:
             self._reconcile_instance(name)
 
@@ -179,6 +184,22 @@ class ClusterResourceManager:
     def add_view_listener(self, fn: Callable[[str, Dict[str, Dict[str, str]]], None]) -> None:
         with self._lock:
             self._view_listeners.append(fn)
+
+    def add_instance_listener(self, fn: Callable[[str, bool], None]) -> None:
+        """Subscribe to instance-liveness flips (``(name, alive)``); the
+        broker health tracker consumes these so a controller-declared
+        dead server enters the penalty box immediately."""
+        with self._lock:
+            self._instance_listeners.append(fn)
+
+    def _notify_instance(self, name: str, alive: bool) -> None:
+        with self._lock:
+            listeners = list(self._instance_listeners)
+        for fn in listeners:
+            try:
+                fn(name, alive)
+            except Exception:
+                logger.exception("instance listener failed for %s", name)
 
     def _notify_view(self, table: str) -> None:
         self.bump_version()

@@ -25,7 +25,12 @@ from pinot_tpu.engine.results import IntermediateResult
 from pinot_tpu.pql import optimize_request, parse_pql
 from pinot_tpu.segment.immutable import ImmutableSegment
 from pinot_tpu.server.datamanager import InstanceDataManager
-from pinot_tpu.server.scheduler import QueryScheduler, SchedulerSaturatedError
+from pinot_tpu.server.scheduler import (
+    QueryAbandonedError,
+    QueryScheduler,
+    SchedulerSaturatedError,
+    SchedulerShutdownError,
+)
 from pinot_tpu.utils.metrics import ServerMetrics
 from pinot_tpu.utils.trace import TraceContext
 
@@ -109,12 +114,25 @@ class ServerInstance:
             )
         except SchedulerSaturatedError as e:
             # overload shed: fast typed rejection, no stack spam — the
-            # broker surfaces it as a partial-failure server error
+            # broker treats 210 as retryable and fails over to a replica
             self.metrics.meter("queriesShed").mark()
             result = IntermediateResult(
                 exceptions=[(ErrorCode.SERVER_SCHEDULER_DOWN, str(e))]
             )
-        except concurrent.futures.TimeoutError:
+        except SchedulerShutdownError as e:
+            # draining for restart: typed 220 so the broker retries the
+            # segment set on a replica instead of failing the query
+            result = IntermediateResult(
+                exceptions=[(ErrorCode.SERVER_SHUTTING_DOWN, str(e))]
+            )
+        except QueryAbandonedError as e:
+            # the broker-propagated deadline expired while this query sat
+            # in the FCFS queue; reply cheaply without executing
+            self.metrics.meter("queriesAbandoned").mark()
+            result = IntermediateResult(
+                exceptions=[(ErrorCode.EXECUTION_TIMEOUT, f"server {self.name}: {e}")]
+            )
+        except (concurrent.futures.TimeoutError, TimeoutError):
             logger.warning("query %s timed out", req.get("requestId"))
             result = IntermediateResult(
                 exceptions=[

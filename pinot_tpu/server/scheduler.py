@@ -12,16 +12,37 @@ are shed immediately with ``SchedulerSaturatedError`` rather than
 queued without bound — a fast 210-coded error reply beats a timeout
 that arrives after the client gave up, and bounds server memory under
 a flood (the reference's analog is its scheduler resource limits).
+
+DEADLINE PROPAGATION: the broker serializes its *remaining* budget into
+each (re-)issued InstanceRequest, and ``run`` pins that budget as a
+monotonic deadline checked when a worker dequeues the query — a query
+that waited out its whole budget in the FCFS queue is abandoned
+broker-side already, so executing it would only steal capacity from
+queries that can still make their deadline.  Such work is shed with
+``QueryAbandonedError`` before touching the executor.
 """
 from __future__ import annotations
 
 import concurrent.futures
 import threading
-from typing import Any, Callable
+import time
+from typing import Any, Callable, Optional
 
 
 class SchedulerSaturatedError(RuntimeError):
-    """Raised on submit when the pending queue is at capacity (shed)."""
+    """Raised on submit when the pending queue is at capacity (shed).
+    Broker-side this is a RETRYABLE failure: another replica may have
+    capacity right now."""
+
+
+class SchedulerShutdownError(RuntimeError):
+    """Raised on submit after shutdown.  Broker-side this is RETRYABLE:
+    the server is draining for restart, its replicas are not."""
+
+
+class QueryAbandonedError(RuntimeError):
+    """Raised when a queued query's deadline expired before a worker
+    picked it up — the broker already gave up on this reply."""
 
 
 class QueryScheduler:
@@ -30,6 +51,8 @@ class QueryScheduler:
         self._max_pending = max_pending
         self._pending = 0  # queued + running
         self._shed = 0
+        self._abandoned = 0
+        self._shutdown = False
         self._lock = threading.Lock()
 
     @property
@@ -40,8 +63,14 @@ class QueryScheduler:
     def shed_count(self) -> int:
         return self._shed
 
+    @property
+    def abandoned_count(self) -> int:
+        return self._abandoned
+
     def submit(self, fn: Callable[[], Any]) -> concurrent.futures.Future:
         with self._lock:
+            if self._shutdown:
+                raise SchedulerShutdownError("scheduler is shut down")
             if self._pending >= self._max_pending:
                 self._shed += 1
                 raise SchedulerSaturatedError(
@@ -51,6 +80,11 @@ class QueryScheduler:
             self._pending += 1
         try:
             fut = self._pool.submit(fn)
+        except RuntimeError as e:
+            # pool shut down between our check and the submit
+            with self._lock:
+                self._pending -= 1
+            raise SchedulerShutdownError(str(e)) from e
         except BaseException:
             with self._lock:
                 self._pending -= 1
@@ -63,17 +97,49 @@ class QueryScheduler:
         fut.add_done_callback(_done)
         return fut
 
-    def run(self, fn: Callable[[], Any], timeout_s: float) -> Any:
-        fut = self.submit(fn)
+    def run(
+        self,
+        fn: Callable[[], Any],
+        timeout_s: float,
+        deadline: Optional[float] = None,
+    ) -> Any:
+        """Run ``fn`` with at most ``timeout_s`` of wall budget.
+
+        ``deadline`` (monotonic seconds) defaults to now+timeout_s; it is
+        checked at dequeue time so a query whose budget drained in the
+        FCFS queue is shed instead of executed (the broker that sent it
+        has already failed over or timed out).
+        """
+        if deadline is None:
+            deadline = time.monotonic() + timeout_s
+
+        def _guarded() -> Any:
+            if time.monotonic() >= deadline:
+                with self._lock:
+                    self._abandoned += 1
+                raise QueryAbandonedError(
+                    "deadline expired while queued; broker already gave up"
+                )
+            return fn()
+
+        fut = self.submit(_guarded)
         try:
-            return fut.result(timeout=timeout_s)
-        except concurrent.futures.TimeoutError:
+            return fut.result(timeout=max(0.0, deadline - time.monotonic()))
+        except concurrent.futures.TimeoutError as e:
             # the client is gone: a still-QUEUED query cancels (its
             # done-callback frees the pending slot immediately) so
             # abandoned work cannot pin the scheduler at max_pending
-            # and shed live traffic; a RUNNING one must drain
+            # and shed live traffic; a RUNNING one must drain.
+            # Re-raised as the builtin TimeoutError (on 3.11+ they are
+            # the same class; on 3.10 the futures one is distinct).
             fut.cancel()
-            raise
+            raise TimeoutError(str(e) or "query timed out") from e
 
     def shutdown(self) -> None:
+        """Idempotent: the first call cancels queued futures and stops
+        accepting submits; later calls are no-ops."""
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
         self._pool.shutdown(wait=False, cancel_futures=True)

@@ -330,9 +330,16 @@ def test_per_query_timeout_override():
         # a huge request value clamps to the broker ceiling (and works)
         out = post({"pql": pql, "timeoutMs": 10_000_000})
         assert not out["exceptions"] and out["numDocsScanned"] == 50
-        # junk timeouts ignored (strings AND booleans: float(True)==1.0)
-        for junk in ("soon", True, -5, None):
+        # junk timeouts are REJECTED with a validation error (strings,
+        # booleans — float(True)==1.0 — and non-positive numbers): a
+        # silently ignored override would leave the client believing a
+        # budget it never got
+        for junk in ("soon", True, -5, 0):
             out = post({"pql": pql, "timeoutMs": junk})
-            assert not out["exceptions"], junk
+            assert out["exceptions"], junk
+            assert out["exceptions"][0]["errorCode"] == 160, junk
+        # absent override still means "broker default", not an error
+        out = post({"pql": pql, "timeoutMs": None})
+        assert not out["exceptions"] and out["numDocsScanned"] == 50
     finally:
         http.stop()

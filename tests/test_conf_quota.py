@@ -36,6 +36,41 @@ def test_conf_from_dict():
     assert c.port == 9001
 
 
+def test_broker_resilience_conf_maps_to_handler():
+    """pinot.broker.* resilience keys flow from properties text into the
+    scatter-gather layer's knobs and the circuit breaker."""
+    from pinot_tpu.broker.broker import BrokerRequestHandler
+    from pinot_tpu.transport.local import LocalTransport
+
+    conf = BrokerConf.from_dict(
+        parse_properties(
+            """
+            pinot.broker.retry.attempts=5
+            pinot.broker.retry.backoff.ms=7
+            pinot.broker.hedge.delay.ms=120
+            pinot.broker.health.failure.threshold=2
+            pinot.broker.health.penalty.ms=900
+            """
+        )
+    )
+    handler = BrokerRequestHandler.from_conf(LocalTransport(), {}, conf)
+    assert handler.retry_attempts == 5
+    assert handler.retry_backoff_ms == 7.0
+    assert handler.hedge_delay_ms == 120.0
+    assert handler.health.failure_threshold == 2
+    assert handler.health.penalty_ms == 900.0
+
+
+def test_quota_headroom():
+    qm = QueryQuotaManager()
+    assert qm.headroom("unlimited") == 1.0
+    qm.set_quota("t", 2.0)
+    assert qm.headroom("t") == 1.0  # full bucket
+    qm.allow("t")
+    qm.allow("t")
+    assert qm.headroom("t") < 0.5  # drained (refills over time)
+
+
 def test_token_bucket_quota():
     qm = QueryQuotaManager()
     qm.set_quota("t", 2.0)  # 2 qps, burst 2

@@ -192,6 +192,27 @@ def test_scheduler_shutdown_cancels_pending():
         pending.result(timeout=1)  # cancelled, never ran
 
 
+def test_scheduler_shutdown_idempotent_and_refuses_submits():
+    """Regression: shutdown twice is a no-op the second time, and a
+    submit after shutdown fails with the typed retryable error (220 on
+    the wire) rather than the pool's bare RuntimeError."""
+    from pinot_tpu.server.scheduler import SchedulerShutdownError
+
+    sched = QueryScheduler(num_workers=1)
+    gate = threading.Event()
+    running = sched.submit(lambda: gate.wait(5))
+    queued = sched.submit(lambda: 1)
+    sched.shutdown()
+    sched.shutdown()  # idempotent: second call must not raise
+    with pytest.raises(SchedulerShutdownError):
+        sched.submit(lambda: 2)
+    gate.set()
+    running.result(timeout=5)
+    with pytest.raises(Exception):
+        queued.result(timeout=1)  # cancelled by the FIRST shutdown
+    sched.shutdown()  # still a no-op after draining
+
+
 # ------------------------------------------------------------------- pruner
 def _time_schema():
     from pinot_tpu.common.schema import TimeFieldSpec
