@@ -207,6 +207,144 @@ def _broker_latencies(segments, queries_per_round: int = 40):
     return report, selective
 
 
+def _closed_loop(broker, queries, clients: int, duration_s: float) -> dict:
+    """N closed-loop clients: each keeps exactly one query in flight for
+    ``duration_s`` (the saturation-throughput measurement — open-loop
+    target-QPS ladders live in tools/serving_curve.py).  Queries beyond
+    a list cycle per-client with a stagger so mixed workloads interleave
+    across clients."""
+    import threading
+
+    lat = []
+    errors = [0]
+    lock = threading.Lock()
+    stop = time.perf_counter() + duration_s
+
+    def client(ci: int) -> None:
+        i = ci  # stagger so concurrent clients mix shapes
+        while time.perf_counter() < stop:
+            q = queries[i % len(queries)]
+            i += 1
+            t0 = time.perf_counter()
+            resp = broker.handle_pql(q)
+            ms = (time.perf_counter() - t0) * 1000.0
+            with lock:
+                lat.append(ms)
+                if resp.exceptions:
+                    errors[0] += 1
+
+    threads = [threading.Thread(target=client, args=(ci,)) for ci in range(clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    lat.sort()
+
+    def pct(p: float) -> float:
+        return lat[min(int(len(lat) * p / 100.0), len(lat) - 1)] if lat else 0.0
+
+    return {
+        "clients": clients,
+        "queries": len(lat),
+        "qps": round(len(lat) / wall, 1),
+        "p50_ms": round(pct(50), 3),
+        "p99_ms": round(pct(99), 3),
+        "errors": errors[0],
+    }
+
+
+def _strip_timing(resp) -> str:
+    """Canonical BrokerResponse payload for differential comparison:
+    everything except the wall-clock field."""
+    return json.dumps(
+        {k: v for k, v in resp.to_json().items() if k != "timeUsedMs"},
+        sort_keys=True,
+    )
+
+
+def _serving_main() -> None:
+    """Concurrent serving-curve mode (PINOT_TPU_BENCH_MODE=serving):
+    closed-loop client ladders over repeated- and mixed-shape workloads
+    against the in-process broker path, pipelined (device lane +
+    identical-dispatch coalescing, engine/dispatch.py) vs serial
+    executor, plus a payload-differential check between the two.
+    Prints ONE JSON document."""
+    from pinot_tpu.tools.cluster_harness import single_server_broker
+    from pinot_tpu.tools.serving_curve import mixed_workload
+
+    num_segments = int(os.environ.get("PINOT_TPU_BENCH_SEGMENTS", "4"))
+    rows_per_segment = int(os.environ.get("PINOT_TPU_BENCH_ROWS_PER_SEGMENT", "250000"))
+    duration_s = float(os.environ.get("PINOT_TPU_BENCH_SERVE_DURATION_S", "6"))
+    ladder = [
+        int(c)
+        for c in os.environ.get("PINOT_TPU_BENCH_SERVE_CLIENTS", "1,4,8,16").split(",")
+    ]
+
+    segments = _build_segments(num_segments, rows_per_segment)
+    queries_mixed = mixed_workload(segments)
+    workloads = {"repeated_q1": [Q1_PQL], "mixed": queries_mixed}
+
+    import jax
+
+    doc = {
+        "metric": "serving_closed_loop_qps_pipelined_vs_serial",
+        "platform": jax.devices()[0].platform,
+        "num_segments": num_segments,
+        "total_rows": num_segments * rows_per_segment,
+        "duration_s_per_step": duration_s,
+        "workloads": "repeated_q1 = the Q1 group-by scan issued by every "
+        "client; mixed = the four BASELINE.md shapes interleaved across "
+        "clients (tools/serving_curve.py mixed_workload)",
+        "modes": {},
+    }
+    brokers = {}
+    for mode, pipelined in (("serial", False), ("pipelined", True)):
+        broker = single_server_broker("lineitem", segments, pipeline=pipelined)
+        brokers[mode] = broker
+        # warm every shape (staging + compile) before any measurement
+        for q in queries_mixed + [Q1_PQL]:
+            for _ in range(2):
+                resp = broker.handle_pql(q)
+                assert not resp.exceptions, resp.exceptions
+        curves = {}
+        for wname, qs in workloads.items():
+            curves[wname] = [_closed_loop(broker, qs, c, duration_s) for c in ladder]
+        server = broker.local_servers[0]
+        doc["modes"][mode] = {
+            "curves": curves,
+            "lane": None if server.lane is None else server.lane.stats(),
+            "scheduler": server.scheduler.stats(),
+        }
+        print(json.dumps({"mode_done": mode}), file=__import__("sys").stderr, flush=True)
+
+    # saturation = best closed-loop QPS across the ladder, per workload
+    for wname in workloads:
+        sat = {
+            m: max(s["qps"] for s in doc["modes"][m]["curves"][wname])
+            for m in doc["modes"]
+        }
+        doc[f"saturation_qps_{wname}"] = sat
+        doc[f"speedup_{wname}"] = round(sat["pipelined"] / max(sat["serial"], 1e-9), 2)
+
+    # differential: pipelined and serial must serve byte-identical
+    # payloads (timing field excluded) for every workload shape
+    diffs = 0
+    for q in queries_mixed + [Q1_PQL]:
+        a = _strip_timing(brokers["serial"].handle_pql(q))
+        b = _strip_timing(brokers["pipelined"].handle_pql(q))
+        if a != b:
+            diffs += 1
+    doc["differential"] = {
+        "queries": len(queries_mixed) + 1,
+        "mismatches": diffs,
+        "identical_payloads": diffs == 0,
+        "note": "payload = BrokerResponse.to_json() minus timeUsedMs, sorted keys",
+    }
+    print(json.dumps(doc, indent=1))
+
+
 def _probe_tpu(timeout_s: float = 180.0) -> bool:
     """Subprocess backend probe (pinot_tpu.utils.platform.probe_device,
     the one shared implementation)."""
@@ -269,6 +407,14 @@ def main() -> None:
         from pinot_tpu.utils.platform import force_cpu_mesh
 
         force_cpu_mesh(1)
+
+    if os.environ.get("PINOT_TPU_BENCH_MODE") == "serving":
+        try:
+            _serving_main()
+        finally:
+            if deadline is not None:
+                deadline.cancel()
+        return
 
     import jax
 

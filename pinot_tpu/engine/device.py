@@ -65,6 +65,11 @@ class StagedColumn:
         return self.stored_type != DataType.STRING
 
 
+import itertools
+
+_stage_tokens = itertools.count()
+
+
 @dataclass
 class StagedTable:
     """A set of segments staged into device memory, stacked on axis 0."""
@@ -76,6 +81,11 @@ class StagedTable:
     num_docs_arr: jnp.ndarray  # int32 [S]
     columns: Dict[str, StagedColumn] = field(default_factory=dict)
     _valid: Optional[jnp.ndarray] = None
+    # process-unique staging identity: the device lane's coalesce key
+    # needs "same staged table" without pinning the object (an id()
+    # would recycle after GC and could alias a RE-staged table into an
+    # in-flight dispatch — silent stale results)
+    token: int = field(default_factory=lambda: next(_stage_tokens))
 
     def column(self, name: str) -> StagedColumn:
         return self.columns[name]

@@ -1,0 +1,329 @@
+"""Device lane: the single-threaded dispatch stage of the serving
+pipeline, with identical-dispatch coalescing.
+
+The whole table executes as ONE vmapped XLA program, so the chip is a
+single serialized execution lane — unlike the reference's per-segment
+operator trees, there is nothing to gain from launching kernels from
+many threads, and every millisecond a scheduler worker spends on host
+planning or finalize while *holding* the device is a millisecond the
+chip idles.  The server query path is therefore a three-stage pipeline:
+
+  PREP      (QueryScheduler worker pool): prune -> stage lookup ->
+            StaticPlan -> QueryInputs -> H2D uploads
+  DISPATCH  (this module, one thread): kernel launches only.  Launches
+            are asynchronous — jax returns device buffers before the
+            program finishes, so the lane keeps the device queue fed
+            while earlier queries are still executing/finalizing.
+  FINALIZE  (back on the worker that submitted): the first D2H read
+            (``np.asarray`` on the packed output buffer) blocks until
+            the program completes, then partials build host-side.
+
+COALESCING: waiters whose (StaticPlan, staged-table identity,
+query-inputs digest) match a dispatch that is queued, launching, or
+still EXECUTING on device attach to it instead of enqueueing their own
+— the one set of output buffers fans out to every waiter, so N
+concurrent dashboard-style repeats of the same query cost ONE kernel
+launch.  Identical key implies identical device inputs implies
+identical outputs, and each waiter still runs its own FINALIZE, so
+results stay independent per query.  The window ends the moment the
+program's outputs are ready (``jax.Array.is_ready``): past that point
+handing out the buffers would be result caching, which this
+deliberately is not — a query arriving after the outputs exist always
+re-dispatches.
+
+DEADLINES: each waiter carries the broker-propagated monotonic deadline
+(server/scheduler.py semantics).  A waiter whose deadline expired while
+its dispatch sat in the lane queue is shed with the existing
+``QueryAbandonedError`` before any device work happens on its behalf;
+a dispatch all of whose waiters expired is dropped without launching.
+
+Counters (surfaced via the server status/metrics snapshot):
+lane depth gauge, dispatch/coalesce-hit/shed meters, and the
+``phase.laneDispatch`` timer for time spent inside launches.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, Hashable, List, Optional
+
+from pinot_tpu.server.scheduler import QueryAbandonedError
+
+# completed dispatches kept open (still coalescible) at once; beyond
+# this the oldest close early — a bound on pinned output buffers, not
+# a correctness knob
+_MAX_OPEN = 32
+# poll period for closing open dispatches while the queue is idle; the
+# check is a non-blocking is_ready() per open dispatch
+_SWEEP_S = 0.005
+
+
+def outputs_pending(value: Any) -> bool:
+    """True while any jax-array leaf of a launch's return value has not
+    finished computing — the coalescibility window for a launch that
+    already returned.  Values with no device arrays report False (no
+    retention)."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(value):
+        is_ready = getattr(leaf, "is_ready", None)
+        if is_ready is not None:
+            try:
+                if not is_ready():
+                    return True
+            except Exception:
+                return False
+    return False
+
+
+class LaneClosedError(RuntimeError):
+    """Submit after close(), or queued work drained by close()."""
+
+
+class LaneTicket:
+    """One waiter's slot: the submitting worker blocks on ``result`` and
+    resumes FINALIZE when the lane delivers outputs (or an error)."""
+
+    __slots__ = ("deadline", "_event", "_value", "_error")
+
+    def __init__(self, deadline: Optional[float]) -> None:
+        self.deadline = deadline
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def _deliver(self, value: Any = None, error: Optional[BaseException] = None) -> None:
+        self._value = value
+        self._error = error
+        self._event.set()
+
+    def result(self, deadline: Optional[float] = None) -> Any:
+        """Block until the dispatch delivers; honors the query deadline
+        (raises the builtin ``TimeoutError`` like ``QueryScheduler.run``
+        so the instance's timeout reply path handles both stages)."""
+        timeout = None
+        if deadline is not None:
+            timeout = max(0.0, deadline - time.monotonic())
+        if not self._event.wait(timeout):
+            raise TimeoutError("device lane result exceeded query deadline")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _Dispatch:
+    __slots__ = ("key", "launch", "pending", "waiters", "completed", "value", "error")
+
+    def __init__(
+        self,
+        key: Hashable,
+        launch: Callable[[], Any],
+        pending: Callable[[Any], bool],
+    ) -> None:
+        self.key = key
+        self.launch = launch
+        self.pending = pending
+        self.waiters: List[LaneTicket] = []
+        self.completed = False
+        self.value: Any = None
+        self.error: Optional[BaseException] = None
+
+
+class DeviceLane:
+    """Single-threaded asynchronous kernel-launch queue with
+    identical-dispatch coalescing (see module docstring)."""
+
+    def __init__(self, metrics=None) -> None:
+        self.metrics = metrics
+        self._cv = threading.Condition()
+        self._queue: Deque[_Dispatch] = deque()
+        self._by_key: Dict[Hashable, _Dispatch] = {}
+        self._open: Deque[_Dispatch] = deque()  # launched, program still running
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self.dispatch_count = 0
+        self.coalesce_hits = 0
+        self.shed_count = 0
+
+    # -- producer side -------------------------------------------------
+    def submit(
+        self,
+        key: Hashable,
+        launch: Callable[[], Any],
+        deadline: Optional[float] = None,
+        pending: Callable[[Any], bool] = outputs_pending,
+    ) -> LaneTicket:
+        """Enqueue a kernel launch, or coalesce onto an identical one
+        that is queued, launching, or still executing on device.
+        Returns immediately; the caller blocks on ``ticket.result`` when
+        FINALIZE actually needs the outputs."""
+        ticket = LaneTicket(deadline)
+        with self._cv:
+            if self._closed:
+                raise LaneClosedError("device lane is closed")
+            d = self._by_key.get(key)
+            if d is not None and d.completed:
+                # launched already: shareable only while the program is
+                # still executing (never serve finished outputs anew)
+                still = d.error is None and self._still_pending(d)
+                if still:
+                    self._hit()
+                    ticket._deliver(value=d.value)
+                    return ticket
+                self._close_open(d)
+                d = None
+            if d is not None:
+                d.waiters.append(ticket)
+                self._hit()
+            else:
+                d = _Dispatch(key, launch, pending)
+                d.waiters.append(ticket)
+                self._by_key[key] = d
+                self._queue.append(d)
+                self._set_depth()
+                self._cv.notify()
+            if self._thread is None:
+                # lazy start: instances that never run a device query
+                # (host-path tables, unit tests) cost no thread
+                self._thread = threading.Thread(
+                    target=self._run, name="device-lane", daemon=True
+                )
+                self._thread.start()
+        return ticket
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "depth": len(self._queue),
+            "open": len(self._open),
+            "dispatches": self.dispatch_count,
+            "coalesceHits": self.coalesce_hits,
+            "shed": self.shed_count,
+        }
+
+    def close(self) -> None:
+        """Idempotent: stop accepting submits, fail queued waiters, and
+        let the lane thread exit after any in-flight launch."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            drained = list(self._queue)
+            self._queue.clear()
+            self._open.clear()
+            self._by_key.clear()
+            for d in drained:
+                d.completed = True
+            self._cv.notify_all()
+        err = LaneClosedError("device lane closed while queued")
+        for d in drained:
+            for w in d.waiters:
+                w._deliver(error=err)
+
+    # -- internals -----------------------------------------------------
+    def _hit(self) -> None:
+        self.coalesce_hits += 1
+        if self.metrics is not None:
+            self.metrics.meter("lane.coalesced").mark()
+
+    def _set_depth(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge("lane.depth").set(len(self._queue))
+
+    def _still_pending(self, d: _Dispatch) -> bool:
+        if d.pending is None:
+            return False
+        try:
+            return bool(d.pending(d.value))
+        except Exception:
+            return False
+
+    def _close_open(self, d: _Dispatch) -> None:
+        """Drop a completed dispatch from the coalescible set (lock
+        held)."""
+        if self._by_key.get(d.key) is d:
+            self._by_key.pop(d.key, None)
+        try:
+            self._open.remove(d)
+        except ValueError:
+            pass
+
+    def _sweep_open_locked(self) -> None:
+        for d in list(self._open):
+            if d.error is not None or not self._still_pending(d):
+                self._close_open(d)
+        while len(self._open) > _MAX_OPEN:
+            self._close_open(self._open[0])
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                self._sweep_open_locked()
+                while not self._queue and not self._closed:
+                    if self._open:
+                        # finite wait: open dispatches must close (and
+                        # release their buffers) soon after the device
+                        # finishes even when no new work arrives
+                        self._cv.wait(timeout=_SWEEP_S)
+                        self._sweep_open_locked()
+                    else:
+                        self._cv.wait()
+                if self._closed and not self._queue:
+                    return
+                d = self._queue.popleft()
+                self._set_depth()
+                # deadline shed at lane-dequeue time, mirroring the
+                # scheduler's dequeue check: the broker already failed
+                # over or timed out, so device work for this waiter
+                # would only delay queries that can still make it
+                now = time.monotonic()
+                live = [w for w in d.waiters if w.deadline is None or now < w.deadline]
+                dead = [w for w in d.waiters if w.deadline is not None and now >= w.deadline]
+                d.waiters = live
+                if not live:
+                    d.completed = True
+                    self._by_key.pop(d.key, None)
+            if dead:
+                self.shed_count += len(dead)
+                if self.metrics is not None:
+                    self.metrics.meter("lane.shed").mark(len(dead))
+                err = QueryAbandonedError(
+                    "deadline expired while queued in device lane; "
+                    "broker already gave up"
+                )
+                for w in dead:
+                    w._deliver(error=err)
+            if not live:
+                continue
+            # launch OUTSIDE the lock: first-call compiles can take
+            # seconds and coalescing submits must not block behind them
+            t0 = time.perf_counter()
+            error: Optional[BaseException] = None
+            value: Any = None
+            try:
+                value = d.launch()
+            except BaseException as e:  # deliver to waiters, keep lane alive
+                error = e
+            self.dispatch_count += 1
+            if self.metrics is not None:
+                self.metrics.meter("lane.dispatches").mark()
+                self.metrics.timer("phase.laneDispatch").update(
+                    (time.perf_counter() - t0) * 1000
+                )
+            with self._cv:
+                d.completed = True
+                d.value, d.error = value, error
+                waiters = list(d.waiters)
+                d.waiters = []
+                if error is None and not self._closed and self._still_pending(d):
+                    # program still executing: keep coalescible
+                    self._open.append(d)
+                    self._sweep_open_locked()
+                else:
+                    self._by_key.pop(d.key, None)
+            for w in waiters:
+                w._deliver(value=value, error=error)

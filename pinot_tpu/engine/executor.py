@@ -160,9 +160,15 @@ class QueryExecutor:
     single-device kernel runs.
     """
 
-    def __init__(self, mesh=None, metrics=None) -> None:
+    def __init__(self, mesh=None, metrics=None, lane=None) -> None:
         self.mesh = mesh
         self.metrics = metrics  # optional MetricsRegistry: per-phase timers
+        # three-stage serving pipeline (engine/dispatch.py): with a
+        # DeviceLane set, kernel launches leave this worker thread and
+        # coalesce with identical in-flight dispatches; without one,
+        # launch + fetch run inline (the serial path, byte-identical
+        # results — the differential suite holds the two together)
+        self.lane = lane
         self._sharded_kernels: Dict[Any, Any] = {}
         from collections import OrderedDict
 
@@ -183,8 +189,14 @@ class QueryExecutor:
         return now
 
     def execute(
-        self, segments: Sequence[ImmutableSegment], request: BrokerRequest
+        self,
+        segments: Sequence[ImmutableSegment],
+        request: BrokerRequest,
+        deadline: Optional[float] = None,
     ) -> IntermediateResult:
+        """``deadline`` (monotonic seconds) is the broker-propagated
+        budget; threaded into the device lane so a query whose budget
+        drained while queued there is shed, not executed."""
         total_docs = sum(s.num_docs for s in segments)
         live = prune_segments(segments, request)
         if not live:
@@ -200,19 +212,22 @@ class QueryExecutor:
             normal = [s for s in live if s not in star]
             parts = [execute_star_tree(s, request) for s in star]
             if normal:
-                parts.append(self._execute_engine(normal, request))
+                parts.append(self._execute_engine(normal, request, deadline))
             merged = parts[0]
             for p in parts[1:]:
                 merged.merge(p)
             merged.total_docs = total_docs
             return merged
 
-        result = self._execute_engine(live, request)
+        result = self._execute_engine(live, request, deadline)
         result.total_docs = total_docs
         return result
 
     def _execute_engine(
-        self, live: List[ImmutableSegment], request: BrokerRequest
+        self,
+        live: List[ImmutableSegment],
+        request: BrokerRequest,
+        deadline: Optional[float] = None,
     ) -> IntermediateResult:
         t0 = time.perf_counter()
         total_docs = sum(s.num_docs for s in live)
@@ -305,7 +320,8 @@ class QueryExecutor:
         from pinot_tpu.engine.device import segment_arrays
 
         q_np = build_query_inputs(request, plan, ctx, staged, scratch=scratch)
-        q_inputs = self._to_device_inputs(q_np, plan=plan)
+        digest = self._inputs_digest(q_np)
+        q_inputs = self._to_device_inputs(q_np, plan=plan, digest=digest)
         seg_arrays = segment_arrays(staged, needed)
         block_ids, scanned_rows = self._block_skip_ids(plan, q_np, live, staged)
         from pinot_tpu.engine.kernel import chunk_rows_limit
@@ -318,7 +334,7 @@ class QueryExecutor:
             # kernel instead (correctness over the block-skip win)
             block_ids = None
         t0 = self._phase("planBuild", t0)
-        # kernels return host numpy via ONE packed D2H transfer
+        # kernel outputs fetch via ONE packed D2H transfer
         # (engine/packing.py): per-leaf fetches pay a tunnel RTT each
         if block_ids is not None:
             from pinot_tpu.engine.zonemap import zone_block_rows
@@ -330,12 +346,12 @@ class QueryExecutor:
                 kernel = make_packed_block_table_kernel(plan, block)
             else:
                 kernel = self._block_kernel(plan, block)
-            outs = kernel(seg_arrays, q_inputs, jnp.asarray(block_ids))
+            args = (seg_arrays, q_inputs, jnp.asarray(block_ids))
         else:
             kernel = self._kernel(plan, staged)
-            outs = kernel(seg_arrays, q_inputs)
-        outs = {k: np.asarray(v) if not isinstance(v, tuple) else tuple(np.asarray(x) for x in v) for k, v in outs.items()}
-        t0 = self._phase("planExec", t0)
+            args = (seg_arrays, q_inputs)
+        outs = self._run_kernel(kernel, args, plan, staged, digest, block_ids, deadline)
+        t0 = time.perf_counter()  # laneWait/planExec timed inside _run_kernel
 
         # sort-dedup distinct overflow: more unique pairs than the
         # device buffer holds — only the host path can finish exactly
@@ -587,18 +603,59 @@ class QueryExecutor:
                     hll_cols.add(a.column)
         return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols)), tuple(sorted(hll_cols))
 
-    def _to_device_inputs(self, inputs: Dict[str, Any], plan=None) -> Dict[str, Any]:
-        """Device-resident query-inputs cache: a repeated query (same
-        plan, same literal tables) reuses the arrays already in HBM
-        instead of re-uploading — on a tunneled chip every upload pays
-        a host->device round trip.  Keyed by (plan, content digest), so
-        realtime watermark changes or different literals miss safely."""
+    def _run_kernel(
+        self, kernel, args, plan, staged, digest, block_ids, deadline
+    ) -> Dict[str, Any]:
+        """DISPATCH + output fetch.  Serial mode (no lane): launch and
+        fetch inline, the pre-pipeline behavior.  Pipelined: the launch
+        runs on the device lane — coalesced with identical in-flight
+        dispatches — and this worker blocks only when FINALIZE first
+        reads the outputs (the packed D2H transfer)."""
+
+        def launch():
+            disp = getattr(kernel, "dispatch", None)
+            if disp is not None:
+                return kernel.fetch, disp(*args)
+            return None, kernel(*args)  # raw jit: device arrays out
+
+        t0 = time.perf_counter()
+        if self.lane is None:
+            fetch, handle = launch()
+        else:
+            # coalesce key: identical (plan, staged-table token, inputs
+            # digest, block-id set) => identical device outputs.  The
+            # token is process-unique (device.py), so a table re-staged
+            # after GC can never alias an in-flight dispatch.
+            bkey = (
+                None
+                if block_ids is None
+                else (block_ids.shape, block_ids.tobytes())
+            )
+            ticket = self.lane.submit(
+                (plan, staged.token, digest, bkey), launch, deadline
+            )
+            fetch, handle = ticket.result(deadline)
+            t0 = self._phase("laneWait", t0)  # queue + coalesce wait only
+        outs = fetch(handle) if fetch is not None else handle
+        outs = {
+            k: np.asarray(v)
+            if not isinstance(v, tuple)
+            else tuple(np.asarray(x) for x in v)
+            for k, v in outs.items()
+        }
+        # planExec excludes lane queueing (timed above as laneWait): it
+        # covers launch (serial mode) + the blocking packed D2H fetch,
+        # so the per-stage timers on status() sum to wall time instead
+        # of double-counting the wait inside planExec
+        self._phase("planExec", t0)
+        return outs
+
+    def _inputs_digest(self, inputs: Dict[str, Any]) -> str:
+        """Content digest of the numpy query-inputs pytree — one
+        computation shared by the device-resident input cache and the
+        lane's coalesce key."""
         import hashlib
 
-        from pinot_tpu.engine.device import to_device_inputs
-
-        if plan is None:
-            return to_device_inputs(inputs)
         h = hashlib.blake2b(digest_size=16)
         leaves, _ = jax.tree_util.tree_flatten(inputs)
         for leaf in leaves:
@@ -610,7 +667,23 @@ class QueryExecutor:
             # re-split into the same byte stream ((1, 23) vs (12, 3))
             h.update(len(part).to_bytes(8, "little"))
             h.update(part)
-        key = (plan, h.hexdigest())
+        return h.hexdigest()
+
+    def _to_device_inputs(
+        self, inputs: Dict[str, Any], plan=None, digest: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Device-resident query-inputs cache: a repeated query (same
+        plan, same literal tables) reuses the arrays already in HBM
+        instead of re-uploading — on a tunneled chip every upload pays
+        a host->device round trip.  Keyed by (plan, content digest), so
+        realtime watermark changes or different literals miss safely."""
+        from pinot_tpu.engine.device import to_device_inputs
+
+        if plan is None:
+            return to_device_inputs(inputs)
+        if digest is None:
+            digest = self._inputs_digest(inputs)
+        key = (plan, digest)
         with self._qinput_cache_lock:
             cached = self._qinput_cache.get(key)
             if cached is not None:

@@ -249,18 +249,24 @@ def _groupby_vectorized(
     space = 1
     for g in gcards:
         space *= g
-    if space <= (1 << 24):
-        # small key space (sort-pairs overflow fallbacks group by a
-        # low-card column): factorize with ONE bincount + rank gather
+    if space <= (1 << 24) and space <= max(keys.size, 1) * 8:
+        # small DENSE key space (sort-pairs overflow fallbacks group by
+        # a low-card column): factorize with presence + rank gather
         # instead of np.unique's 134M-row argsort + cumsum (~30s saved
-        # at north-star scale)
-        present = np.bincount(keys, minlength=space)
+        # at north-star scale).  The dense-side peak is 5 bytes/slot
+        # (bool presence + int32 cumsum ranks) — the r5 version's two
+        # space-sized int64 arrays cost 16 bytes/slot, a peak-RSS
+        # regression that bit even when only a handful of keys were
+        # live; a space much larger than the matched-row count (sparse)
+        # takes the sort path instead, whose footprint scales with rows.
+        present = np.zeros(space, dtype=bool)
+        present[keys] = True
         uniq = np.flatnonzero(present).astype(np.int64)
-        rank = np.zeros(space, dtype=np.int64)
-        rank[uniq] = np.arange(uniq.size, dtype=np.int64)
-        inv = rank[keys]
-        counts = present[uniq].astype(np.float64)
+        rank = np.cumsum(present, dtype=np.int32)  # rank+1 at each live key
+        inv = (rank[keys] - 1).astype(np.int64)
+        del present, rank
         k = uniq.size
+        counts = np.bincount(inv, minlength=k).astype(np.float64)
     else:
         uniq, inv = np.unique(keys, return_inverse=True)
         k = uniq.size

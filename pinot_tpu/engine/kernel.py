@@ -1157,7 +1157,7 @@ def _chunked_run(table: Callable, reducers: Dict[str, str], num_segments: int, c
     def sliced(tree, s, e):
         return jax.tree_util.tree_map(lambda x: x[s:e], tree)
 
-    def run(segs: Dict[str, Any], q: Dict[str, Any]) -> Dict[str, Any]:
+    def dispatch(segs: Dict[str, Any], q: Dict[str, Any]):
         outs = None
         for s in range(0, num_segments, chunk):
             e = min(s + chunk, num_segments)
@@ -1167,8 +1167,15 @@ def _chunked_run(table: Callable, reducers: Dict[str, str], num_segments: int, c
                 if outs is None
                 else {k: combine_reduced(reducers[k], outs[k], o[k]) for k in o}
             )
-        return pack(outs)
+        return pack.dispatch(outs)
 
+    def run(segs: Dict[str, Any], q: Dict[str, Any]) -> Dict[str, Any]:
+        return pack.fetch(dispatch(segs, q))
+
+    # device-lane pipeline halves (engine/dispatch.py): launch the chunk
+    # sequence without blocking, fetch later from the FINALIZE worker
+    run.dispatch = dispatch
+    run.fetch = pack.fetch
     return run
 
 

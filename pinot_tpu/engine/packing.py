@@ -55,7 +55,15 @@ def _layout_for(out_shapes) -> Tuple[Any, list]:
 def make_packed_kernel(fn: Callable) -> Callable:
     """Wrap a kernel-like callable (pytree of device arrays out) so a
     call returns the same pytree as HOST numpy arrays via one packed
-    device-to-host transfer."""
+    device-to-host transfer.
+
+    The returned callable also exposes the two pipeline halves as
+    attributes: ``.dispatch(*args) -> handle`` launches the packed
+    program and returns WITHOUT reading it back (jax dispatch is
+    asynchronous — the device lane uses this to keep the device queue
+    fed), and ``.fetch(handle)`` performs the single blocking D2H
+    transfer + unpack (the FINALIZE stage, safe to call from any
+    thread and from several waiters of one coalesced dispatch)."""
 
     @jax.jit
     def packed(*args):
@@ -73,7 +81,9 @@ def make_packed_kernel(fn: Callable) -> Callable:
 
     layout_cache: Dict[Tuple, Tuple] = {}
 
-    def call(*args):
+    def dispatch(*args):
+        """Launch the packed program; returns an opaque (layout, device
+        buffer) handle without blocking on execution."""
         key = tuple(
             (tuple(l.shape), str(l.dtype))
             for l in jax.tree_util.tree_leaves(args)
@@ -85,8 +95,13 @@ def make_packed_kernel(fn: Callable) -> Callable:
             if len(layout_cache) > 64:
                 layout_cache.clear()
             layout_cache[key] = lay
-        treedef, layout = lay
-        buf = np.asarray(packed(*args))  # ONE device->host transfer
+        return lay, packed(*args)
+
+    def fetch(handle):
+        """ONE device->host transfer + unpack; blocks until the
+        dispatched program completes."""
+        (treedef, layout), buf_dev = handle
+        buf = np.asarray(buf_dev)
         outs = []
         for shape, dt, off, nbytes in layout:
             if nbytes == 0:
@@ -99,4 +114,9 @@ def make_packed_kernel(fn: Callable) -> Callable:
                 outs.append(part.copy().view(dt).reshape(shape))
         return jax.tree_util.tree_unflatten(treedef, outs)
 
+    def call(*args):
+        return fetch(dispatch(*args))
+
+    call.dispatch = dispatch
+    call.fetch = fetch
     return call

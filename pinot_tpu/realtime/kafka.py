@@ -52,6 +52,18 @@ LATEST = -1
 ERR_NONE = 0
 ERR_UNKNOWN_TOPIC = 3
 ERR_OFFSET_OUT_OF_RANGE = 1
+# shim-specific (outside the v0 error range): the addressed partition
+# stores verbatim columnar blocks, which the row-oriented Kafka wire
+# protocol cannot serve.  Mirrors the netstream broker's typed
+# '{"error": "columnar partition"}' rejection — without it a populated
+# columnar partition silently reported high-watermark 0 and consumers
+# idled forever believing the partition empty.
+ERR_COLUMNAR_PARTITION = 87
+
+
+class ColumnarPartitionError(IOError):
+    """A Kafka-protocol fetch/offsets request addressed a columnar-mode
+    partition; consume it via the netstream fetchc transport instead."""
 
 
 # -- primitive encoders ------------------------------------------------
@@ -315,6 +327,11 @@ class KafkaWireClient:
                 r.i32()  # partition
                 err = r.i16()
                 got = [r.i64() for _ in range(r.i32())]
+                if err == ERR_COLUMNAR_PARTITION:
+                    raise ColumnarPartitionError(
+                        f"columnar partition {topic}/{partition}: not servable "
+                        "over the row-oriented Kafka protocol (use fetchc)"
+                    )
                 if err != ERR_NONE:
                     raise IOError(f"ListOffsets error {err} for {topic}/{partition}")
                 offsets.extend(got)
@@ -378,6 +395,11 @@ class KafkaWireClient:
                 data = r._take(size)
                 if err == ERR_OFFSET_OUT_OF_RANGE:
                     raise IndexError(f"offset {offset} out of range for {topic}/{partition}")
+                if err == ERR_COLUMNAR_PARTITION:
+                    raise ColumnarPartitionError(
+                        f"columnar partition {topic}/{partition}: not servable "
+                        "over the row-oriented Kafka protocol (use fetchc)"
+                    )
                 if err != ERR_NONE:
                     raise IOError(f"Fetch error {err} for {topic}/{partition}")
                 raw_len += len(data)
@@ -573,6 +595,9 @@ class KafkaProtocolShim:
                 if t is None or pid >= len(t.raw):
                     body += _i32(pid) + _i16(ERR_UNKNOWN_TOPIC) + _i32(0)
                     continue
+                if t.columnar is not None and t.columnar.counts[pid]:
+                    body += _i32(pid) + _i16(ERR_COLUMNAR_PARTITION) + _i32(0)
+                    continue
                 off = 0 if time == EARLIEST else len(t.raw[pid])
                 body += _i32(pid) + _i16(ERR_NONE) + _i32(1) + _i64(off)
         return body
@@ -594,6 +619,11 @@ class KafkaProtocolShim:
                 max_bytes = r.i32()
                 if t is None or pid >= len(t.raw):
                     body += _i32(pid) + _i16(ERR_UNKNOWN_TOPIC) + _i64(0) + _i32(0)
+                    continue
+                if t.columnar is not None and t.columnar.counts[pid]:
+                    # typed rejection, not a silent empty reply: the
+                    # partition HAS data, just not row-protocol data
+                    body += _i32(pid) + _i16(ERR_COLUMNAR_PARTITION) + _i64(0) + _i32(0)
                     continue
                 log = t.raw[pid]  # stored serialized bytes, verbatim
                 hw = len(log)

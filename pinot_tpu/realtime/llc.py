@@ -614,15 +614,22 @@ class RealtimeSegmentDataManager:
             try:
                 cols, n, next_offset = fetch_cols(self.partition, self.offset)
             except RuntimeError as e:
-                if "row-mode" not in str(e) and self._columnar is True:
-                    raise  # transient transport error on a KNOWN-columnar
-                    # partition must not latch the consumer onto the row
-                    # path (the broker rejects row fetches there forever)
-                self._columnar = False  # row-mode partition / no support
-            except Exception:
-                if self._columnar is True:
+                # Only a DEFINITIVE broker verdict may latch row mode:
+                # the broker's typed "row-mode partition" rejection, or
+                # a broker that doesn't know the fetchc op at all.  A
+                # transient transport error must re-raise whether the
+                # mode is KNOWN-columnar (the broker rejects row fetches
+                # there forever) or still UNKNOWN — latching False on a
+                # first-fetch hiccup would wedge ingest on a columnar
+                # partition until restart (the consume loop retries the
+                # raised error next step instead).
+                msg = str(e)
+                if "row-mode" in msg or "unknown op" in msg:
+                    self._columnar = False  # row-mode partition / no fetchc support
+                else:
                     raise
-                self._columnar = False
+            # any other exception (socket, decode) propagates: never
+            # evidence of the partition's mode — always retryable
             else:
                 self._columnar = True
                 if n <= 0:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
+import os
 import time
 from typing import Optional, Sequence, Tuple
 
@@ -44,11 +45,22 @@ class ServerInstance:
         mesh=None,
         num_workers: int = 4,
         max_pending: int = 64,
+        pipeline: Optional[bool] = None,
     ) -> None:
         self.name = name
         self.data_manager = InstanceDataManager()
         self.metrics = ServerMetrics(name)
-        self.executor = QueryExecutor(mesh=mesh, metrics=self.metrics)
+        # three-stage serving pipeline (engine/dispatch.py): PREP on the
+        # scheduler's worker pool, kernel launches on the single device
+        # lane (coalescing identical dispatches), FINALIZE back on the
+        # submitting worker.  On by default; PINOT_TPU_PIPELINE=0 (or
+        # pipeline=False) restores the serial per-worker path.
+        if pipeline is None:
+            pipeline = os.environ.get("PINOT_TPU_PIPELINE", "1") != "0"
+        from pinot_tpu.engine.dispatch import DeviceLane
+
+        self.lane = DeviceLane(metrics=self.metrics) if pipeline else None
+        self.executor = QueryExecutor(mesh=mesh, metrics=self.metrics, lane=self.lane)
         self.scheduler = QueryScheduler(num_workers=num_workers, max_pending=max_pending)
         self._table_schemas: dict = {}  # raw table name -> Schema
 
@@ -108,9 +120,15 @@ class ServerInstance:
         """Framed request bytes -> framed DataTable bytes."""
         t_start = time.perf_counter()
         req = deserialize_instance_request(payload)
+        # ONE deadline for both queueing tiers: the scheduler checks it
+        # at worker-dequeue time, the device lane at launch-dequeue time
+        timeout_s = req["timeoutMs"] / 1000.0
+        deadline = time.monotonic() + timeout_s
         try:
             result = self.scheduler.run(
-                lambda: self._process(req), timeout_s=req["timeoutMs"] / 1000.0
+                lambda: self._process(req, deadline),
+                timeout_s=timeout_s,
+                deadline=deadline,
             )
         except SchedulerSaturatedError as e:
             # overload shed: fast typed rejection, no stack spam — the
@@ -151,7 +169,26 @@ class ServerInstance:
         self.metrics.meter("queries").mark()
         return serialize_result(result)
 
-    def _process(self, req: dict) -> IntermediateResult:
+    def status(self) -> dict:
+        """Serving-surface snapshot: scheduler depth/shed, device-lane
+        depth + coalesce/dispatch/shed counters, and the per-stage phase
+        timers (staging/planBuild/laneWait/planExec/finalize) inside the
+        metrics snapshot."""
+        return {
+            "name": self.name,
+            "scheduler": self.scheduler.stats(),
+            "lane": None if self.lane is None else self.lane.stats(),
+            "metrics": self.metrics.snapshot(),
+        }
+
+    def shutdown(self) -> None:
+        """Idempotent: drain-stop the scheduler and close the device
+        lane (queued lane waiters fail fast with LaneClosedError)."""
+        self.scheduler.shutdown()
+        if self.lane is not None:
+            self.lane.close()
+
+    def _process(self, req: dict, deadline: Optional[float] = None) -> IntermediateResult:
         request = parse_pql(req["pql"])
         request.debug_options = dict(req.get("debugOptions") or {})
         request = optimize_request(request)
@@ -168,7 +205,9 @@ class ServerInstance:
         acquired = tdm.acquire_segments(names)
         try:
             with trace.span("planAndExecute"):
-                result = self.executor.execute([a.query_view() for a in acquired], request)
+                result = self.executor.execute(
+                    [a.query_view() for a in acquired], request, deadline=deadline
+                )
         finally:
             tdm.release_segments(acquired)
         if trace.enabled:

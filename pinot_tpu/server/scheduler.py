@@ -5,8 +5,9 @@ The reference bounds query concurrency with runner/worker pools
 (``QueryScheduler.java:35``, ``FCFSQueryScheduler``); queries beyond
 pool capacity wait FCFS, and the serving bar is what happens at
 saturation.  Device execution is serialized per chip anyway, so the
-pool here mainly bounds host-side planning/finalize concurrency and
-provides the submit/timeout surface.  The OVERLOAD POLICY (r5): at most
+pool here bounds the host-side PREP/FINALIZE stages of the serving
+pipeline (kernel launches live on the single device lane,
+``engine/dispatch.py``) and provides the submit/timeout surface.  The OVERLOAD POLICY (r5): at most
 ``max_pending`` queries may be queued-or-running; beyond that submits
 are shed immediately with ``SchedulerSaturatedError`` rather than
 queued without bound — a fast 210-coded error reply beats a timeout
@@ -66,6 +67,17 @@ class QueryScheduler:
     @property
     def abandoned_count(self) -> int:
         return self._abandoned
+
+    def stats(self) -> dict:
+        """Status-surface snapshot (ServerInstance.status)."""
+        with self._lock:
+            return {
+                "pending": self._pending,
+                "maxPending": self._max_pending,
+                "shed": self._shed,
+                "abandoned": self._abandoned,
+                "shutdown": self._shutdown,
+            }
 
     def submit(self, fn: Callable[[], Any]) -> concurrent.futures.Future:
         with self._lock:

@@ -119,25 +119,35 @@ class InProcessCluster:
 
 
 def single_server_broker(
-    table: str, segments, timeout_ms: float = 600_000.0, max_pending: int = 64
+    table: str,
+    segments,
+    timeout_ms: float = 600_000.0,
+    max_pending: int = 64,
+    **server_kwargs,
 ):
     """One in-process server + broker over LocalTransport — the
     minimal serving topology every bench uses (bench.py,
     tools/config_bench.py).  The generous default timeout covers the
-    first query's staging + compile on a tunneled chip."""
+    first query's staging + compile on a tunneled chip.  Extra kwargs
+    reach the ServerInstance (e.g. ``pipeline=False`` for the serial
+    executor path); the instance is reachable as
+    ``broker.local_servers[0]`` so benches can read lane/scheduler
+    counters."""
     from pinot_tpu.broker.broker import BrokerRequestHandler
     from pinot_tpu.broker.routing import RoutingTableProvider
 
-    server = ServerInstance("benchServer", max_pending=max_pending)
+    server = ServerInstance("benchServer", max_pending=max_pending, **server_kwargs)
     for seg in segments:
         server.add_segment(table, seg)
     transport = LocalTransport()
     transport.register(("benchServer", 0), server.handle_request)
     routing = RoutingTableProvider()
     routing.update(table, {s.segment_name: {"benchServer": "ONLINE"} for s in segments})
-    return BrokerRequestHandler(
+    broker = BrokerRequestHandler(
         transport,
         {"benchServer": ("benchServer", 0)},
         routing=routing,
         timeout_ms=timeout_ms,
     )
+    broker.local_servers = [server]
+    return broker

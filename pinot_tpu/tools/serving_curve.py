@@ -139,8 +139,10 @@ def run_curve(
             broker.quota.set_quota("lineitem", None)
         print(json.dumps({"quota_step": quota_step}), flush=True)
 
+    server = broker.local_servers[0]
     return {
         "workload": "mixed: Q1 groupby scan, Q6 IN+range, selection needle, HLL groupby",
+        "lane": None if server.lane is None else server.lane.stats(),
         "num_segments": len(segments),
         "total_rows": sum(s.num_docs for s in segments),
         "duration_s_per_step": duration_s,

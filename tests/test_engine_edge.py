@@ -333,3 +333,42 @@ def test_docrange_filter_on_group_column_skips_base_correctly():
     want = oracle.execute(parse_pql(pql))
     assert json.dumps(got.to_json()["aggregationResults"], sort_keys=True) == \
         json.dumps(want.to_json()["aggregationResults"], sort_keys=True)
+
+
+def test_host_fallback_factorization_branches_match_oracle(monkeypatch):
+    """Both group-key factorization branches of the vectorized host path
+    (peak-RSS satellite): the DENSE presence+cumsum-rank branch engages
+    only when the key space is small relative to the matched rows (its
+    space-sized transients are now bool + int32, not two int64 arrays);
+    a SPARSE key space takes np.unique whose footprint scales with rows.
+    Responses must match the scan oracle on both."""
+    from pinot_tpu.engine import config
+
+    monkeypatch.setattr(config, "MAX_GROUP_CAPACITY", 64)  # force host path
+
+    schema = Schema(
+        "big",
+        dimensions=[
+            FieldSpec("a", DataType.INT),
+            FieldSpec("b", DataType.INT),
+            FieldSpec("c", DataType.INT),
+        ],
+        metrics=[FieldSpec("m", DataType.INT, FieldType.METRIC)],
+    )
+    rows = random_rows(schema, 1600, seed=13, cardinality=20)
+    seg = build_segment(schema, rows, "big", "fseg")
+
+    # dense: space = 20*20 = 400 <= 8 * ~1600 matched rows
+    got, want = run_both(
+        schema, rows, [seg],
+        "SELECT count(*), sum(m) FROM big GROUP BY a, b TOP 10",
+    )
+    assert got == want
+
+    # sparse: space = 20^3 = 8000 > 8 * (few matched rows)
+    needle = rows[0]["a"]
+    got, want = run_both(
+        schema, rows, [seg],
+        f"SELECT count(*), sum(m) FROM big WHERE a = {needle} GROUP BY a, b, c TOP 10",
+    )
+    assert got == want

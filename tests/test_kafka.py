@@ -515,3 +515,30 @@ def test_real_broker_wrapper_below_offset_filtered():
     msgs, raw_len, decoded_any = c._fetch_once("wtopic", 0, 2, 1 << 20)
     assert [o for o, _, _ in msgs] == [2, 3, 4]  # 0 and 1 filtered
     assert raw_len == len(wrapper) and decoded_any
+
+
+# -- columnar partitions over the row protocol ------------------------
+
+
+def test_columnar_partition_fetch_is_typed_error(kafka_stack):
+    """A populated columnar partition must reject Kafka-protocol reads
+    with the typed columnar error (mirroring the netstream broker's
+    rejection), NOT silently report high-watermark 0 — consumers would
+    idle forever believing the partition empty."""
+    import numpy as np
+
+    from pinot_tpu.realtime.kafka import ColumnarPartitionError
+
+    sb, producer, shim = kafka_stack
+    producer.produce_columns({"i": np.arange(8, dtype=np.int64)}, partition=0)
+    producer.produce({"i": 99}, partition=1)  # row partition, same topic
+
+    host, port = shim.address
+    client = KafkaWireClient(host, port)
+    with pytest.raises(ColumnarPartitionError, match="columnar partition"):
+        client.fetch("ktopic", 0, 0)
+    with pytest.raises(ColumnarPartitionError, match="columnar partition"):
+        client.list_offsets("ktopic", 0, LATEST)
+    # the row-mode partition of the SAME topic keeps serving normally
+    assert client.list_offsets("ktopic", 1, LATEST) == [1]
+    assert [o for o, _, _ in client.fetch("ktopic", 1, 0)] == [0]

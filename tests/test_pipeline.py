@@ -1,0 +1,302 @@
+"""Serving-pipeline tests: DeviceLane unit behavior (coalescing,
+deadline shed, error fan-out, close), scheduler interaction when the
+LANE (not the worker pool) is the bottleneck, and the pipelined-vs-
+serial differential on the full broker path."""
+import json
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.engine.dispatch import DeviceLane, LaneClosedError
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.server.scheduler import (
+    QueryAbandonedError,
+    QueryScheduler,
+    SchedulerSaturatedError,
+)
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+from pinot_tpu.utils.metrics import ServerMetrics
+
+
+# -- DeviceLane units --------------------------------------------------
+
+
+def test_lane_dispatches_and_delivers():
+    lane = DeviceLane()
+    t = lane.submit("k", lambda: 41 + 1)
+    assert t.result(time.monotonic() + 5) == 42
+    assert lane.dispatch_count == 1
+    assert lane.coalesce_hits == 0
+
+
+def test_lane_coalesces_identical_queued_dispatches():
+    """Waiters keyed identically behind a busy lane ride ONE launch."""
+    lane = DeviceLane(metrics=ServerMetrics("t"))
+    gate = threading.Event()
+    launches = []
+
+    def slow():
+        gate.wait(5)
+        launches.append("slow")
+        return "slow-out"
+
+    def fast():
+        launches.append("fast")
+        return "fast-out"
+
+    t_block = lane.submit("blocker", slow)  # occupies the lane thread
+    time.sleep(0.05)  # let the lane pick it up
+    tickets = [lane.submit("same", fast) for _ in range(5)]
+    other = lane.submit("different", fast)
+    gate.set()
+    deadline = time.monotonic() + 5
+    assert t_block.result(deadline) == "slow-out"
+    assert [t.result(deadline) for t in tickets] == ["fast-out"] * 5
+    assert other.result(deadline) == "fast-out"
+    # 5 identical submits -> 1 launch; the different key launches alone
+    assert launches.count("fast") == 2
+    assert lane.coalesce_hits == 4
+    assert lane.stats()["coalesceHits"] == 4
+
+
+def test_lane_no_result_caching_after_completion():
+    """A submit AFTER an identical dispatch finished re-launches: the
+    lane coalesces in-flight work, it is not a result cache."""
+    lane = DeviceLane()
+    calls = []
+    fn = lambda: calls.append(1) or len(calls)
+    t1 = lane.submit("k", fn)
+    assert t1.result(time.monotonic() + 5) == 1
+    # plain python values have no pending device buffers -> closed
+    t2 = lane.submit("k", fn)
+    assert t2.result(time.monotonic() + 5) == 2
+    assert lane.dispatch_count == 2
+
+
+def test_lane_deadline_shed_while_queued():
+    """A waiter whose deadline drains in the lane queue sheds with
+    QueryAbandonedError and its dispatch never launches."""
+    lane = DeviceLane(metrics=ServerMetrics("t"))
+    gate = threading.Event()
+    launched = []
+
+    lane.submit("blocker", lambda: gate.wait(5))
+    time.sleep(0.05)
+    doomed = lane.submit(
+        "doomed", lambda: launched.append(1), deadline=time.monotonic() + 0.01
+    )
+    time.sleep(0.05)  # the deadline expires while 'blocker' holds the lane
+    gate.set()
+    with pytest.raises(QueryAbandonedError):
+        doomed.result(time.monotonic() + 5)
+    time.sleep(0.1)
+    assert launched == []  # shed before launch, not after
+    assert lane.shed_count == 1
+
+
+def test_lane_mixed_deadline_waiters_still_serve_live_ones():
+    """When only SOME coalesced waiters expired, the dispatch still runs
+    for the rest."""
+    lane = DeviceLane()
+    gate = threading.Event()
+    lane.submit("blocker", lambda: gate.wait(5))
+    time.sleep(0.05)
+    dead = lane.submit("k", lambda: "v", deadline=time.monotonic() + 0.01)
+    live = lane.submit("k", lambda: "v", deadline=time.monotonic() + 30)
+    time.sleep(0.05)
+    gate.set()
+    with pytest.raises(QueryAbandonedError):
+        dead.result(time.monotonic() + 5)
+    assert live.result(time.monotonic() + 5) == "v"
+
+
+def test_lane_error_fans_out_to_all_waiters():
+    lane = DeviceLane()
+    gate = threading.Event()
+
+    def boom():
+        gate.wait(5)
+        raise ValueError("kernel exploded")
+
+    lane.submit("blocker", lambda: gate.wait(5))
+    time.sleep(0.05)
+    tickets = [lane.submit("bad", boom) for _ in range(3)]
+    gate.set()
+    for t in tickets:
+        with pytest.raises(ValueError, match="kernel exploded"):
+            t.result(time.monotonic() + 5)
+    # an error never stays coalescible: the next submit re-launches
+    ok = lane.submit("bad", lambda: "fine")
+    assert ok.result(time.monotonic() + 5) == "fine"
+
+
+def test_lane_close_fails_queued_and_rejects_new():
+    lane = DeviceLane()
+    gate = threading.Event()
+    lane.submit("blocker", lambda: gate.wait(5))
+    time.sleep(0.05)
+    queued = lane.submit("q", lambda: "never")
+    lane.close()
+    lane.close()  # idempotent
+    gate.set()
+    with pytest.raises(LaneClosedError):
+        queued.result(time.monotonic() + 5)
+    with pytest.raises(LaneClosedError):
+        lane.submit("x", lambda: 1)
+
+
+def test_lane_result_honors_caller_deadline():
+    lane = DeviceLane()
+    gate = threading.Event()
+    lane.submit("blocker", lambda: gate.wait(5))
+    time.sleep(0.05)
+    slow = lane.submit("s", lambda: "late")
+    with pytest.raises(TimeoutError):
+        slow.result(time.monotonic() + 0.05)
+    gate.set()
+
+
+# -- scheduler x lane interaction -------------------------------------
+
+
+def test_saturation_shed_when_lane_is_bottleneck():
+    """With the device lane wedged, workers pile up blocked on tickets,
+    the pending queue fills, and NEW submits shed with the saturation
+    error — the overload policy holds no matter which stage binds."""
+    lane = DeviceLane()
+    sched = QueryScheduler(num_workers=2, max_pending=3)
+    gate = threading.Event()
+    lane.submit("blocker", lambda: gate.wait(10))
+    time.sleep(0.05)
+
+    def query(i):
+        ticket = lane.submit(f"q{i}", lambda: i)  # distinct keys: no coalesce
+        return ticket.result(time.monotonic() + 10)
+
+    futs = [sched.submit(lambda i=i: query(i)) for i in range(3)]
+    time.sleep(0.1)  # two workers blocked in the lane, one queued
+    with pytest.raises(SchedulerSaturatedError):
+        sched.submit(lambda: query(99))
+    assert sched.shed_count == 1
+    gate.set()
+    assert sorted(f.result(timeout=10) for f in futs) == [0, 1, 2]
+    sched.shutdown()
+    lane.close()
+
+
+def test_deadline_abandonment_with_lane_bottleneck():
+    """Deadline expiry while BLOCKED BEHIND the lane (not the worker
+    queue) still surfaces as abandonment/timeout, and the lane sheds the
+    expired waiter instead of executing it."""
+    lane = DeviceLane()
+    sched = QueryScheduler(num_workers=1, max_pending=4)
+    gate = threading.Event()
+    executed = []
+    lane.submit("blocker", lambda: gate.wait(10))
+    time.sleep(0.05)
+
+    deadline = time.monotonic() + 0.2
+
+    def query():
+        if time.monotonic() >= deadline:
+            raise QueryAbandonedError("expired pre-lane")
+        ticket = lane.submit("q", lambda: executed.append(1), deadline=deadline)
+        return ticket.result(deadline)
+
+    fut = sched.submit(query)
+    with pytest.raises((QueryAbandonedError, TimeoutError)):
+        fut.result(timeout=10)
+    gate.set()
+    time.sleep(0.1)
+    assert executed == []  # never ran device work for the dead query
+    sched.shutdown()
+    lane.close()
+
+
+# -- full-path differential -------------------------------------------
+
+
+def _payload(resp) -> str:
+    return json.dumps(
+        {k: v for k, v in resp.to_json().items() if k != "timeUsedMs"},
+        sort_keys=True,
+    )
+
+
+@pytest.fixture(scope="module")
+def differential_stack():
+    from pinot_tpu.tools.cluster_harness import single_server_broker
+
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 4000, seed=9)
+    segs = [
+        build_segment(schema, rows[:2000], "testTable", "pseg0"),
+        build_segment(schema, rows[2000:], "testTable", "pseg1"),
+    ]
+    serial = single_server_broker("testTable", segs, pipeline=False)
+    pipelined = single_server_broker("testTable", segs, pipeline=True)
+    return serial, pipelined
+
+
+DIFF_QUERIES = [
+    "SELECT count(*) FROM testTable",
+    "SELECT sum(metInt), min(metFloat), max(metInt) FROM testTable WHERE dimInt > 50",
+    "SELECT sum(metInt) FROM testTable GROUP BY dimStr TOP 5",
+    "SELECT distinctcount(dimInt) FROM testTable GROUP BY dimStr TOP 5",
+    "SELECT dimStr, metInt FROM testTable ORDER BY metInt DESC LIMIT 7",
+]
+
+
+def test_pipelined_matches_serial_payloads(differential_stack):
+    serial, pipelined = differential_stack
+    for pql in DIFF_QUERIES:
+        a = serial.handle_pql(pql)
+        b = pipelined.handle_pql(pql)
+        assert not a.exceptions and not b.exceptions, (pql, a.exceptions, b.exceptions)
+        assert _payload(a) == _payload(b), pql
+
+
+def test_coalesced_waiters_get_independent_correct_results(differential_stack):
+    """Concurrent identical queries through the pipelined broker: every
+    waiter's payload equals the serial path's, and the lane actually
+    coalesced (same results from FEWER dispatches)."""
+    serial, pipelined = differential_stack
+    pql = DIFF_QUERIES[2]
+    want = _payload(serial.handle_pql(pql))
+    server = pipelined.local_servers[0]
+    base_hits = server.lane.coalesce_hits
+
+    payloads = []
+    errs = []
+    lock = threading.Lock()
+
+    def hit():
+        for _ in range(8):
+            resp = pipelined.handle_pql(pql)
+            with lock:
+                if resp.exceptions:
+                    errs.append(resp.exceptions)
+                else:
+                    payloads.append(_payload(resp))
+
+    threads = [threading.Thread(target=hit) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs[:1]
+    assert len(payloads) == 64
+    assert set(payloads) == {want}
+    assert server.lane.coalesce_hits > base_hits  # dispatches were shared
+
+
+def test_status_surface_exposes_pipeline_counters(differential_stack):
+    _, pipelined = differential_stack
+    status = pipelined.local_servers[0].status()
+    assert status["lane"] is not None
+    for key in ("depth", "dispatches", "coalesceHits", "shed"):
+        assert key in status["lane"]
+    assert "pending" in status["scheduler"]
+    timers = status["metrics"]["timers"]
+    assert "phase.laneWait" in timers and "phase.laneDispatch" in timers

@@ -371,3 +371,132 @@ def test_index_batch_nan_dict_cardinality_stable():
     ids_slow = seg_slow._columns["m"].ids[:2].tolist()
     # both paths must key the two NaNs the same way
     assert card_fast == len(set(ids_slow))
+
+
+# ------------------------------------------- columnar mode detection
+def _columnar_dm(stream):
+    from pinot_tpu.realtime.llc import RealtimeSegmentDataManager
+
+    return RealtimeSegmentDataManager(
+        None, None, "rt_REALTIME", "rt__0__0__t", rsvp_schema(), stream, 0, 0, 1000
+    )
+
+
+def _block(n, start=0):
+    import numpy as np
+
+    return {
+        "venue_name": np.array([f"venue{i % 5}" for i in range(start, start + n)]),
+        "event_name": np.array([f"event{i % 3}" for i in range(start, start + n)]),
+        "rsvp_count": np.arange(start, start + n, dtype=np.int64) % 7,
+        "mtime": np.arange(1_000_000 + start, 1_000_000 + start + n, dtype=np.int64),
+    }
+
+
+def test_columnar_transient_error_does_not_latch_row_mode():
+    """Regression (llc.py _fetch_and_index): a transient transport error
+    on the FIRST columnar fetch must re-raise — the mode is still
+    unknown.  The old code latched _columnar=False, permanently wedging
+    ingest on columnar partitions (whose row fetches the broker rejects
+    forever) until a restart."""
+
+    class FailOnceStream:
+        def __init__(self):
+            self.transport_failures = 1
+            self.row_fetches = 0
+
+        def fetch_columns(self, partition, offset):
+            if self.transport_failures:
+                self.transport_failures -= 1
+                raise OSError("connection reset by peer")
+            return _block(10), 10, offset + 10
+
+        def fetch(self, partition, offset, max_rows):
+            self.row_fetches += 1
+            return [], offset
+
+    stream = FailOnceStream()
+    dm = _columnar_dm(stream)
+    with pytest.raises(OSError):
+        dm.consume_step()
+    assert dm._columnar is None  # mode still unknown, nothing latched
+    assert stream.row_fetches == 0  # never fell through to the row path
+    assert dm.consume_step() == 10  # plain retry next step recovers
+    assert dm._columnar is True and dm.offset == 10
+
+
+def test_columnar_transient_runtime_error_unknown_mode_reraises():
+    """A non-definitive RuntimeError (bad reply, truncated frame) while
+    the mode is unknown re-raises too — only the broker's typed verdict
+    may latch."""
+
+    class BadReplyOnceStream:
+        def __init__(self):
+            self.bad = 1
+
+        def fetch_columns(self, partition, offset):
+            if self.bad:
+                self.bad -= 1
+                raise RuntimeError("stream broker: bad reply")
+            return _block(4), 4, offset + 4
+
+        def fetch(self, partition, offset, max_rows):
+            raise AssertionError("row path must not engage")
+
+    dm = _columnar_dm(BadReplyOnceStream())
+    with pytest.raises(RuntimeError, match="bad reply"):
+        dm.consume_step()
+    assert dm._columnar is None
+    assert dm.consume_step() == 4
+    assert dm._columnar is True
+
+
+def test_columnar_definitive_row_mode_latches():
+    """The broker's typed row-mode rejection IS definitive: latch row
+    mode and consume via the row path from then on."""
+
+    class RowModeStream:
+        def __init__(self):
+            self.columnar_attempts = 0
+
+        def fetch_columns(self, partition, offset):
+            self.columnar_attempts += 1
+            raise RuntimeError("stream broker: row-mode partition")
+
+        def fetch(self, partition, offset, max_rows):
+            rows = [make_row(i) for i in range(offset, min(offset + max_rows, 5))]
+            return rows, offset + len(rows)
+
+    stream = RowModeStream()
+    dm = _columnar_dm(stream)
+    assert dm.consume_step() == 5
+    assert dm._columnar is False
+    dm.consume_step()
+    assert stream.columnar_attempts == 1  # latched: no more fetchc probes
+
+
+def test_columnar_transport_error_on_known_columnar_reraises():
+    """Once KNOWN columnar, transport errors keep re-raising (retryable)
+    rather than flipping to the row path."""
+
+    class FlakyColumnarStream:
+        def __init__(self):
+            self.calls = 0
+
+        def fetch_columns(self, partition, offset):
+            self.calls += 1
+            if self.calls == 2:
+                raise OSError("tunnel hiccup")
+            return _block(3, start=offset), 3, offset + 3
+
+        def fetch(self, partition, offset, max_rows):
+            raise AssertionError("row path must not engage")
+
+    dm = _columnar_dm(FlakyColumnarStream())
+    assert dm.consume_step() == 3
+    assert dm._columnar is True
+    with pytest.raises(OSError):
+        dm.consume_step()
+    assert dm._columnar is True  # still columnar
+    assert dm.consume_step() == 3  # recovers at the same offset
+    assert dm.offset == 6

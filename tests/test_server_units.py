@@ -151,9 +151,9 @@ def test_server_sheds_with_scheduler_down_code():
     gate = threading.Event()
     real_execute = inst.executor.execute
 
-    def slow_execute(segs, req):
+    def slow_execute(segs, req, **kwargs):
         gate.wait(5)
-        return real_execute(segs, req)
+        return real_execute(segs, req, **kwargs)
 
     inst.executor.execute = slow_execute
     payload = serialize_instance_request(
