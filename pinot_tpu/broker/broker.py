@@ -530,6 +530,42 @@ class BrokerRequestHandler:
                 open_lineages -= 1
                 servers_responded.add(server)
                 ordered_parts.append((batch.order, result))
+                # server-reported unserved segments (dropped on that
+                # server / quarantined pending re-fetch): the served
+                # part merges above; the missing slice re-covers on an
+                # untried replica or degrades honestly
+                batch_set = set(batch.segments)
+                missing = [s for s in result.unserved_segments if s in batch_set]
+                if missing:
+                    merr = QueryException(
+                        ErrorCode.SERVER_SEGMENT_MISSING,
+                        f"server {server}: segments unavailable: {sorted(missing)}",
+                    )
+                    assignment: Dict[str, List[str]] = {}
+                    leftover = list(missing)
+                    if batch.reissues < self.retry_attempts:
+                        assignment, leftover = self.routing.alternates(
+                            batch.table, missing, batch.excluded, health=self.health
+                        )
+                    if leftover:
+                        exceptions.append(merr)
+                        unserved.extend(leftover)
+                    for alt_server, alt_segments in assignment.items():
+                        child = _Batch(
+                            batch.table,
+                            batch.pql,
+                            alt_segments,
+                            alt_server,
+                            excluded=batch.excluded,
+                            reissues=batch.reissues + 1,
+                            errors=[] if leftover else [merr],
+                            order=batch.order,
+                        )
+                        all_batches.append(child)
+                        open_lineages += 1
+                        retries += 1
+                        self.metrics.meter("failoverRetries").mark()
+                        submit(child, alt_server)
                 # best effort: free the loser's queued twin if it never started
                 for other, (ob, _osrv, _oh, _osent) in list(pending.items()):
                     if ob is batch:

@@ -254,6 +254,7 @@ def serialize_result(res: IntermediateResult) -> bytes:
     w.i64(res.num_entries_scanned_post_filter)
     w.value(sorted(res.trace.items()) if res.trace else [])
     w.value([[int(c), str(m)] for c, m in res.exceptions])
+    w.value([str(s) for s in res.unserved_segments])
 
     # sections present flags
     w.u8(1 if res.aggregations is not None else 0)
@@ -296,6 +297,7 @@ def deserialize_result(data: bytes) -> IntermediateResult:
     res.num_entries_scanned_post_filter = r.i64()
     res.trace = dict(tuple(kv) for kv in r.value())
     res.exceptions = [(int(c), str(m)) for c, m in r.value()]
+    res.unserved_segments = [str(s) for s in r.value()]
 
     if r.u8():
         cnt = r.i64()

@@ -22,6 +22,13 @@ Fault modes per address (composable):
 
 Every call is appended to ``calls`` (address, mode-applied) so tests can
 assert exactly which replicas absorbed retries and hedges.
+
+``DeviceFaultInjector`` is the same idea one layer down: it hooks the
+server's DeviceLane (``engine/dispatch.py``) and injects *device-side*
+faults — failed launches (retryable or poison), stalls that wedge the
+lane thread (the watchdog trigger), and per-plan-digest poisoning — so
+the self-healing path (device retry, watchdog restart, host failover,
+poison quarantine) runs deterministically on a CPU test rig.
 """
 from __future__ import annotations
 
@@ -119,3 +126,115 @@ class FaultInjectingTransport:
         with self._lock:
             self.calls.append(CallRecord(address, "ok", time.perf_counter() - t0 + delay))
         return reply
+
+
+# ---------------------------------------------------------------------------
+# Device-side fault injection (the lane-supervision chaos hook)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LaunchRecord:
+    """One lane launch as seen by the injector (digest is the StaticPlan
+    digest the executor handed the lane; None for raw key-only
+    submits)."""
+
+    digest: Optional[str]
+    outcome: str  # "ok" | "fail_next" | "error_rate" | "poison" | "stall"
+
+
+class DeviceFaultInjector:
+    """Seedable device-fault programming for the DeviceLane.
+
+    Modes (composable, mirroring the transport injector):
+
+    - ``fail_next(n, retryable=True)`` — the next ``n`` launches raise a
+      typed ``DeviceExecutionError`` (transient blip or hard fault).
+    - ``stall_next(n, stall_s)``      — the next ``n`` launches sleep
+      ``stall_s`` inside the lane thread before proceeding (the
+      watchdog-restart trigger when ``stall_s`` exceeds the lane's
+      stall timeout).
+    - ``poison_plan(digest)``         — every launch whose StaticPlan
+      digest matches raises a non-retryable poison error until
+      ``heal()``; the executor's quarantine is expected to stop sending
+      the plan to the device at all.
+    - ``error_rate``                  — each launch fails (retryable)
+      with probability p from a seeded RNG.
+
+    Every launch decision is recorded in ``launches`` so tests can
+    assert which plans were poisoned/stalled and read back digests.
+    """
+
+    def __init__(self, seed: int = 0) -> None:
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.launches: List[LaunchRecord] = []
+        self._fail_next = 0
+        self._fail_retryable = True
+        self._stall_next = 0
+        self._stall_s = 0.0
+        self._poisoned: set = set()
+        self.error_rate = 0.0
+
+    # -- fault programming --------------------------------------------
+    def fail_next(self, n: int, retryable: bool = True) -> None:
+        with self._lock:
+            self._fail_next = n
+            self._fail_retryable = retryable
+
+    def stall_next(self, n: int, stall_s: float) -> None:
+        with self._lock:
+            self._stall_next = n
+            self._stall_s = stall_s
+
+    def poison_plan(self, digest: str) -> None:
+        with self._lock:
+            self._poisoned.add(digest)
+
+    def heal(self) -> None:
+        with self._lock:
+            self._fail_next = 0
+            self._stall_next = 0
+            self._stall_s = 0.0
+            self._poisoned.clear()
+            self.error_rate = 0.0
+
+    def records_for(self, outcome: str) -> List[LaunchRecord]:
+        with self._lock:
+            return [r for r in self.launches if r.outcome == outcome]
+
+    # -- lane hook -----------------------------------------------------
+    def on_launch(self, digest: Optional[str], key: Any) -> None:
+        """Called by the lane thread immediately before a launch; may
+        sleep (stall) or raise ``DeviceExecutionError``."""
+        from pinot_tpu.engine.dispatch import DeviceExecutionError
+
+        with self._lock:
+            if digest is not None and digest in self._poisoned:
+                self.launches.append(LaunchRecord(digest, "poison"))
+                raise DeviceExecutionError(
+                    f"injected: poisoned plan {digest}", retryable=False
+                )
+            if self._fail_next > 0:
+                self._fail_next -= 1
+                retryable = self._fail_retryable
+                self.launches.append(LaunchRecord(digest, "fail_next"))
+                raise DeviceExecutionError(
+                    "injected: device launch failure", retryable=retryable
+                )
+            if self.error_rate > 0.0 and self._rng.random() < self.error_rate:
+                self.launches.append(LaunchRecord(digest, "error_rate"))
+                raise DeviceExecutionError(
+                    "injected: flaky device launch", retryable=True
+                )
+            stall = 0.0
+            if self._stall_next > 0:
+                self._stall_next -= 1
+                stall = self._stall_s
+                self.launches.append(LaunchRecord(digest, "stall"))
+            else:
+                self.launches.append(LaunchRecord(digest, "ok"))
+        if stall > 0.0:
+            # sleep OUTSIDE the injector lock, inside the lane thread:
+            # this is the wedge the watchdog must detect
+            time.sleep(stall)

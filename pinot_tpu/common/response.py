@@ -87,6 +87,10 @@ class ErrorCode:
     QUERY_EXECUTION = 200
     SERVER_SCHEDULER_DOWN = 210
     SERVER_SHUTTING_DOWN = 220
+    # a server answered but could not serve some requested segments
+    # (dropped / quarantined pending re-fetch); the broker re-covers
+    # them on a replica or degrades honestly via partialResponse
+    SERVER_SEGMENT_MISSING = 230
     EXECUTION_TIMEOUT = 250
     BROKER_GATHER = 300
     BROKER_TIMEOUT = 350

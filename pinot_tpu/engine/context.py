@@ -81,7 +81,10 @@ _context_cache: Dict[Tuple[str, ...], TableContext] = {}
 
 
 def get_table_context(segments: Sequence[ImmutableSegment]) -> TableContext:
-    key = tuple(f"{s.segment_name}:{s.metadata.crc}" for s in segments)
+    # (name, crc, instance token): the token makes a re-loaded segment
+    # (quarantine re-fetch) miss — a context built from a corrupt load's
+    # dictionaries must never serve the clean copy (see engine/device.py)
+    key = tuple((s.segment_name, s.metadata.crc, s.staging_token) for s in segments)
     ctx = _context_cache.get(key)
     if ctx is None:
         ctx = TableContext(segments)

@@ -298,8 +298,16 @@ def get_staged(
     (skip_base_columns) gets its base arrays backfilled if a later
     query needs them (e.g. a filter arrives on a former agg-only
     column)."""
+    # identity component: (name, claimed crc, instance token).  The
+    # token (segment/immutable.py) is what makes a re-loaded copy of the
+    # same segment a guaranteed MISS — name+crc alone would alias a
+    # clean re-fetch onto arrays staged from a quarantined corrupt load,
+    # even mid-flight (no eviction race can resurrect the old entry:
+    # new instances simply never produce the old key).
     key = (
-        tuple(f"{s.segment_name}:{s.metadata.crc}" for s in segments),
+        tuple(
+            (s.segment_name, s.metadata.crc, s.staging_token) for s in segments
+        ),
         tuple(sorted(column_names)),
         pad_segments_to,
     )
@@ -425,6 +433,22 @@ def _hll_streams(cols, S: int, n_pad: int):
 
 def clear_staging_cache() -> None:
     _stage_cache.clear()
+
+
+def evict_staged_segment(segment_name: str) -> int:
+    """Drop every cached staged table containing ``segment_name`` — the
+    quarantine path's HBM hygiene.  Correctness does not depend on this
+    (the per-instance staging token already guarantees a re-loaded
+    segment misses the cache); eviction just releases the quarantined
+    copy's device arrays instead of waiting for the size-cap clear.
+    Returns the number of cache entries dropped."""
+    victims = []
+    for key in list(_stage_cache):
+        if any(e[0] == segment_name for e in key[0]):
+            victims.append(key)
+    for key in victims:
+        _stage_cache.pop(key, None)
+    return len(victims)
 
 
 def to_device_inputs(tree):

@@ -37,14 +37,29 @@ its dispatch sat in the lane queue is shed with the existing
 ``QueryAbandonedError`` before any device work happens on its behalf;
 a dispatch all of whose waiters expired is dropped without launching.
 
+SUPERVISION: the lane is the server's single point of device contact,
+so it is also where device faults are contained.  Every launch
+exception is classified into a typed ``DeviceExecutionError``
+(retryable transient vs deterministic poison) before it reaches a
+waiter, and a watchdog thread detects an in-flight launch stalled past
+``stall_timeout_s``: the wedged lane thread is abandoned (generation
+bump — when its launch finally returns it discards the result and
+exits), the stalled dispatch's waiters get a ``stalled`` error (the
+executor fails them over to the host path), and a fresh lane thread is
+spawned that re-drives everything still queued.  One bad kernel launch
+never takes down serving.
+
 Counters (surfaced via the server status/metrics snapshot):
-lane depth gauge, dispatch/coalesce-hit/shed meters, and the
-``phase.laneDispatch`` timer for time spent inside launches.
+lane depth gauge, dispatch/coalesce-hit/shed meters, device-failure /
+restart / stale-completion counters, and the ``phase.laneDispatch``
+timer for time spent inside launches.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Callable, Deque, Dict, Hashable, List, Optional
 
@@ -57,6 +72,90 @@ _MAX_OPEN = 32
 # poll period for closing open dispatches while the queue is idle; the
 # check is a non-blocking is_ready() per open dispatch
 _SWEEP_S = 0.005
+
+# every lane ever constructed, for the test-suite thread-leak check
+# (tests/conftest.py): a CLOSED lane must not keep threads alive
+_all_lanes: "weakref.WeakSet[DeviceLane]" = weakref.WeakSet()
+
+
+class DeviceExecutionError(RuntimeError):
+    """Typed device-dispatch failure — the lane-supervision contract.
+
+    ``retryable=True``: transient (transfer hiccup, device busy) — one
+    more device attempt is worth it.  ``retryable=False``: poison — the
+    failure is deterministic for this (plan, inputs) shape (trace-time
+    type error, compile failure, injected poison), so the executor
+    quarantines the plan and serves via the host path.  ``stalled``
+    marks watchdog-detected wedges (never device-retried: the retry
+    would wedge the fresh lane thread for another full timeout)."""
+
+    def __init__(
+        self,
+        message: str,
+        retryable: bool,
+        cause: Optional[BaseException] = None,
+        stalled: bool = False,
+    ) -> None:
+        super().__init__(message)
+        self.retryable = retryable
+        self.cause = cause
+        self.stalled = stalled
+
+
+# substrings that mark a launch failure as transient: PJRT/XLA status
+# codes for resource pressure and transport trouble, plus tunnel-layer
+# connection wording.  Anything else (TypeError from tracing, lowering
+# and shape errors, INVALID_ARGUMENT…) is deterministic for the plan —
+# poison, not worth a device retry.
+_RETRYABLE_MARKERS = (
+    "resource_exhausted",
+    "unavailable",
+    "aborted",
+    "data_loss",
+    "cancelled",
+    "deadline_exceeded",
+    "connection",
+    "transfer",
+    "tunnel",
+)
+
+
+def classify_device_error(exc: BaseException) -> DeviceExecutionError:
+    """Wrap a raw launch exception in the typed error (idempotent)."""
+    if isinstance(exc, DeviceExecutionError):
+        return exc
+    text = f"{type(exc).__name__}: {exc}"
+    low = text.lower()
+    retryable = any(marker in low for marker in _RETRYABLE_MARKERS)
+    return DeviceExecutionError(text, retryable=retryable, cause=exc)
+
+
+def plan_digest(plan: Any) -> str:
+    """Stable (within a process) digest of a StaticPlan — the handle the
+    device fault injector and the executor's poison quarantine share.
+    StaticPlan is a frozen dataclass, so repr is deterministic."""
+    import hashlib
+
+    return hashlib.blake2b(repr(plan).encode(), digest_size=8).hexdigest()
+
+
+def leaked_lane_threads(grace_s: float = 2.0) -> List[threading.Thread]:
+    """Threads still alive on CLOSED lanes — the post-test leak check
+    guarding the watchdog-restart path (a restart must never leak one
+    wedged thread per wedge once the wedge resolves and the lane is
+    closed).  Open lanes (module-scoped fixtures) are exempt."""
+    suspects: List[threading.Thread] = []
+    for lane in list(_all_lanes):
+        if not lane._closed:
+            continue
+        suspects.extend(t for t in lane._threads if t.is_alive())
+    deadline = time.monotonic() + grace_s
+    leaked = []
+    for t in suspects:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if t.is_alive():
+            leaked.append(t)
+    return leaked
 
 
 def outputs_pending(value: Any) -> bool:
@@ -113,17 +212,22 @@ class LaneTicket:
 
 
 class _Dispatch:
-    __slots__ = ("key", "launch", "pending", "waiters", "completed", "value", "error")
+    __slots__ = (
+        "key", "launch", "pending", "waiters", "completed", "value",
+        "error", "plan_digest",
+    )
 
     def __init__(
         self,
         key: Hashable,
         launch: Callable[[], Any],
         pending: Callable[[Any], bool],
+        plan_digest: Optional[str] = None,
     ) -> None:
         self.key = key
         self.launch = launch
         self.pending = pending
+        self.plan_digest = plan_digest
         self.waiters: List[LaneTicket] = []
         self.completed = False
         self.value: Any = None
@@ -132,19 +236,50 @@ class _Dispatch:
 
 class DeviceLane:
     """Single-threaded asynchronous kernel-launch queue with
-    identical-dispatch coalescing (see module docstring)."""
+    identical-dispatch coalescing and watchdog supervision (see module
+    docstring).
 
-    def __init__(self, metrics=None) -> None:
+    ``stall_timeout_s`` arms the watchdog (default from
+    ``PINOT_TPU_LANE_STALL_S``, 120s — above the worst observed cold
+    compile; <= 0 disables it).
+    ``fault_injector`` is an optional ``common.faults``
+    ``DeviceFaultInjector`` consulted before every launch."""
+
+    def __init__(
+        self,
+        metrics=None,
+        stall_timeout_s: Optional[float] = None,
+        fault_injector=None,
+    ) -> None:
         self.metrics = metrics
+        if stall_timeout_s is None:
+            # default well ABOVE the worst observed first-call compile
+            # over a tunneled chip (~25s cold, PARITY.md): a watchdog
+            # that fires during a legitimate cold compile would poison
+            # a healthy plan
+            stall_timeout_s = float(os.environ.get("PINOT_TPU_LANE_STALL_S", "120"))
+        self.stall_timeout_s = stall_timeout_s
+        self.fault_injector = fault_injector
         self._cv = threading.Condition()
         self._queue: Deque[_Dispatch] = deque()
         self._by_key: Dict[Hashable, _Dispatch] = {}
         self._open: Deque[_Dispatch] = deque()  # launched, program still running
         self._thread: Optional[threading.Thread] = None
+        self._watchdog: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []  # all ever spawned (leak check)
+        # restart fencing: a wedged thread that finally returns compares
+        # its spawn-time generation against this and, when stale, drops
+        # its result and exits without touching lane state
+        self._generation = 0
+        self._inflight: Optional[tuple] = None  # (dispatch, started_at)
         self._closed = False
         self.dispatch_count = 0
         self.coalesce_hits = 0
         self.shed_count = 0
+        self.device_failure_count = 0
+        self.restart_count = 0
+        self.stale_completions = 0
+        _all_lanes.add(self)
 
     # -- producer side -------------------------------------------------
     def submit(
@@ -153,6 +288,7 @@ class DeviceLane:
         launch: Callable[[], Any],
         deadline: Optional[float] = None,
         pending: Callable[[Any], bool] = outputs_pending,
+        plan_digest: Optional[str] = None,
     ) -> LaneTicket:
         """Enqueue a kernel launch, or coalesce onto an identical one
         that is queued, launching, or still executing on device.
@@ -177,19 +313,21 @@ class DeviceLane:
                 d.waiters.append(ticket)
                 self._hit()
             else:
-                d = _Dispatch(key, launch, pending)
+                d = _Dispatch(key, launch, pending, plan_digest)
                 d.waiters.append(ticket)
                 self._by_key[key] = d
                 self._queue.append(d)
                 self._set_depth()
-                self._cv.notify()
+                # notify_all: the WATCHDOG also sleeps on this condition
+                # — a single notify could wake it instead of the lane
+                # thread and strand the queued dispatch
+                self._cv.notify_all()
             if self._thread is None:
                 # lazy start: instances that never run a device query
                 # (host-path tables, unit tests) cost no thread
-                self._thread = threading.Thread(
-                    target=self._run, name="device-lane", daemon=True
-                )
-                self._thread.start()
+                self._spawn_lane_locked()
+                if self.stall_timeout_s and self.stall_timeout_s > 0:
+                    self._spawn_watchdog_locked()
         return ticket
 
     @property
@@ -203,11 +341,15 @@ class DeviceLane:
             "dispatches": self.dispatch_count,
             "coalesceHits": self.coalesce_hits,
             "shed": self.shed_count,
+            "deviceFailures": self.device_failure_count,
+            "restarts": self.restart_count,
+            "staleCompletions": self.stale_completions,
         }
 
     def close(self) -> None:
         """Idempotent: stop accepting submits, fail queued waiters, and
-        let the lane thread exit after any in-flight launch."""
+        let the lane + watchdog threads exit after any in-flight
+        launch."""
         with self._cv:
             if self._closed:
                 return
@@ -225,6 +367,78 @@ class DeviceLane:
                 w._deliver(error=err)
 
     # -- internals -----------------------------------------------------
+    def _spawn_lane_locked(self) -> None:
+        t = threading.Thread(
+            target=self._run,
+            args=(self._generation,),
+            name=f"device-lane-g{self._generation}",
+            daemon=True,
+        )
+        self._thread = t
+        self._threads.append(t)
+        t.start()
+
+    def _spawn_watchdog_locked(self) -> None:
+        if self._watchdog is not None:
+            return
+        w = threading.Thread(
+            target=self._watch, name="device-lane-watchdog", daemon=True
+        )
+        self._watchdog = w
+        self._threads.append(w)
+        w.start()
+
+    def _watch(self) -> None:
+        """Watchdog: restart the lane when the in-flight launch stalls
+        past ``stall_timeout_s`` — abandon the wedged thread (generation
+        bump), fail the stalled dispatch's waiters with a typed stall
+        error, and respawn a lane thread that re-drives the queue.
+
+        Sleeps under the lane condition variable, waking exactly at the
+        in-flight dispatch's stall deadline (or a coarse idle poll) —
+        no free-running high-frequency timer, and ``close()``'s
+        notify_all wakes it immediately for a prompt exit."""
+        idle_poll = max(0.05, self.stall_timeout_s / 4.0)
+        while True:
+            victims: List[LaneTicket] = []
+            err: Optional[DeviceExecutionError] = None
+            with self._cv:
+                if self._closed:
+                    return
+                infl = self._inflight
+                now = time.monotonic()
+                if infl is None:
+                    self._cv.wait(timeout=idle_poll)
+                elif now - infl[1] <= self.stall_timeout_s:
+                    self._cv.wait(
+                        timeout=infl[1] + self.stall_timeout_s - now + 0.005
+                    )
+                else:
+                    d = infl[0]
+                    self._inflight = None
+                    self._generation += 1
+                    self.restart_count += 1
+                    self.device_failure_count += 1
+                    d.completed = True
+                    if self._by_key.get(d.key) is d:
+                        self._by_key.pop(d.key)
+                    victims = list(d.waiters)
+                    d.waiters = []
+                    err = DeviceExecutionError(
+                        f"device dispatch stalled > {self.stall_timeout_s:.3f}s; "
+                        "lane restarted",
+                        retryable=False,
+                        stalled=True,
+                    )
+                    d.error = err
+                    self._spawn_lane_locked()
+            if victims:
+                if self.metrics is not None:
+                    self.metrics.meter("lane.restarts").mark()
+                    self.metrics.meter("lane.deviceFailures").mark()
+                for w in victims:
+                    w._deliver(error=err)
+
     def _hit(self) -> None:
         self.coalesce_hits += 1
         if self.metrics is not None:
@@ -259,11 +473,13 @@ class DeviceLane:
         while len(self._open) > _MAX_OPEN:
             self._close_open(self._open[0])
 
-    def _run(self) -> None:
+    def _run(self, gen: int) -> None:
         while True:
             with self._cv:
+                if gen != self._generation:
+                    return  # restarted away while we held no work
                 self._sweep_open_locked()
-                while not self._queue and not self._closed:
+                while not self._queue and not self._closed and gen == self._generation:
                     if self._open:
                         # finite wait: open dispatches must close (and
                         # release their buffers) soon after the device
@@ -272,6 +488,8 @@ class DeviceLane:
                         self._sweep_open_locked()
                     else:
                         self._cv.wait()
+                if gen != self._generation:
+                    return
                 if self._closed and not self._queue:
                     return
                 d = self._queue.popleft()
@@ -287,6 +505,11 @@ class DeviceLane:
                 if not live:
                     d.completed = True
                     self._by_key.pop(d.key, None)
+                else:
+                    # watchdog window opens BEFORE the launch call: a
+                    # wedge inside the fault injector or the launch
+                    # itself both count as in-flight stalls
+                    self._inflight = (d, now)
             if dead:
                 self.shed_count += len(dead)
                 if self.metrics is not None:
@@ -305,16 +528,29 @@ class DeviceLane:
             error: Optional[BaseException] = None
             value: Any = None
             try:
+                inj = self.fault_injector
+                if inj is not None:
+                    inj.on_launch(d.plan_digest, d.key)
                 value = d.launch()
-            except BaseException as e:  # deliver to waiters, keep lane alive
+            except Exception as e:  # typed delivery, lane stays alive
+                error = classify_device_error(e)
+            except BaseException as e:  # deliver raw, keep the lane alive:
+                # a dead lane thread would strand every waiter and (with
+                # self._thread non-None) never respawn
                 error = e
-            self.dispatch_count += 1
-            if self.metrics is not None:
-                self.metrics.meter("lane.dispatches").mark()
-                self.metrics.timer("phase.laneDispatch").update(
-                    (time.perf_counter() - t0) * 1000
-                )
             with self._cv:
+                stale = gen != self._generation
+                if not stale and self._inflight is not None and self._inflight[0] is d:
+                    self._inflight = None
+                if stale:
+                    # the watchdog already failed our waiters and moved
+                    # the lane on; delivering now would hand out a result
+                    # nobody waits for (or double-deliver an error)
+                    self.stale_completions += 1
+                    return
+                self.dispatch_count += 1
+                if error is not None:
+                    self.device_failure_count += 1
                 d.completed = True
                 d.value, d.error = value, error
                 waiters = list(d.waiters)
@@ -323,7 +559,14 @@ class DeviceLane:
                     # program still executing: keep coalescible
                     self._open.append(d)
                     self._sweep_open_locked()
-                else:
-                    self._by_key.pop(d.key, None)
+                elif self._by_key.get(d.key) is d:
+                    self._by_key.pop(d.key)
+            if self.metrics is not None:
+                self.metrics.meter("lane.dispatches").mark()
+                if error is not None:
+                    self.metrics.meter("lane.deviceFailures").mark()
+                self.metrics.timer("phase.laneDispatch").update(
+                    (time.perf_counter() - t0) * 1000
+                )
             for w in waiters:
                 w._deliver(value=value, error=error)

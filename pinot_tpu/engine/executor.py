@@ -179,6 +179,67 @@ class QueryExecutor:
         import threading
 
         self._qinput_cache_lock = threading.Lock()
+        # self-healing state: device failures fail over to the host
+        # path, and a (plan digest, segment set) that keeps failing on
+        # device is quarantined so repeat offenders skip the device
+        # entirely (engine/dispatch.py classification contract)
+        self._heal_lock = threading.Lock()
+        self._healing = {
+            "deviceFailures": 0,
+            "deviceRetries": 0,
+            "hostFailovers": 0,
+            "poisonSkips": 0,
+        }
+        # poison key -> (reason, expiry): quarantine entries carry a TTL
+        # (PINOT_TPU_POISON_TTL_S, default 300s) so a plan poisoned by a
+        # transient burst is eventually re-admitted to the device — the
+        # worst case of a wrong verdict is one more failover cycle, the
+        # worst case of a permanent verdict is serving a healthy plan
+        # from the slow host path forever
+        self._poisoned: Dict[Any, Tuple[str, float]] = {}
+        import os as _os
+
+        self._poison_ttl_s = float(_os.environ.get("PINOT_TPU_POISON_TTL_S", "300"))
+
+    # -- self-healing bookkeeping --------------------------------------
+    def _heal_mark(self, name: str) -> None:
+        with self._heal_lock:
+            self._healing[name] += 1
+        if self.metrics is not None:
+            self.metrics.meter(f"heal.{name}").mark()
+
+    def healing_stats(self) -> Dict[str, int]:
+        now = time.monotonic()
+        with self._heal_lock:
+            stats = dict(self._healing)
+            stats["poisonedPlans"] = sum(
+                1 for _, exp in self._poisoned.values() if now < exp
+            )
+        return stats
+
+    def _is_poisoned(self, key: Any) -> bool:
+        with self._heal_lock:
+            entry = self._poisoned.get(key)
+            if entry is None:
+                return False
+            if time.monotonic() >= entry[1]:
+                self._poisoned.pop(key, None)  # TTL expired: re-admit
+                return False
+            return True
+
+    def _poison(self, key: Any, reason: str) -> None:
+        expiry = time.monotonic() + self._poison_ttl_s
+        with self._heal_lock:
+            self._poisoned[key] = (reason, expiry)
+            if len(self._poisoned) > 1024:  # runaway-workload backstop
+                self._poisoned.clear()
+                self._poisoned[key] = (reason, expiry)
+
+    def clear_poisoned(self) -> None:
+        """Ops/test hook: re-admit quarantined plans to the device (a
+        rolled-out runtime fix makes old poison verdicts stale)."""
+        with self._heal_lock:
+            self._poisoned.clear()
 
     def _phase(self, name: str, t0: float) -> float:
         """Record a ServerQueryPhase-style timer (SURVEY §5: pruning /
@@ -270,6 +331,70 @@ class QueryExecutor:
             self._phase("hostPath", t0)
             return res
 
+        # -- device section under the self-healing contract -----------
+        # The WHOLE device path (staging, H2D uploads, kernel dispatch,
+        # D2H fetch, finalize) is covered: classify the failure
+        # (engine/dispatch.py), retry ONCE on device for transients,
+        # then quarantine the (plan digest, segment set) and serve the
+        # same request via the always-correct host path.  Deadline and
+        # shutdown control flow propagates untouched.
+        from pinot_tpu.engine.dispatch import (
+            DeviceExecutionError,
+            LaneClosedError,
+            classify_device_error,
+        )
+        from pinot_tpu.server.scheduler import QueryAbandonedError
+
+        poison_ref: Dict[str, Any] = {}  # device section records the key
+        last: Optional[DeviceExecutionError] = None
+        for attempt in (0, 1):
+            if attempt:
+                if last is None or not last.retryable:
+                    break  # poison/stall: deterministic, a device retry
+                    # would fail (or wedge the fresh lane) identically
+                self._heal_mark("deviceRetries")
+            try:
+                return self._device_section(
+                    live, request, deadline, ctx, needed, sel_columns,
+                    pad_to, total_docs, t0, poison_ref,
+                )
+            except (QueryAbandonedError, LaneClosedError, TimeoutError):
+                raise
+            except Exception as e:
+                if poison_ref.pop("host", False):
+                    # the section had already LEFT the device path (plan
+                    # not on device / poison skip / pair overflow) — a
+                    # host execution error is not a device failure and
+                    # re-running the host path could only fail again
+                    raise
+                last = classify_device_error(e)
+                self._heal_mark("deviceFailures")
+        # device exhausted: quarantine (when the section got far enough
+        # to know its plan) and transparently fail over.  Coalesced
+        # waiters each land here and each finalize from the host.
+        from pinot_tpu.engine.host_fallback import execute_host
+
+        if poison_ref.get("key") is not None:
+            self._poison(poison_ref["key"], str(last))
+        self._heal_mark("hostFailovers")
+        t0 = time.perf_counter()
+        res = execute_host(live, ctx, request, total_docs, sel_columns)
+        self._phase("hostFailover", t0)
+        return res
+
+    def _device_section(
+        self,
+        live: List[ImmutableSegment],
+        request: BrokerRequest,
+        deadline: Optional[float],
+        ctx: TableContext,
+        needed: set,
+        sel_columns: Optional[List[str]],
+        pad_to: int,
+        total_docs: int,
+        t0: float,
+        poison_ref: Dict[str, Any],
+    ) -> IntermediateResult:
         raw_cols, gfwd_cols, hll_cols = self._role_columns(request, live, ctx)
         # Columns the kernel reads ONLY through a role stream skip their
         # base fwd/dict arrays: at 1B rows the dictId stream is the
@@ -315,7 +440,28 @@ class QueryExecutor:
         if not plan.on_device:
             from pinot_tpu.engine.host_fallback import execute_host
 
+            poison_ref["host"] = True  # host path from here: not a device fault
             return execute_host(live, ctx, request, total_docs, sel_columns)
+
+        # poison quarantine: this (plan digest, segment set) keeps
+        # failing on device — skip the device entirely and serve from
+        # the always-correct host path (PIMDAL-style contract: the host
+        # path stays a correct fallback for the accelerator path).  The
+        # digest is computed ONCE here and shared with the lane's
+        # injector hook and the failover wrapper's quarantine.
+        from pinot_tpu.engine.dispatch import plan_digest as _plan_digest
+
+        pdigest = _plan_digest(plan)
+        poison_ref["key"] = (pdigest, staged.segment_names)
+        if self._is_poisoned(poison_ref["key"]):
+            from pinot_tpu.engine.host_fallback import execute_host
+
+            self._heal_mark("poisonSkips")
+            t0 = self._phase("planBuild", t0)
+            poison_ref["host"] = True  # host path from here: not a device fault
+            res = execute_host(live, ctx, request, total_docs, sel_columns)
+            self._phase("hostFailover", t0)
+            return res
 
         from pinot_tpu.engine.device import segment_arrays
 
@@ -350,7 +496,9 @@ class QueryExecutor:
         else:
             kernel = self._kernel(plan, staged)
             args = (seg_arrays, q_inputs)
-        outs = self._run_kernel(kernel, args, plan, staged, digest, block_ids, deadline)
+        outs = self._run_kernel(
+            kernel, args, plan, staged, digest, block_ids, deadline, pdigest
+        )
         t0 = time.perf_counter()  # laneWait/planExec timed inside _run_kernel
 
         # sort-dedup distinct overflow: more unique pairs than the
@@ -363,6 +511,9 @@ class QueryExecutor:
                 if int(state[3]) > state[0].shape[0]:
                     from pinot_tpu.engine.host_fallback import execute_host
 
+                    # pair overflow: host finishes exactly — leaving the
+                    # device path, so host errors are not device faults
+                    poison_ref["host"] = True
                     return execute_host(live, ctx, request, total_docs, sel_columns)
 
         result = self._finalize(request, plan, ctx, staged, live, outs, total_docs, sel_columns)
@@ -604,7 +755,7 @@ class QueryExecutor:
         return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols)), tuple(sorted(hll_cols))
 
     def _run_kernel(
-        self, kernel, args, plan, staged, digest, block_ids, deadline
+        self, kernel, args, plan, staged, digest, block_ids, deadline, pdigest=None
     ) -> Dict[str, Any]:
         """DISPATCH + output fetch.  Serial mode (no lane): launch and
         fetch inline, the pre-pipeline behavior.  Pipelined: the launch
@@ -632,7 +783,10 @@ class QueryExecutor:
                 else (block_ids.shape, block_ids.tobytes())
             )
             ticket = self.lane.submit(
-                (plan, staged.token, digest, bkey), launch, deadline
+                (plan, staged.token, digest, bkey),
+                launch,
+                deadline,
+                plan_digest=pdigest,
             )
             fetch, handle = ticket.result(deadline)
             t0 = self._phase("laneWait", t0)  # queue + coalesce wait only

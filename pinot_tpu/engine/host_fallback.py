@@ -436,7 +436,9 @@ def execute_host(
         if _vectorizable_aggs(request, segments):
             _aggregation_vectorized(segments, request, res, matched_rows)
             return res
-        res.aggregations = [make_partial(a.base_function) for a in request.aggregations]
+        # row-wise accumulators (NOT mergeable partials — those have no
+        # .add); _to_partial adapts them below, same as the group-by path
+        res.aggregations = [_Accumulator(a) for a in request.aggregations]
     else:
         res.selection_rows = []
         res.selection_columns = sel_columns

@@ -232,9 +232,14 @@ class IntermediateResult:
         trace: Optional[Dict[str, Any]] = None,
         selection_columns: Optional[List[str]] = None,
         exceptions: Optional[List[Tuple[int, str]]] = None,
+        unserved_segments: Optional[List[str]] = None,
     ) -> None:
         self.selection_columns = selection_columns
         self.exceptions: List[Tuple[int, str]] = exceptions or []
+        # requested segments this server could not serve (dropped /
+        # quarantined pending re-fetch): the broker re-covers them on a
+        # replica or folds them into partialResponse/numSegmentsUnserved
+        self.unserved_segments: List[str] = unserved_segments or []
         self.aggregations = aggregations
         self.groups = groups
         self.selection_rows = selection_rows
@@ -247,6 +252,7 @@ class IntermediateResult:
 
     def merge(self, other: "IntermediateResult") -> None:
         self.exceptions.extend(other.exceptions)
+        self.unserved_segments.extend(other.unserved_segments)
         self.num_docs_scanned += other.num_docs_scanned
         self.total_docs += other.total_docs
         self.num_segments_queried += other.num_segments_queried

@@ -365,4 +365,5 @@ class MutableSegment:
             {"startOffset": self.start_offset, "endOffset": self.end_offset}
         )
         snap.metadata.crc = snap.compute_crc()
+        snap.metadata.custom["dataCrc"] = True  # verifiable (format.verify_segment_crc)
         return snap

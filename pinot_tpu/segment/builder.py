@@ -183,6 +183,7 @@ def finalize_segment(
 
     segment = ImmutableSegment(metadata=meta, columns=columns)
     meta.crc = segment.compute_crc()
+    meta.custom["dataCrc"] = True  # verifiable claim (format.verify_segment_crc)
 
     if config.startree_config is not None:
         from pinot_tpu.startree.builder import build_star_tree

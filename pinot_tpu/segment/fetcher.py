@@ -21,7 +21,7 @@ import os
 import shutil
 import urllib.parse
 import urllib.request
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 from pinot_tpu.utils.retry import ExponentialBackoffRetryPolicy
 
@@ -50,26 +50,48 @@ class LocalFileSegmentFetcher(SegmentFetcher):
 def _http_download(
     url: str, dest_path: str, timeout_s: float, policy: ExponentialBackoffRetryPolicy
 ) -> None:
-    """Shared retried GET-to-file (tmp + rename) for the http-based
-    fetchers."""
+    """Shared retried GET-to-file for the http-based fetchers.
+
+    The body streams into ``dest_path + ".part"`` and only an attempt
+    that passes the length check renames into place — a connection cut
+    mid-stream can never leave a truncated file where a later load (or a
+    parallel fetch attempt) would pick it up.  Failed attempts clean
+    their ``.part`` up before the retry."""
 
     def _once():
-        with urllib.request.urlopen(url, timeout=timeout_s) as r:
-            tmp = dest_path + ".part"
-            with open(tmp, "wb") as f:
-                shutil.copyfileobj(r, f)
+        tmp = dest_path + ".part"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as r:
+                expected = r.headers.get("Content-Length")
+                with open(tmp, "wb") as f:
+                    shutil.copyfileobj(r, f)
+            if expected is not None:
+                size = os.path.getsize(tmp)
+                if size != int(expected):
+                    raise IOError(
+                        f"truncated download from {url}: {size} of "
+                        f"{expected} bytes"
+                    )
             os.replace(tmp, dest_path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
 
     policy.attempt(_once)
 
 
 class HttpSegmentFetcher(SegmentFetcher):
-    """``http(s)://`` download with exponential-backoff retries
-    (HttpSegmentFetcher.java + its RetryPolicy)."""
+    """``http(s)://`` download with full-jitter exponential-backoff
+    retries (HttpSegmentFetcher.java + its RetryPolicy; jitter so a
+    replica fleet re-downloading after a controller restart does not
+    hammer it in lockstep)."""
 
     def __init__(self, timeout_s: float = 120.0, attempts: int = 3) -> None:
         self.timeout_s = timeout_s
-        self.policy = ExponentialBackoffRetryPolicy(attempts, 0.2)
+        self.policy = ExponentialBackoffRetryPolicy(attempts, 0.2, jitter=True)
 
     def fetch(self, uri: str, dest_path: str) -> None:
         _http_download(uri, dest_path, self.timeout_s, self.policy)
@@ -86,7 +108,7 @@ class WebHdfsSegmentFetcher(SegmentFetcher):
         # uri authority (hdfs://host:port/path -> http://host:port)
         self.gateway = gateway.rstrip("/")
         self.timeout_s = timeout_s
-        self.policy = ExponentialBackoffRetryPolicy(attempts, 0.2)
+        self.policy = ExponentialBackoffRetryPolicy(attempts, 0.2, jitter=True)
 
     def fetch(self, uri: str, dest_path: str) -> None:
         parsed = urllib.parse.urlparse(uri)
@@ -121,9 +143,55 @@ class SegmentFetcherFactory:
             )
         return f
 
-    def fetch(self, uri: str, dest_path: str) -> None:
+    def fetch(self, uri: str, dest_path: str, expected_crc: Optional[int] = None):
+        """Fetch ``uri`` to ``dest_path``; with ``expected_crc`` the
+        download lands in a side file, is parsed and CRC-verified, and
+        only then atomically renamed into place — a corrupt copy raises
+        ``SegmentIntegrityError`` (a wrong-version one the softer
+        ``SegmentStaleError``) and leaves ``dest_path`` untouched (the
+        server's quarantine/re-fetch loop depends on never installing
+        bad bytes).  Returns the already-parsed, already-verified
+        segment on the verified path (None otherwise) so callers don't
+        decode + CRC multi-GB files a second time."""
         os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
-        self.for_uri(uri).fetch(uri, dest_path)
+        if expected_crc is None:
+            self.for_uri(uri).fetch(uri, dest_path)
+            return None
+        from pinot_tpu.segment.format import (
+            SegmentIntegrityError,
+            SegmentStaleError,
+            read_segment,
+            verify_segment_crc,
+        )
+
+        tmp = dest_path + ".verify"
+        self.for_uri(uri).fetch(uri, tmp)
+        try:
+            try:
+                seg = read_segment(tmp)
+            except SegmentIntegrityError:
+                raise
+            except Exception as e:  # unparseable: corrupt beyond the CRC
+                raise SegmentIntegrityError(
+                    f"fetched segment from {uri} is unreadable: "
+                    f"{type(e).__name__}: {e}"
+                ) from e
+            verify_segment_crc(seg, source=uri)
+            if seg.metadata.crc and seg.metadata.crc != expected_crc:
+                # internally consistent (verified above) but a different
+                # VERSION than asked for: replication lag, not corruption
+                raise SegmentStaleError(
+                    f"fetched segment from {uri}: metadata CRC "
+                    f"{seg.metadata.crc} != expected {expected_crc} (stale copy)"
+                )
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        os.replace(tmp, dest_path)
+        return seg
 
 
 DEFAULT_FACTORY = SegmentFetcherFactory()

@@ -37,6 +37,43 @@ MAGIC = b"PNTPUSEG"
 SEGMENT_FILE_NAME = "columns.pnt"  # analog of v3's columns.psf
 
 
+class SegmentIntegrityError(RuntimeError):
+    """A segment's bytes do not match their metadata CRC claim — a
+    corrupt download or bit-rotted disk copy.  The load paths quarantine
+    the copy and re-fetch from the controller's durable store instead of
+    serving wrong data (SegmentFetcherAndLoader.java:84 semantics)."""
+
+
+class SegmentStaleError(SegmentIntegrityError):
+    """An internally-CONSISTENT copy whose CRC is simply a different
+    version than the ideal state asked for (replication lag during a
+    segment refresh).  Not corruption: no quarantine, no crcFailures —
+    the load is retried on the next transition once the source catches
+    up."""
+
+
+def verify_segment_crc(segment: ImmutableSegment, source: str = "") -> None:
+    """Recompute the column-data CRC and compare against the metadata
+    claim.
+
+    Only producers that actually computed a data CRC mark the claim
+    verifiable (``custom["dataCrc"]``: segment/builder.py and the
+    realtime commit conversion).  Synthetic bench segments and consuming
+    snapshots reuse the crc field as a cheap cache-identity token —
+    those (and crc == 0) pass trivially: there is no byte-level claim to
+    hold them to."""
+    claimed = segment.metadata.crc
+    if not claimed or not segment.metadata.custom.get("dataCrc"):
+        return
+    actual = segment.compute_crc()
+    if actual != claimed:
+        where = f" ({source})" if source else ""
+        raise SegmentIntegrityError(
+            f"segment {segment.segment_name!r}{where}: computed CRC {actual} != "
+            f"metadata CRC {claimed} — corrupt copy"
+        )
+
+
 def write_segment(segment: ImmutableSegment, directory: str) -> str:
     """Write a segment directory: one data file (index map inside)."""
     os.makedirs(directory, exist_ok=True)

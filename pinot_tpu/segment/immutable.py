@@ -158,12 +158,25 @@ class ColumnData:
         return vals[0] if self.is_single_value else vals
 
 
+import itertools
+
+_staging_tokens = itertools.count()
+
+
 @dataclass
 class ImmutableSegment:
     """A sealed columnar segment: metadata + per-column index data."""
 
     metadata: SegmentMetadata
     columns: Dict[str, ColumnData]
+    # process-unique instance identity for the device staging cache
+    # (engine/device.py): a RE-LOADED segment (e.g. re-fetched after a
+    # corruption quarantine) carries the same name and claimed crc but a
+    # fresh token, so it can never alias stale arrays staged from the
+    # old copy.  compare=False keeps segment equality by content.
+    staging_token: int = field(
+        default_factory=lambda: next(_staging_tokens), compare=False, repr=False
+    )
 
     @property
     def segment_name(self) -> str:

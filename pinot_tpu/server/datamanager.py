@@ -61,6 +61,9 @@ class TableDataManager:
         self._lock = threading.Lock()
 
     def add_segment(self, segment) -> None:
+        # integrity note: the disk-load CRC gate lives one layer up in
+        # ServerInstance.add_segment(verify_crc=True) — it must run
+        # BEFORE default-column injection, which this layer can't order
         name = segment.segment_name if hasattr(segment, "segment_name") else segment.metadata.segment_name
         with self._lock:
             old = self._segments.get(name)

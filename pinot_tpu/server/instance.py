@@ -14,7 +14,7 @@ import concurrent.futures
 import logging
 import os
 import time
-from typing import Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from pinot_tpu.common.datatable import (
     deserialize_instance_request,
@@ -46,6 +46,8 @@ class ServerInstance:
         num_workers: int = 4,
         max_pending: int = 64,
         pipeline: Optional[bool] = None,
+        lane_stall_timeout_s: Optional[float] = None,
+        device_fault_injector=None,
     ) -> None:
         self.name = name
         self.data_manager = InstanceDataManager()
@@ -55,11 +57,22 @@ class ServerInstance:
         # lane (coalescing identical dispatches), FINALIZE back on the
         # submitting worker.  On by default; PINOT_TPU_PIPELINE=0 (or
         # pipeline=False) restores the serial per-worker path.
+        # ``lane_stall_timeout_s`` arms the lane watchdog (wedged-launch
+        # restart); ``device_fault_injector`` is the deterministic-chaos
+        # hook (common/faults.py DeviceFaultInjector).
         if pipeline is None:
             pipeline = os.environ.get("PINOT_TPU_PIPELINE", "1") != "0"
         from pinot_tpu.engine.dispatch import DeviceLane
 
-        self.lane = DeviceLane(metrics=self.metrics) if pipeline else None
+        self.lane = (
+            DeviceLane(
+                metrics=self.metrics,
+                stall_timeout_s=lane_stall_timeout_s,
+                fault_injector=device_fault_injector,
+            )
+            if pipeline
+            else None
+        )
         self.executor = QueryExecutor(mesh=mesh, metrics=self.metrics, lane=self.lane)
         self.scheduler = QueryScheduler(num_workers=num_workers, max_pending=max_pending)
         self._table_schemas: dict = {}  # raw table name -> Schema
@@ -102,7 +115,23 @@ class ServerInstance:
             finally:
                 tdm.release_segments(acquired)
 
-    def add_segment(self, table: str, segment: ImmutableSegment) -> None:
+    def add_segment(
+        self, table: str, segment: ImmutableSegment, verify_crc: bool = False
+    ) -> None:
+        """``verify_crc=True`` (the disk-load paths) recomputes the
+        column-data CRC against the metadata claim before the segment
+        can serve; a mismatch raises ``SegmentIntegrityError`` and
+        counts a ``crcFailures`` mark (the caller quarantines)."""
+        if verify_crc:
+            # BEFORE default-column injection: injected columns are not
+            # part of the on-disk CRC claim and would skew the recompute
+            from pinot_tpu.segment.format import verify_segment_crc
+
+            try:
+                verify_segment_crc(segment)
+            except Exception:
+                self.metrics.meter("crcFailures").mark()
+                raise
         schema = self._table_schemas.get(self._raw_table(table))
         if schema is not None and isinstance(segment, ImmutableSegment):
             from pinot_tpu.segment.default_column import inject_default_columns
@@ -114,6 +143,23 @@ class ServerInstance:
         tdm = self.data_manager.table(table)
         if tdm is not None:
             tdm.remove_segment(name)
+
+    def record_crc_failure(self, table: str, name: str) -> None:
+        """A disk copy failed its integrity check (load or fetch)."""
+        logger.warning("segment %s/%s failed CRC verification", table, name)
+        self.metrics.meter("crcFailures").mark()
+
+    def quarantine_segment(self, table: str, name: str) -> None:
+        """Pull a corrupt segment out of serving: drop it from the data
+        manager AND evict any staged device arrays built from the
+        corrupt load — the staging cache keys on (name, claimed crc),
+        which a clean re-fetch would collide with."""
+        from pinot_tpu.engine.device import evict_staged_segment
+
+        self.remove_segment(table, name)
+        evict_staged_segment(name)
+        self.metrics.meter("quarantinedSegments").mark()
+        logger.warning("segment %s/%s quarantined pending re-fetch", table, name)
 
     # -- query path ---------------------------------------------------
     def handle_request(self, payload: bytes) -> bytes:
@@ -171,13 +217,20 @@ class ServerInstance:
 
     def status(self) -> dict:
         """Serving-surface snapshot: scheduler depth/shed, device-lane
-        depth + coalesce/dispatch/shed counters, and the per-stage phase
+        depth + coalesce/dispatch/shed counters, the per-stage phase
         timers (staging/planBuild/laneWait/planExec/finalize) inside the
-        metrics snapshot."""
+        metrics snapshot, and the self-healing counters (device
+        failures, host failovers, lane restarts, poisoned plans, CRC
+        failures, quarantined segments)."""
+        heal = self.executor.healing_stats()
+        heal["laneRestarts"] = 0 if self.lane is None else self.lane.restart_count
+        heal["crcFailures"] = self.metrics.meter("crcFailures").count
+        heal["quarantinedSegments"] = self.metrics.meter("quarantinedSegments").count
         return {
             "name": self.name,
             "scheduler": self.scheduler.stats(),
             "lane": None if self.lane is None else self.lane.stats(),
+            "selfHealing": heal,
             "metrics": self.metrics.snapshot(),
         }
 
@@ -204,10 +257,22 @@ class ServerInstance:
         names: Optional[Sequence[str]] = req["segments"] or None
         acquired = tdm.acquire_segments(names)
         try:
+            # honest degradation: requested segments this server cannot
+            # serve right now (dropped, quarantined pending re-fetch…)
+            # are REPORTED, not silently skipped — the broker re-covers
+            # them on a replica or flips partialResponse /
+            # numSegmentsUnserved for the client
+            missing: List[str] = []
+            if names:
+                held = {a.name for a in acquired}
+                missing = [n for n in names if n not in held]
+                if missing:
+                    self.metrics.meter("segmentsMissedServing").mark(len(missing))
             with trace.span("planAndExecute"):
                 result = self.executor.execute(
                     [a.query_view() for a in acquired], request, deadline=deadline
                 )
+            result.unserved_segments = missing
         finally:
             tdm.release_segments(acquired)
         if trace.enabled:

@@ -27,7 +27,13 @@ from typing import Any, Dict, Optional
 from pinot_tpu.common.schema import Schema
 from pinot_tpu.controller.resource_manager import CONSUMING, DROPPED, OFFLINE, ONLINE
 from pinot_tpu.realtime.mutable import MutableSegment
-from pinot_tpu.segment.format import SEGMENT_FILE_NAME, read_segment
+from pinot_tpu.segment.format import (
+    SEGMENT_FILE_NAME,
+    SegmentIntegrityError,
+    SegmentStaleError,
+    read_segment,
+    verify_segment_crc,
+)
 from pinot_tpu.server.instance import ServerInstance
 from pinot_tpu.transport.tcp import TcpServer
 
@@ -564,28 +570,72 @@ class NetworkedServerStarter:
             try:
                 cached = read_segment(local)
                 if crc is None or cached.metadata.crc == crc:
-                    seg_obj = cached  # local cache hit, skip download
+                    # local cache hit — but only a copy whose BYTES
+                    # verify may serve (a bit-rotted cache with an
+                    # intact header would otherwise sail through)
+                    verify_segment_crc(cached, source=local)
+                    seg_obj = cached
+            except SegmentIntegrityError:
+                # quarantine the corrupt cache copy aside (forensics)
+                # and fall through to a verified re-download from the
+                # controller's durable copy
+                from pinot_tpu.server.starter import quarantine_local_copy
+
+                self.server.record_crc_failure(table, segment)
+                quarantine_local_copy(self.server, table, segment, local)
+                logger.warning(
+                    "corrupt local cache for %s/%s quarantined; re-downloading",
+                    table, segment,
+                )
             except Exception:
                 logger.warning("corrupt local cache for %s/%s; re-downloading", table, segment)
         if seg_obj is None:
             # scheme-dispatched fetch (SegmentFetcherFactory.java):
             # an explicit downloadUri (hdfs://, external http…) wins;
-            # default is the controller-served copy over HTTP
+            # default is the controller-served copy over HTTP.  With a
+            # known CRC the factory verifies before install and returns
+            # the parsed segment (no second decode); with crc=None the
+            # download's own dataCrc claim is still self-verified — a
+            # corrupt controller copy must never enter serving.
             from pinot_tpu.segment.fetcher import DEFAULT_FACTORY
 
             uri = download_uri or (
                 f"{self.controller_url}/segments/{table}/{segment}/file"
             )
-            if local is not None:
-                os.makedirs(local, exist_ok=True)
-                DEFAULT_FACTORY.fetch(uri, os.path.join(local, SEGMENT_FILE_NAME))
-                seg_obj = read_segment(local)
-            else:
-                import tempfile
+            try:
+                if local is not None:
+                    os.makedirs(local, exist_ok=True)
+                    seg_obj = DEFAULT_FACTORY.fetch(
+                        uri, os.path.join(local, SEGMENT_FILE_NAME), expected_crc=crc
+                    )
+                    if seg_obj is None:
+                        seg_obj = read_segment(local)
+                        verify_segment_crc(seg_obj, source=uri)
+                else:
+                    import tempfile
 
-                with tempfile.TemporaryDirectory() as td:
-                    DEFAULT_FACTORY.fetch(uri, os.path.join(td, SEGMENT_FILE_NAME))
-                    seg_obj = read_segment(td)
+                    with tempfile.TemporaryDirectory() as td:
+                        seg_obj = DEFAULT_FACTORY.fetch(
+                            uri, os.path.join(td, SEGMENT_FILE_NAME), expected_crc=crc
+                        )
+                        if seg_obj is None:
+                            seg_obj = read_segment(td)
+                            verify_segment_crc(seg_obj, source=uri)
+            except SegmentStaleError:
+                # wrong VERSION at the source (replication lag), not
+                # corruption: no counters, retried on the next transition
+                logger.warning(
+                    "controller copy of %s/%s is a stale version; leaving "
+                    "unserved until it catches up", table, segment,
+                )
+                return False
+            except SegmentIntegrityError:
+                self.server.record_crc_failure(table, segment)
+                logger.exception(
+                    "downloaded copy of %s/%s failed integrity verification; "
+                    "leaving unserved", table, segment,
+                )
+                return False
         self.server.add_segment(table, seg_obj)
         from pinot_tpu.segment.invindex import warm_inverted_indexes
 

@@ -7,11 +7,20 @@ factory whose transitions download + load segments
 skip if equal, else fetch/untar/load).  Here the participant callback
 loads from the controller's segment store path (or takes the in-memory
 segment for freshly-committed realtime segments).
+
+INTEGRITY: every disk load verifies the column-data CRC against the
+metadata claim.  With a server-local ``data_dir`` the starter keeps its
+own durable copy per segment (fetched from ``downloadUri`` — the
+controller's store); a copy that fails verification is QUARANTINED
+(directory renamed aside, segment pulled from serving, staged device
+arrays evicted) and re-fetched from the controller copy, so local bit
+rot costs one re-download, never a wrong answer.
 """
 from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Any, Dict, Optional
 
 from pinot_tpu.controller.resource_manager import (
@@ -23,16 +32,31 @@ from pinot_tpu.controller.resource_manager import (
     CONSUMING,
     Participant,
 )
-from pinot_tpu.segment.format import read_segment
+from pinot_tpu.segment.format import (
+    SEGMENT_FILE_NAME,
+    SegmentIntegrityError,
+    SegmentStaleError,
+    read_segment,
+    verify_segment_crc,
+)
 from pinot_tpu.server.instance import ServerInstance
 
 logger = logging.getLogger(__name__)
 
 
 class ServerStarter:
-    def __init__(self, server: ServerInstance, resources: ClusterResourceManager) -> None:
+    def __init__(
+        self,
+        server: ServerInstance,
+        resources: ClusterResourceManager,
+        data_dir: Optional[str] = None,
+    ) -> None:
         self.server = server
         self.resources = resources
+        # server-local segment cache; None = read the shared store path
+        # directly (in-process clusters) — quarantine then only pulls
+        # the segment from serving (we never rename a dir we don't own)
+        self.data_dir = data_dir
         self._local_crcs: Dict[str, int] = {}  # segment -> crc loaded
 
     def start(self) -> None:
@@ -76,28 +100,12 @@ class ServerStarter:
             return True  # CRC match: already loaded (SegmentFetcherAndLoader.java:84)
         seg_obj = info.get("segment")  # in-memory handoff (realtime commit)
         if seg_obj is None:
-            path = info.get("dir")
-            uri = info.get("downloadUri")
-            if path is None and uri is None:
-                logger.error("segment %s/%s has no download info", table, segment)
-                return False
-            try:
-                if path is not None:
-                    seg_obj = read_segment(path)
-                else:
-                    # scheme-dispatched fetch (SegmentFetcherFactory.java)
-                    import tempfile
-
-                    from pinot_tpu.segment.fetcher import DEFAULT_FACTORY
-                    from pinot_tpu.segment.format import SEGMENT_FILE_NAME
-
-                    with tempfile.TemporaryDirectory() as td:
-                        DEFAULT_FACTORY.fetch(uri, os.path.join(td, SEGMENT_FILE_NAME))
-                        seg_obj = read_segment(td)
-            except Exception:
-                logger.exception(
-                    "failed to load %s/%s from %s", table, segment, path or uri
-                )
+            # disk loads verify inside _load_from_store (quarantine +
+            # re-fetch live there); in-memory handoffs were built in this
+            # process and are trusted — a consuming snapshot's crc field
+            # is a watermark identity hash, not a data CRC
+            seg_obj = self._load_from_store(table, segment, info, crc)
+            if seg_obj is None:
                 return False
         self.server.add_segment(table, seg_obj)
         from pinot_tpu.segment.invindex import warm_inverted_indexes
@@ -106,3 +114,146 @@ class ServerStarter:
         if crc is not None:
             self._local_crcs[segment] = crc
         return True
+
+    # -- disk load + integrity quarantine ------------------------------
+    def _local_segment_dir(self, table: str, segment: str) -> str:
+        return os.path.join(self.data_dir, table, segment)
+
+    def _load_from_store(
+        self, table: str, segment: str, info: Dict[str, Any], crc: Optional[int]
+    ) -> Optional["object"]:
+        path = info.get("dir")
+        uri = info.get("downloadUri")
+        if path is None and uri is None:
+            logger.error("segment %s/%s has no download info", table, segment)
+            return None
+        if self.data_dir is not None and uri is not None:
+            return self._load_via_local_copy(table, segment, uri, crc)
+        try:
+            if path is not None:
+                seg_obj = read_segment(path)
+                verify_segment_crc(seg_obj, source=path)
+            else:
+                # scheme-dispatched fetch (SegmentFetcherFactory.java),
+                # CRC-verified before the temp copy is even loaded; the
+                # self-verify after read also covers crc=None messages
+                # (the download's own dataCrc claim must still hold)
+                import tempfile
+
+                from pinot_tpu.segment.fetcher import DEFAULT_FACTORY
+
+                with tempfile.TemporaryDirectory() as td:
+                    seg_obj = DEFAULT_FACTORY.fetch(
+                        uri, os.path.join(td, SEGMENT_FILE_NAME), expected_crc=crc
+                    )
+                    if seg_obj is None:  # crc unknown: self-verify claim
+                        seg_obj = read_segment(td)
+                        verify_segment_crc(seg_obj, source=uri)
+            return seg_obj
+        except SegmentIntegrityError:
+            # a corrupt SHARED copy is the controller's to fix; pull the
+            # segment from serving and report, but never rename a
+            # directory this server does not own
+            self.server.record_crc_failure(table, segment)
+            self.server.quarantine_segment(table, segment)
+            logger.exception(
+                "segment %s/%s failed integrity verification at %s",
+                table, segment, path or uri,
+            )
+            return None
+        except Exception:
+            logger.exception(
+                "failed to load %s/%s from %s", table, segment, path or uri
+            )
+            return None
+
+    def _load_via_local_copy(
+        self, table: str, segment: str, uri: str, crc: Optional[int]
+    ) -> Optional["object"]:
+        """Load from the server-local copy, (re-)fetching from the
+        controller's durable copy as needed.  One quarantine + re-fetch
+        round heals local corruption; a second failure means the SOURCE
+        is bad and the segment stays out of serving (the broker's
+        partialResponse contract covers it meanwhile)."""
+        d = self._local_segment_dir(table, segment)
+        fpath = os.path.join(d, SEGMENT_FILE_NAME)
+        from pinot_tpu.segment.fetcher import DEFAULT_FACTORY
+
+        for attempt in (0, 1):
+            try:
+                if not os.path.exists(fpath):
+                    os.makedirs(d, exist_ok=True)
+                    # the factory returns the parsed + verified segment:
+                    # no second decode/CRC pass over a multi-GB file
+                    fetched = DEFAULT_FACTORY.fetch(uri, fpath, expected_crc=crc)
+                    if fetched is not None:
+                        return fetched
+                seg_obj = read_segment(d)
+                if crc is not None and seg_obj.metadata.crc and seg_obj.metadata.crc != crc:
+                    # STALE, not corrupt: the ideal state moved to a new
+                    # CRC (routine segment refresh) — replace the intact
+                    # old copy silently, no quarantine, no counters
+                    logger.info(
+                        "segment %s/%s: local copy CRC %s behind ideal-state"
+                        " %s; re-downloading", table, segment,
+                        seg_obj.metadata.crc, crc,
+                    )
+                    try:
+                        os.remove(fpath)
+                    except OSError:
+                        pass
+                    if attempt:
+                        return None
+                    continue
+                verify_segment_crc(seg_obj, source=fpath)
+                return seg_obj
+            except SegmentStaleError:
+                # the SOURCE copy is a different version than the ideal
+                # state asked for (replication lag): no quarantine, no
+                # corruption counters — retried on the next transition
+                logger.warning(
+                    "segment %s/%s: controller copy at %s is a stale "
+                    "version; leaving unserved until it catches up",
+                    table, segment, uri,
+                )
+                return None
+            except SegmentIntegrityError:
+                self.server.record_crc_failure(table, segment)
+                quarantine_local_copy(self.server, table, segment, d)
+                if attempt:
+                    logger.exception(
+                        "segment %s/%s corrupt after re-fetch from %s; "
+                        "leaving unserved", table, segment, uri,
+                    )
+                    return None
+                logger.warning(
+                    "segment %s/%s: local copy corrupt; quarantined, "
+                    "re-fetching from %s", table, segment, uri,
+                )
+            except Exception:
+                logger.exception(
+                    "failed to load %s/%s from %s", table, segment, uri
+                )
+                return None
+        return None
+
+
+def quarantine_local_copy(
+    server: ServerInstance, table: str, segment: str, d: str
+) -> None:
+    """Shared quarantine step for server-local segment copies (used by
+    both the in-process and the networked starter): move the corrupt
+    copy aside (kept for forensics, out of every load path) and pull the
+    segment from serving.  When there is no on-disk copy to impound (a
+    verified fetch refused to land one), only the serving pull happens —
+    no rename of an empty dir, no double-count of
+    ``quarantinedSegments`` for the same incident."""
+    if os.path.exists(os.path.join(d, SEGMENT_FILE_NAME)):
+        server.quarantine_segment(table, segment)
+        target = f"{d}.quarantined.{int(time.time() * 1000)}"
+        try:
+            os.rename(d, target)
+        except OSError:
+            logger.exception("could not quarantine %s", d)
+    else:
+        server.remove_segment(table, segment)

@@ -1,9 +1,12 @@
 """Retry policies (pinot-common ``common/utils/retry/`` analog:
-fixed-delay, exponential-backoff, no-delay)."""
+fixed-delay, exponential-backoff, no-delay; exponential backoff
+supports full jitter so a fleet retrying the same dead dependency
+doesn't re-converge on it in lockstep)."""
 from __future__ import annotations
 
+import random
 import time
-from typing import Callable, TypeVar
+from typing import Callable, Optional, TypeVar
 
 T = TypeVar("T")
 
@@ -46,10 +49,28 @@ class FixedDelayRetryPolicy(RetryPolicy):
 
 
 class ExponentialBackoffRetryPolicy(RetryPolicy):
-    def __init__(self, max_attempts: int, initial_delay_s: float, factor: float = 2.0) -> None:
+    """Exponential backoff, optionally with FULL jitter: each delay is
+    drawn uniformly from [0, initial * factor**attempt].  Synchronized
+    failures (every replica fetching from a just-restarted controller)
+    otherwise retry in lockstep and hammer the recovering dependency at
+    exactly the backoff boundaries; jitter spreads the herd.  ``seed``
+    makes the draw deterministic for tests."""
+
+    def __init__(
+        self,
+        max_attempts: int,
+        initial_delay_s: float,
+        factor: float = 2.0,
+        jitter: bool = False,
+        seed: Optional[int] = None,
+    ) -> None:
         super().__init__(max_attempts)
         self.initial = initial_delay_s
         self.factor = factor
+        self._rng = random.Random(seed) if jitter else None
 
     def delay_s(self, attempt: int) -> float:
-        return self.initial * (self.factor**attempt)
+        cap = self.initial * (self.factor**attempt)
+        if self._rng is not None:
+            return self._rng.uniform(0.0, cap)
+        return cap

@@ -29,3 +29,25 @@ else:
     import jax
 
     jax.config.update("jax_enable_x64", True)
+
+
+# ---------------------------------------------------------------------------
+# Thread-leak guard for the device-lane supervision path: a watchdog
+# restart abandons the wedged lane thread, and a bug there would leak
+# one thread per wedge.  After every test, any lane that was CLOSED must
+# have no surviving lane/watchdog threads (lanes left open by
+# module-scoped fixtures are exempt — they are still serving).
+# ---------------------------------------------------------------------------
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_lane_threads():
+    yield
+    from pinot_tpu.engine.dispatch import leaked_lane_threads
+
+    leaked = leaked_lane_threads(grace_s=2.0)
+    assert not leaked, (
+        f"device-lane threads leaked past lane close: "
+        f"{[t.name for t in leaked]}"
+    )

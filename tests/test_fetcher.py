@@ -131,3 +131,75 @@ def test_server_load_resolves_download_uri(tmp_path):
     assert ok
     tdm = server.data_manager.table("lineitem")
     assert tdm is not None and "fseg" in tdm.segment_names()
+
+
+def test_http_download_truncation_cleans_part_and_retries(tmp_path):
+    """A connection cut mid-body must not leave a truncated file (or a
+    stale .part) behind: the attempt fails the length check, cleans up,
+    and the retry can land a full copy."""
+    import http.server
+    import threading as _threading
+
+    state = {"truncate_next": 0}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b"0123456789" * 100
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if state["truncate_next"] > 0:
+                state["truncate_next"] -= 1
+                self.wfile.write(body[: len(body) // 2])
+                self.wfile.flush()
+                self.connection.close()  # cut mid-stream
+            else:
+                self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = _threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        host, port = srv.server_address
+        dest = tmp_path / "seg"
+        state["truncate_next"] = 1
+        HttpSegmentFetcher(attempts=3).fetch(f"http://{host}:{port}/s", str(dest))
+        assert dest.read_bytes() == b"0123456789" * 100  # retry healed it
+        assert not (tmp_path / "seg.part").exists()
+
+        # every attempt truncated -> typed retry failure, no leftovers
+        from pinot_tpu.utils.retry import RetryError
+
+        state["truncate_next"] = 99
+        with pytest.raises(RetryError):
+            HttpSegmentFetcher(attempts=2).fetch(
+                f"http://{host}:{port}/s", str(tmp_path / "seg2")
+            )
+        assert not (tmp_path / "seg2").exists()
+        assert not (tmp_path / "seg2.part").exists()
+    finally:
+        srv.shutdown()
+
+
+def test_exponential_backoff_full_jitter():
+    """Full jitter: delays draw uniformly from [0, cap], deterministic
+    per seed, and do NOT re-synchronize retrying replicas (plain
+    exponential backoff fires every replica at the same instants)."""
+    from pinot_tpu.utils.retry import ExponentialBackoffRetryPolicy
+
+    plain = ExponentialBackoffRetryPolicy(5, 0.2)
+    assert [plain.delay_s(i) for i in range(3)] == [0.2, 0.4, 0.8]
+
+    j1 = ExponentialBackoffRetryPolicy(5, 0.2, jitter=True, seed=42)
+    j2 = ExponentialBackoffRetryPolicy(5, 0.2, jitter=True, seed=42)
+    j3 = ExponentialBackoffRetryPolicy(5, 0.2, jitter=True, seed=43)
+    d1 = [j1.delay_s(i) for i in range(8)]
+    d2 = [j2.delay_s(i) for i in range(8)]
+    d3 = [j3.delay_s(i) for i in range(8)]
+    assert d1 == d2  # deterministic per seed
+    assert d1 != d3  # different replicas spread out
+    for i, d in enumerate(d1):
+        assert 0.0 <= d <= 0.2 * 2**i

@@ -112,6 +112,11 @@ def test_lane_mixed_deadline_waiters_still_serve_live_ones():
 
 
 def test_lane_error_fans_out_to_all_waiters():
+    """Launch failures reach every coalesced waiter as the TYPED
+    DeviceExecutionError (lane supervision contract), carrying the raw
+    cause; a deterministic error classifies as poison."""
+    from pinot_tpu.engine.dispatch import DeviceExecutionError
+
     lane = DeviceLane()
     gate = threading.Event()
 
@@ -124,8 +129,11 @@ def test_lane_error_fans_out_to_all_waiters():
     tickets = [lane.submit("bad", boom) for _ in range(3)]
     gate.set()
     for t in tickets:
-        with pytest.raises(ValueError, match="kernel exploded"):
+        with pytest.raises(DeviceExecutionError, match="kernel exploded") as ei:
             t.result(time.monotonic() + 5)
+        assert isinstance(ei.value.cause, ValueError)
+        assert ei.value.retryable is False  # deterministic -> poison
+    assert lane.device_failure_count == 1  # one launch, fanned out
     # an error never stays coalescible: the next submit re-launches
     ok = lane.submit("bad", lambda: "fine")
     assert ok.result(time.monotonic() + 5) == "fine"

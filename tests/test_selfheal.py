@@ -1,0 +1,639 @@
+"""Server self-healing tests: device-lane supervision (typed errors,
+watchdog restart, re-driven queue), transparent host failover with the
+poison quarantine, deterministic device chaos (seeded
+DeviceFaultInjector), and segment integrity (CRC verification at fetch
+/ load / add time, quarantine + re-fetch from the controller copy with
+the partialResponse contract served mid-recovery)."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.common.faults import DeviceFaultInjector
+from pinot_tpu.engine.dispatch import (
+    DeviceExecutionError,
+    DeviceLane,
+    classify_device_error,
+)
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+from pinot_tpu.tools.cluster_harness import single_server_broker
+
+TABLE = "healTable"
+
+
+# excluded from the byte-identity check: wall time and the
+# entries-scanned WORK accounting (they describe how a path executed —
+# a host fallback scans different entry counts than the device kernel
+# by construction).  Results, docs scanned, and the degradation
+# contract fields all must match exactly.
+_PATH_DEPENDENT = {
+    "timeUsedMs",
+    "numEntriesScannedInFilter",
+    "numEntriesScannedPostFilter",
+}
+
+
+def _payload(resp) -> str:
+    return json.dumps(
+        {k: v for k, v in resp.to_json().items() if k not in _PATH_DEPENDENT},
+        sort_keys=True,
+    )
+
+
+# -- error classification ---------------------------------------------
+
+
+def test_classify_device_error_retryable_vs_poison():
+    transient = classify_device_error(RuntimeError("RESOURCE_EXHAUSTED: hbm oom"))
+    assert transient.retryable is True
+    poison = classify_device_error(TypeError("lowering failed for shape (3,)"))
+    assert poison.retryable is False
+    assert isinstance(poison.cause, TypeError)
+    # idempotent: an already-typed error passes through untouched
+    again = classify_device_error(poison)
+    assert again is poison
+
+
+# -- lane watchdog / restart units ------------------------------------
+
+
+def test_lane_watchdog_restarts_wedged_lane_and_redrives_queue():
+    """A launch wedged past the stall timeout: waiters get the typed
+    stall error, the lane respawns, and dispatches still QUEUED behind
+    the wedge run to completion on the new thread."""
+    lane = DeviceLane(stall_timeout_s=0.15)
+    gate = threading.Event()
+
+    def wedge():
+        gate.wait(10)
+        return "late"
+
+    stuck = lane.submit("wedge", wedge)
+    time.sleep(0.05)  # lane thread inside the wedge
+    behind = lane.submit("behind", lambda: "ok")
+    with pytest.raises(DeviceExecutionError) as ei:
+        stuck.result(time.monotonic() + 5)
+    assert ei.value.stalled and ei.value.retryable is False
+    # the queued dispatch was re-driven by the respawned lane thread
+    assert behind.result(time.monotonic() + 5) == "ok"
+    assert lane.restart_count == 1
+    assert lane.device_failure_count >= 1
+    assert lane.stats()["restarts"] == 1
+    gate.set()  # unwedge the abandoned thread
+    time.sleep(0.05)
+    lane.close()
+
+
+def test_lane_stale_completion_discarded_after_restart():
+    """The abandoned thread's eventual return value must be dropped: a
+    fresh identical submit re-launches instead of seeing stale state."""
+    lane = DeviceLane(stall_timeout_s=0.1)
+    gate = threading.Event()
+    calls = []
+
+    def wedge():
+        gate.wait(10)
+        calls.append("wedge")
+        return "stale-value"
+
+    stuck = lane.submit("k", wedge)
+    with pytest.raises(DeviceExecutionError):
+        stuck.result(time.monotonic() + 5)
+    gate.set()  # old thread completes NOW, after the restart
+    time.sleep(0.2)
+    assert lane.stale_completions == 1
+    fresh = lane.submit("k", lambda: "fresh")
+    assert fresh.result(time.monotonic() + 5) == "fresh"
+    assert calls == ["wedge"]
+    lane.close()
+
+
+def test_lane_injector_raises_typed_faults():
+    inj = DeviceFaultInjector(seed=3)
+    lane = DeviceLane(fault_injector=inj)
+    inj.fail_next(1, retryable=True)
+    bad = lane.submit("a", lambda: 1)
+    with pytest.raises(DeviceExecutionError) as ei:
+        bad.result(time.monotonic() + 5)
+    assert ei.value.retryable is True
+    ok = lane.submit("a", lambda: 2)  # injector healed after one
+    assert ok.result(time.monotonic() + 5) == 2
+    assert [r.outcome for r in inj.launches] == ["fail_next", "ok"]
+    lane.close()
+
+
+# -- full-path failover (chaos tier) ----------------------------------
+
+
+@pytest.fixture()
+def heal_stack():
+    """One pipelined server + broker with a seeded device fault
+    injector and a fast lane watchdog, plus a serial (device-healthy)
+    twin for byte-identical reference payloads."""
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 3000, seed=21)
+    segs = [
+        build_segment(schema, rows[:1500], TABLE, "h0"),
+        build_segment(schema, rows[1500:], TABLE, "h1"),
+    ]
+    inj = DeviceFaultInjector(seed=11)
+    broker = single_server_broker(
+        TABLE,
+        segs,
+        pipeline=True,
+        device_fault_injector=inj,
+        lane_stall_timeout_s=0.2,
+    )
+    reference = single_server_broker(TABLE, segs, pipeline=False)
+    yield broker, reference, inj
+    broker.local_servers[0].shutdown()
+    reference.local_servers[0].shutdown()
+
+
+CHAOS_QUERIES = [
+    "SELECT count(*) FROM healTable",
+    "SELECT sum(metInt), min(metFloat), max(metInt) FROM healTable WHERE dimInt > 50",
+    "SELECT sum(metInt) FROM healTable GROUP BY dimStr TOP 5",
+    "SELECT distinctcount(dimInt) FROM healTable GROUP BY dimStr TOP 5",
+    "SELECT dimStr, metInt FROM healTable ORDER BY metInt DESC LIMIT 7",
+    # scalar distinct + percentile with a filter: exercises the host
+    # fallback's ROW-WISE accumulator path under failover (regression:
+    # it used to build mergeable partials and crash on .add)
+    "SELECT distinctcount(dimInt), percentile50(metInt) FROM healTable WHERE metInt > 100",
+]
+
+
+@pytest.mark.chaos
+def test_transient_device_failure_heals_with_one_device_retry(heal_stack):
+    broker, reference, inj = heal_stack
+    pql = CHAOS_QUERIES[1]
+    want = _payload(reference.handle_pql(pql))
+    inj.fail_next(1, retryable=True)
+    resp = broker.handle_pql(pql)
+    assert not resp.exceptions
+    assert _payload(resp) == want
+    heal = broker.local_servers[0].status()["selfHealing"]
+    assert heal["deviceFailures"] >= 1
+    assert heal["deviceRetries"] >= 1
+    assert heal["hostFailovers"] == 0  # the device retry was enough
+
+
+@pytest.mark.chaos
+def test_poisoned_plan_serves_byte_identical_via_host_failover(heal_stack):
+    """Acceptance (a): a poisoned plan keeps answering, byte-identical
+    to the healthy device run, and repeat offenders skip the device."""
+    broker, reference, inj = heal_stack
+    pql = CHAOS_QUERIES[2]
+    want = _payload(reference.handle_pql(pql))
+    healthy = broker.handle_pql(pql)
+    assert _payload(healthy) == want
+    digest = inj.launches[-1].digest
+    assert digest is not None
+
+    inj.poison_plan(digest)
+    poisoned = broker.handle_pql(pql)
+    assert not poisoned.exceptions
+    assert _payload(poisoned) == want  # host failover, same bytes
+    server = broker.local_servers[0]
+    heal = server.status()["selfHealing"]
+    assert heal["deviceFailures"] >= 1
+    assert heal["hostFailovers"] >= 1
+    assert heal["poisonedPlans"] >= 1
+
+    # quarantined now: the next repeat goes straight to host — the
+    # injector must see NO new launch for this digest
+    launches_before = len(inj.launches)
+    again = broker.handle_pql(pql)
+    assert _payload(again) == want
+    assert len(inj.launches) == launches_before
+    assert server.status()["selfHealing"]["poisonSkips"] >= 1
+
+    # other plans still run on device
+    other = broker.handle_pql(CHAOS_QUERIES[0])
+    assert not other.exceptions
+    assert len(inj.launches) > launches_before
+
+
+@pytest.mark.chaos
+def test_stalled_dispatch_restarts_lane_and_still_answers(heal_stack):
+    """Acceptance (b): a wedged kernel launch trips the watchdog; the
+    stalled query fails over to host (answered, not errored), and a
+    query queued behind the wedge is re-driven on device."""
+    broker, reference, inj = heal_stack
+    stall_pql = CHAOS_QUERIES[3]
+    behind_pql = CHAOS_QUERIES[0]
+    want_stall = _payload(reference.handle_pql(stall_pql))
+    want_behind = _payload(reference.handle_pql(behind_pql))
+
+    inj.stall_next(1, stall_s=1.0)  # >> lane stall timeout (0.2s)
+    results = {}
+
+    def run(name, pql):
+        results[name] = broker.handle_pql(pql)
+
+    t1 = threading.Thread(target=run, args=("stalled", stall_pql))
+    t1.start()
+    time.sleep(0.08)  # stalled launch occupies the lane thread
+    t2 = threading.Thread(target=run, args=("behind", behind_pql))
+    t2.start()
+    t1.join(30)
+    t2.join(30)
+    assert not results["stalled"].exceptions
+    assert not results["behind"].exceptions
+    assert _payload(results["stalled"]) == want_stall  # host failover
+    assert _payload(results["behind"]) == want_behind
+    server = broker.local_servers[0]
+    heal = server.status()["selfHealing"]
+    assert heal["laneRestarts"] >= 1
+    assert heal["hostFailovers"] >= 1
+    assert server.lane.restart_count >= 1
+
+
+@pytest.mark.chaos
+def test_coalesced_waiters_all_get_failover_result(heal_stack):
+    """Acceptance (c): waiters coalesced onto a failing dispatch all
+    receive the failover RESULT — never the raw device exception."""
+    broker, reference, inj = heal_stack
+    pql = CHAOS_QUERIES[2]
+    want = _payload(reference.handle_pql(pql))
+    server = broker.local_servers[0]
+
+    # warm both plans so PREP is milliseconds and submits overlap
+    assert _payload(broker.handle_pql(pql)) == want
+    broker.handle_pql(CHAOS_QUERIES[0])
+
+    # wedge the lane briefly (below the watchdog timeout) so identical
+    # queries pile up + coalesce behind the blocker...
+    inj.stall_next(1, stall_s=0.15)
+    base_hits = server.lane.coalesce_hits
+
+    blocker_done = []
+
+    def blocker():
+        blocker_done.append(broker.handle_pql(CHAOS_QUERIES[0]))
+
+    tb = threading.Thread(target=blocker)
+    tb.start()
+    time.sleep(0.05)  # blocker's launch is stalling inside the lane
+    # ...then fail their one shared launch hard (non-retryable)
+    inj.fail_next(99, retryable=False)
+
+    payloads, errors = [], []
+    lock = threading.Lock()
+
+    def hit():
+        resp = broker.handle_pql(pql)
+        with lock:
+            if resp.exceptions:
+                errors.append(resp.exceptions)
+            else:
+                payloads.append(_payload(resp))
+
+    threads = [threading.Thread(target=hit) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    tb.join(30)
+    inj.heal()
+    assert not errors, errors[:1]
+    assert len(payloads) == 6 and set(payloads) == {want}
+    assert server.lane.coalesce_hits > base_hits  # they really coalesced
+    heal = server.status()["selfHealing"]
+    # every waiter was answered off-device: via explicit host failover
+    # or, once the plan was quarantined, the poison skip
+    assert heal["hostFailovers"] + heal["poisonSkips"] >= len(payloads)
+    assert heal["hostFailovers"] >= 1
+
+
+@pytest.mark.chaos
+def test_seeded_device_chaos_run_completes_clean(heal_stack):
+    """Acceptance sweep: a seeded chaos schedule (poison + stall +
+    fail_next) over the query ladder finishes with ZERO failed queries,
+    every payload byte-identical to the healthy run, and every
+    self-healing counter that was exercised nonzero."""
+    broker, reference, inj = heal_stack
+    server = broker.local_servers[0]
+    want = {pql: _payload(reference.handle_pql(pql)) for pql in CHAOS_QUERIES}
+
+    # healthy warmup (also records plan digests per query)
+    digests = {}
+    for pql in CHAOS_QUERIES:
+        resp = broker.handle_pql(pql)
+        assert not resp.exceptions
+        assert _payload(resp) == want[pql]
+        if inj.launches and inj.launches[-1].digest is not None:
+            digests[pql] = inj.launches[-1].digest
+
+    # chaos schedule: poison the group-by plan AND the scalar-distinct
+    # plan (row-wise host fallback), stall one launch (lane restart),
+    # sprinkle transient failures over the rest
+    inj.poison_plan(digests[CHAOS_QUERIES[2]])
+    inj.poison_plan(digests[CHAOS_QUERIES[5]])
+    inj.stall_next(1, stall_s=1.0)
+    failed = 0
+    for round_no in range(3):
+        for pql in CHAOS_QUERIES:
+            resp = broker.handle_pql(pql)
+            if resp.exceptions:
+                failed += 1
+            else:
+                assert _payload(resp) == want[pql], pql
+        inj.fail_next(1, retryable=True)
+    assert failed == 0
+
+    heal = server.status()["selfHealing"]
+    assert heal["deviceFailures"] >= 2  # stall + fail_next + poison hits
+    assert heal["hostFailovers"] >= 1
+    assert heal["laneRestarts"] >= 1
+    assert heal["poisonedPlans"] >= 1
+    assert heal["poisonSkips"] >= 1
+    assert heal["deviceRetries"] >= 1
+    # the status surface exposes the full counter contract
+    for key in (
+        "deviceFailures", "deviceRetries", "hostFailovers", "poisonSkips",
+        "poisonedPlans", "laneRestarts", "crcFailures", "quarantinedSegments",
+    ):
+        assert key in heal, key
+
+
+# -- segment integrity -------------------------------------------------
+
+
+def _write_store_segment(tmp_path, seg):
+    from pinot_tpu.segment.format import write_segment
+
+    d = tmp_path / "store" / seg.segment_name
+    write_segment(seg, str(d))
+    return d
+
+
+def _corrupt_segment_file(path):
+    """Flip bytes in the buffer region (past the JSON header) so the
+    file still parses but the column data no longer matches the CRC."""
+    with open(path, "r+b") as f:
+        data = f.read()
+        hlen = int.from_bytes(data[8:16], "little")
+        pos = 16 + hlen + max(0, (len(data) - 16 - hlen) // 2)
+        f.seek(pos)
+        chunk = data[pos : pos + 8]
+        f.write(bytes((~b) & 0xFF for b in chunk))
+
+
+def test_verify_crc_on_add_rejects_corrupt_segment():
+    import numpy as np
+
+    from pinot_tpu.segment.format import SegmentIntegrityError
+    from pinot_tpu.server.instance import ServerInstance
+
+    schema = make_test_schema(with_mv=False)
+    seg = build_segment(schema, random_rows(schema, 200, seed=5), TABLE, "bad0")
+    col = next(iter(seg.columns.values()))
+    col.fwd = np.ascontiguousarray(col.fwd[::-1])  # silent bit-rot analog
+    server = ServerInstance("intsrv", pipeline=False)
+    with pytest.raises(SegmentIntegrityError):
+        server.add_segment(TABLE, seg, verify_crc=True)
+    assert server.status()["selfHealing"]["crcFailures"] == 1
+    tdm = server.data_manager.table(TABLE)
+    assert tdm is None or "bad0" not in tdm.segment_names()
+    server.shutdown()
+
+
+def test_fetch_with_expected_crc_rejects_corrupt_copy(tmp_path):
+    from pinot_tpu.segment.fetcher import DEFAULT_FACTORY
+    from pinot_tpu.segment.format import SEGMENT_FILE_NAME, SegmentIntegrityError
+
+    schema = make_test_schema(with_mv=False)
+    seg = build_segment(schema, random_rows(schema, 200, seed=6), TABLE, "f0")
+    d = _write_store_segment(tmp_path, seg)
+    _corrupt_segment_file(d / SEGMENT_FILE_NAME)
+    dest = tmp_path / "local" / SEGMENT_FILE_NAME
+    with pytest.raises(SegmentIntegrityError):
+        DEFAULT_FACTORY.fetch(
+            "file://" + str(d), str(dest), expected_crc=seg.metadata.crc
+        )
+    assert not dest.exists()  # nothing corrupt ever lands at the dest
+    assert not (tmp_path / "local").joinpath(SEGMENT_FILE_NAME + ".verify").exists()
+
+
+@pytest.mark.chaos
+def test_corrupt_local_segment_quarantined_refetched_and_serving(tmp_path):
+    """Acceptance: a committed segment whose LOCAL copy rots on disk is
+    quarantined at load time and re-fetched from the controller copy;
+    a query answered mid-recovery carries partialResponse=true +
+    numSegmentsUnserved, and serving is fully restored by the reload —
+    all inside this test."""
+    from pinot_tpu.broker.broker import BrokerRequestHandler
+    from pinot_tpu.broker.routing import RoutingTableProvider
+    from pinot_tpu.controller.resource_manager import ClusterResourceManager
+    from pinot_tpu.segment import fetcher as fetcher_mod
+    from pinot_tpu.segment.format import SEGMENT_FILE_NAME
+    from pinot_tpu.server.instance import ServerInstance
+    from pinot_tpu.server.starter import ServerStarter
+    from pinot_tpu.transport.local import LocalTransport
+
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 400, seed=17)
+    segs = {
+        "q0": build_segment(schema, rows[:200], TABLE, "q0"),
+        "q1": build_segment(schema, rows[200:], TABLE, "q1"),
+    }
+    stores = {n: _write_store_segment(tmp_path, s) for n, s in segs.items()}
+
+    server = ServerInstance("intsrv2", pipeline=False)
+    starter = ServerStarter(
+        server, ClusterResourceManager(), data_dir=str(tmp_path / "server-data")
+    )
+    transport = LocalTransport()
+    transport.register(("intsrv2", 0), server.handle_request)
+    routing = RoutingTableProvider()
+    routing.update(
+        TABLE, {"q0": {"intsrv2": "ONLINE"}, "q1": {"intsrv2": "ONLINE"}}
+    )
+    broker = BrokerRequestHandler(
+        transport, {"intsrv2": ("intsrv2", 0)}, routing=routing, timeout_ms=30_000
+    )
+
+    def load(name):
+        return starter._load(
+            TABLE,
+            name,
+            {
+                "metadata": segs[name].metadata,
+                "downloadUri": "file://" + str(stores[name]),
+            },
+        )
+
+    assert load("q0") and load("q1")
+    resp = broker.handle_pql("SELECT count(*) FROM healTable")
+    assert resp.num_docs_scanned == 400 and not resp.partial_response
+
+    # rot the LOCAL copy of q1 on disk, then simulate a server restart
+    # (fresh instance + starter over the same data_dir)
+    local_q1 = os.path.join(str(tmp_path / "server-data"), TABLE, "q1")
+    _corrupt_segment_file(os.path.join(local_q1, SEGMENT_FILE_NAME))
+    server.shutdown()
+
+    server2 = ServerInstance("intsrv2", pipeline=False)
+    starter2 = ServerStarter(
+        server2, ClusterResourceManager(), data_dir=str(tmp_path / "server-data")
+    )
+    transport.register(("intsrv2", 0), server2.handle_request)
+
+    def load2(name):
+        return starter2._load(
+            TABLE,
+            name,
+            {
+                "metadata": segs[name].metadata,
+                "downloadUri": "file://" + str(stores[name]),
+            },
+        )
+
+    assert load2("q0")
+
+    # hook the re-fetch: mid-recovery (q1 quarantined, clean copy not
+    # yet down) a query must serve the degraded-but-honest contract
+    mid_recovery = {}
+    real_fetch = fetcher_mod.DEFAULT_FACTORY.fetch
+
+    def spying_fetch(uri, dest_path, expected_crc=None):
+        if "q1" in uri and "mid" not in mid_recovery:
+            mid_recovery["mid"] = broker.handle_pql(
+                "SELECT count(*) FROM healTable"
+            )
+        return real_fetch(uri, dest_path, expected_crc=expected_crc)
+
+    fetcher_mod.DEFAULT_FACTORY.fetch = spying_fetch
+    try:
+        assert load2("q1")  # quarantine -> re-fetch -> verified load
+    finally:
+        fetcher_mod.DEFAULT_FACTORY.fetch = real_fetch
+
+    mid = mid_recovery["mid"]
+    assert mid.partial_response is True
+    assert mid.num_segments_unserved == 1
+    assert mid.num_docs_scanned == 200  # q0 still answered
+    assert any(e.error_code == 230 for e in mid.exceptions)
+
+    # recovery complete: full serving restored, quarantine dir kept
+    resp = broker.handle_pql("SELECT count(*) FROM healTable")
+    assert resp.num_docs_scanned == 400
+    assert resp.partial_response is False and not resp.exceptions
+    heal = server2.status()["selfHealing"]
+    assert heal["crcFailures"] >= 1
+    assert heal["quarantinedSegments"] >= 1
+    parent = os.path.dirname(local_q1)
+    assert any(".quarantined." in n for n in os.listdir(parent))
+    server2.shutdown()
+
+
+def test_corrupt_source_copy_stays_unserved(tmp_path):
+    """When the CONTROLLER copy itself is bad, the re-fetch round must
+    not loop forever or serve corrupt data: the segment stays out of
+    serving after one quarantine + failed re-fetch."""
+    from pinot_tpu.controller.resource_manager import ClusterResourceManager
+    from pinot_tpu.segment.format import SEGMENT_FILE_NAME
+    from pinot_tpu.server.instance import ServerInstance
+    from pinot_tpu.server.starter import ServerStarter
+
+    schema = make_test_schema(with_mv=False)
+    seg = build_segment(schema, random_rows(schema, 200, seed=8), TABLE, "s0")
+    store = _write_store_segment(tmp_path, seg)
+    _corrupt_segment_file(store / SEGMENT_FILE_NAME)
+
+    server = ServerInstance("intsrv3", pipeline=False)
+    starter = ServerStarter(
+        server, ClusterResourceManager(), data_dir=str(tmp_path / "sd")
+    )
+    ok = starter._load(
+        TABLE,
+        "s0",
+        {"metadata": seg.metadata, "downloadUri": "file://" + str(store)},
+    )
+    assert ok is False
+    tdm = server.data_manager.table(TABLE)
+    assert tdm is None or "s0" not in tdm.segment_names()
+    heal = server.status()["selfHealing"]
+    assert heal["crcFailures"] >= 1
+    # the verified fetch never landed a copy, so there was nothing to
+    # impound: no quarantine count for the fetch-refused incident
+    assert heal["quarantinedSegments"] == 0
+    server.shutdown()
+
+
+def test_stale_source_copy_not_counted_as_corruption(tmp_path):
+    """Replication lag: the ideal state asks for a NEWER CRC than the
+    controller store currently serves.  The load must fail softly —
+    unserved, retried later — with NO corruption counters and NO
+    quarantine of an intact (just old) copy."""
+    from pinot_tpu.controller.resource_manager import ClusterResourceManager
+    from pinot_tpu.segment.format import write_segment
+    from pinot_tpu.server.instance import ServerInstance
+    from pinot_tpu.server.starter import ServerStarter
+
+    schema = make_test_schema(with_mv=False)
+    v1 = build_segment(schema, random_rows(schema, 100, seed=40), TABLE, "st0")
+    v2 = build_segment(schema, random_rows(schema, 150, seed=41), TABLE, "st0")
+    store = tmp_path / "store" / "st0"
+    write_segment(v1, str(store))  # store still serves v1...
+
+    server = ServerInstance("intsrv5", pipeline=False)
+    starter = ServerStarter(
+        server, ClusterResourceManager(), data_dir=str(tmp_path / "sd")
+    )
+    ok = starter._load(  # ...while the ideal state already names v2
+        TABLE,
+        "st0",
+        {"metadata": v2.metadata, "downloadUri": "file://" + str(store)},
+    )
+    assert ok is False
+    heal = server.status()["selfHealing"]
+    assert heal["crcFailures"] == 0
+    assert heal["quarantinedSegments"] == 0
+    server.shutdown()
+
+
+def test_stale_local_copy_refreshed_without_quarantine(tmp_path):
+    """A segment REFRESH (ideal-state CRC moved) must not read as
+    corruption: the intact old local copy is silently replaced — no
+    crcFailures, no quarantine dir, new data serving."""
+    from pinot_tpu.controller.resource_manager import ClusterResourceManager
+    from pinot_tpu.segment.format import write_segment
+    from pinot_tpu.server.instance import ServerInstance
+    from pinot_tpu.server.starter import ServerStarter
+
+    schema = make_test_schema(with_mv=False)
+    v1 = build_segment(schema, random_rows(schema, 100, seed=30), TABLE, "r0")
+    v2 = build_segment(schema, random_rows(schema, 150, seed=31), TABLE, "r0")
+    assert v1.metadata.crc != v2.metadata.crc
+    store = tmp_path / "store" / "r0"
+    write_segment(v1, str(store))
+
+    server = ServerInstance("intsrv4", pipeline=False)
+    starter = ServerStarter(
+        server, ClusterResourceManager(), data_dir=str(tmp_path / "sd")
+    )
+    info = lambda seg: {
+        "metadata": seg.metadata, "downloadUri": "file://" + str(store)
+    }
+    assert starter._load(TABLE, "r0", info(v1))
+
+    write_segment(v2, str(store))  # controller refreshed the segment
+    assert starter._load(TABLE, "r0", info(v2))
+    tdm = server.data_manager.table(TABLE)
+    sdm = tdm.acquire_segments(["r0"])[0]
+    try:
+        assert sdm.segment.num_docs == 150  # the NEW copy serves
+    finally:
+        tdm.release_segments([sdm])
+    heal = server.status()["selfHealing"]
+    assert heal["crcFailures"] == 0
+    assert heal["quarantinedSegments"] == 0
+    assert not any(
+        ".quarantined." in n for n in os.listdir(tmp_path / "sd" / TABLE)
+    )
+    server.shutdown()
