@@ -257,9 +257,11 @@ def _closed_loop(broker, queries, clients: int, duration_s: float) -> dict:
 
 def _strip_timing(resp) -> str:
     """Canonical BrokerResponse payload for differential comparison:
-    everything except the wall-clock field."""
+    everything except the wall-clock field and the broker-assigned
+    per-query requestId."""
     return json.dumps(
-        {k: v for k, v in resp.to_json().items() if k != "timeUsedMs"},
+        {k: v for k, v in resp.to_json().items()
+         if k not in ("timeUsedMs", "requestId")},
         sort_keys=True,
     )
 
@@ -340,7 +342,7 @@ def _serving_main() -> None:
         "queries": len(queries_mixed) + 1,
         "mismatches": diffs,
         "identical_payloads": diffs == 0,
-        "note": "payload = BrokerResponse.to_json() minus timeUsedMs, sorted keys",
+        "note": "payload = BrokerResponse.to_json() minus timeUsedMs/requestId, sorted keys",
     }
     print(json.dumps(doc, indent=1))
 

@@ -50,9 +50,11 @@ from pinot_tpu.engine.reduce import reduce_to_response
 from pinot_tpu.engine.results import IntermediateResult
 from pinot_tpu.pql import PqlParseError, optimize_request, parse_pql
 from pinot_tpu.broker.health import ServerHealthTracker
+from pinot_tpu.broker.querylog import SlowQueryLog
 from pinot_tpu.broker.routing import RoutingTableProvider
 from pinot_tpu.broker.time_boundary import TimeBoundaryService
-from pinot_tpu.utils.metrics import BrokerMetrics
+from pinot_tpu.utils.metrics import BrokerMetrics, prometheus_text
+from pinot_tpu.utils.trace import NULL_TRACE, TraceContext, merge_scope
 
 logger = logging.getLogger(__name__)
 
@@ -126,7 +128,9 @@ class BrokerRequestHandler:
         self.routing = routing or RoutingTableProvider()
         self.time_boundary = time_boundary or TimeBoundaryService()
         self.timeout_ms = timeout_ms
+        self.name = name
         self.metrics = BrokerMetrics(name)
+        self.querylog = SlowQueryLog()
         self.retry_attempts = max(0, retry_attempts)
         self.retry_backoff_ms = retry_backoff_ms
         self.retry_backoff_cap_ms = retry_backoff_cap_ms
@@ -139,6 +143,12 @@ class BrokerRequestHandler:
         self.quota = QueryQuotaManager()
         self._request_id = 0
         self._id_lock = threading.Lock()
+        # globally-unique request ids: broker name + a process-unique
+        # token (two brokers sharing a default name, or one restarting,
+        # can never reuse an id) + a per-broker sequence
+        import uuid
+
+        self._id_prefix = f"{name}-{uuid.uuid4().hex[:6]}"
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=16)
 
     @classmethod
@@ -166,10 +176,11 @@ class BrokerRequestHandler:
     def set_server_address(self, server: str, address: Tuple[str, int]) -> None:
         self.server_addresses[server] = address
 
-    def _next_id(self) -> int:
+    def _next_request_id(self) -> str:
         with self._id_lock:
             self._request_id += 1
-            return self._request_id
+            n = self._request_id
+        return f"{self._id_prefix}-{n}"
 
     # ------------------------------------------------------------------
     def handle_pql(
@@ -181,24 +192,73 @@ class BrokerRequestHandler:
     ) -> BrokerResponse:
         t0 = time.perf_counter()
         self.metrics.meter("queries").mark()
-        try:
-            request = parse_pql(pql)
-            if debug_options:
-                request.debug_options = dict(debug_options)
-            request = optimize_request(request)
-        except PqlParseError as e:
-            # InvalidQueryOptionsError subclasses this; internal
-            # ValueErrors now propagate instead of masquerading as
-            # client parse errors (ADVICE r1)
-            resp = BrokerResponse(
-                exceptions=[QueryException(ErrorCode.PQL_PARSING, str(e))]
-            )
-            resp.time_used_ms = (time.perf_counter() - t0) * 1000
-            return resp
-        request.enable_trace = trace
-        resp = self.handle_request(request, pql, timeout_ms=timeout_ms)
+        request_id = self._next_request_id()
+        # untraced queries share the NULL context — no span allocation
+        # anywhere on the handle path (the zero-overhead contract)
+        ctx = (
+            TraceContext(enabled=True, scope=self.name, trace_id=request_id)
+            if trace
+            else NULL_TRACE
+        )
+        resp: Optional[BrokerResponse] = None
+        request = None
+        with ctx.span("query", requestId=request_id, pql=pql[:200]):
+            t_parse = time.perf_counter()
+            try:
+                with ctx.span("parse"):
+                    request = parse_pql(pql)
+                    if debug_options:
+                        request.debug_options = dict(debug_options)
+                    request = optimize_request(request)
+            except PqlParseError as e:
+                # InvalidQueryOptionsError subclasses this; internal
+                # ValueErrors now propagate instead of masquerading as
+                # client parse errors (ADVICE r1)
+                resp = BrokerResponse(
+                    exceptions=[QueryException(ErrorCode.PQL_PARSING, str(e))]
+                )
+            parse_ms = (time.perf_counter() - t_parse) * 1000
+            self.metrics.timer("phase.parse").update(parse_ms)
+            if resp is None:
+                request.enable_trace = trace
+                resp = self.handle_request(
+                    request,
+                    pql,
+                    timeout_ms=timeout_ms,
+                    request_id=request_id,
+                    trace_ctx=ctx,
+                )
+        resp.request_id = request_id
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
         self.metrics.timer("queryTotal").update(resp.time_used_ms)
+        if ctx.enabled:
+            # merge the per-server span trees under their scatter
+            # attempts, next to this broker's own tree — ONE waterfall
+            scopes: Dict[str, Any] = {}
+            merge_scope(scopes, ctx.to_dict())
+            for attempt_id, server_trace in getattr(resp, "_server_traces", ()) or ():
+                merge_scope(scopes, server_trace, root_parent=attempt_id)
+            resp.trace_info = {"traceId": request_id, "scopes": scopes}
+        phases = dict(getattr(resp, "phase_ms", ()) or ())
+        phases["parse"] = round(parse_ms, 3)
+        if self.querylog.observe(
+            {
+                "requestId": request_id,
+                "pql": pql[:500],
+                "table": getattr(request, "table_name", None),
+                "timeUsedMs": round(resp.time_used_ms, 3),
+                "phasesMs": phases,
+                "partialResponse": resp.partial_response,
+                "numServersQueried": resp.num_servers_queried,
+                "numServersResponded": resp.num_servers_responded,
+                "numSegmentsUnserved": resp.num_segments_unserved,
+                "numRetries": resp.num_retries,
+                "numHedges": resp.num_hedges,
+                "exceptions": [e.error_code for e in resp.exceptions],
+                "traced": trace,
+            }
+        ):
+            self.metrics.meter("slowQueries").mark()
         return resp
 
     def handle_request(
@@ -206,7 +266,12 @@ class BrokerRequestHandler:
         request: BrokerRequest,
         pql: str,
         timeout_ms: Optional[float] = None,
+        request_id: Optional[str] = None,
+        trace_ctx: Optional[TraceContext] = None,
     ) -> BrokerResponse:
+        ctx = trace_ctx if trace_ctx is not None else NULL_TRACE
+        if request_id is None:
+            request_id = self._next_request_id()
         # per-query override (reference: timeoutMs request parameter,
         # InstanceRequest carries it); the broker's configured timeout
         # is the CEILING so a client can shorten but never extend.  A
@@ -217,7 +282,8 @@ class BrokerRequestHandler:
             timeout_ms = _parse_timeout(timeout_ms)
         except InvalidTimeoutError as e:
             return BrokerResponse(
-                exceptions=[QueryException(ErrorCode.QUERY_VALIDATION, str(e))]
+                exceptions=[QueryException(ErrorCode.QUERY_VALIDATION, str(e))],
+                request_id=request_id,
             )
         timeout_ms = (
             self.timeout_ms if timeout_ms is None else min(timeout_ms, self.timeout_ms)
@@ -231,58 +297,85 @@ class BrokerRequestHandler:
                         ErrorCode.TOO_MANY_REQUESTS,
                         f"query rate on table {table} exceeds the configured quota",
                     )
-                ]
+                ],
+                request_id=request_id,
             )
-        physical = self._physical_tables(table, pql)
-        if not physical:
-            return BrokerResponse(
-                exceptions=[
-                    QueryException(
-                        ErrorCode.BROKER_RESOURCE_MISSING, f"no routing for table {table}"
+        t_route = time.perf_counter()
+        try:
+            with ctx.span("route", table=table):
+                physical = self._physical_tables(table, pql)
+                if not physical:
+                    return BrokerResponse(
+                        exceptions=[
+                            QueryException(
+                                ErrorCode.BROKER_RESOURCE_MISSING, f"no routing for table {table}"
+                            )
+                        ],
+                        request_id=request_id,
                     )
-                ]
-            )
 
-        exceptions: List[QueryException] = []
-        batches: List[_Batch] = []
-        routing_gap = False
-        for phys_table, sub_pql in physical:
-            routing = self.routing.find_servers(phys_table, health=self.health)
-            if not routing:
-                # None (table unknown) or {} (external view refilling
-                # after a restart): either way this physical table is
-                # currently unanswerable — surface a retriable error
-                # rather than silently dropping it from the result
-                routing_gap = True
-                exceptions.append(
-                    QueryException(
-                        ErrorCode.BROKER_RESOURCE_MISSING,
-                        f"no servers currently serving table {phys_table}",
-                    )
-                )
-                continue
-            for server, segments in routing.items():
-                batches.append(
-                    _Batch(phys_table, sub_pql, segments, server, order=len(batches))
-                )
+                exceptions: List[QueryException] = []
+                batches: List[_Batch] = []
+                routing_gap = False
+                for phys_table, sub_pql in physical:
+                    routing = self.routing.find_servers(phys_table, health=self.health)
+                    if not routing:
+                        # None (table unknown) or {} (external view refilling
+                        # after a restart): either way this physical table is
+                        # currently unanswerable — surface a retriable error
+                        # rather than silently dropping it from the result
+                        routing_gap = True
+                        exceptions.append(
+                            QueryException(
+                                ErrorCode.BROKER_RESOURCE_MISSING,
+                                f"no servers currently serving table {phys_table}",
+                            )
+                        )
+                        continue
+                    for server, segments in routing.items():
+                        batches.append(
+                            _Batch(phys_table, sub_pql, segments, server, order=len(batches))
+                        )
+        finally:
+            # timed even on the no-routing return: a silent phase.route
+            # series during an external-view refill would hide exactly
+            # the period when route behavior changed
+            self.metrics.timer("phase.route").update(
+                (time.perf_counter() - t_route) * 1000
+            )
 
         t_sg = time.perf_counter()
-        parts, sg = self._scatter_gather(request, batches, timeout_ms, table)
+        with ctx.span("scatterGather", batches=len(batches)):
+            parts, sg = self._scatter_gather(
+                request, batches, timeout_ms, table, request_id, ctx
+            )
         exceptions.extend(sg["exceptions"])
-        self.metrics.timer("scatterGather").update((time.perf_counter() - t_sg) * 1000)
+        sg_ms = (time.perf_counter() - t_sg) * 1000
+        self.metrics.timer("scatterGather").update(sg_ms)
 
         t_red = time.perf_counter()
         for p in parts:
             for code, msg in p.exceptions:
                 exceptions.append(QueryException(code, msg))
-        resp = reduce_to_response(request, parts, exceptions)
-        self.metrics.timer("reduce").update((time.perf_counter() - t_red) * 1000)
+        with ctx.span("reduce", parts=len(parts)):
+            resp = reduce_to_response(request, parts, exceptions)
+        red_ms = (time.perf_counter() - t_red) * 1000
+        self.metrics.timer("reduce").update(red_ms)
+        resp.request_id = request_id
         resp.num_servers_queried = len(sg["servers_queried"])
         resp.num_servers_responded = len(sg["servers_responded"])
         resp.num_segments_unserved = len(sg["unserved"])
         resp.partial_response = bool(sg["unserved"]) or routing_gap
         resp.num_retries = sg["retries"]
         resp.num_hedges = sg["hedges"]
+        # side-channel for handle_pql: per-server trace trees keyed by
+        # the attempt span that carried them + the phase breakdown the
+        # slow-query log records (not serialized into the response)
+        resp._server_traces = sg["server_traces"]
+        resp.phase_ms = {
+            "scatterGather": round(sg_ms, 3),
+            "reduce": round(red_ms, 3),
+        }
         return resp
 
     # ------------------------------------------------------------------
@@ -311,7 +404,12 @@ class BrokerRequestHandler:
         batches: List[_Batch],
         timeout_ms: float,
         logical_table: str,
+        request_id: str,
+        ctx: TraceContext,
     ) -> Tuple[List[IntermediateResult], Dict[str, Any]]:
+        # request_id is REQUIRED: minting a fallback here would hand the
+        # servers a different id than the one echoed to the client,
+        # silently breaking the correlation contract
         deadline = time.monotonic() + timeout_ms / 1000.0
         # (batch.order, result): parts merge in BATCH CREATION order, not
         # completion order — ties in sort keys (and any other
@@ -332,11 +430,30 @@ class BrokerRequestHandler:
             # quota that amplification would starve first-try queries
             hedge_delay_s = None
 
-        # future -> (batch, server, is_hedge, sent_at)
-        pending: Dict[concurrent.futures.Future, Tuple[_Batch, str, bool, float]] = {}
+        # future -> (batch, server, is_hedge, sent_at, wall_sent_ms)
+        pending: Dict[concurrent.futures.Future, Tuple[_Batch, str, bool, float, float]] = {}
         all_batches: List[_Batch] = list(batches)
         delayed: List[Tuple[float, _Batch]] = []  # (fire_time, batch) backoff queue
         open_lineages = len(batches)  # batches neither completed nor superseded
+        # (attempt span id, {scope: spans}) per merged server reply —
+        # handle_pql re-parents each tree under its attempt span
+        server_traces: List[Tuple[Optional[str], Dict[str, Any]]] = []
+
+        def attempt_span(
+            batch: _Batch, server: str, hedge: bool, sent_at: float,
+            wall_sent: float, status: str, **tags
+        ) -> Optional[str]:
+            return ctx.add(
+                "serverAttempt",
+                (time.monotonic() - sent_at) * 1000.0,
+                start_ms=wall_sent,
+                server=server,
+                hedge=hedge,
+                reissues=batch.reissues,
+                segments=len(batch.segments),
+                status=status,
+                **tags,
+            )
 
         def submit(batch: _Batch, server: str, hedge: bool = False) -> None:
             now = time.monotonic()
@@ -370,11 +487,12 @@ class BrokerRequestHandler:
                 request.debug_options or None,
                 remaining_ms,
                 attempt_ms,
+                request_id,
             )
             batch.inflight += 1
             if not hedge:
                 batch.first_sent = now
-            pending[fut] = (batch, server, hedge, now)
+            pending[fut] = (batch, server, hedge, now, time.time() * 1000.0)
 
         def fail_batch(batch: _Batch) -> None:
             nonlocal open_lineages
@@ -425,6 +543,13 @@ class BrokerRequestHandler:
                 open_lineages += 1
                 retries += 1
                 self.metrics.meter("failoverRetries").mark()
+                ctx.event(
+                    "failover",
+                    fromServer=batch.server,
+                    toServer=server,
+                    segments=len(segments),
+                    reissues=child.reissues,
+                )
                 fire = time.monotonic() + self._backoff_s(child.reissues)
                 if fire >= deadline:
                     # no budget left to back off AND run the query; try
@@ -449,7 +574,7 @@ class BrokerRequestHandler:
             # arm hedges on stragglers
             next_hedge = math.inf
             if hedge_delay_s is not None:
-                for batch, server, hedge, _sent in list(pending.values()):
+                for batch, server, hedge, _sent, _wall in list(pending.values()):
                     if hedge or batch.done or batch.hedged:
                         continue
                     fire = batch.first_sent + hedge_delay_s
@@ -470,6 +595,10 @@ class BrokerRequestHandler:
                         batch.excluded.add(alt_server)
                         hedges += 1
                         self.metrics.meter("hedgesSent").mark()
+                        ctx.event(
+                            "hedge", fromServer=server, toServer=alt_server,
+                            segments=len(batch.segments),
+                        )
                         submit(batch, alt_server, hedge=True)
             if not pending:
                 # nothing inflight: sleep until the next backoff fire
@@ -484,7 +613,7 @@ class BrokerRequestHandler:
                 return_when=concurrent.futures.FIRST_COMPLETED,
             )
             for fut in done:
-                batch, server, hedge, sent_at = pending.pop(fut)
+                batch, server, hedge, sent_at, wall_sent = pending.pop(fut)
                 batch.inflight -= 1
                 try:
                     result = fut.result()
@@ -495,6 +624,10 @@ class BrokerRequestHandler:
                 except Exception as e:
                     self.health.record_failure(server)
                     logger.warning("server %s failed: %s", server, e)
+                    attempt_span(
+                        batch, server, hedge, sent_at, wall_sent,
+                        "error", error=f"{type(e).__name__}: {e}"[:200],
+                    )
                     batch.errors.append(
                         QueryException(
                             ErrorCode.BROKER_GATHER,
@@ -511,6 +644,10 @@ class BrokerRequestHandler:
                     # the server answered "not me, not now" (saturated /
                     # draining): treat as failover-able, not as data
                     self.health.record_failure(server)
+                    attempt_span(
+                        batch, server, hedge, sent_at, wall_sent,
+                        "refused", errorCode=result.exceptions[0][0],
+                    )
                     batch.errors.append(
                         QueryException(result.exceptions[0][0], result.exceptions[0][1])
                     )
@@ -525,7 +662,21 @@ class BrokerRequestHandler:
                     (time.monotonic() - sent_at) * 1000.0
                 )
                 if batch.done:
-                    continue  # hedge race loser: first reply already merged
+                    # hedge race loser: first reply already merged; the
+                    # attempt still shows on the waterfall as the slower
+                    # twin, but its data (and trace) is discarded
+                    attempt_span(
+                        batch, server, hedge, sent_at, wall_sent, "hedgeLoser"
+                    )
+                    continue
+                aid = attempt_span(batch, server, hedge, sent_at, wall_sent, "ok")
+                if result.trace:
+                    # snapshot: reduce later merges parts IN PLACE, which
+                    # would fold every later part's spans into the first
+                    # reply's trace dict (aliased here)
+                    server_traces.append(
+                        (aid, {k: list(v) for k, v in result.trace.items()})
+                    )
                 batch.done = True
                 open_lineages -= 1
                 servers_responded.add(server)
@@ -567,14 +718,15 @@ class BrokerRequestHandler:
                         self.metrics.meter("failoverRetries").mark()
                         submit(child, alt_server)
                 # best effort: free the loser's queued twin if it never started
-                for other, (ob, _osrv, _oh, _osent) in list(pending.items()):
+                for other, (ob, _osrv, _oh, _osent, _owall) in list(pending.items()):
                     if ob is batch:
                         other.cancel()
 
         # deadline expired (or queue drained): account every lineage that
         # never completed
-        for fut, (pbatch, pserver, _h, _s) in pending.items():
+        for fut, (pbatch, pserver, _h, _sent, _wall) in pending.items():
             if not pbatch.done and not fut.cancel():
+                attempt_span(pbatch, pserver, _h, _sent, _wall, "timeout")
                 # an attempt for a still-open lineage ran past the
                 # deadline: the circuit breaker must learn about hung
                 # servers too, or a blackholed replica would stay CLOSED
@@ -604,6 +756,7 @@ class BrokerRequestHandler:
             "servers_responded": servers_responded,
             "retries": retries,
             "hedges": hedges,
+            "server_traces": server_traces,
         }
 
     # ------------------------------------------------------------------
@@ -672,7 +825,8 @@ class BrokerRequestHandler:
         trace: bool,
         debug_options: Optional[Dict[str, str]],
         timeout_ms: float,
-        attempt_timeout_ms: Optional[float] = None,
+        attempt_timeout_ms: Optional[float],
+        request_id: str,
     ) -> IntermediateResult:
         # timeout_ms is the REMAINING deadline budget at (re-)issue time,
         # already clamped by handle_request — the server's scheduler pins
@@ -684,7 +838,7 @@ class BrokerRequestHandler:
         # worst, not an early server-side timeout).
         address = self.server_addresses[server]
         payload = serialize_instance_request(
-            self._next_id(),
+            request_id,
             pql,
             table,
             segments,
@@ -759,6 +913,14 @@ class BrokerHttpServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _respond_text(self, text: str, status: int = 200) -> None:
+                body = text.encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _invalid_timeout(self, e: InvalidTimeoutError) -> None:
                 self._respond(
                     BrokerResponse(
@@ -772,7 +934,12 @@ class BrokerHttpServer:
                     if url.path == "/health":
                         return self._respond({"status": "ok"})
                     if url.path == "/metrics":
+                        # Prometheus text exposition (scrape target)
+                        return self._respond_text(prometheus_text(broker.metrics))
+                    if url.path == "/debug/metrics":
                         return self._respond(broker.metrics.snapshot())
+                    if url.path == "/debug/queries":
+                        return self._respond(broker.querylog.snapshot())
                     if url.path == "/serverhealth":
                         return self._respond(broker.health.snapshot())
                     return self._respond({"error": "not found"}, 404)

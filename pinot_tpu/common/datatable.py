@@ -324,7 +324,7 @@ def deserialize_result(data: bytes) -> IntermediateResult:
 
 
 def serialize_instance_request(
-    request_id: int,
+    request_id,
     pql: str,
     table: str,
     segments: List[str],
@@ -332,8 +332,12 @@ def serialize_instance_request(
     trace: bool = False,
     debug_options: Optional[Dict[str, str]] = None,
 ) -> bytes:
+    # request_id is the broker-assigned globally-unique id (a
+    # broker-name-prefixed string, e.g. "broker0-3fa9c1-17"); it rides
+    # the wire so server-side traces and logs correlate with the
+    # broker's response/slow-query log.  Legacy integer ids stringify.
     w = _Writer()
-    w.i64(request_id)
+    w.string(str(request_id))
     w.string(pql)
     w.string(table)
     w.value(list(segments))
@@ -348,7 +352,7 @@ def serialize_instance_request(
 def deserialize_instance_request(data: bytes) -> Dict[str, Any]:
     r = _Reader(data)
     out = {
-        "requestId": r.i64(),
+        "requestId": r.string(),
         "pql": r.string(),
         "table": r.string(),
         "segments": list(r.value()),

@@ -124,9 +124,14 @@ class BrokerResponse:
     num_hedges: int = 0
     time_used_ms: float = 0.0
     trace_info: Dict[str, Any] = field(default_factory=dict)
+    # broker-assigned globally-unique id echoed to the client so a
+    # response correlates with traces and the slow-query log
+    request_id: str = ""
 
     def to_json(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
+        if self.request_id:
+            d["requestId"] = self.request_id
         if self.selection_results is not None:
             d["selectionResults"] = self.selection_results.to_json()
         if self.aggregation_results is not None:

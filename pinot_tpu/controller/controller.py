@@ -27,6 +27,7 @@ from pinot_tpu.controller.managers import (
 from pinot_tpu.controller.resource_manager import ClusterResourceManager
 from pinot_tpu.controller.store import SegmentStore
 from pinot_tpu.segment.immutable import ImmutableSegment
+from pinot_tpu.utils.metrics import ControllerMetrics, prometheus_text
 
 logger = logging.getLogger(__name__)
 
@@ -38,6 +39,12 @@ class Controller:
         self.property_store = PropertyStore(os.path.join(data_dir, "property_store"))
         self.resources = ClusterResourceManager(property_store=self.property_store)
         self.store = SegmentStore(os.path.join(data_dir, "segments"))
+        self.metrics = ControllerMetrics("controller")
+        # pre-register the control-plane series so /metrics exposes
+        # them at zero from process start
+        for m in ("instanceRegistrations", "heartbeats", "instancesMarkedDead",
+                  "transitionAcks", "clusterStatePolls", "segmentUploads"):
+            self.metrics.meter(m)
         self.retention_manager = RetentionManager(self.resources, self.store)
         self.validation_manager = ValidationManager(self.resources)
         self.status_checker = SegmentStatusChecker(self.resources)
@@ -50,7 +57,7 @@ class Controller:
         from pinot_tpu.controller.network import ParticipantGateway
 
         # remote-instance control plane (started by ControllerHttpServer)
-        self.gateway = ParticipantGateway(self.resources)
+        self.gateway = ParticipantGateway(self.resources, metrics=self.metrics)
         self.gateway.on_server_available = (
             self.realtime_manager.ensure_consuming_segments
         )
@@ -202,6 +209,7 @@ class Controller:
                 path = self.store.save_file(
                     table_physical, segment.segment_name, staged
                 )
+        self.metrics.meter("segmentUploads").mark()
         return self.resources.add_segment(
             table_physical,
             segment.metadata,
@@ -227,6 +235,7 @@ class Controller:
             segment = read_segment(td)
             self._check_storage_quota(table_physical, segment.segment_name, len(data))
             stored = self.store.save_file(table_physical, segment.segment_name, path)
+        self.metrics.meter("segmentUploads").mark()
         return self.resources.add_segment(
             table_physical,
             segment.metadata,
@@ -241,10 +250,80 @@ class Controller:
     def delete_table(self, table_physical: str) -> None:
         self.resources.delete_table(table_physical)
 
+    # -- observability --------------------------------------------------
+    def _refresh_gauges(self) -> None:
+        insts = self.resources.instances_snapshot()
+        self.metrics.gauge("aliveServers").set(
+            sum(1 for i in insts if i.role == "server" and i.alive)
+        )
+        self.metrics.gauge("aliveBrokers").set(
+            sum(1 for i in insts if i.role == "broker" and i.alive)
+        )
+        self.metrics.gauge("deadInstances").set(sum(1 for i in insts if not i.alive))
+        self.metrics.gauge("tables").set(len(self.resources.tables()))
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Controller-side registries as JSON (``/debug/metrics``):
+        control-plane traffic plus the validation/status-checker
+        per-table health gauges."""
+        self._refresh_gauges()
+        return {
+            "controller": self.metrics.snapshot(),
+            "validation": self.validation_manager.metrics.snapshot(),
+            "segmentStatus": self.status_checker.metrics.snapshot(),
+        }
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition of every controller registry."""
+        self._refresh_gauges()
+        return prometheus_text(
+            [
+                self.metrics,
+                self.validation_manager.metrics,
+                self.status_checker.metrics,
+            ]
+        )
+
     def stop(self) -> None:
         self.retention_manager.stop()
         self.validation_manager.stop()
         self.status_checker.stop()
+
+
+def collect_cluster_metrics(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, Any]:
+    """Cluster-wide metrics snapshot: the controller's own registries
+    plus ``/debug/metrics`` fetched from every alive instance that
+    advertises an HTTP surface (brokers' query port, servers' admin
+    port).  Unreachable instances degrade to an ``error`` entry instead
+    of failing the aggregate."""
+    import concurrent.futures
+    import urllib.error
+    import urllib.request
+
+    def fetch(inst) -> Dict[str, Any]:
+        entry: Dict[str, Any] = {"role": inst.role, "url": inst.url}
+        try:
+            with urllib.request.urlopen(
+                inst.url.rstrip("/") + "/debug/metrics", timeout=timeout_s
+            ) as r:
+                entry["metrics"] = json.loads(r.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            entry["error"] = str(e)
+        return entry
+
+    out: Dict[str, Any] = {"controller": ctrl.metrics_snapshot(), "instances": {}}
+    targets = [
+        i for i in ctrl.resources.instances_snapshot() if i.alive and i.url
+    ]
+    if targets:
+        # concurrent fetches: a few blackholed instances must cost ONE
+        # timeout, not one each, or the dashboard page crawls
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(16, len(targets))
+        ) as pool:
+            for inst, entry in zip(targets, pool.map(fetch, targets)):
+                out["instances"][inst.name] = entry
+    return out
 
 
 def _split_path(path: str) -> Optional[List[str]]:
@@ -326,6 +405,14 @@ class ControllerHttpServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _respond_text(self, text: str) -> None:
+                body = text.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _respond_bytes(self, data: bytes) -> None:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/octet-stream")
@@ -354,6 +441,17 @@ class ControllerHttpServer:
                         return self._respond(_proxy_pql(ctrl, pql, trace))
                     if parts == ["health"]:
                         return self._respond({"status": "ok"})
+                    if parts == ["metrics"]:
+                        # Prometheus text exposition (scrape target)
+                        return self._respond_text(ctrl.metrics_text())
+                    if parts == ["debug", "metrics"]:
+                        return self._respond(ctrl.metrics_snapshot())
+                    if parts == ["debug", "clustermetrics"]:
+                        return self._respond(collect_cluster_metrics(ctrl))
+                    if parts == ["dashboard", "metrics"]:
+                        return self._respond_html(
+                            dashboard.render_metrics(ctrl, collect_cluster_metrics(ctrl))
+                        )
                     if parts == ["clusterstate"]:
                         qs = parse_qs(url.query)
                         if_newer = int((qs.get("ifNewer") or ["-1"])[0])

@@ -38,6 +38,7 @@ _STYLE = """
 _NAV = (
     "<nav><a href='/dashboard'>Cluster</a>"
     "<a href='/dashboard/query'>Query console</a>"
+    "<a href='/dashboard/metrics'>Metrics</a>"
     "<a href='/clusterstate'>Raw state (JSON)</a></nav>"
 )
 
@@ -156,6 +157,72 @@ def render_table(ctrl, table: str) -> str:
         )
     body.append("</table>")
     return _page(table, body)
+
+
+def _metrics_rows(body: List[str], snap: dict) -> None:
+    """One registry snapshot -> meter/timer/gauge rows."""
+    meters = snap.get("meters") or {}
+    timers = snap.get("timers") or {}
+    gauges = snap.get("gauges") or {}
+    if not (meters or timers or gauges):
+        return
+    body.append(
+        "<table><tr><th>metric</th><th>kind</th><th>count</th>"
+        "<th>rate 1m</th><th>mean ms</th><th>p95 ms</th><th>value</th></tr>"
+    )
+    for name in sorted(meters):
+        m = meters[name]
+        body.append(
+            f"<tr><td>{_esc(name)}</td><td>meter</td><td>{m.get('count')}</td>"
+            f"<td>{m.get('rate1m', m.get('rate'))}</td><td></td><td></td><td></td></tr>"
+        )
+    for name in sorted(timers):
+        t = timers[name]
+        body.append(
+            f"<tr><td>{_esc(name)}</td><td>timer</td><td>{t.get('count')}</td>"
+            f"<td></td><td>{t.get('meanMs')}</td><td>{t.get('p95Ms')}</td><td></td></tr>"
+        )
+    for name in sorted(gauges):
+        body.append(
+            f"<tr><td>{_esc(name)}</td><td>gauge</td><td></td><td></td>"
+            f"<td></td><td></td><td>{_esc(gauges[name])}</td></tr>"
+        )
+    body.append("</table>")
+
+
+def render_metrics(ctrl, cluster_metrics: dict) -> str:
+    """Cluster-wide metrics page: the controller's own registries plus
+    the ``/debug/metrics`` snapshot of every alive instance that
+    advertises an HTTP surface (``collect_cluster_metrics``)."""
+    body = ["<h1>Cluster metrics</h1>"]
+    body.append(
+        "<p>Prometheus exposition: controller <a href='/metrics'>/metrics</a>; "
+        "every broker and server serves its own <code>/metrics</code> and "
+        "<code>/debug/metrics</code>. Raw aggregate: "
+        "<a href='/debug/clustermetrics'>/debug/clustermetrics</a>.</p>"
+    )
+    for scope, snap in (cluster_metrics.get("controller") or {}).items():
+        body.append(f"<h2>controller · {_esc(scope)}</h2>")
+        _metrics_rows(body, snap or {})
+    for name, entry in sorted((cluster_metrics.get("instances") or {}).items()):
+        body.append(
+            f"<h2>{_esc(entry.get('role', '?'))} · {_esc(name)}</h2>"
+        )
+        if entry.get("error"):
+            body.append(f"<p class='bad'>unreachable: {_esc(entry['error'])}</p>")
+            continue
+        payload = entry.get("metrics") or {}
+        # broker /debug/metrics is a bare registry snapshot; the server
+        # one nests it under "metrics" next to scheduler/lane state
+        snap = payload.get("metrics") if isinstance(payload.get("metrics"), dict) else payload
+        _metrics_rows(body, snap or {})
+        heal = payload.get("selfHealing")
+        if heal:
+            body.append("<table><tr><th>selfHealing</th><th>count</th></tr>")
+            for k in sorted(heal):
+                body.append(f"<tr><td>{_esc(k)}</td><td>{_esc(heal[k])}</td></tr>")
+            body.append("</table>")
+    return _page("Cluster metrics", body)
 
 
 def render_query_console() -> str:

@@ -157,9 +157,12 @@ class ParticipantGateway:
         resources: ClusterResourceManager,
         heartbeat_timeout_s: float = 6.0,
         check_interval_s: float = 1.0,
+        metrics=None,
     ) -> None:
         self.resources = resources
         self.board = MessageBoard()
+        # optional ControllerMetrics: control-plane traffic counters
+        self.metrics = metrics
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self._check_interval_s = check_interval_s
         self._heartbeats: Dict[str, float] = {}
@@ -196,6 +199,8 @@ class ParticipantGateway:
                 inst = self.resources.instances.get(name)
                 if inst is not None and inst.alive:
                     logger.warning("instance %s missed heartbeats; marking dead", name)
+                    if self.metrics is not None:
+                        self.metrics.meter("instancesMarkedDead").mark()
                     self.board.clear(name)
                     # one code path: this liveness flip rewrites external
                     # views (version bump -> remote brokers refetch) AND
@@ -208,6 +213,8 @@ class ParticipantGateway:
     def register(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         name = payload["name"]
         role = payload.get("role", "server")
+        if self.metrics is not None:
+            self.metrics.meter("instanceRegistrations").mark()
         if payload.get("tags"):
             tags = set(payload["tags"])
         else:
@@ -242,6 +249,8 @@ class ParticipantGateway:
         }
 
     def heartbeat(self, name: str) -> Dict[str, Any]:
+        if self.metrics is not None:
+            self.metrics.meter("heartbeats").mark()
         inst = self.resources.instances.get(name)
         if inst is None:
             return {"error": "unknown instance", "reregister": True}
@@ -273,6 +282,8 @@ class ParticipantGateway:
         return self.board.fetch(name)
 
     def ack(self, name: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        if self.metrics is not None:
+            self.metrics.meter("transitionAcks").mark()
         self.board.remove(name, payload.get("msgId"))
         state = payload["state"] if payload.get("ok", True) else ERROR
         self.resources.report_state(
@@ -284,6 +295,8 @@ class ParticipantGateway:
     def cluster_state(self) -> Dict[str, Any]:
         """Versioned snapshot remote brokers poll to rebuild routing,
         server addresses, quotas, and hybrid time boundaries."""
+        if self.metrics is not None:
+            self.metrics.meter("clusterStatePolls").mark()
         res = self.resources
         with res._lock:
             # version captured BEFORE the snapshot: a concurrent bump then

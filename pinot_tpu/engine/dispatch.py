@@ -182,12 +182,15 @@ class LaneClosedError(RuntimeError):
 
 class LaneTicket:
     """One waiter's slot: the submitting worker blocks on ``result`` and
-    resumes FINALIZE when the lane delivers outputs (or an error)."""
+    resumes FINALIZE when the lane delivers outputs (or an error).
+    ``coalesced`` marks a ticket that attached to an identical in-flight
+    dispatch instead of enqueueing its own (trace/metrics attribution)."""
 
-    __slots__ = ("deadline", "_event", "_value", "_error")
+    __slots__ = ("deadline", "coalesced", "_event", "_value", "_error")
 
     def __init__(self, deadline: Optional[float]) -> None:
         self.deadline = deadline
+        self.coalesced = False
         self._event = threading.Event()
         self._value: Any = None
         self._error: Optional[BaseException] = None
@@ -279,6 +282,16 @@ class DeviceLane:
         self.device_failure_count = 0
         self.restart_count = 0
         self.stale_completions = 0
+        if metrics is not None:
+            # pre-register the lane series (depth/inflight gauges,
+            # dispatch/coalesce/shed/restart meters) so /metrics shows
+            # them at zero before the first device query
+            for name in ("lane.dispatches", "lane.coalesced", "lane.shed",
+                         "lane.deviceFailures", "lane.restarts"):
+                metrics.meter(name)
+            metrics.gauge("lane.depth").set(0)
+            metrics.gauge("lane.open").set(0)
+            metrics.gauge("lane.inflight").set(0)
         _all_lanes.add(self)
 
     # -- producer side -------------------------------------------------
@@ -305,12 +318,14 @@ class DeviceLane:
                 still = d.error is None and self._still_pending(d)
                 if still:
                     self._hit()
+                    ticket.coalesced = True
                     ticket._deliver(value=d.value)
                     return ticket
                 self._close_open(d)
                 d = None
             if d is not None:
                 d.waiters.append(ticket)
+                ticket.coalesced = True
                 self._hit()
             else:
                 d = _Dispatch(key, launch, pending, plan_digest)
@@ -447,6 +462,11 @@ class DeviceLane:
     def _set_depth(self) -> None:
         if self.metrics is not None:
             self.metrics.gauge("lane.depth").set(len(self._queue))
+            self.metrics.gauge("lane.open").set(len(self._open))
+
+    def _set_inflight(self, n: int) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge("lane.inflight").set(n)
 
     def _still_pending(self, d: _Dispatch) -> bool:
         if d.pending is None:
@@ -525,6 +545,7 @@ class DeviceLane:
             # launch OUTSIDE the lock: first-call compiles can take
             # seconds and coalescing submits must not block behind them
             t0 = time.perf_counter()
+            self._set_inflight(1)
             error: Optional[BaseException] = None
             value: Any = None
             try:
@@ -538,6 +559,8 @@ class DeviceLane:
                 # a dead lane thread would strand every waiter and (with
                 # self._thread non-None) never respawn
                 error = e
+            finally:
+                self._set_inflight(0)
             with self._cv:
                 stale = gen != self._generation
                 if not stale and self._inflight is not None and self._inflight[0] is d:

@@ -162,7 +162,19 @@ class QueryExecutor:
 
     def __init__(self, mesh=None, metrics=None, lane=None) -> None:
         self.mesh = mesh
-        self.metrics = metrics  # optional MetricsRegistry: per-phase timers
+        if metrics is None:
+            # the registry is the single source of truth for phase
+            # timers AND the self-healing counters (heal.*), so a
+            # standalone executor gets a private one instead of
+            # branching on None at every mark
+            from pinot_tpu.utils.metrics import ServerMetrics
+
+            metrics = ServerMetrics("executor")
+        self.metrics = metrics  # MetricsRegistry: per-phase timers + heal.*
+        # pre-register the self-healing series so /metrics exposes them
+        # at zero from process start (a scrape gap is not "no failures")
+        for name in self._HEAL_COUNTERS:
+            metrics.meter(f"heal.{name}")
         # three-stage serving pipeline (engine/dispatch.py): with a
         # DeviceLane set, kernel launches leave this worker thread and
         # coalesce with identical in-flight dispatches; without one,
@@ -182,14 +194,10 @@ class QueryExecutor:
         # self-healing state: device failures fail over to the host
         # path, and a (plan digest, segment set) that keeps failing on
         # device is quarantined so repeat offenders skip the device
-        # entirely (engine/dispatch.py classification contract)
+        # entirely (engine/dispatch.py classification contract).
+        # Counters live in the metrics registry (heal.*) — ONE source
+        # of truth for status(), /metrics, and /debug/metrics.
         self._heal_lock = threading.Lock()
-        self._healing = {
-            "deviceFailures": 0,
-            "deviceRetries": 0,
-            "hostFailovers": 0,
-            "poisonSkips": 0,
-        }
         # poison key -> (reason, expiry): quarantine entries carry a TTL
         # (PINOT_TPU_POISON_TTL_S, default 300s) so a plan poisoned by a
         # transient burst is eventually re-admitted to the device — the
@@ -202,16 +210,23 @@ class QueryExecutor:
         self._poison_ttl_s = float(_os.environ.get("PINOT_TPU_POISON_TTL_S", "300"))
 
     # -- self-healing bookkeeping --------------------------------------
-    def _heal_mark(self, name: str) -> None:
-        with self._heal_lock:
-            self._healing[name] += 1
-        if self.metrics is not None:
-            self.metrics.meter(f"heal.{name}").mark()
+    _HEAL_COUNTERS = ("deviceFailures", "deviceRetries", "hostFailovers", "poisonSkips")
+
+    def _heal_mark(self, name: str, **tags) -> None:
+        self.metrics.meter(f"heal.{name}").mark()
+        from pinot_tpu.utils.trace import current_trace
+
+        tr = current_trace()
+        if tr is not None and tr.enabled:
+            tr.event(name, **tags)
 
     def healing_stats(self) -> Dict[str, int]:
         now = time.monotonic()
+        stats = {
+            name: self.metrics.meter(f"heal.{name}").count
+            for name in self._HEAL_COUNTERS
+        }
         with self._heal_lock:
-            stats = dict(self._healing)
             stats["poisonedPlans"] = sum(
                 1 for _, exp in self._poisoned.values() if now < exp
             )
@@ -241,12 +256,18 @@ class QueryExecutor:
         with self._heal_lock:
             self._poisoned.clear()
 
-    def _phase(self, name: str, t0: float) -> float:
+    def _phase(self, name: str, t0: float, **tags) -> float:
         """Record a ServerQueryPhase-style timer (SURVEY §5: pruning /
-        planBuild / planExec phases); returns a fresh t0."""
+        planBuild / planExec phases) AND, when the request is traced, a
+        span on the current trace tree; returns a fresh t0."""
         now = time.perf_counter()
-        if self.metrics is not None:
-            self.metrics.timer(f"phase.{name}").update((now - t0) * 1000)
+        ms = (now - t0) * 1000
+        self.metrics.timer(f"phase.{name}").update(ms)
+        from pinot_tpu.utils.trace import current_trace
+
+        tr = current_trace()
+        if tr is not None and tr.enabled:
+            tr.add(name, ms, **tags)
         return now
 
     def execute(
@@ -368,7 +389,9 @@ class QueryExecutor:
                     # re-running the host path could only fail again
                     raise
                 last = classify_device_error(e)
-                self._heal_mark("deviceFailures")
+                self._heal_mark(
+                    "deviceFailures", retryable=last.retryable, error=str(last)[:200]
+                )
         # device exhausted: quarantine (when the section got far enough
         # to know its plan) and transparently fail over.  Coalesced
         # waiters each land here and each finalize from the host.
@@ -376,7 +399,7 @@ class QueryExecutor:
 
         if poison_ref.get("key") is not None:
             self._poison(poison_ref["key"], str(last))
-        self._heal_mark("hostFailovers")
+        self._heal_mark("hostFailovers", reason=str(last)[:200])
         t0 = time.perf_counter()
         res = execute_host(live, ctx, request, total_docs, sel_columns)
         self._phase("hostFailover", t0)
@@ -789,7 +812,9 @@ class QueryExecutor:
                 plan_digest=pdigest,
             )
             fetch, handle = ticket.result(deadline)
-            t0 = self._phase("laneWait", t0)  # queue + coalesce wait only
+            # queue + coalesce wait only; the coalesced tag marks a
+            # query that rode an identical in-flight dispatch
+            t0 = self._phase("laneWait", t0, coalesced=ticket.coalesced)
         outs = fetch(handle) if fetch is not None else handle
         outs = {
             k: np.asarray(v)

@@ -258,7 +258,14 @@ class IntermediateResult:
         self.num_segments_queried += other.num_segments_queried
         self.num_entries_scanned_in_filter += other.num_entries_scanned_in_filter
         self.num_entries_scanned_post_filter += other.num_entries_scanned_post_filter
-        self.trace.update(other.trace)
+        # trace values are span LISTS keyed by scope: two partials from
+        # the same scope concatenate instead of clobbering each other
+        for scope, spans in other.trace.items():
+            mine = self.trace.get(scope)
+            if isinstance(mine, list) and isinstance(spans, list):
+                self.trace[scope] = mine + spans
+            else:
+                self.trace[scope] = spans
         if other.aggregations is not None:
             if self.aggregations is None:
                 self.aggregations = other.aggregations

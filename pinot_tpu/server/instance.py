@@ -32,8 +32,13 @@ from pinot_tpu.server.scheduler import (
     SchedulerSaturatedError,
     SchedulerShutdownError,
 )
-from pinot_tpu.utils.metrics import ServerMetrics
-from pinot_tpu.utils.trace import TraceContext
+from pinot_tpu.utils.metrics import ServerMetrics, prometheus_text
+from pinot_tpu.utils.trace import (
+    NULL_TRACE,
+    TraceContext,
+    reset_current,
+    set_current,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -74,7 +79,14 @@ class ServerInstance:
             else None
         )
         self.executor = QueryExecutor(mesh=mesh, metrics=self.metrics, lane=self.lane)
-        self.scheduler = QueryScheduler(num_workers=num_workers, max_pending=max_pending)
+        self.scheduler = QueryScheduler(
+            num_workers=num_workers, max_pending=max_pending, metrics=self.metrics
+        )
+        # pre-register the serving/integrity series (zero > absent on a
+        # scrape); lane.* and heal.* register in their constructors
+        for m in ("queries", "queriesShed", "queriesAbandoned",
+                  "segmentsMissedServing", "crcFailures", "quarantinedSegments"):
+            self.metrics.meter(m)
         self._table_schemas: dict = {}  # raw table name -> Schema
 
     # -- segment lifecycle -------------------------------------------
@@ -170,9 +182,10 @@ class ServerInstance:
         # at worker-dequeue time, the device lane at launch-dequeue time
         timeout_s = req["timeoutMs"] / 1000.0
         deadline = time.monotonic() + timeout_s
+        t_enqueue = time.monotonic()
         try:
             result = self.scheduler.run(
-                lambda: self._process(req, deadline),
+                lambda: self._process(req, deadline, t_enqueue),
                 timeout_s=timeout_s,
                 deadline=deadline,
             )
@@ -234,6 +247,13 @@ class ServerInstance:
             "metrics": self.metrics.snapshot(),
         }
 
+    def metrics_text(self) -> str:
+        """Prometheus exposition of this server's registry (served at
+        ``/metrics`` by the admin HTTP surface).  The lane/scheduler
+        gauges update on activity; self-healing counters live in the
+        same registry (heal.*, crcFailures, quarantinedSegments)."""
+        return prometheus_text(self.metrics)
+
     def shutdown(self) -> None:
         """Idempotent: drain-stop the scheduler and close the device
         lane (queued lane waiters fail fast with LaneClosedError)."""
@@ -241,40 +261,81 @@ class ServerInstance:
         if self.lane is not None:
             self.lane.close()
 
-    def _process(self, req: dict, deadline: Optional[float] = None) -> IntermediateResult:
+    def _process(
+        self,
+        req: dict,
+        deadline: Optional[float] = None,
+        t_enqueue: Optional[float] = None,
+    ) -> IntermediateResult:
         request = parse_pql(req["pql"])
         request.debug_options = dict(req.get("debugOptions") or {})
         request = optimize_request(request)
         request.enable_trace = bool(req.get("trace"))
-        trace = TraceContext(enabled=request.enable_trace, scope=self.name)
-        tdm = self.data_manager.table(req["table"])
-        if tdm is None:
-            return IntermediateResult(
-                exceptions=[
-                    (ErrorCode.SERVER_SCHEDULER_DOWN, f"table {req['table']} not on server {self.name}")
-                ]
+        # untraced requests share the NULL context: no span allocation
+        # anywhere on this path (the zero-overhead contract)
+        if request.enable_trace:
+            trace = TraceContext(
+                enabled=True, scope=self.name, trace_id=str(req.get("requestId") or "")
             )
-        names: Optional[Sequence[str]] = req["segments"] or None
-        acquired = tdm.acquire_segments(names)
+        else:
+            trace = NULL_TRACE
+        token = set_current(trace if trace.enabled else None)
         try:
-            # honest degradation: requested segments this server cannot
-            # serve right now (dropped, quarantined pending re-fetch…)
-            # are REPORTED, not silently skipped — the broker re-covers
-            # them on a replica or flips partialResponse /
-            # numSegmentsUnserved for the client
-            missing: List[str] = []
-            if names:
-                held = {a.name for a in acquired}
-                missing = [n for n in names if n not in held]
-                if missing:
-                    self.metrics.meter("segmentsMissedServing").mark(len(missing))
-            with trace.span("planAndExecute"):
-                result = self.executor.execute(
-                    [a.query_view() for a in acquired], request, deadline=deadline
-                )
-            result.unserved_segments = missing
+            return self._process_traced(req, request, trace, deadline, t_enqueue)
         finally:
-            tdm.release_segments(acquired)
+            reset_current(token)
+
+    def _process_traced(
+        self,
+        req: dict,
+        request,
+        trace: TraceContext,
+        deadline: Optional[float],
+        t_enqueue: Optional[float],
+    ) -> IntermediateResult:
+        with trace.span(
+            "serverQuery", requestId=str(req.get("requestId") or ""), server=self.name
+        ):
+            if t_enqueue is not None:
+                # FCFS queue wait, child of serverQuery: the scheduler
+                # phase of the waterfall (metrics twin lives in
+                # QueryScheduler.run as phase.schedulerWait)
+                trace.add("queueWait", (time.monotonic() - t_enqueue) * 1000.0)
+            tdm = self.data_manager.table(req["table"])
+            if tdm is None:
+                # fall through to the trace attach below: the span tree
+                # for a misrouted query is exactly what an operator
+                # debugging stale routing needs to see
+                result = IntermediateResult(
+                    exceptions=[
+                        (ErrorCode.SERVER_SCHEDULER_DOWN, f"table {req['table']} not on server {self.name}")
+                    ]
+                )
+                trace.event("tableNotHosted", table=req["table"])
+                if trace.enabled:
+                    result.trace.update(trace.to_dict())
+                return result
+            names: Optional[Sequence[str]] = req["segments"] or None
+            acquired = tdm.acquire_segments(names)
+            try:
+                # honest degradation: requested segments this server cannot
+                # serve right now (dropped, quarantined pending re-fetch…)
+                # are REPORTED, not silently skipped — the broker re-covers
+                # them on a replica or flips partialResponse /
+                # numSegmentsUnserved for the client
+                missing: List[str] = []
+                if names:
+                    held = {a.name for a in acquired}
+                    missing = [n for n in names if n not in held]
+                    if missing:
+                        self.metrics.meter("segmentsMissedServing").mark(len(missing))
+                with trace.span("planAndExecute", segments=len(acquired)):
+                    result = self.executor.execute(
+                        [a.query_view() for a in acquired], request, deadline=deadline
+                    )
+                result.unserved_segments = missing
+            finally:
+                tdm.release_segments(acquired)
         if trace.enabled:
             result.trace.update(trace.to_dict())
         return result

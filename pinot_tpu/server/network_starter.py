@@ -22,6 +22,7 @@ import logging
 import os
 import threading
 import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from pinot_tpu.common.schema import Schema
@@ -38,6 +39,62 @@ from pinot_tpu.server.instance import ServerInstance
 from pinot_tpu.transport.tcp import TcpServer
 
 logger = logging.getLogger(__name__)
+
+
+class ServerAdminHttpServer:
+    """Server-side observability HTTP surface (the reference server's
+    admin-application analog): ``/health``, Prometheus text at
+    ``/metrics``, and the full status/metrics JSON at
+    ``/debug/metrics``.  The query data plane stays on the framed TCP
+    socket; this port is scrape/ops-only.  The networked starter
+    advertises it to the controller as the instance URL so the
+    dashboard can aggregate a cluster-wide metrics snapshot."""
+
+    def __init__(self, server: ServerInstance, host: str = "127.0.0.1", port: int = 0):
+        inst = server
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, body: bytes, ctype: str, status: int = 200) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    return self._send(b'{"status": "ok"}', "application/json")
+                if self.path == "/metrics":
+                    return self._send(
+                        inst.metrics_text().encode("utf-8"),
+                        "text/plain; version=0.0.4",
+                    )
+                if self.path == "/debug/metrics":
+                    return self._send(
+                        json.dumps(inst.status()).encode("utf-8"),
+                        "application/json",
+                    )
+                self._send(b'{"error": "not found"}', "application/json", 404)
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
 
 
 class RemoteConsumer:
@@ -357,11 +414,14 @@ class NetworkedServerStarter:
         data_dir: Optional[str] = None,
         heartbeat_interval_s: float = 1.0,
         poll_interval_s: float = 0.3,
+        admin_port: int = 0,
     ) -> None:
         self.controller_url = controller_url.rstrip("/")
         self.name = name
         self.server = ServerInstance(name)
         self.tcp = TcpServer(self.server.handle_request, host=host, port=port)
+        # ops/scrape surface: /health, /metrics (Prometheus), /debug/metrics
+        self.admin = ServerAdminHttpServer(self.server, host=host, port=admin_port)
         self.data_dir = data_dir
         self.heartbeat_interval_s = heartbeat_interval_s
         self.poll_interval_s = poll_interval_s
@@ -424,12 +484,16 @@ class NetworkedServerStarter:
                 "lazily on the first query"
             )
         self.tcp.start()
+        self.admin.start()
         self._post(
             "/instances",
             {
                 "name": self.name,
                 "role": "server",
                 "addr": [self.tcp.address[0], self.tcp.address[1]],
+                # admin URL rides the registration so the controller
+                # dashboard can aggregate this server's /debug/metrics
+                "url": self.admin.url,
             },
         )
         for fn in (self._heartbeat_loop, self._message_loop):
@@ -444,6 +508,7 @@ class NetworkedServerStarter:
         for t in self._threads:
             t.join(timeout=2)
         self.tcp.stop()
+        self.admin.stop()
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval_s):
@@ -456,6 +521,7 @@ class NetworkedServerStarter:
                             "name": self.name,
                             "role": "server",
                             "addr": [self.tcp.address[0], self.tcp.address[1]],
+                            "url": self.admin.url,
                         },
                     )
             except Exception as e:

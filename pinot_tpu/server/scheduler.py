@@ -47,7 +47,9 @@ class QueryAbandonedError(RuntimeError):
 
 
 class QueryScheduler:
-    def __init__(self, num_workers: int = 4, max_pending: int = 64) -> None:
+    def __init__(
+        self, num_workers: int = 4, max_pending: int = 64, metrics=None
+    ) -> None:
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=num_workers)
         self._max_pending = max_pending
         self._pending = 0  # queued + running
@@ -55,6 +57,13 @@ class QueryScheduler:
         self._abandoned = 0
         self._shutdown = False
         self._lock = threading.Lock()
+        # optional ServerMetrics: pending-depth gauge + the
+        # ServerQueryPhase-style queue-wait timer (phase.schedulerWait)
+        self.metrics = metrics
+
+    def _note_pending_locked(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge("scheduler.pending").set(self._pending)
 
     @property
     def pending(self) -> int:
@@ -90,21 +99,25 @@ class QueryScheduler:
                     f"{self._max_pending} cap"
                 )
             self._pending += 1
+            self._note_pending_locked()
         try:
             fut = self._pool.submit(fn)
         except RuntimeError as e:
             # pool shut down between our check and the submit
             with self._lock:
                 self._pending -= 1
+                self._note_pending_locked()
             raise SchedulerShutdownError(str(e)) from e
         except BaseException:
             with self._lock:
                 self._pending -= 1
+                self._note_pending_locked()
             raise
 
         def _done(_f) -> None:
             with self._lock:
                 self._pending -= 1
+                self._note_pending_locked()
 
         fut.add_done_callback(_done)
         return fut
@@ -124,9 +137,17 @@ class QueryScheduler:
         """
         if deadline is None:
             deadline = time.monotonic() + timeout_s
+        t_submit = time.monotonic()
 
         def _guarded() -> Any:
-            if time.monotonic() >= deadline:
+            now = time.monotonic()
+            if self.metrics is not None:
+                # FCFS queue wait — the ServerQueryPhase SCHEDULER_WAIT
+                # analog, measured submit -> worker dequeue
+                self.metrics.timer("phase.schedulerWait").update(
+                    (now - t_submit) * 1000.0
+                )
+            if now >= deadline:
                 with self._lock:
                     self._abandoned += 1
                 raise QueryAbandonedError(

@@ -1,0 +1,141 @@
+"""Render a merged query trace as an ASCII waterfall.
+
+Input is the ``traceInfo`` object a ``trace=true`` query returns
+(``{"traceId": ..., "scopes": {scope: [span dicts]}}`` — see
+``utils/trace.py`` for the span schema), either from a saved broker
+response JSON / bare traceInfo JSON on disk or stdin, or fetched live
+with ``--broker http://... --pql "SELECT ..."``.
+
+Usage:
+  python -m pinot_tpu.tools.trace_dump response.json
+  python -m pinot_tpu.tools.trace_dump --broker http://127.0.0.1:8099 \\
+      --pql "SELECT count(*) FROM myTable"
+
+Output: one line per span, indented by tree depth, with a bar showing
+the span's wall-clock window relative to the whole trace.  Broker and
+server clocks are only as aligned as the hosts' NTP, so cross-process
+offsets are approximate; durations are exact.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _all_spans(trace_info: Dict[str, Any]) -> List[Dict[str, Any]]:
+    scopes = trace_info.get("scopes")
+    if scopes is None:
+        # bare {scope: [spans]} shape (a server-side trace dict)
+        scopes = {
+            k: v for k, v in trace_info.items() if isinstance(v, list)
+        }
+    out: List[Dict[str, Any]] = []
+    for scope, spans in scopes.items():
+        for s in spans:
+            out.append(dict(s, _scope=scope))
+    return out
+
+
+def render_waterfall(trace_info: Dict[str, Any], width: int = 40) -> str:
+    """traceInfo -> multi-line ASCII waterfall (pure; unit-testable)."""
+    spans = _all_spans(trace_info)
+    if not spans:
+        return "(empty trace)\n"
+    by_id: Dict[Optional[str], Dict[str, Any]] = {s.get("id"): s for s in spans}
+    children: Dict[Optional[str], List[Dict[str, Any]]] = {}
+    roots: List[Dict[str, Any]] = []
+    for s in spans:
+        parent = s.get("parent")
+        if parent is not None and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+
+    t0 = min(float(s.get("startMs") or 0.0) for s in spans)
+    t1 = max(float(s.get("startMs") or 0.0) + float(s.get("ms") or 0.0) for s in spans)
+    total = max(t1 - t0, 1e-9)
+
+    def _key(s: Dict[str, Any]) -> Tuple[float, str]:
+        return (float(s.get("startMs") or 0.0), str(s.get("id")))
+
+    lines: List[str] = []
+    trace_id = trace_info.get("traceId")
+    header = f"trace {trace_id}  " if trace_id else ""
+    lines.append(f"{header}total {total:.3f}ms  ({len(spans)} spans)")
+
+    name_w = 44
+
+    def _bar(start: float, dur: float) -> str:
+        a = int((start - t0) / total * width)
+        b = max(a + 1, int((start - t0 + dur) / total * width))
+        a, b = min(a, width), min(b, width)
+        return " " * a + "#" * (b - a) + " " * (width - b)
+
+    def _emit(s: Dict[str, Any], depth: int) -> None:
+        name = f"{'  ' * depth}{s.get('_scope')}:{s.get('span')}"
+        if len(name) > name_w:
+            name = name[: name_w - 1] + "…"
+        start = float(s.get("startMs") or 0.0)
+        dur = float(s.get("ms") or 0.0)
+        tags = s.get("tags") or {}
+        tag_str = (
+            " " + ",".join(f"{k}={tags[k]}" for k in sorted(tags)) if tags else ""
+        )
+        lines.append(
+            f"{name:<{name_w}} |{_bar(start, dur)}| "
+            f"+{start - t0:9.3f}ms {dur:9.3f}ms{tag_str}"
+        )
+        for c in sorted(children.get(s.get("id"), ()), key=_key):
+            _emit(c, depth + 1)
+
+    for root in sorted(roots, key=_key):
+        _emit(root, 0)
+    return "\n".join(lines) + "\n"
+
+
+def _load_trace(obj: Dict[str, Any]) -> Dict[str, Any]:
+    """Accept a full broker response JSON or a bare traceInfo."""
+    if "traceInfo" in obj:
+        return obj["traceInfo"]
+    return obj
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="pinot_tpu-trace-dump", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("file", nargs="?", help="broker response / traceInfo JSON (default stdin)")
+    p.add_argument("--broker", help="broker base URL: run --pql live with trace=true")
+    p.add_argument("--pql", help="query to run against --broker")
+    p.add_argument("--width", type=int, default=40, help="bar width in columns")
+    args = p.parse_args(argv)
+
+    if args.broker and args.pql:
+        import urllib.request
+
+        req = urllib.request.Request(
+            args.broker.rstrip("/") + "/query",
+            data=json.dumps({"pql": args.pql, "trace": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            obj = json.loads(r.read())
+    elif args.file:
+        with open(args.file) as f:
+            obj = json.load(f)
+    else:
+        obj = json.load(sys.stdin)
+
+    trace_info = _load_trace(obj)
+    if not trace_info:
+        print("no traceInfo in input (was the query run with trace=true?)", file=sys.stderr)
+        return 1
+    sys.stdout.write(render_waterfall(trace_info, width=args.width))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

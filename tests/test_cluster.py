@@ -143,8 +143,10 @@ def test_cluster_matches_oracle(cluster, pql):
     broker, oracle, _ = cluster
     got = broker.handle_pql(pql).to_json()
     want = oracle.execute(optimize_request(parse_pql(pql))).to_json()
-    for k in ("timeUsedMs", "numEntriesScannedInFilter", "numEntriesScannedPostFilter",
-              "numSegmentsQueried", "numServersQueried", "numServersResponded"):
+    # requestId is broker-assigned (the oracle issues none)
+    for k in ("timeUsedMs", "requestId", "numEntriesScannedInFilter",
+              "numEntriesScannedPostFilter", "numSegmentsQueried",
+              "numServersQueried", "numServersResponded"):
         got.pop(k, None)
         want.pop(k, None)
     assert got == want

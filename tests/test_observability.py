@@ -1,0 +1,536 @@
+"""End-to-end query observability: distributed trace trees, unified
+metrics exposition, the slow-query log, and the metric-name lint.
+
+Covers the PR-4 acceptance bar: a trace=true query returns ONE merged
+span tree in traceInfo (broker phases + per-server scheduler/lane/
+device phases) on both the in-process and networked cluster paths; a
+failover query's trace carries the retry + failover spans; /metrics on
+broker, server, and controller serves valid Prometheus text; the
+slow-query ring rolls over; and the disabled-trace path allocates zero
+spans.
+"""
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pinot_tpu.broker.broker import BrokerHttpServer, BrokerRequestHandler
+from pinot_tpu.broker.routing import RoutingTableProvider
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.server.instance import ServerInstance
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+from pinot_tpu.transport.local import LocalTransport
+
+TABLE = "testTable"
+
+
+def _spans(trace_info):
+    """Flatten {scopes: {scope: [span...]}} -> [(scope, span)]."""
+    out = []
+    for scope, spans in trace_info.get("scopes", {}).items():
+        for s in spans:
+            out.append((scope, s))
+    return out
+
+
+def _span_names(trace_info, scope_prefix=""):
+    return {
+        s["span"]
+        for scope, s in _spans(trace_info)
+        if scope.startswith(scope_prefix)
+    }
+
+
+def _assert_single_tree(trace_info):
+    """Every span's parent resolves and every root chain reaches the
+    broker's root query span — one connected tree, not islands."""
+    by_id = {s["id"]: s for _, s in _spans(trace_info)}
+    roots = [s for _, s in _spans(trace_info) if s["parent"] is None]
+    assert len(roots) == 1, f"expected one root, got {roots}"
+    for _, s in _spans(trace_info):
+        if s["parent"] is not None:
+            assert s["parent"] in by_id, f"dangling parent on {s}"
+        # chain terminates at the root (cycle-free)
+        seen, cur = set(), s
+        while cur["parent"] is not None:
+            assert cur["id"] not in seen
+            seen.add(cur["id"])
+            cur = by_id[cur["parent"]]
+        assert cur is roots[0]
+
+
+# ---------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def cluster():
+    """2 servers, every segment replicated on both (failover-capable)."""
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 400, seed=21, cardinality=8)
+    seg1 = build_segment(schema, rows[:200], TABLE, "obsSeg1")
+    seg2 = build_segment(schema, rows[200:], TABLE, "obsSeg2")
+
+    servers = {}
+    transport = LocalTransport()
+    for name in ("obsA", "obsB"):
+        s = ServerInstance(name)
+        s.add_segment(TABLE, seg1)
+        s.add_segment(TABLE, seg2)
+        transport.register((name, 0), s.handle_request)
+        servers[name] = s
+    routing = RoutingTableProvider()
+    routing.update(
+        TABLE,
+        {
+            "obsSeg1": {"obsA": "ONLINE", "obsB": "ONLINE"},
+            "obsSeg2": {"obsA": "ONLINE", "obsB": "ONLINE"},
+        },
+    )
+    broker = BrokerRequestHandler(
+        transport,
+        {"obsA": ("obsA", 0), "obsB": ("obsB", 0)},
+        routing=routing,
+        timeout_ms=30_000,
+        retry_attempts=2,
+        retry_backoff_ms=1.0,
+    )
+    return broker, servers, transport
+
+
+# ------------------------------------------------------------- trace trees
+def test_trace_tree_in_process(cluster):
+    broker, servers, _ = cluster
+    resp = broker.handle_pql(f"SELECT sum(metInt) FROM {TABLE}", trace=True)
+    assert not resp.exceptions
+    ti = resp.trace_info
+    assert ti["traceId"] == resp.request_id
+    _assert_single_tree(ti)
+    # broker phases present
+    broker_spans = _span_names(ti, broker.name)
+    assert {"query", "parse", "route", "scatterGather", "serverAttempt", "reduce"} <= broker_spans
+    # per-server scheduler + executor phases present, nested under the
+    # attempt spans (single-tree assertion above proves the nesting)
+    for sname in servers:
+        names = _span_names(ti, sname)
+        assert {"serverQuery", "queueWait", "planAndExecute", "finalize"} <= names, (
+            sname, names,
+        )
+    # the server spans carry the broker's requestId tag
+    tagged = [
+        s for scope, s in _spans(ti)
+        if s["span"] == "serverQuery"
+    ]
+    assert tagged and all(
+        s["tags"]["requestId"] == resp.request_id for s in tagged
+    )
+
+
+def test_trace_disabled_allocates_no_spans(cluster):
+    broker, _, _ = cluster
+    import pinot_tpu.utils.trace as trace_mod
+
+    broker.handle_pql(f"SELECT count(*) FROM {TABLE}")  # warm
+    before = trace_mod.SPAN_ALLOCATIONS
+    resp = broker.handle_pql(f"SELECT count(*) FROM {TABLE}")
+    assert not resp.exceptions
+    assert trace_mod.SPAN_ALLOCATIONS == before, (
+        "untraced handle-request path allocated spans"
+    )
+    assert resp.trace_info == {}
+
+
+def test_trace_shows_retry_and_failover(cluster):
+    """A downed replica's attempt fails, the broker fails over, and the
+    merged trace shows BOTH: the error attempt and the failover event
+    plus the replacement attempt that succeeded."""
+    broker, _, transport = cluster
+    transport.set_down(("obsA", 0))
+    try:
+        # routing picks replicas randomly: retry until a batch actually
+        # landed on the downed server (usually the first query)
+        for _ in range(20):
+            resp = broker.handle_pql(f"SELECT count(*) FROM {TABLE}", trace=True)
+            if resp.num_retries >= 1:
+                break
+    finally:
+        transport.set_down(("obsA", 0), down=False)
+        broker.health.mark_alive("obsA")
+    assert not resp.partial_response and resp.num_docs_scanned == 400
+    assert resp.num_retries >= 1
+    ti = resp.trace_info
+    _assert_single_tree(ti)
+    attempts = [s for _, s in _spans(ti) if s["span"] == "serverAttempt"]
+    statuses = {s["tags"]["status"] for s in attempts}
+    assert "error" in statuses and "ok" in statuses, attempts
+    events = [s for _, s in _spans(ti) if s["span"] == "failover"]
+    assert events and events[0]["tags"]["fromServer"] == "obsA"
+    # reissued attempts are tagged with their reissue count
+    assert any(s["tags"]["reissues"] >= 1 for s in attempts if s["tags"]["status"] == "ok")
+
+
+def test_trace_shows_device_host_failover():
+    """A transient device fault heals transparently (PR 3) and the
+    traced query shows the deviceFailures/deviceRetries events."""
+    from pinot_tpu.common.faults import DeviceFaultInjector
+    from pinot_tpu.tools.cluster_harness import single_server_broker
+
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 300, seed=3)
+    seg = build_segment(schema, rows, TABLE, "healTraceSeg")
+    inj = DeviceFaultInjector(seed=7)
+    broker = single_server_broker(TABLE, [seg], device_fault_injector=inj)
+    try:
+        pql = f"SELECT sum(metInt) FROM {TABLE}"
+        want = broker.handle_pql(pql)
+        assert not want.exceptions
+        inj.fail_next(1, retryable=True)
+        resp = broker.handle_pql(pql, trace=True)
+        assert not resp.exceptions
+        names = _span_names(resp.trace_info)
+        assert "deviceFailures" in names and "deviceRetries" in names, names
+        _assert_single_tree(resp.trace_info)
+    finally:
+        broker.local_servers[0].shutdown()
+
+
+def test_request_id_globally_unique_and_echoed(cluster):
+    broker, _, _ = cluster
+    other = BrokerRequestHandler(
+        LocalTransport(), {}, name=broker.name  # same display name!
+    )
+    r1 = broker.handle_pql(f"SELECT count(*) FROM {TABLE}")
+    r2 = broker.handle_pql(f"SELECT count(*) FROM {TABLE}")
+    r3 = other.handle_pql("SELECT count(*) FROM nosuchtable")
+    ids = {r1.request_id, r2.request_id, r3.request_id}
+    assert len(ids) == 3
+    assert all(i.startswith(broker.name + "-") for i in ids)
+    assert r1.to_json()["requestId"] == r1.request_id
+    # error responses echo the id too (correlation with /debug/queries)
+    assert r3.to_json()["requestId"] == r3.request_id
+
+
+# ----------------------------------------------------------- exposition
+# one metric sample line: name{labels} value
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})? [^ ]+$"
+)
+
+
+def _assert_valid_prometheus(text: str, required_substrings=()):
+    assert text.endswith("\n")
+    families = set()
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# TYPE "):
+            name = line.split()[2]
+            assert name not in families, f"duplicate TYPE for {name}"
+            families.add(name)
+            continue
+        if line.startswith("#"):
+            continue
+        assert _PROM_SAMPLE.match(line), f"bad exposition line: {line!r}"
+    for sub in required_substrings:
+        assert sub in text, f"{sub} missing from exposition"
+
+
+def test_prometheus_text_valid_and_covers_key_series(cluster):
+    broker, servers, _ = cluster
+    from pinot_tpu.utils.metrics import prometheus_text
+
+    broker.handle_pql(f"SELECT count(*) FROM {TABLE}")
+    _assert_valid_prometheus(
+        prometheus_text(broker.metrics),
+        required_substrings=[
+            "pinot_tpu_broker_queries_total",
+            "pinot_tpu_broker_scatterGather_ms",
+        ],
+    )
+    server = next(iter(servers.values()))
+    text = server.metrics_text()
+    _assert_valid_prometheus(
+        text,
+        required_substrings=[
+            "pinot_tpu_server_queries_total",
+            "pinot_tpu_server_lane_depth",  # lane depth gauge
+            "pinot_tpu_server_phase_schedulerWait_ms",
+        ],
+    )
+
+
+def test_meter_windowed_rate_and_timer_interpolation():
+    from pinot_tpu.utils.metrics import Meter, Timer, Gauge
+
+    m = Meter()
+    m.mark(100)
+    assert m.count == 100
+    assert m.rate > 0
+    assert m.rate_1m >= 0  # pre-first-tick instantaneous estimate
+    # after a simulated idle minute the EWMA decays instead of
+    # reporting the lifetime average forever
+    m._last_tick -= 120.0
+    m.mark(0)
+    decayed = m.rate_1m
+    m._last_tick -= 600.0
+    assert m.rate_1m <= decayed + 1e-9
+
+    t = Timer()
+    for v in (10.0, 20.0, 30.0, 40.0):
+        t.update(v)
+    # interpolated median of [10,20,30,40] = 25 (nearest-rank gave 30)
+    assert t.percentile(50) == pytest.approx(25.0)
+    assert t.percentile(0) == 10.0 and t.percentile(100) == 40.0
+    p50, p95 = t.percentiles((50, 95))
+    assert p50 == pytest.approx(25.0) and p95 == pytest.approx(38.5)
+
+    g = Gauge()
+    g.set(7)
+    assert g.value == 7
+    g.set_fn(lambda: 42)
+    assert g.value == 42
+
+
+def test_gauge_snapshot_thread_safety():
+    from pinot_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry("t")
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            reg.gauge("g").set(i)
+            reg.meter("m").mark()
+            i += 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(50):
+            snap = reg.snapshot()
+            assert isinstance(snap["gauges"]["g"], int)
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_trace_survives_misrouted_table():
+    """A traced query for a table the server doesn't host still returns
+    its span tree next to the error — stale routing is exactly when an
+    operator needs the server-side view."""
+    from pinot_tpu.common.datatable import (
+        deserialize_result,
+        serialize_instance_request,
+    )
+
+    server = ServerInstance("misServer")
+    payload = serialize_instance_request(
+        "rid-1", "SELECT count(*) FROM ghostTable", "ghostTable", [], 10_000,
+        trace=True,
+    )
+    res = deserialize_result(server.handle_request(payload))
+    assert res.exceptions
+    names = {s["span"] for s in res.trace["misServer"]}
+    assert {"serverQuery", "tableNotHosted"} <= names
+    server.shutdown()
+
+
+# ------------------------------------------------------------ slow log
+def test_slow_query_log_ring_and_threshold(monkeypatch):
+    from pinot_tpu.broker.querylog import SlowQueryLog
+
+    log = SlowQueryLog(capacity=3, threshold_ms=100.0)
+    assert not log.observe({"requestId": "a", "timeUsedMs": 5.0})
+    assert log.observe({"requestId": "b", "timeUsedMs": 500.0})
+    assert log.observe({"requestId": "c", "timeUsedMs": 1.0, "exceptions": [200]})
+    assert log.observe({"requestId": "d", "timeUsedMs": 1.0, "partialResponse": True})
+    assert log.observe({"requestId": "e", "timeUsedMs": 150.0})
+    snap = log.snapshot()
+    assert snap["totalQueries"] == 5 and snap["totalRecorded"] == 4
+    # ring holds the LAST 3, newest first
+    assert [e["requestId"] for e in snap["entries"]] == ["e", "d", "c"]
+    # env-var construction path
+    monkeypatch.setenv("PINOT_TPU_SLOW_QUERY_MS", "7")
+    monkeypatch.setenv("PINOT_TPU_SLOW_QUERY_LOG_N", "2")
+    log2 = SlowQueryLog()
+    assert log2.threshold_ms == 7.0 and log2.capacity == 2
+
+
+def test_broker_http_debug_endpoints(cluster):
+    """/metrics (Prometheus), /debug/metrics (JSON), /debug/queries on
+    the broker HTTP surface; a failed query lands in the slow log with
+    its requestId."""
+    broker, _, _ = cluster
+    http = BrokerHttpServer(broker)
+    http.start()
+    try:
+        base = f"http://127.0.0.1:{http.port}"
+        bad = json.loads(
+            urllib.request.urlopen(
+                base + "/query?pql=" + urllib.parse.quote("SELECT count(*) FROM nosuchtable"),
+                timeout=10,
+            ).read()
+        )
+        assert bad["exceptions"]
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            _assert_valid_prometheus(
+                r.read().decode(), ["pinot_tpu_broker_queries_total"]
+            )
+        dbg = json.loads(urllib.request.urlopen(base + "/debug/metrics", timeout=10).read())
+        assert dbg["scope"] == broker.name and "meters" in dbg
+        queries = json.loads(urllib.request.urlopen(base + "/debug/queries", timeout=10).read())
+        assert any(
+            e["requestId"] == bad["requestId"] for e in queries["entries"]
+        ), queries
+    finally:
+        http.stop()
+
+
+# ------------------------------------------------------- networked path
+def test_networked_cluster_trace_and_metrics(tmp_path):
+    """Controller + networked server + networked broker as real HTTP/TCP
+    endpoints (in one process): trace trees merge across the TCP
+    transport, and all three roles serve Prometheus /metrics — including
+    lane/selfHealing series on the server."""
+    from pinot_tpu.controller.controller import Controller, ControllerHttpServer
+    from pinot_tpu.broker.network_starter import NetworkedBrokerStarter
+    from pinot_tpu.server.network_starter import NetworkedServerStarter
+
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 300, seed=11)
+
+    ctrl = Controller(str(tmp_path / "ctl"))
+    chttp = ControllerHttpServer(ctrl)
+    chttp.start()
+    ctrl_url = f"http://127.0.0.1:{chttp.port}"
+    server = NetworkedServerStarter(
+        ctrl_url, "netObsSrv", data_dir=str(tmp_path / "srv"), poll_interval_s=0.1
+    )
+    broker = NetworkedBrokerStarter(ctrl_url, "netObsBrk", poll_interval_s=0.1)
+    try:
+        server.start()
+        broker.start()
+        ctrl.add_schema(schema)
+        from pinot_tpu.common.tableconfig import TableConfig
+
+        physical = ctrl.add_table(TableConfig(table_name=TABLE, table_type="OFFLINE"))
+        ctrl.upload_segment(physical, build_segment(schema, rows, physical, "netObs1"))
+
+        def _query(trace=False):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{broker.http.port}/query",
+                data=json.dumps(
+                    {"pql": f"SELECT sum(metInt) FROM {TABLE}", "trace": trace}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        deadline = time.time() + 30
+        out = None
+        while time.time() < deadline:
+            out = _query()
+            if not out.get("exceptions") and out.get("numDocsScanned") == 300:
+                break
+            time.sleep(0.2)
+        assert out and out.get("numDocsScanned") == 300, out
+
+        out = _query(trace=True)
+        ti = out["traceInfo"]
+        assert ti["traceId"] == out["requestId"]
+        _assert_single_tree(ti)
+        assert "netObsSrv" in ti["scopes"]
+        assert {"serverQuery", "planAndExecute"} <= _span_names(ti, "netObsSrv")
+        assert "serverAttempt" in _span_names(ti, "netObsBrk")
+        # the waterfall renders the merged tree
+        from pinot_tpu.tools.trace_dump import render_waterfall
+
+        art = render_waterfall(ti)
+        assert "netObsSrv:planAndExecute" in art and "netObsBrk:query" in art
+
+        # all three roles expose Prometheus text
+        for url, needles in (
+            (f"http://127.0.0.1:{broker.http.port}/metrics",
+             ["pinot_tpu_broker_queries_total"]),
+            (f"{server.admin.url}/metrics",
+             ["pinot_tpu_server_queries_total", "pinot_tpu_server_lane_depth",
+              "pinot_tpu_server_heal_", "pinot_tpu_server_lane_coalesced"]),
+            (f"{ctrl_url}/metrics",
+             ["pinot_tpu_controller_heartbeats_total",
+              "pinot_tpu_controller_aliveServers"]),
+        ):
+            with urllib.request.urlopen(url, timeout=10) as r:
+                text = r.read().decode()
+            _assert_valid_prometheus(text)
+            for n in needles:
+                assert n in text, (url, n, text[:2000])
+
+        # controller-side cluster aggregation sees broker AND server
+        agg = json.loads(
+            urllib.request.urlopen(ctrl_url + "/debug/clustermetrics", timeout=10).read()
+        )
+        assert "netObsSrv" in agg["instances"] and "netObsBrk" in agg["instances"]
+        srv_entry = agg["instances"]["netObsSrv"]
+        assert "selfHealing" in srv_entry["metrics"], srv_entry
+        # the dashboard metrics page renders it
+        with urllib.request.urlopen(ctrl_url + "/dashboard/metrics", timeout=10) as r:
+            html = r.read().decode()
+        assert "netObsSrv" in html and "netObsBrk" in html
+    finally:
+        broker.stop()
+        server.stop()
+        chttp.stop()
+        ctrl.stop()
+        server.server.shutdown()
+
+
+# ------------------------------------------------------------ trace dump
+def test_trace_dump_waterfall_pure():
+    from pinot_tpu.tools.trace_dump import render_waterfall
+
+    ti = {
+        "traceId": "b-1",
+        "scopes": {
+            "b": [
+                {"span": "query", "id": "b:1", "parent": None, "startMs": 0.0, "ms": 10.0},
+                {"span": "scatter", "id": "b:2", "parent": "b:1", "startMs": 1.0, "ms": 8.0},
+            ],
+            "s": [
+                {"span": "serverQuery", "id": "s:1", "parent": "b:2",
+                 "startMs": 2.0, "ms": 6.0, "tags": {"requestId": "b-1"}},
+            ],
+        },
+    }
+    art = render_waterfall(ti, width=20)
+    lines = art.splitlines()
+    assert "total 10.000ms" in lines[0]
+    assert lines[1].lstrip().startswith("b:query")
+    # depth-indented child chain b:query > b:scatter > s:serverQuery
+    assert lines[2].startswith("  b:scatter")
+    assert lines[3].startswith("    s:serverQuery")
+    assert "requestId=b-1" in lines[3]
+    assert render_waterfall({"scopes": {}}) == "(empty trace)\n"
+
+
+# ------------------------------------------------------------- the lint
+def test_metrics_lint():
+    """Tier-1 guard: every metric name used in pinot_tpu appears in the
+    per-role catalogs — a typo cannot silently fork a series."""
+    from pinot_tpu.tools.metrics_lint import run_lint
+
+    problems = run_lint()
+    assert problems == []
+
+
+def test_metrics_lint_catches_unknown_name(tmp_path):
+    pkg = tmp_path / "fakepkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'def f(reg):\n    reg.meter("definitelyNotCatalogued").mark()\n'
+    )
+    from pinot_tpu.tools.metrics_lint import run_lint
+
+    problems = run_lint(str(pkg))
+    assert len(problems) == 1 and "definitelyNotCatalogued" in problems[0]

@@ -227,7 +227,8 @@ def test_deadline_abandonment_with_lane_bottleneck():
 
 def _payload(resp) -> str:
     return json.dumps(
-        {k: v for k, v in resp.to_json().items() if k != "timeUsedMs"},
+        {k: v for k, v in resp.to_json().items()
+         if k not in ("timeUsedMs", "requestId")},
         sort_keys=True,
     )
 

@@ -31,6 +31,7 @@ TABLE = "healTable"
 # contract fields all must match exactly.
 _PATH_DEPENDENT = {
     "timeUsedMs",
+    "requestId",  # broker-assigned per query, never payload
     "numEntriesScannedInFilter",
     "numEntriesScannedPostFilter",
 }
