@@ -138,6 +138,10 @@ class BrokerRequestHandler:
         self.hedge_latency_percentile = hedge_latency_percentile
         self.hedge_min_quota_headroom = hedge_min_quota_headroom
         self.health = health or ServerHealthTracker()
+        # controller-declared draining servers (deliberate decommission,
+        # NOT failures): routing views already exclude them; kept here so
+        # /serverhealth can tell an operator drain from a sick circuit
+        self.draining_servers: Set[str] = set()
         from pinot_tpu.broker.quota import QueryQuotaManager
 
         self.quota = QueryQuotaManager()
@@ -941,7 +945,12 @@ class BrokerHttpServer:
                     if url.path == "/debug/queries":
                         return self._respond(broker.querylog.snapshot())
                     if url.path == "/serverhealth":
-                        return self._respond(broker.health.snapshot())
+                        return self._respond(
+                            {
+                                "circuits": broker.health.snapshot(),
+                                "drainingServers": sorted(broker.draining_servers),
+                            }
+                        )
                     return self._respond({"error": "not found"}, 404)
                 qs = parse_qs(url.query)
                 pql = (qs.get("pql") or qs.get("bql") or [""])[0]

@@ -129,6 +129,11 @@ class NetworkedBrokerStarter:
         for server in self._dead_servers - dead:
             self.handler.health.mark_alive(server)
         self._dead_servers = dead
+        # draining servers already dropped out of the snapshot's routing
+        # views (so no new covers land on them) but stay healthy and
+        # addressable for in-flight work — surfaced at /serverhealth so
+        # ops can tell a deliberate drain from a sick circuit
+        self.handler.draining_servers = set(state.get("drainingServers", []))
         known = set(self.handler.routing.tables())
         for table, view in state["tables"].items():
             self.handler.routing.update(table, view)

@@ -45,14 +45,22 @@ class Controller:
         for m in ("instanceRegistrations", "heartbeats", "instancesMarkedDead",
                   "transitionAcks", "clusterStatePolls", "segmentUploads"):
             self.metrics.meter(m)
-        self.retention_manager = RetentionManager(self.resources, self.store)
-        self.validation_manager = ValidationManager(self.resources)
-        self.status_checker = SegmentStatusChecker(self.resources)
-
         from pinot_tpu.realtime.llc import RealtimeSegmentManager
 
         self.realtime_manager = RealtimeSegmentManager(self.resources, self.store)
-        self.validation_manager.realtime_manager = self.realtime_manager
+        self.retention_manager = RetentionManager(self.resources, self.store)
+        self.validation_manager = ValidationManager(
+            self.resources, realtime_manager=self.realtime_manager
+        )
+        self.status_checker = SegmentStatusChecker(self.resources)
+
+        from pinot_tpu.controller.stabilizer import SelfStabilizer
+
+        # the convergence loop: re-replicates off dead/draining servers,
+        # retires orphaned consuming segments, cleans the ideal state
+        self.stabilizer = SelfStabilizer(
+            self.resources, realtime_manager=self.realtime_manager
+        )
 
         from pinot_tpu.controller.network import ParticipantGateway
 
@@ -68,6 +76,7 @@ class Controller:
             self.retention_manager.start()
             self.validation_manager.start()
             self.status_checker.start()
+            self.stabilizer.start()
 
     def _recover(self) -> None:
         """Reload cluster metadata from the property store after a
@@ -125,6 +134,16 @@ class Controller:
                         "was not describable); re-create the table",
                         physical,
                     )
+        # draining flags were reloaded by ClusterResourceManager from the
+        # property store's "instances" namespace: an in-flight drain (or
+        # a partially-applied stabilizer plan, which is just persisted
+        # ideal-state writes) resumes exactly where the crash left it —
+        # re-registering servers replay transitions, the next stabilizer
+        # round re-derives the remaining work from ideal vs external view
+        if res._draining_flags:
+            logger.info(
+                "recovered draining flags for %s", sorted(res._draining_flags)
+            )
         if recovered_tables:
             logger.info(
                 "recovered %d tables, %d schemas from property store",
@@ -157,6 +176,43 @@ class Controller:
 
     def rebalance_table(self, table_physical: str, dry_run: bool = False) -> Dict[str, Any]:
         return self.resources.rebalance_table(table_physical, dry_run=dry_run)
+
+    # -- drain / decommission -------------------------------------------
+    def drain_status(self, name: str) -> Dict[str, Any]:
+        """Drained-vs-remaining accounting for one instance: the rolling
+        -restart loop polls this until ``drained`` flips true."""
+        remaining = self.resources.segments_on(name)
+        total = sum(len(v) for v in remaining.values())
+        inst = self.resources.instances.get(name)
+        if inst is None and not remaining and name not in self.resources._draining_flags:
+            # never registered, holds nothing, no recovered drain flag: a
+            # typo'd name must error, not report drained=true to a
+            # rolling-restart loop about to bounce the REAL server
+            raise KeyError(f"unknown instance {name!r}")
+        return {
+            "instance": name,
+            "draining": name in self.resources._draining_flags,
+            "alive": inst.alive if inst is not None else False,
+            "remainingSegments": total,
+            "remaining": remaining,
+            "drained": total == 0,
+        }
+
+    def drain_instance(self, name: str) -> Dict[str, Any]:
+        """Mark an instance draining: brokers stop routing NEW queries
+        to it (in-flight ones finish), the stabilizer migrates its
+        replicas off, and the returned status reports progress.
+        Idempotent — a rolling restart is drain -> poll until drained ->
+        restart the process -> undrain."""
+        self.resources.set_instance_draining(name, True)
+        return self.drain_status(name)
+
+    def undrain_instance(self, name: str) -> Dict[str, Any]:
+        """Explicitly re-admit a drained instance to routing/placement
+        (registration alone never clears the flag — a controller restart
+        mid-drain must not silently resurrect the instance)."""
+        self.resources.set_instance_draining(name, False)
+        return self.drain_status(name)
 
 
     def add_realtime_table(self, config: TableConfig, stream) -> str:
@@ -271,6 +327,8 @@ class Controller:
             "controller": self.metrics.snapshot(),
             "validation": self.validation_manager.metrics.snapshot(),
             "segmentStatus": self.status_checker.metrics.snapshot(),
+            "stabilizer": self.stabilizer.metrics.snapshot(),
+            "retention": self.retention_manager.metrics.snapshot(),
         }
 
     def metrics_text(self) -> str:
@@ -281,6 +339,8 @@ class Controller:
                 self.metrics,
                 self.validation_manager.metrics,
                 self.status_checker.metrics,
+                self.stabilizer.metrics,
+                self.retention_manager.metrics,
             ]
         )
 
@@ -288,6 +348,7 @@ class Controller:
         self.retention_manager.stop()
         self.validation_manager.stop()
         self.status_checker.stop()
+        self.stabilizer.stop()
 
 
 def collect_cluster_metrics(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, Any]:
@@ -448,6 +509,14 @@ class ControllerHttpServer:
                         return self._respond(ctrl.metrics_snapshot())
                     if parts == ["debug", "clustermetrics"]:
                         return self._respond(collect_cluster_metrics(ctrl))
+                    if parts == ["debug", "stabilizer"]:
+                        return self._respond(ctrl.stabilizer.debug_snapshot())
+                    if len(parts) == 3 and parts[0] == "instances" and parts[2] == "drain":
+                        # poll surface for the rolling-restart loop
+                        try:
+                            return self._respond(ctrl.drain_status(parts[1]))
+                        except KeyError as e:
+                            return self._respond({"error": str(e)}, 404)
                     if parts == ["dashboard", "metrics"]:
                         return self._respond_html(
                             dashboard.render_metrics(ctrl, collect_cluster_metrics(ctrl))
@@ -552,6 +621,20 @@ class ControllerHttpServer:
                         return self._respond(ctrl.gateway.heartbeat(parts[1]))
                     if len(parts) == 3 and parts[0] == "instances" and parts[2] == "ack":
                         return self._respond(ctrl.gateway.ack(parts[1], self._read_json()))
+                    if len(parts) == 3 and parts[0] == "instances" and parts[2] in (
+                        "drain", "undrain"
+                    ):
+                        fn = (
+                            ctrl.drain_instance
+                            if parts[2] == "drain"
+                            else ctrl.undrain_instance
+                        )
+                        try:
+                            return self._respond(fn(parts[1]))
+                        except KeyError as e:
+                            # same contract as the GET poll surface: an
+                            # unknown name is 404, never a silent no-op
+                            return self._respond({"error": str(e)}, 404)
                     if parts == ["schemas"]:
                         schema = Schema.from_json(self._read_json())
                         ctrl.add_schema(schema)

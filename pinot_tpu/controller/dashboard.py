@@ -27,6 +27,7 @@ _STYLE = """
   th { background: #f2f2f2; }
   tr.mismatch { background: #fdd; }
   .ok { color: #188038; } .bad { color: #c5221f; }
+  .warn { color: #b06000; }
   nav { margin-bottom: 1.4em; }
   nav a { margin-right: 1.2em; }
   textarea { width: 100%; max-width: 56em; font-family: monospace; }
@@ -68,11 +69,12 @@ def render_home(ctrl) -> str:
         "<th>tags</th><th>url</th></tr>"
     )
     for inst in ctrl.resources.instances_snapshot():
-        status = (
-            "<span class='ok'>alive</span>"
-            if inst.alive
-            else "<span class='bad'>down</span>"
-        )
+        if not inst.alive:
+            status = "<span class='bad'>down</span>"
+        elif getattr(inst, "draining", False):
+            status = "<span class='warn'>draining</span>"
+        else:
+            status = "<span class='ok'>alive</span>"
         tags = ", ".join(sorted(getattr(inst, "tags", []) or []))
         body.append(
             f"<tr><td>{_esc(inst.name)}</td><td>{_esc(inst.role)}</td>"
@@ -92,6 +94,28 @@ def render_home(ctrl) -> str:
                 f"<td>{_esc(', '.join(ctrl.resources.tenant_instances(t, 'broker')))}</td></tr>"
             )
         body.append("</table>")
+
+    stabilizer = getattr(ctrl, "stabilizer", None)
+    if stabilizer is not None:
+        events = stabilizer.events()
+        if events:
+            body.append("<h2>Self-stabilization (recent heal events)</h2>")
+            body.append(
+                "<table><tr><th>event</th><th>server</th><th>table</th>"
+                "<th>segment</th></tr>"
+            )
+            for ev in list(events)[-12:][::-1]:
+                body.append(
+                    f"<tr><td>{_esc(ev.get('event'))}</td>"
+                    f"<td>{_esc(ev.get('server', ev.get('servers', '')))}</td>"
+                    f"<td>{_esc(ev.get('table', ''))}</td>"
+                    f"<td>{_esc(ev.get('segment', ''))}</td></tr>"
+                )
+            body.append("</table>")
+            body.append(
+                "<p>Full event ring + metrics: "
+                "<a href='/debug/stabilizer'>/debug/stabilizer</a></p>"
+            )
 
     body.append("<h2>Tables</h2>")
     body.append(

@@ -19,6 +19,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional
 
 from pinot_tpu.common.schema import time_unit_to_millis
@@ -28,11 +29,19 @@ from pinot_tpu.utils.metrics import ControllerMetrics
 logger = logging.getLogger(__name__)
 
 
+# every started manager registers here so the conftest thread-leak
+# guard can assert that a stopped manager's worker actually exited
+# (mirrors engine.dispatch._all_lanes / leaked_lane_threads)
+_all_managers: "weakref.WeakSet[_PeriodicManager]" = weakref.WeakSet()
+
+
 class _PeriodicManager:
-    def __init__(self, interval_s: float) -> None:
+    def __init__(self, interval_s: float, metrics_scope: Optional[str] = None) -> None:
         self.interval_s = interval_s
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self.metrics = ControllerMetrics(metrics_scope or type(self).__name__)
+        _all_managers.add(self)
 
     def run_once(self) -> None:
         raise NotImplementedError
@@ -43,13 +52,44 @@ class _PeriodicManager:
                 try:
                     self.run_once()
                 except Exception:
+                    # counted, not only logged: a manager silently
+                    # failing every round (retention never deleting,
+                    # stabilizer never healing) must show on a meter
+                    self.metrics.meter(
+                        f"manager.{type(self).__name__}.failures"
+                    ).mark()
                     logger.exception("%s run failed", type(self).__name__)
 
-        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread = threading.Thread(
+            target=loop, name=f"manager-{type(self).__name__}", daemon=True
+        )
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, join_timeout_s: float = 2.0) -> None:
         self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            # bounded join: the worker is at most one run_once away from
+            # seeing the stop event; a wedged run must not hang shutdown
+            t.join(timeout=join_timeout_s)
+
+
+def leaked_manager_threads(grace_s: float = 2.0) -> List[threading.Thread]:
+    """Worker threads still alive on STOPPED managers — the post-test
+    leak check (running managers, e.g. module-scoped fixtures, are
+    exempt: they are still on duty)."""
+    suspects: List[threading.Thread] = []
+    for mgr in list(_all_managers):
+        t = mgr._thread
+        if mgr._stop.is_set() and t is not None and t.is_alive():
+            suspects.append(t)
+    deadline = time.monotonic() + grace_s
+    leaked = []
+    for t in suspects:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if t.is_alive():
+            leaked.append(t)
+    return leaked
 
 
 class RetentionManager(_PeriodicManager):
@@ -60,7 +100,7 @@ class RetentionManager(_PeriodicManager):
         interval_s: float = 3600.0,
         now_ms=None,
     ) -> None:
-        super().__init__(interval_s)
+        super().__init__(interval_s, metrics_scope="retention")
         self.resources = resources
         self.store = store
         self._now_ms = now_ms or (lambda: int(time.time() * 1000))
@@ -90,11 +130,18 @@ class RetentionManager(_PeriodicManager):
 
 
 class ValidationManager(_PeriodicManager):
-    def __init__(self, resources: ClusterResourceManager, interval_s: float = 300.0) -> None:
-        super().__init__(interval_s)
+    def __init__(
+        self,
+        resources: ClusterResourceManager,
+        interval_s: float = 300.0,
+        realtime_manager=None,
+    ) -> None:
+        super().__init__(interval_s, metrics_scope="validation")
         self.resources = resources
-        self.metrics = ControllerMetrics("validation")
-        self.realtime_manager = None  # wired by realtime coordinator (stage 7)
+        # RealtimeSegmentManager: every run also re-creates missing
+        # CONSUMING segments (the LLC repair half of the reference's
+        # ValidationManager); the Controller wires it at construction
+        self.realtime_manager = realtime_manager
 
     def run_once(self) -> None:
         for table in self.resources.tables():
@@ -120,9 +167,8 @@ class ValidationManager(_PeriodicManager):
 
 class SegmentStatusChecker(_PeriodicManager):
     def __init__(self, resources: ClusterResourceManager, interval_s: float = 300.0) -> None:
-        super().__init__(interval_s)
+        super().__init__(interval_s, metrics_scope="segmentStatus")
         self.resources = resources
-        self.metrics = ControllerMetrics("segmentStatus")
 
     def run_once(self) -> None:
         for table in self.resources.tables():

@@ -158,6 +158,11 @@ class ParticipantGateway:
         heartbeat_timeout_s: float = 6.0,
         check_interval_s: float = 1.0,
         metrics=None,
+        flap_window_s: float = 60.0,
+        flap_threshold: int = 3,
+        flap_hold_base_s: float = 5.0,
+        flap_hold_max_s: float = 300.0,
+        clock=None,
     ) -> None:
         self.resources = resources
         self.board = MessageBoard()
@@ -166,6 +171,17 @@ class ParticipantGateway:
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self._check_interval_s = check_interval_s
         self._heartbeats: Dict[str, float] = {}
+        # flap hysteresis: dead->alive cycles inside flap_window_s; at
+        # flap_threshold the re-admit is HELD for an escalating window
+        # (doubling per extra flap, capped) so the stabilizer never
+        # thrashes segments onto a host that keeps dying
+        self.flap_window_s = flap_window_s
+        self.flap_threshold = flap_threshold
+        self.flap_hold_base_s = flap_hold_base_s
+        self.flap_hold_max_s = flap_hold_max_s
+        self._clock = clock or time.monotonic
+        self._revives: Dict[str, List[float]] = {}  # dead->alive times
+        self._readmit_hold: Dict[str, float] = {}  # name -> held until
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -209,12 +225,61 @@ class ParticipantGateway:
                     # health poll that could race the routing update
                     self.resources.set_instance_alive(name, False)
 
+    # -- flap hysteresis ----------------------------------------------
+    def _flap_gate(self, name: str) -> Optional[float]:
+        """Called when a DEAD instance asks to be re-admitted.  Returns
+        the seconds remaining on a re-admit hold (refuse), or None
+        (admit now).  Only ADMITTED dead->alive cycles count as flaps,
+        so a stable survivor is never punished for heartbeating through
+        its own hold window."""
+        now = self._clock()
+        with self._lock:
+            hold_until = self._readmit_hold.get(name, 0.0)
+            if now < hold_until:
+                return hold_until - now
+            revives = [
+                t
+                for t in self._revives.get(name, ())
+                if now - t < self.flap_window_s
+            ]
+            if len(revives) >= self.flap_threshold:
+                excess = len(revives) - self.flap_threshold
+                hold = min(
+                    self.flap_hold_base_s * (2**excess), self.flap_hold_max_s
+                )
+                self._readmit_hold[name] = now + hold
+                # the refused attempt itself counts into the window (one
+                # entry per hold — heartbeats DURING a hold return above
+                # without appending), so repeated holds escalate; once
+                # holds outgrow the window the entries age out and a
+                # now-stable host is re-admitted
+                revives.append(now)
+                self._revives[name] = revives
+                logger.warning(
+                    "instance %s flapped %d times in %.0fs; holding re-admit "
+                    "for %.1fs",
+                    name, len(revives) - 1, self.flap_window_s, hold,
+                )
+                return hold
+            revives.append(now)
+            self._revives[name] = revives
+            flapped = len(revives) > 1
+        if flapped and self.metrics is not None:
+            self.metrics.meter("gateway.flaps").mark()
+        return None
+
     # -- instance API (called from HTTP handlers) ----------------------
     def register(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         name = payload["name"]
         role = payload.get("role", "server")
         if self.metrics is not None:
             self.metrics.meter("instanceRegistrations").mark()
+        prev = self.resources.instances.get(name)
+        was_dead = prev is not None and not prev.alive
+        # a crash-looping process re-REGISTERS on every loop: the same
+        # hysteresis that gates heartbeat revives gates registration, or
+        # the hold would be trivially bypassed
+        hold = self._flap_gate(name) if was_dead else None
         if payload.get("tags"):
             tags = set(payload["tags"])
         else:
@@ -234,6 +299,16 @@ class ParticipantGateway:
         with self._lock:
             self._heartbeats[name] = time.monotonic()
         self.resources.register_instance(state, participant)
+        if hold is not None:
+            # flapping host: registered (address/participant current)
+            # but NOT re-admitted to routing until the hold expires —
+            # its heartbeats will revive it once the gate clears
+            self.resources.set_instance_alive(name, False)
+            return {
+                "status": "held",
+                "holdSeconds": round(hold, 3),
+                "heartbeatTimeoutSeconds": self.heartbeat_timeout_s,
+            }
         if role == "server":
             # replay any ideal-state transitions targeting this server:
             # covers re-registration after a server crash AND first
@@ -246,6 +321,7 @@ class ParticipantGateway:
         return {
             "status": "ok",
             "heartbeatTimeoutSeconds": self.heartbeat_timeout_s,
+            "draining": state.draining,
         }
 
     def heartbeat(self, name: str) -> Dict[str, Any]:
@@ -257,9 +333,21 @@ class ParticipantGateway:
         with self._lock:
             self._heartbeats[name] = time.monotonic()
         if not inst.alive:
+            hold = self._flap_gate(name)
+            if hold is not None:
+                # flapping: stays out of routing until the hold expires
+                # (the heartbeat is still recorded so the monitor loop
+                # doesn't pile a fresh death on top)
+                return {
+                    "status": "held",
+                    "holdSeconds": round(hold, 3),
+                    "draining": inst.draining,
+                }
             self.resources.set_instance_alive(name, True)
             self._kick_server_available()
-        return {"status": "ok"}
+        # drain ack rides the heartbeat reply: a draining server learns
+        # its state without a dedicated poll and surfaces it in status()
+        return {"status": "ok", "draining": inst.draining}
 
     def _kick_server_available(self) -> None:
         """A server just became available: run deferred repairs (e.g.
@@ -310,12 +398,16 @@ class ParticipantGateway:
         quotas: Dict[str, Any] = {}
         for table in res.tables():
             view = res.get_external_view(table)
-            # hide dead servers from routing, as _notify_view does
+            # hide dead AND draining servers from routing, as
+            # _notify_view does: brokers stop sending NEW queries to a
+            # draining instance while its in-flight ones finish
             tables[table] = {
                 seg: {
                     srv: st
                     for srv, st in replicas.items()
-                    if instances.get(srv) is not None and instances[srv].alive
+                    if instances.get(srv) is not None
+                    and instances[srv].alive
+                    and not instances[srv].draining
                 }
                 for seg, replicas in view.items()
             }
@@ -349,12 +441,21 @@ class ParticipantGateway:
             for name, inst in instances.items()
             if inst.role == "server" and not inst.alive
         ]
+        # draining servers stay in "servers" (their addresses must keep
+        # resolving for in-flight work) but are listed here so remote
+        # brokers/ops can tell deliberate drain from failure
+        draining_servers = [
+            name
+            for name, inst in instances.items()
+            if inst.role == "server" and inst.alive and inst.draining
+        ]
         return {
             "version": version,
             "epoch": out_epoch,
             "tables": tables,
             "servers": servers,
             "deadServers": dead_servers,
+            "drainingServers": draining_servers,
             "quotas": quotas,
             "timeBoundaries": boundaries,
         }

@@ -49,6 +49,11 @@ class InstanceState:
     tags: Set[str] = field(default_factory=lambda: {"DefaultTenant"})
     url: Optional[str] = None  # broker HTTP url (client discovery)
     addr: Optional[Tuple[str, int]] = None  # server query-TCP endpoint
+    # drain/decommission: a draining server keeps serving in-flight
+    # queries but is hidden from NEW routing covers and excluded from
+    # segment placement; the SelfStabilizer migrates its replicas off
+    # so a rolling restart is drain -> restart -> rejoin (undrain)
+    draining: bool = False
 
 
 class Participant:
@@ -86,6 +91,16 @@ class ClusterResourceManager:
         self.external_views: Dict[str, Dict[str, Dict[str, str]]] = {}
         self.instances: Dict[str, InstanceState] = {}
         self._participants: Dict[str, Participant] = {}
+        # drain flags survive BOTH instance re-registration and
+        # controller restarts: kept by name (not on the InstanceState,
+        # which registration replaces) and persisted to the property
+        # store so a recovered controller resumes an in-flight drain
+        self._draining_flags: Set[str] = set()
+        if property_store is not None:
+            for name in property_store.list_keys("instances"):
+                rec = property_store.get("instances", name)
+                if rec and rec.get("draining"):
+                    self._draining_flags.add(name)
         self._view_listeners: List[Callable[[str, Dict[str, Dict[str, str]]], None]] = []
         self._instance_listeners: List[Callable[[str, bool], None]] = []
         self._assign_rr = 0
@@ -108,10 +123,57 @@ class ClusterResourceManager:
 
     def register_instance(self, state: InstanceState, participant: Optional[Participant] = None) -> None:
         with self._lock:
+            # a drain is an operator intent keyed by NAME: registration
+            # (fresh process or re-register after a controller restart)
+            # must not silently re-admit a draining instance — only an
+            # explicit undrain does
+            state.draining = state.name in self._draining_flags
             self.instances[state.name] = state
             if participant is not None:
                 self._participants[state.name] = participant
         self.bump_version()
+
+    def set_instance_draining(self, name: str, draining: bool) -> None:
+        """Mark an instance draining (decommission intent): it keeps
+        answering in-flight queries but drops out of NEW routing covers
+        and of segment placement; the SelfStabilizer migrates its
+        replicas off.  The flag is durable (property store) and survives
+        re-registration — cleared only by an explicit undrain."""
+        with self._lock:
+            inst = self.instances.get(name)
+            if inst is None and name not in self._draining_flags:
+                if not draining:
+                    return
+                raise KeyError(f"unknown instance {name!r}")
+            if draining:
+                self._draining_flags.add(name)
+            else:
+                self._draining_flags.discard(name)
+            if inst is not None:
+                if inst.draining == draining:
+                    return
+                inst.draining = draining
+            tables = list(self.external_views.keys())
+        if self.property_store is not None:
+            if draining:
+                self.property_store.put("instances", name, {"draining": True})
+            else:
+                self.property_store.delete("instances", name)
+        # routing covers rebuild from the filtered views (draining
+        # servers hidden), on the same version bump remote brokers poll
+        for table in tables:
+            self._notify_view(table)
+        self.bump_version()
+
+    def segments_on(self, name: str) -> Dict[str, List[str]]:
+        """Ideal-state replicas still placed on ``name`` per table (the
+        drain endpoint's drained-vs-remaining accounting)."""
+        with self._lock:
+            return {
+                table: segs
+                for table, ideal in self.ideal_states.items()
+                if (segs := sorted(s for s, r in ideal.items() if name in r))
+            }
 
     def set_instance_alive(self, name: str, alive: bool) -> None:
         """Liveness flip (the ZK-session-loss analog): a dead server's
@@ -201,6 +263,13 @@ class ClusterResourceManager:
             except Exception:
                 logger.exception("instance listener failed for %s", name)
 
+    def _routable(self, srv: str) -> bool:
+        """Server visible to brokers for NEW queries: registered, alive,
+        and not draining (a draining server still answers in-flight
+        work; it just stops receiving fresh covers)."""
+        inst = self.instances.get(srv)
+        return inst is not None and inst.alive and not inst.draining
+
     def _notify_view(self, table: str) -> None:
         self.bump_version()
         with self._lock:
@@ -208,7 +277,7 @@ class ClusterResourceManager:
                 seg: {
                     srv: st
                     for srv, st in replicas.items()
-                    if self.instances.get(srv, InstanceState(srv, "server", False)).alive
+                    if self._routable(srv)
                 }
                 for seg, replicas in self.external_views.get(table, {}).items()
             }
@@ -302,7 +371,10 @@ class ClusterResourceManager:
             eligible = sorted(
                 n
                 for n, inst in self.instances.items()
-                if inst.role == "server" and inst.alive and config.server_tenant in inst.tags
+                if inst.role == "server"
+                and inst.alive
+                and not inst.draining
+                and config.server_tenant in inst.tags
             )
             if not eligible:
                 raise RuntimeError("no live servers to rebalance onto")
@@ -470,7 +542,10 @@ class ClusterResourceManager:
             servers = sorted(
                 n
                 for n, inst in self.instances.items()
-                if inst.role == "server" and inst.alive and config.server_tenant in inst.tags
+                if inst.role == "server"
+                and inst.alive
+                and not inst.draining
+                and config.server_tenant in inst.tags
             )
         if not servers:
             raise RuntimeError("no live servers to assign segment")
@@ -578,6 +653,67 @@ class ClusterResourceManager:
             else:
                 tbl_view.setdefault(segment, {})[server] = state
         self._notify_view(table)
+
+    # -- per-replica surgery (SelfStabilizer) --------------------------
+    def add_segment_replica(self, table: str, segment: str, server: str) -> bool:
+        """Add ``server`` to a segment's ideal replica set and drive it
+        to the set's existing target state (the re-replication step: the
+        new replica fetches from the controller's durable copy via the
+        segment record's downloadUri/dir).  Idempotent."""
+        with self._lock:
+            replicas = self.ideal_states.get(table, {}).get(segment)
+            if replicas is None or server in replicas:
+                return False
+            state = next(iter(replicas.values()), ONLINE)
+            replicas[server] = state
+        self.persist_ideal_state(table)
+        self._execute_transition(table, segment, server, state)
+        self._notify_view(table)
+        return True
+
+    def remove_segment_replica(self, table: str, segment: str, server: str) -> bool:
+        """Remove one replica from a segment's ideal state.  A live
+        holder gets a DROPPED transition (unload); a dead one gets no
+        message — its queue was cleared on death, and re-registration
+        reconciles against the ideal state that no longer names it."""
+        with self._lock:
+            replicas = self.ideal_states.get(table, {}).get(segment)
+            if replicas is None or server not in replicas:
+                return False
+            del replicas[server]
+            inst = self.instances.get(server)
+            send_drop = inst is not None and inst.alive
+        self.persist_ideal_state(table)
+        if send_drop:
+            self._execute_transition(table, segment, server, DROPPED)
+        with self._lock:
+            self.external_views.get(table, {}).get(segment, {}).pop(server, None)
+        self._notify_view(table)
+        return True
+
+    def retire_segment(self, table: str, segment: str) -> List[str]:
+        """Drop a segment from ideal state + metadata, transitioning
+        only LIVE holders to DROPPED (unlike ``delete_segment``, which
+        messages every replica).  Used by the stabilizer to retire a
+        CONSUMING segment whose holders are all dead/draining so the
+        realtime manager can re-create it on a live server at the last
+        committed offset.  Returns the replica servers it held."""
+        with self._lock:
+            replicas = self.ideal_states.get(table, {}).pop(segment, {})
+            self.segment_metadata.pop((table, segment), None)
+            live = [
+                s
+                for s in replicas
+                if (inst := self.instances.get(s)) is not None and inst.alive
+            ]
+        self.persist_ideal_state(table)
+        self.persist_segment_record(table, segment)
+        for server in live:
+            self._execute_transition(table, segment, server, DROPPED)
+        with self._lock:
+            self.external_views.get(table, {}).pop(segment, None)
+        self._notify_view(table)
+        return sorted(replicas)
 
     def reset_segment(self, physical_table: str, segment: str, server: str) -> None:
         """ERROR -> OFFLINE -> retarget (the Helix error-reset analog)."""

@@ -1,0 +1,327 @@
+"""SelfStabilizer: failure-driven convergence of ideal state onto the
+live cluster.
+
+The reference control plane (Helix full-auto rebalancer +
+``ValidationManager``) continuously converges the external view toward
+the ideal state, so replicas on a dead server are re-hosted without an
+operator.  The heartbeat expiry in ``controller/network.py`` only
+*hides* a dead server from routing; every replica it held would stay
+lost until a human called ``rebalance_table``.  This manager closes the
+loop:
+
+- **Detect** under-replicated segments: replicas on dead (or
+  unregistered) and draining servers do not count against the table's
+  target replication.
+- **Grace window** (``PINOT_TPU_STABILIZE_GRACE_S``): a server's death
+  only becomes actionable after the window, so a GC pause or a rolling
+  bounce never triggers mass data movement.  Draining is deliberate
+  operator intent and gets no grace.
+- **Re-replicate** onto live tenant servers, least-loaded first with
+  load measured in DOCS (not segment count), so placement stays
+  balanced under skewed segment sizes (the skew-resistant-placement
+  idea from PIM-tree, PAPERS.md).  The new replica is driven ONLINE
+  through the normal transition path and re-fetches the segment from
+  the controller's durable store copy.
+- **Clean up** one round later: once the external view shows the
+  target number of live ONLINE replicas, the dead/draining replicas
+  drop out of the ideal state (DROPPED is sent only to live holders).
+- **CONSUMING segments** are never copied (a consumer's rows are not
+  durable): when every holder is unavailable the segment is retired and
+  handed to ``RealtimeSegmentManager.ensure_consuming_segments``, which
+  re-creates it on a live server resuming from the last COMMITTED
+  offset.
+
+Every action is a persisted ideal-state write, so the whole plan is
+crash-idempotent: a controller killed mid-round recovers the
+partially-applied ideal state from the property store and the next
+round converges to the same fixpoint (add-phase is keyed on deficits,
+drop-phase on restored coverage — both derived, never remembered).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+from pinot_tpu.controller.managers import _PeriodicManager
+from pinot_tpu.controller.resource_manager import (
+    CONSUMING,
+    ClusterResourceManager,
+    ONLINE,
+)
+
+logger = logging.getLogger(__name__)
+
+_EVENT_RING = 256
+
+
+class SelfStabilizer(_PeriodicManager):
+    def __init__(
+        self,
+        resources: ClusterResourceManager,
+        realtime_manager=None,
+        interval_s: float = 2.0,
+        grace_s: Optional[float] = None,
+        now=None,
+    ) -> None:
+        super().__init__(interval_s, metrics_scope="stabilizer")
+        self.resources = resources
+        self.realtime_manager = realtime_manager
+        if grace_s is None:
+            grace_s = float(os.environ.get("PINOT_TPU_STABILIZE_GRACE_S", "5"))
+        self.grace_s = grace_s
+        self._now = now or time.monotonic
+        # first-observed-dead timestamps; entries clear on recovery
+        self._dead_since: Dict[str, float] = {}
+        # heal-event ring for /debug/stabilizer and the dashboard (the
+        # controller-side analog of the server's selfHealing counters)
+        self._events: Deque[Dict[str, Any]] = deque(maxlen=_EVENT_RING)
+        for m in (
+            "stabilizer.rounds",
+            "stabilizer.replicasAdded",
+            "stabilizer.replicasDropped",
+            "stabilizer.consumingReassigned",
+            "stabilizer.graceDeferrals",
+        ):
+            self.metrics.meter(m)
+        for g in (
+            "stabilizer.underReplicatedSegments",
+            "stabilizer.drainingInstances",
+            "stabilizer.deadServers",
+        ):
+            self.metrics.gauge(g).set(0)
+
+    # -- observability --------------------------------------------------
+    def _event(self, kind: str, **fields: Any) -> None:
+        self._events.append({"tsMs": int(time.time() * 1000), "event": kind, **fields})
+
+    def events(self) -> List[Dict[str, Any]]:
+        return list(self._events)
+
+    def debug_snapshot(self) -> Dict[str, Any]:
+        now = self._now()
+        return {
+            "graceSeconds": self.grace_s,
+            "deadTracked": {
+                name: round(now - since, 3)
+                for name, since in sorted(self._dead_since.items())
+            },
+            "events": self.events(),
+            "metrics": self.metrics.snapshot(),
+        }
+
+    def _quiescent(self, healthy, draining, server_state) -> bool:
+        """Cheap precheck: True when no round work can possibly exist —
+        nobody draining, every ideal-state replica sits on a healthy
+        server, and every non-consuming segment meets its target
+        replication.  One lock hold, no copies, no metadata reads."""
+        if draining:
+            return False
+        res = self.resources
+        with res._lock:
+            for table, ideal in res.ideal_states.items():
+                config = res.table_configs.get(table)
+                if config is None or not ideal:
+                    continue
+                n_eligible = sum(
+                    1
+                    for s in healthy
+                    if config.server_tenant in server_state[s][2]
+                )
+                n_target = min(config.replication, n_eligible)
+                for replicas in ideal.values():
+                    if not set(replicas) <= healthy:
+                        return False
+                    if (
+                        CONSUMING not in replicas.values()
+                        and len(replicas) < n_target
+                    ):
+                        return False
+        return True
+
+    # -- the convergence round -----------------------------------------
+    def run_once(self) -> None:
+        res = self.resources
+        now = self._now()
+        self.metrics.meter("stabilizer.rounds").mark()
+        with res._lock:
+            server_state = {
+                n: (i.alive, i.draining, set(i.tags))
+                for n, i in res.instances.items()
+                if i.role == "server"
+            }
+        healthy = {n for n, (a, d, _) in server_state.items() if a and not d}
+        draining = {n for n, (a, d, _) in server_state.items() if a and d}
+
+        def is_dead(s: str) -> bool:
+            st = server_state.get(s)
+            return st is None or not st[0]
+
+        _actionable: Dict[str, bool] = {}
+
+        def actionable_dead(s: str) -> bool:
+            """Dead AND past the grace window (tracking starts at first
+            observation, so a controller restarted mid-outage re-waits
+            the window rather than acting on a stale clock).  Memoized
+            per round: the deferral meter counts servers, not replicas."""
+            if s in _actionable:
+                return _actionable[s]
+            if not is_dead(s):
+                _actionable[s] = False
+                return False
+            since = self._dead_since.setdefault(s, now)
+            if since == now:
+                self._event("serverDead", server=s)
+            ok = now - since >= self.grace_s
+            if not ok:
+                self.metrics.meter("stabilizer.graceDeferrals").mark()
+            _actionable[s] = ok
+            return ok
+
+        # recoveries clear the death clock (a flap restarts the window)
+        for s in [s for s in self._dead_since if not is_dead(s)]:
+            del self._dead_since[s]
+            self._event("serverRecovered", server=s)
+
+        if self._quiescent(healthy, draining, server_state):
+            # steady state: one lock hold over replica-set keys, no view
+            # copies, no per-segment metadata reads — the 2s background
+            # cadence must not contend with the serving path for nothing
+            self.metrics.gauge("stabilizer.underReplicatedSegments").set(0)
+            self.metrics.gauge("stabilizer.drainingInstances").set(0)
+            self.metrics.gauge("stabilizer.deadServers").set(len(self._dead_since))
+            return
+
+        under_replicated = 0
+        consuming_repair = False
+        for table in res.tables():
+            config = res.table_configs.get(table)
+            if config is None:
+                continue
+            eligible = sorted(
+                s for s in healthy if config.server_tenant in server_state[s][2]
+            )
+            ideal = res.get_ideal_state(table)
+            if not ideal:
+                continue
+            n_target = min(config.replication, len(eligible))
+            # doc-weighted load: a server holding one huge segment is
+            # "fuller" than one holding three tiny ones (skew-resistant
+            # placement) — counted over the ideal state incl. this
+            # round's own additions
+            def weight(seg: str) -> int:
+                info = res.get_segment_metadata(table, seg)
+                meta = info.get("metadata") if info else None
+                docs = getattr(meta, "num_docs", 0) if meta is not None else 0
+                return max(1, int(docs or 0))
+
+            load = {s: 0 for s in eligible}
+            for seg, replicas in ideal.items():
+                w = weight(seg)
+                for s in replicas:
+                    if s in load:
+                        load[s] += w
+            view = res.get_external_view(table)
+            for seg in sorted(ideal):
+                replicas = ideal[seg]
+                unavailable = [
+                    s for s in replicas if s in draining or actionable_dead(s)
+                ]
+                if CONSUMING in replicas.values():
+                    # a consumer's rows are not durable — never copy the
+                    # segment; if NO holder is serving it, retire it so
+                    # ensure_consuming_segments re-creates it on a live
+                    # server at the last committed offset
+                    if replicas and not (set(replicas) & healthy) and len(
+                        unavailable
+                    ) == len(replicas):
+                        if self.realtime_manager is not None:
+                            self.realtime_manager.release_segment_consumers(seg)
+                        held = res.retire_segment(table, seg)
+                        consuming_repair = True
+                        self.metrics.meter("stabilizer.consumingReassigned").mark()
+                        self._event(
+                            "consumingRetired", table=table, segment=seg,
+                            servers=held,
+                        )
+                    elif unavailable:
+                        # a healthy holder keeps consuming: shed only the
+                        # unavailable replicas (a drain would otherwise
+                        # never report drained — the next sequence opens
+                        # at full replication on live servers at commit).
+                        # Transiently under-replicated, as the
+                        # reference's fixed consuming assignment is too.
+                        for s in unavailable:
+                            if self.realtime_manager is not None:
+                                self.realtime_manager.release_segment_consumers(
+                                    seg, server=s
+                                )
+                            if res.remove_segment_replica(table, seg, s):
+                                self.metrics.meter(
+                                    "stabilizer.replicasDropped"
+                                ).mark()
+                                self._event(
+                                    "replicaDropped", table=table, segment=seg,
+                                    server=s, consuming=True,
+                                    reason="draining" if s in draining else "dead",
+                                )
+                    continue
+                if n_target == 0:
+                    under_replicated += 1
+                    continue
+                # drop phase FIRST, using the pre-round external view: a
+                # dead/draining replica leaves the ideal state only after
+                # the view proves target-many live replicas serve the
+                # segment (so the add phase of round N is confirmed by
+                # the view before round N+1 drops anything)
+                target_state = next(iter(replicas.values()), ONLINE)
+                covered = [
+                    s
+                    for s, st in view.get(seg, {}).items()
+                    if s in healthy and s in replicas and st == target_state
+                ]
+                if len(covered) >= n_target:
+                    for s in unavailable:
+                        if res.remove_segment_replica(table, seg, s):
+                            self.metrics.meter("stabilizer.replicasDropped").mark()
+                            self._event(
+                                "replicaDropped", table=table, segment=seg,
+                                server=s, reason="draining" if s in draining else "dead",
+                            )
+                            replicas.pop(s, None)
+                # add phase: replicas within grace still count (that IS
+                # the grace: no movement yet), draining/actionable ones
+                # do not
+                counted = [
+                    s
+                    for s in replicas
+                    if s in healthy or (is_dead(s) and not actionable_dead(s))
+                ]
+                deficit = n_target - len(counted)
+                if deficit <= 0:
+                    continue
+                under_replicated += 1
+                w = weight(seg)
+                candidates = [s for s in eligible if s not in replicas]
+                for _ in range(deficit):
+                    if not candidates:
+                        break
+                    pick = min(candidates, key=lambda s: (load[s], s))
+                    candidates.remove(pick)
+                    if res.add_segment_replica(table, seg, pick):
+                        load[pick] += w
+                        self.metrics.meter("stabilizer.replicasAdded").mark()
+                        self._event(
+                            "replicaAdded", table=table, segment=seg,
+                            server=pick, docs=w,
+                        )
+        if consuming_repair and self.realtime_manager is not None:
+            try:
+                self.realtime_manager.ensure_consuming_segments()
+            except Exception:
+                logger.exception("consuming-segment repair failed")
+        self.metrics.gauge("stabilizer.underReplicatedSegments").set(under_replicated)
+        self.metrics.gauge("stabilizer.drainingInstances").set(len(draining))
+        self.metrics.gauge("stabilizer.deadServers").set(len(self._dead_since))

@@ -248,7 +248,7 @@ class RealtimeSegmentManager:
             live = sorted(
                 name
                 for name, inst in self.resources.instances.items()
-                if inst.role == "server" and inst.alive
+                if inst.role == "server" and inst.alive and not inst.draining
             )
         ideal = self.resources.get_ideal_state(physical)
         # ownership from the pinned replica sets (sealed uploads replace
@@ -484,6 +484,23 @@ class RealtimeSegmentManager:
     def consumers_of(self, segment: str) -> List["RealtimeSegmentDataManager"]:
         with self._lock:
             return [dm for (seg, _), dm in self._consumers.items() if seg == segment]
+
+    def release_segment_consumers(self, segment: str, server: Optional[str] = None) -> None:
+        """Stop and forget in-process consumers of ``segment`` — all of
+        them, or only ``server``'s (the stabilizer retires a consuming
+        segment whose holders are all dead/draining, or sheds one
+        unavailable replica of a still-consuming segment; a stale map
+        entry would make a later CONSUMING transition on the same
+        (segment, server) resume the OLD mutable with uncommitted rows
+        instead of re-consuming from the committed offset)."""
+        with self._lock:
+            for key in [
+                k
+                for k in self._consumers
+                if k[0] == segment and (server is None or k[1] == server)
+            ]:
+                self._consumers[key].stop()
+                del self._consumers[key]
 
     # -- commit --------------------------------------------------------
     def on_segment_committed(self, segment: str, committed) -> None:

@@ -88,6 +88,11 @@ class ServerInstance:
                   "segmentsMissedServing", "crcFailures", "quarantinedSegments"):
             self.metrics.meter(m)
         self._table_schemas: dict = {}  # raw table name -> Schema
+        # controller-acknowledged drain state (set from the heartbeat
+        # reply by the networked starter): the instance keeps serving —
+        # brokers simply stop routing new covers here — but ops can see
+        # the drain in status()/debug output
+        self.draining = False
 
     # -- segment lifecycle -------------------------------------------
     @staticmethod
@@ -241,6 +246,7 @@ class ServerInstance:
         heal["quarantinedSegments"] = self.metrics.meter("quarantinedSegments").count
         return {
             "name": self.name,
+            "draining": self.draining,
             "scheduler": self.scheduler.stats(),
             "lane": None if self.lane is None else self.lane.stats(),
             "selfHealing": heal,

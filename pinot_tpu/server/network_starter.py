@@ -514,6 +514,10 @@ class NetworkedServerStarter:
         while not self._stop.wait(self.heartbeat_interval_s):
             try:
                 out = self._post(f"/instances/{self.name}/heartbeat", {})
+                # drain ack: the controller tells us (on the heartbeat it
+                # already makes) that an operator is draining this host;
+                # surfaced in status() so ops tooling sees the ack
+                self.server.draining = bool(out.get("draining"))
                 if out.get("reregister"):
                     self._post(
                         "/instances",

@@ -5,11 +5,19 @@ The reference's ``PerfBenchmarkDriver.java:61`` (starts the whole
 cluster in-process, :160-162) and the integration tests' ``ClusterTest``
 use the same trick; this is the standard harness for quickstarts, perf
 runs, and integration tests.
+
+``--scenario kill-server|drain|rolling-restart`` runs the cluster
+self-stabilization chaos scenarios (closed-loop query load while a
+server dies / drains / every server rolls): the SAME scenario code
+drives manual chaos runs from this CLI and the deterministic tier-1
+chaos tests (``tests/test_stabilizer.py``).
 """
 from __future__ import annotations
 
 import tempfile
-from typing import Dict, List, Optional, Sequence
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
 
 from pinot_tpu.broker.broker import BrokerHttpServer, BrokerRequestHandler
 from pinot_tpu.broker.starter import BrokerStarter
@@ -151,3 +159,290 @@ def single_server_broker(
     )
     broker.local_servers = [server]
     return broker
+
+
+# ---------------------------------------------------------------------------
+# Self-stabilization chaos scenarios (shared by the CLI and the tier-1
+# chaos tests): closed-loop load over an in-process cluster while a
+# server is killed / drained / the whole fleet rolling-restarts, with
+# the SelfStabilizer driven explicitly (run_once — deterministic, no
+# background sleeps).
+# ---------------------------------------------------------------------------
+
+
+class ClosedLoopLoad:
+    """N client threads issuing the same query back-to-back, classifying
+    every response: ok (complete + correct), partial (transient
+    ``partialResponse`` — allowed during healing), failed (wrong count
+    or exceptions on a response claiming to be complete)."""
+
+    def __init__(
+        self, cluster: "InProcessCluster", pql: str, expected_docs: int,
+        clients: int = 3,
+    ) -> None:
+        self.cluster = cluster
+        self.pql = pql
+        self.expected_docs = expected_docs
+        self.clients = clients
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self.total = 0
+        self.ok = 0
+        self.partials = 0
+        self.failed = 0
+        self.failures: List[str] = []  # first few failure descriptions
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                resp = self.cluster.broker.handle_pql(self.pql)
+            except Exception as e:  # a raised handler is always a failure
+                with self._lock:
+                    self.total += 1
+                    self.failed += 1
+                    if len(self.failures) < 8:
+                        self.failures.append(f"{type(e).__name__}: {e}")
+                continue
+            with self._lock:
+                self.total += 1
+                if resp.partial_response:
+                    self.partials += 1
+                elif resp.exceptions or resp.num_docs_scanned != self.expected_docs:
+                    self.failed += 1
+                    if len(self.failures) < 8:
+                        self.failures.append(
+                            f"docs={resp.num_docs_scanned}/{self.expected_docs} "
+                            f"exceptions={[e.message for e in resp.exceptions][:2]}"
+                        )
+                else:
+                    self.ok += 1
+
+    def start(self) -> "ClosedLoopLoad":
+        for i in range(self.clients):
+            t = threading.Thread(target=self._loop, name=f"load-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> Dict[str, Any]:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+        return {
+            "queries": self.total,
+            "okQueries": self.ok,
+            "partialQueries": self.partials,
+            "failedQueries": self.failed,
+            "failures": list(self.failures),
+        }
+
+
+def _build_scenario_cluster(
+    num_servers: int, replication: int, num_segments: int,
+    data_dir: Optional[str] = None, seed: int = 5,
+):
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.tools.datagen import make_test_schema, random_rows
+
+    cluster = InProcessCluster(num_servers=num_servers, data_dir=data_dir)
+    # scenarios drive rounds explicitly; act on death immediately
+    cluster.controller.stabilizer.grace_s = 0.0
+    schema = make_test_schema(with_mv=False)
+    physical = cluster.add_offline_table(schema, replication=replication)
+    rows = random_rows(schema, 260, seed=seed)
+    total = 0
+    for i in range(num_segments):
+        # skewed sizes: the stabilizer's doc-weighted placement is what
+        # keeps re-replication balanced under this skew
+        n = 30 + 45 * (i % 5)
+        cluster.upload(physical, build_segment(schema, rows[:n], physical, f"seg{i}"))
+        total += n
+    return cluster, physical, total
+
+
+def _replication_state(cluster, physical: str, excluded=()) -> Dict[str, Any]:
+    res = cluster.controller.resources
+    ideal = res.get_ideal_state(physical)
+    sizes = sorted({len(r) for r in ideal.values()}) if ideal else []
+    return {
+        "segments": len(ideal),
+        "replicaSetSizes": sizes,
+        "onExcluded": sum(
+            1 for r in ideal.values() if any(s in r for s in excluded)
+        ),
+        "viewConverged": res.get_external_view(physical) == ideal,
+    }
+
+
+def run_kill_server_scenario(
+    num_servers: int = 3, replication: int = 2, num_segments: int = 6,
+    clients: int = 3, rounds: int = 2, victim: str = "server0",
+    data_dir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Kill one server under closed-loop load: zero failed queries
+    (replica failover absorbs the loss), full replication restored by
+    the stabilizer within ``rounds`` rounds, dead replicas dropped."""
+    cluster, physical, total = _build_scenario_cluster(
+        num_servers, replication, num_segments, data_dir
+    )
+    try:
+        load = ClosedLoopLoad(
+            cluster, "SELECT count(*) FROM testTable", total, clients
+        ).start()
+        time.sleep(0.15)  # warm: some queries complete pre-fault
+        # kill: data plane goes dark, then the control plane declares the
+        # death (the heartbeat-expiry path calls the same liveness flip)
+        cluster.transport.set_down((victim, 0))
+        cluster.controller.resources.set_instance_alive(victim, False)
+        for _ in range(rounds):
+            cluster.controller.stabilizer.run_once()
+        time.sleep(0.15)  # healed steady state under load
+        summary = load.stop()
+        state = _replication_state(cluster, physical, excluded=[victim])
+        final = cluster.query("SELECT count(*) FROM testTable")
+        want = min(replication, num_servers - 1)
+        return {
+            "scenario": "kill-server",
+            "victim": victim,
+            "rounds": rounds,
+            **summary,
+            **state,
+            "replicationRestored": state["replicaSetSizes"] == [want]
+            and state["onExcluded"] == 0,
+            "finalDocs": final.num_docs_scanned,
+            "expectedDocs": total,
+            "finalComplete": not final.partial_response and not final.exceptions,
+            "stabilizer": cluster.controller.stabilizer.metrics.snapshot()["meters"],
+        }
+    finally:
+        cluster.stop()
+
+
+def _drain_one(cluster, name: str, max_rounds: int = 6) -> int:
+    """Drain ``name`` and run stabilizer rounds until its replicas are
+    fully migrated; returns rounds used."""
+    cluster.controller.drain_instance(name)
+    used = 0
+    while used < max_rounds:
+        if cluster.controller.drain_status(name)["drained"]:
+            break
+        cluster.controller.stabilizer.run_once()
+        used += 1
+    return used
+
+
+def run_drain_scenario(
+    num_servers: int = 3, replication: int = 2, num_segments: int = 6,
+    clients: int = 3, victim: str = "server0", data_dir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Drain one server under load: new routing stops covering it, the
+    stabilizer migrates every replica off, the drain endpoint reports
+    drained, and no query fails along the way."""
+    cluster, physical, total = _build_scenario_cluster(
+        num_servers, replication, num_segments, data_dir
+    )
+    try:
+        load = ClosedLoopLoad(
+            cluster, "SELECT count(*) FROM testTable", total, clients
+        ).start()
+        time.sleep(0.15)
+        rounds = _drain_one(cluster, victim)
+        status = cluster.controller.drain_status(victim)
+        time.sleep(0.15)
+        summary = load.stop()
+        state = _replication_state(cluster, physical, excluded=[victim])
+        final = cluster.query("SELECT count(*) FROM testTable")
+        return {
+            "scenario": "drain",
+            "victim": victim,
+            "roundsToDrain": rounds,
+            "drainStatus": {k: status[k] for k in ("draining", "remainingSegments", "drained")},
+            **summary,
+            **state,
+            "finalDocs": final.num_docs_scanned,
+            "expectedDocs": total,
+            "finalComplete": not final.partial_response and not final.exceptions,
+        }
+    finally:
+        cluster.stop()
+
+
+def run_rolling_restart_scenario(
+    num_servers: int = 3, replication: int = 2, num_segments: int = 6,
+    clients: int = 3, data_dir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Rolling restart of EVERY server under load, one at a time:
+    drain -> (replicas migrate) -> restart (down+dead, then back) ->
+    undrain -> next.  Zero failed queries, zero permanent segment loss."""
+    cluster, physical, total = _build_scenario_cluster(
+        num_servers, replication, num_segments, data_dir
+    )
+    res = cluster.controller.resources
+    try:
+        load = ClosedLoopLoad(
+            cluster, "SELECT count(*) FROM testTable", total, clients
+        ).start()
+        time.sleep(0.1)
+        rounds_per_server: Dict[str, int] = {}
+        for server in [s.name for s in cluster.servers]:
+            rounds_per_server[server] = _drain_one(cluster, server)
+            assert cluster.controller.drain_status(server)["drained"], server
+            # "restart": the process goes away (data plane down, death
+            # declared) and comes back — it holds nothing, so this is
+            # invisible to queries
+            cluster.transport.set_down((server, 0))
+            res.set_instance_alive(server, False)
+            cluster.transport.set_down((server, 0), False)
+            res.set_instance_alive(server, True)
+            cluster.controller.undrain_instance(server)
+            cluster.controller.stabilizer.run_once()
+        time.sleep(0.1)
+        summary = load.stop()
+        state = _replication_state(cluster, physical)
+        final = cluster.query("SELECT count(*) FROM testTable")
+        return {
+            "scenario": "rolling-restart",
+            "roundsPerServer": rounds_per_server,
+            **summary,
+            **state,
+            "noSegmentLoss": state["replicaSetSizes"] == [replication]
+            and final.num_docs_scanned == total
+            and not final.partial_response,
+            "finalDocs": final.num_docs_scanned,
+            "expectedDocs": total,
+        }
+    finally:
+        cluster.stop()
+
+
+SCENARIOS = {
+    "kill-server": run_kill_server_scenario,
+    "drain": run_drain_scenario,
+    "rolling-restart": run_rolling_restart_scenario,
+}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--scenario", choices=sorted(SCENARIOS), required=True)
+    p.add_argument("--servers", type=int, default=3)
+    p.add_argument("--replication", type=int, default=2)
+    p.add_argument("--segments", type=int, default=6)
+    p.add_argument("--clients", type=int, default=3)
+    args = p.parse_args(argv)
+    out = SCENARIOS[args.scenario](
+        num_servers=args.servers,
+        replication=args.replication,
+        num_segments=args.segments,
+        clients=args.clients,
+    )
+    print(json.dumps(out, indent=2))
+    return 0 if out["failedQueries"] == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -400,6 +400,20 @@ CONTROLLER_METRIC_CATALOG: Dict[str, str] = {
     "transitionAcks": "segment-transition acks processed",
     "clusterStatePolls": "full cluster-state snapshots served to brokers",
     "segmentUploads": "segments stored via the upload paths",
+    "gateway.flaps": "dead->alive instance cycles admitted (flap hysteresis)",
+    "manager.*.failures": "periodic-manager run_once failures, by manager",
+    "stabilizer.rounds": "self-stabilizer convergence rounds executed",
+    "stabilizer.replicasAdded": "replicas re-replicated onto live servers",
+    "stabilizer.replicasDropped": "dead/draining replicas removed from ideal "
+    "state after coverage was restored",
+    "stabilizer.consumingReassigned": "consuming segments retired for "
+    "re-creation on a live server at the committed offset",
+    "stabilizer.graceDeferrals": "dead servers whose re-replication was "
+    "deferred inside the grace window",
+    "stabilizer.underReplicatedSegments": "segments currently below target "
+    "replication on live servers",
+    "stabilizer.drainingInstances": "instances currently draining",
+    "stabilizer.deadServers": "servers currently tracked as dead",
     "aliveServers": "registered server instances currently alive",
     "aliveBrokers": "registered broker instances currently alive",
     "deadInstances": "registered instances currently marked dead",

@@ -51,3 +51,20 @@ def _no_leaked_lane_threads():
         f"device-lane threads leaked past lane close: "
         f"{[t.name for t in leaked]}"
     )
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_manager_threads():
+    """Controller periodic managers (retention/validation/status/
+    stabilizer): a stopped manager's worker must actually exit —
+    ``_PeriodicManager.stop()`` joins it with a bounded timeout, and
+    this guard catches any manager loop that shrugged off the stop
+    event.  Still-running managers (module fixtures) are exempt."""
+    yield
+    from pinot_tpu.controller.managers import leaked_manager_threads
+
+    leaked = leaked_manager_threads(grace_s=2.0)
+    assert not leaked, (
+        f"controller-manager threads leaked past stop(): "
+        f"{[t.name for t in leaked]}"
+    )

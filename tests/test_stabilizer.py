@@ -1,0 +1,600 @@
+"""Cluster self-stabilization tests.
+
+Chaos acceptance (``-m chaos``, deterministic, tier-1): killing a
+server under closed-loop load loses zero queries and replication is
+restored within 2 stabilizer rounds; a drain-based rolling restart of
+every server completes with zero failed queries and zero permanent
+segment loss; a controller killed and restarted mid-stabilization
+resumes idempotently and converges to the same ideal state.
+
+Plus unit coverage: grace-window deferral, skew-aware (doc-weighted)
+re-replication placement, consuming-segment handoff at the committed
+offset, drain REST endpoints, heartbeat flap hysteresis, periodic-
+manager stop/failure accounting, and RetentionManager /
+SegmentStatusChecker run_once edge cases.
+"""
+import os
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.common.schema import DataType, FieldSpec, FieldType, Schema, TimeFieldSpec
+from pinot_tpu.controller.controller import Controller, ControllerHttpServer
+from pinot_tpu.controller.managers import (
+    RetentionManager,
+    SegmentStatusChecker,
+    _PeriodicManager,
+)
+from pinot_tpu.controller.network import ParticipantGateway
+from pinot_tpu.controller.resource_manager import ClusterResourceManager, InstanceState
+from pinot_tpu.controller.stabilizer import SelfStabilizer
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.server.instance import ServerInstance
+from pinot_tpu.server.starter import ServerStarter
+from pinot_tpu.tools.cluster_harness import (
+    InProcessCluster,
+    run_drain_scenario,
+    run_kill_server_scenario,
+    run_rolling_restart_scenario,
+)
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+
+
+# ------------------------------------------------------------------
+# chaos acceptance — the same scenario code the CLI runs
+# ------------------------------------------------------------------
+@pytest.mark.chaos
+def test_kill_server_acceptance(tmp_path):
+    out = run_kill_server_scenario(data_dir=str(tmp_path))
+    assert out["failedQueries"] == 0, out["failures"]
+    assert out["replicationRestored"], out
+    assert out["viewConverged"], out
+    assert out["finalComplete"] and out["finalDocs"] == out["expectedDocs"]
+    assert out["stabilizer"]["stabilizer.replicasAdded"]["count"] > 0
+    assert out["stabilizer"]["stabilizer.replicasDropped"]["count"] > 0
+
+
+@pytest.mark.chaos
+def test_drain_acceptance(tmp_path):
+    out = run_drain_scenario(data_dir=str(tmp_path))
+    assert out["failedQueries"] == 0, out["failures"]
+    assert out["drainStatus"]["drained"] and out["drainStatus"]["draining"]
+    assert out["onExcluded"] == 0  # nothing left on the drained server
+    assert out["finalComplete"] and out["finalDocs"] == out["expectedDocs"]
+
+
+@pytest.mark.chaos
+def test_rolling_restart_acceptance(tmp_path):
+    out = run_rolling_restart_scenario(data_dir=str(tmp_path))
+    assert out["failedQueries"] == 0, out["failures"]
+    assert out["noSegmentLoss"], out
+    assert out["viewConverged"], out
+
+
+# ------------------------------------------------------------------
+# grace window + placement
+# ------------------------------------------------------------------
+def _offline_cluster(tmp_path, num_servers=3, replication=2, segments=3, docs=60):
+    cluster = InProcessCluster(num_servers=num_servers, data_dir=str(tmp_path))
+    schema = make_test_schema(with_mv=False)
+    physical = cluster.add_offline_table(schema, replication=replication)
+    rows = random_rows(schema, docs, seed=3)
+    for i in range(segments):
+        cluster.upload(physical, build_segment(schema, rows, physical, f"g{i}"))
+    return cluster, physical
+
+
+def test_grace_window_defers_movement(tmp_path):
+    """A dead server inside the grace window triggers NO data movement
+    (a GC pause / rolling bounce must not cause a mass copy); once the
+    window passes, re-replication proceeds."""
+    cluster, physical = _offline_cluster(tmp_path)
+    res = cluster.controller.resources
+    clock = [100.0]
+    st = SelfStabilizer(res, grace_s=10.0, now=lambda: clock[0])
+    before = res.get_ideal_state(physical)
+
+    res.set_instance_alive("server0", False)
+    st.run_once()
+    assert res.get_ideal_state(physical) == before  # deferred
+    assert st.metrics.meter("stabilizer.graceDeferrals").count == 1  # per server
+    assert st.metrics.gauge("stabilizer.deadServers").value == 1
+
+    # a recovery inside the window resets the death clock
+    res.set_instance_alive("server0", True)
+    clock[0] = 105.0
+    st.run_once()
+    assert st.metrics.gauge("stabilizer.deadServers").value == 0
+    res.set_instance_alive("server0", False)
+    clock[0] = 109.0  # only 4s into the NEW window
+    st.run_once()
+    assert res.get_ideal_state(physical) == before
+
+    clock[0] = 125.0  # past the window: act
+    st.run_once()
+    ideal = res.get_ideal_state(physical)
+    for seg, replicas in ideal.items():
+        assert len([s for s in replicas if s != "server0"]) == 2
+    st.run_once()  # cleanup round drops the dead replicas
+    ideal = res.get_ideal_state(physical)
+    assert all("server0" not in r for r in ideal.values())
+    cluster.stop()
+
+
+def test_skew_aware_replacement_placement(tmp_path):
+    """Re-replication load-balances by DOCS, not segment count: one huge
+    segment plus three small ones re-replicate onto the two survivors
+    with the big one alone on its server (PIM-tree-style skew
+    resistance)."""
+    cluster = InProcessCluster(num_servers=3, data_dir=str(tmp_path))
+    schema = make_test_schema(with_mv=False)
+    physical = cluster.add_offline_table(schema, replication=1)
+    res = cluster.controller.resources
+    rows = random_rows(schema, 200, seed=9)
+    for name, n in (("big", 200), ("t1", 10), ("t2", 10), ("t3", 10)):
+        seg = build_segment(schema, rows[:n], physical, name)
+        path = cluster.controller.store.save(physical, seg)
+        res.add_segment(
+            physical, seg.metadata,
+            {"dir": path, "downloadUri": "file://" + os.path.abspath(path)},
+            servers=["server0"],
+        )
+    res.set_instance_alive("server0", False)
+    st = cluster.controller.stabilizer
+    st.grace_s = 0.0
+    st.run_once()
+    st.run_once()
+    ideal = res.get_ideal_state(physical)
+    by_server = {}
+    for seg, replicas in ideal.items():
+        for s in replicas:
+            by_server.setdefault(s, set()).add(seg)
+    assert "server0" not in by_server
+    # the 200-doc segment sits alone; the three 10-doc ones share a host
+    big_host = next(s for s, segs in by_server.items() if "big" in segs)
+    assert by_server[big_host] == {"big"}
+    other = next(s for s in by_server if s != big_host)
+    assert by_server[other] == {"t1", "t2", "t3"}
+    # queries serve the full data from the rebuilt placement
+    resp = cluster.query("SELECT count(*) FROM testTable")
+    assert resp.num_docs_scanned == 230 and not resp.exceptions
+    cluster.stop()
+
+
+# ------------------------------------------------------------------
+# consuming-segment handoff
+# ------------------------------------------------------------------
+def _rt_schema():
+    return Schema(
+        "meetupRsvp",
+        dimensions=[FieldSpec("venue_name", DataType.STRING)],
+        metrics=[FieldSpec("rsvp_count", DataType.INT, FieldType.METRIC)],
+        time_field=TimeFieldSpec("mtime", DataType.LONG, time_unit="MILLISECONDS"),
+    )
+
+
+def test_consuming_handoff_resumes_at_committed_offset(tmp_path):
+    """Killing the server that hosts a CONSUMING segment retires it and
+    re-creates it on a live server resuming from the COMMITTED offset
+    (uncommitted rows re-consume from the stream — at-least-once, no
+    double count, no loss)."""
+    from pinot_tpu.realtime.llc import make_segment_name
+    from pinot_tpu.realtime.stream import MemoryStreamProvider
+
+    cluster = InProcessCluster(num_servers=2, data_dir=str(tmp_path))
+    schema = _rt_schema()
+    stream = MemoryStreamProvider(num_partitions=1)
+    physical = cluster.add_realtime_table(schema, stream, rows_per_segment=50)
+    for i in range(70):
+        stream.produce({"venue_name": f"v{i % 3}", "rsvp_count": i % 5, "mtime": 10_000 + i})
+
+    rm = cluster.controller.realtime_manager
+    res = cluster.controller.resources
+    seg0 = make_segment_name(physical, 0, 0)
+    dm = rm.consumers_of(seg0)[0]
+    dm.consume_step(max_rows=1000)
+    assert dm.try_commit() == "KEEP"  # seg0 committed at offset 50
+
+    seg1 = make_segment_name(physical, 0, 1)
+    holder = next(iter(res.get_ideal_state(physical)[seg1]))
+    dm1 = next(c for c in rm.consumers_of(seg1) if c.server.name == holder)
+    dm1.consume_step(max_rows=20)  # 20 UNCOMMITTED rows at offsets 50..69
+
+    res.set_instance_alive(holder, False)
+    st = cluster.controller.stabilizer
+    st.grace_s = 0.0
+    st.run_once()  # retire + recreate consuming, re-replicate seg0
+    st.run_once()
+
+    ideal = res.get_ideal_state(physical)
+    assert seg1 in ideal
+    new_holder = next(iter(ideal[seg1]))
+    assert new_holder != holder
+    assert ideal[seg1][new_holder] == "CONSUMING"
+    assert st.metrics.meter("stabilizer.consumingReassigned").count == 1
+    new_dm = rm.consumers_of(seg1)
+    assert len(new_dm) == 1 and new_dm[0].server.name == new_holder
+    assert new_dm[0].offset == 50  # committed offset, NOT the lost 70
+
+    new_dm[0].consume_step(max_rows=20)  # re-consume the 20 lost rows
+    resp = cluster.query("SELECT count(*) FROM meetupRsvp")
+    assert resp.num_docs_scanned == 70 and not resp.exceptions
+    assert resp.partial_response is False
+    cluster.stop()
+
+
+def test_drain_sheds_replicated_consuming_replica(tmp_path):
+    """Draining a server that holds one replica of a still-consuming
+    segment must complete: the draining replica is shed (the healthy
+    holder keeps consuming; the next sequence reopens at full
+    replication on commit) instead of wedging drained=false forever."""
+    from pinot_tpu.realtime.llc import make_segment_name
+    from pinot_tpu.realtime.stream import MemoryStreamProvider
+
+    cluster = InProcessCluster(num_servers=2, data_dir=str(tmp_path))
+    schema = _rt_schema()
+    stream = MemoryStreamProvider(num_partitions=1)
+    physical = cluster.add_realtime_table(
+        schema, stream, rows_per_segment=50, replication=2
+    )
+    seg0 = make_segment_name(physical, 0, 0)
+    rm = cluster.controller.realtime_manager
+    res = cluster.controller.resources
+    assert set(res.get_ideal_state(physical)[seg0]) == {"server0", "server1"}
+
+    cluster.controller.drain_instance("server0")
+    st = cluster.controller.stabilizer
+    st.grace_s = 0.0
+    st.run_once()
+    assert cluster.controller.drain_status("server0")["drained"]
+    ideal = res.get_ideal_state(physical)
+    assert ideal[seg0] == {"server1": "CONSUMING"}
+    # server0's consumer is released; server1's keeps consuming
+    holders = {dm.server.name for dm in rm.consumers_of(seg0)}
+    assert holders == {"server1"}
+    cluster.stop()
+
+
+# ------------------------------------------------------------------
+# drain endpoints
+# ------------------------------------------------------------------
+def test_drain_endpoints_http(tmp_path):
+    import json
+    import urllib.request
+
+    cluster, physical = _offline_cluster(tmp_path)
+    cluster.controller.stabilizer.grace_s = 0.0
+    http = ControllerHttpServer(cluster.controller)
+    http.start()
+    base = f"http://127.0.0.1:{http.port}"
+
+    def post(path):
+        req = urllib.request.Request(base + path, data=b"{}")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return json.loads(r.read())
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=5) as r:
+            return json.loads(r.read())
+
+    try:
+        out = post("/instances/server0/drain")
+        assert out["draining"] and out["remainingSegments"] > 0 and not out["drained"]
+        # draining server drops out of NEW routing covers immediately
+        cover = cluster.broker.routing.find_servers(physical)
+        assert "server0" not in cover
+        # the clusterstate lists it as DRAINING (deliberate), not dead
+        state = get("/clusterstate")
+        assert "server0" in state["drainingServers"]
+        assert "server0" not in state["deadServers"]
+        assert all(
+            "server0" not in replicas
+            for replicas in state["tables"][physical].values()
+        )
+
+        cluster.controller.stabilizer.run_once()
+        cluster.controller.stabilizer.run_once()
+        out = get("/instances/server0/drain")
+        assert out["drained"] and out["remainingSegments"] == 0
+
+        out = post("/instances/server0/undrain")
+        assert not out["draining"]
+        # stabilizer events + metrics ride the debug surface
+        dbg = get("/debug/stabilizer")
+        assert any(e["event"] == "replicaAdded" for e in dbg["events"])
+        assert dbg["metrics"]["meters"]["stabilizer.replicasDropped"]["count"] > 0
+
+        resp = cluster.query("SELECT count(*) FROM testTable")
+        assert not resp.exceptions and resp.partial_response is False
+
+        # a typo'd name must 404, never report drained=true to a
+        # rolling-restart loop about to bounce the real server
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/instances/serverO/drain")
+        assert ei.value.code == 404
+    finally:
+        http.stop()
+        cluster.stop()
+
+
+# ------------------------------------------------------------------
+# heartbeat flap hysteresis
+# ------------------------------------------------------------------
+def test_flap_hysteresis_holds_readmit():
+    from pinot_tpu.utils.metrics import ControllerMetrics
+
+    res = ClusterResourceManager()
+    clock = [0.0]
+    metrics = ControllerMetrics("controller")
+    gw = ParticipantGateway(
+        res, metrics=metrics, flap_window_s=60.0, flap_threshold=3,
+        flap_hold_base_s=5.0, clock=lambda: clock[0],
+    )
+    gw.register({"name": "s1", "role": "server"})
+
+    # three dead->alive cycles inside the window: admitted (metered)
+    for t in (1.0, 2.0, 3.0):
+        res.set_instance_alive("s1", False)
+        clock[0] = t
+        out = gw.heartbeat("s1")
+        assert out["status"] == "ok"
+        assert res.instances["s1"].alive
+    assert metrics.meter("gateway.flaps").count == 2  # cycles beyond the first
+
+    # the fourth revive attempt is HELD with an escalating window
+    res.set_instance_alive("s1", False)
+    clock[0] = 4.0
+    out = gw.heartbeat("s1")
+    assert out["status"] == "held" and out["holdSeconds"] == pytest.approx(5.0)
+    assert not res.instances["s1"].alive
+    clock[0] = 6.0  # still inside the hold
+    assert gw.heartbeat("s1")["status"] == "held"
+
+    # re-REGISTERING does not bypass the gate either
+    clock[0] = 7.0
+    out = gw.register({"name": "s1", "role": "server"})
+    assert out["status"] == "held"
+    assert not res.instances["s1"].alive
+
+    # a further attempt after the hold ESCALATES it (2x per extra flap)
+    clock[0] = 10.0
+    out = gw.heartbeat("s1")
+    assert out["status"] == "held" and out["holdSeconds"] == pytest.approx(10.0)
+
+    # once the flap window drains, the instance is re-admitted
+    clock[0] = 80.0
+    out = gw.heartbeat("s1")
+    assert out["status"] == "ok"
+    assert res.instances["s1"].alive
+    gw.stop()
+
+
+# ------------------------------------------------------------------
+# controller crash recovery
+# ------------------------------------------------------------------
+def _expected_ideal_after_kill(tmp_path, victim="server0"):
+    """The UNINTERRUPTED reference run: same cluster build, kill, two
+    stabilizer rounds — placement is deterministic, so this is the
+    fixpoint an interrupted run must also reach."""
+    cluster, physical = _offline_cluster(tmp_path, segments=4)
+    res = cluster.controller.resources
+    res.set_instance_alive(victim, False)
+    st = cluster.controller.stabilizer
+    st.grace_s = 0.0
+    st.run_once()
+    st.run_once()
+    ideal = res.get_ideal_state(physical)
+    cluster.stop()
+    return physical, ideal
+
+
+def test_controller_restart_mid_stabilization(tmp_path):
+    """Kill a controller between the stabilizer's add phase and its
+    cleanup phase: the recovered controller replays the partially-
+    applied plan from the property store and converges to the SAME
+    ideal state as an uninterrupted run — idempotently (a further round
+    changes nothing, and every server holds exactly its ideal-state
+    segments: no duplicate transitions)."""
+    physical, expected = _expected_ideal_after_kill(tmp_path / "ref")
+
+    data_dir = str(tmp_path / "live")
+    cluster, _ = _offline_cluster(tmp_path / "live", segments=4)
+    res = cluster.controller.resources
+    res.set_instance_alive("server0", False)
+    st = cluster.controller.stabilizer
+    st.grace_s = 0.0
+    st.run_once()  # ADD phase applied; dead replicas not yet dropped
+    mid = res.get_ideal_state(physical)
+    assert any("server0" in r for r in mid.values())  # plan half-applied
+    cluster.stop()  # controller "crashes" here
+
+    ctrl2 = Controller(data_dir)
+    ctrl2.stabilizer.grace_s = 0.0
+    # the surviving servers re-register with the recovered controller
+    # (server0 never comes back); registration replays their ideal-state
+    # transitions from the recovered property store
+    servers = {}
+    for name in ("server1", "server2"):
+        s = ServerInstance(name)
+        ServerStarter(s, ctrl2.resources).start()
+        servers[name] = s
+    ctrl2.stabilizer.run_once()
+    ctrl2.stabilizer.run_once()
+
+    ideal = ctrl2.resources.get_ideal_state(physical)
+    assert ideal == expected  # same fixpoint as the uninterrupted run
+    assert ctrl2.resources.get_external_view(physical) == ideal
+    # idempotent: another round is a no-op
+    ctrl2.stabilizer.run_once()
+    assert ctrl2.resources.get_ideal_state(physical) == ideal
+    # no duplicate/ghost replicas on the servers themselves
+    for name, s in servers.items():
+        want = sorted(seg for seg, r in ideal.items() if name in r)
+        assert sorted(s.data_manager.table(physical).segment_names()) == want
+    ctrl2.stop()
+
+
+def test_drain_flag_survives_controller_restart(tmp_path):
+    data_dir = str(tmp_path)
+    cluster, physical = _offline_cluster(tmp_path, num_servers=2, replication=1)
+    cluster.controller.drain_instance("server0")
+    assert cluster.controller.resources.instances["server0"].draining
+    cluster.stop()
+
+    ctrl2 = Controller(data_dir)
+    # recovered BEFORE the instance re-registers
+    assert ctrl2.drain_status("server0")["draining"]
+    # re-registration does not launder the drain away
+    s0 = ServerInstance("server0")
+    ServerStarter(s0, ctrl2.resources).start()
+    assert ctrl2.resources.instances["server0"].draining
+    # only an explicit undrain clears it — durably
+    ctrl2.undrain_instance("server0")
+    assert not ctrl2.resources.instances["server0"].draining
+    ctrl2.stop()
+    ctrl3 = Controller(data_dir)
+    assert not ctrl3.drain_status("server0")["draining"]
+    ctrl3.stop()
+
+
+# ------------------------------------------------------------------
+# periodic-manager lifecycle + failure accounting
+# ------------------------------------------------------------------
+def test_manager_stop_joins_worker_thread():
+    class _Tick(_PeriodicManager):
+        def __init__(self):
+            super().__init__(0.005)
+            self.runs = 0
+
+        def run_once(self):
+            self.runs += 1
+
+    m = _Tick()
+    m.start()
+    deadline = time.monotonic() + 5
+    while m.runs == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    m.stop()
+    assert m._thread is not None and not m._thread.is_alive()
+    assert m.runs >= 1
+
+
+def test_manager_run_failures_are_metered():
+    class _Boom(_PeriodicManager):
+        def run_once(self):
+            raise RuntimeError("boom")
+
+    m = _Boom(0.005)
+    m.start()
+    meter = m.metrics.meter("manager._Boom.failures")
+    deadline = time.monotonic() + 5
+    while meter.count < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    m.stop()
+    assert meter.count >= 2  # counted, not only logged
+
+
+def test_validation_manager_autowired_to_realtime(tmp_path):
+    ctrl = Controller(str(tmp_path))
+    assert ctrl.validation_manager.realtime_manager is ctrl.realtime_manager
+    ctrl.stop()
+
+
+# ------------------------------------------------------------------
+# RetentionManager / SegmentStatusChecker run_once edge cases
+# ------------------------------------------------------------------
+def _retention_fixture(tmp_path, retention_value):
+    from pinot_tpu.common.tableconfig import RetentionConfig, TableConfig
+
+    cluster = InProcessCluster(num_servers=1, data_dir=str(tmp_path))
+    schema = Schema(
+        "rt",
+        metrics=[FieldSpec("m", DataType.INT, FieldType.METRIC)],
+        time_field=TimeFieldSpec("days", DataType.INT, time_unit="DAYS"),
+    )
+    cluster.controller.add_schema(schema)
+    physical = cluster.controller.add_table(
+        TableConfig(
+            table_name="rt",
+            retention=RetentionConfig(
+                retention_time_unit="DAYS", retention_time_value=retention_value
+            ),
+        )
+    )
+    return cluster, schema, physical
+
+
+def test_retention_zero_and_negative_config_never_deletes(tmp_path):
+    for i, value in enumerate((0, -5)):
+        cluster, schema, physical = _retention_fixture(tmp_path / str(i), value)
+        ancient = build_segment(schema, [{"m": 1, "days": 1}], physical, "ancient")
+        cluster.upload(physical, ancient)
+        cluster.controller.retention_manager.run_once()
+        assert cluster.controller.resources.segments_of(physical) == ["ancient"]
+        cluster.stop()
+
+
+def test_retention_skips_segment_without_metadata(tmp_path):
+    cluster, schema, physical = _retention_fixture(tmp_path, 30)
+    res = cluster.controller.resources
+    with res._lock:  # a ghost ideal-state entry with no metadata record
+        res.ideal_states[physical]["ghost"] = {"server0": "ONLINE"}
+    cluster.controller.retention_manager.run_once()  # must not raise
+    assert "ghost" in res.segments_of(physical)
+    cluster.controller.status_checker.run_once()  # nor the checker
+    snap = cluster.controller.status_checker.metrics.snapshot()
+    assert snap["gauges"][f"{physical}.segmentCount"] == 1
+    cluster.stop()
+
+
+def test_retention_and_status_on_empty_table(tmp_path):
+    cluster, schema, physical = _retention_fixture(tmp_path, 30)
+    cluster.controller.retention_manager.run_once()
+    cluster.controller.status_checker.run_once()
+    snap = cluster.controller.status_checker.metrics.snapshot()
+    assert snap["gauges"][f"{physical}.percentSegmentsAvailable"] == 100.0
+    assert snap["gauges"][f"{physical}.segmentCount"] == 0
+    cluster.stop()
+
+
+def test_retention_tolerates_deletion_racing_snapshot(tmp_path, monkeypatch):
+    """A segment deleted between the ``segments_of`` snapshot and the
+    per-segment metadata fetch is skipped, not crashed on."""
+    cluster, schema, physical = _retention_fixture(tmp_path, 30)
+    now_days = int(time.time() // 86400)
+    cluster.upload(
+        physical, build_segment(schema, [{"m": 1, "days": now_days - 100}], physical, "old")
+    )
+    cluster.upload(
+        physical, build_segment(schema, [{"m": 2, "days": now_days}], physical, "fresh")
+    )
+    res = cluster.controller.resources
+    orig = res.segments_of
+
+    def racy(table):
+        segs = orig(table)
+        if "old" in segs:  # concurrent delete AFTER the snapshot
+            cluster.controller.delete_segment(physical, "old")
+        return segs
+
+    monkeypatch.setattr(res, "segments_of", racy)
+    cluster.controller.retention_manager.run_once()  # must not raise
+    monkeypatch.undo()
+    assert res.segments_of(physical) == ["fresh"]
+    cluster.stop()
+
+
+def test_status_checker_counts_missing_view_replicas(tmp_path):
+    cluster, physical = _offline_cluster(
+        tmp_path, num_servers=1, replication=1, segments=2
+    )
+    res = cluster.controller.resources
+    with res._lock:  # one replica silently vanishes from the view
+        res.external_views[physical]["g0"].clear()
+    cluster.controller.status_checker.run_once()
+    snap = cluster.controller.status_checker.metrics.snapshot()
+    assert snap["gauges"][f"{physical}.percentSegmentsAvailable"] == 50.0
+    cluster.stop()
