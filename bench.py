@@ -257,11 +257,13 @@ def _closed_loop(broker, queries, clients: int, duration_s: float) -> dict:
 
 def _strip_timing(resp) -> str:
     """Canonical BrokerResponse payload for differential comparison:
-    everything except the wall-clock field and the broker-assigned
-    per-query requestId."""
+    everything except the wall-clock field, the broker-assigned
+    per-query requestId, and the cost vector (path-dependent by
+    construction: serial vs pipelined time device work differently and
+    coalesce hits only exist pipelined)."""
     return json.dumps(
         {k: v for k, v in resp.to_json().items()
-         if k not in ("timeUsedMs", "requestId")},
+         if k not in ("timeUsedMs", "requestId", "cost")},
         sort_keys=True,
     )
 

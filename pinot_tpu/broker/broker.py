@@ -154,6 +154,14 @@ class BrokerRequestHandler:
 
         self._id_prefix = f"{name}-{uuid.uuid4().hex[:6]}"
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=16)
+        # cost-accounting plane: broker-side totals of the merged per-
+        # query cost vector, pre-registered so /metrics shows zeros
+        # before first use (per-table table.<name>.* twins register on
+        # the first query that names the table)
+        for m in ("cost.docsScanned", "cost.bytesScanned"):
+            self.metrics.meter(m)
+        for t in ("cost.deviceMs", "cost.hostMs"):
+            self.metrics.timer(t)
 
     @classmethod
     def from_conf(cls, transport, server_addresses, conf, **overrides) -> "BrokerRequestHandler":
@@ -252,6 +260,13 @@ class BrokerRequestHandler:
                 "table": getattr(request, "table_name", None),
                 "timeUsedMs": round(resp.time_used_ms, 3),
                 "phasesMs": phases,
+                # the merged cost vector: "why was this slow" answerable
+                # from the log entry alone (rows/bytes, device vs host)
+                "numDocsScanned": resp.num_docs_scanned,
+                "cost": {
+                    k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in sorted(resp.cost.items())
+                },
                 "partialResponse": resp.partial_response,
                 "numServersQueried": resp.num_servers_queried,
                 "numServersResponded": resp.num_servers_responded,
@@ -366,6 +381,23 @@ class BrokerRequestHandler:
         red_ms = (time.perf_counter() - t_red) * 1000
         self.metrics.timer("reduce").update(red_ms)
         resp.request_id = request_id
+        # per-table cost attribution into the metrics registry: who is
+        # burning the cluster, by logical table (rendered cluster-wide
+        # on the controller's /debug/capacity rollup)
+        self.metrics.meter("cost.docsScanned").mark(int(resp.num_docs_scanned))
+        self.metrics.meter("cost.bytesScanned").mark(
+            int(resp.cost.get("bytesScanned", 0))
+        )
+        self.metrics.meter(f"table.{table}.docsScanned").mark(
+            int(resp.num_docs_scanned)
+        )
+        self.metrics.meter(f"table.{table}.bytesScanned").mark(
+            int(resp.cost.get("bytesScanned", 0))
+        )
+        for key, timer in (("deviceMs", "cost.deviceMs"), ("hostMs", "cost.hostMs")):
+            ms = resp.cost.get(key)
+            if ms:
+                self.metrics.timer(timer).update(float(ms))
         resp.num_servers_queried = len(sg["servers_queried"])
         resp.num_servers_responded = len(sg["servers_responded"])
         resp.num_segments_unserved = len(sg["unserved"])

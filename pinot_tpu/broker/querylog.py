@@ -5,8 +5,11 @@ it is slow (``timeUsedMs >= threshold``), failed (any exception), or
 degraded (``partialResponse``) — the three cases an operator pages
 through ``/debug/queries`` to find.  The ring keeps the last N entries
 (oldest evicted), each carrying the latency breakdown, the requestId
-(correlates with the client's response and any captured trace), and the
-scatter health counters.
+(correlates with the client's response and any captured trace), the
+scatter health counters, and the merged per-query cost vector
+(``numDocsScanned`` + ``cost`` — rows/bytes scanned, device vs host
+kernel ms, serving-tier segment counts; engine/results.py COST_KEYS) so
+"why was this slow" is answerable from the log entry alone.
 
 Env knobs:
 

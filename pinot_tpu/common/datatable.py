@@ -280,6 +280,12 @@ def serialize_result(res: IntermediateResult) -> bytes:
             w.value(sort_vals)
             w.value(row)
 
+    # trailing optional cost vector (engine/results.py COST_KEYS): old
+    # readers stop before it, old payloads simply end here — the same
+    # mixed-version contract as InstanceRequest.debugOptions.  Keys are
+    # written sorted so identical costs serialize byte-identically.
+    w.value({k: res.cost[k] for k in sorted(res.cost)})
+
     payload = w.getvalue()
     return MAGIC + struct.pack("<Q", len(payload)) + payload
 
@@ -315,6 +321,9 @@ def deserialize_result(data: bytes) -> IntermediateResult:
         res.selection_columns = list(cols) if cols else None
         cnt = r.i64()
         res.selection_rows = [(r.value(), r.value()) for _ in range(cnt)]
+    if r.pos < len(r.data):
+        # trailing cost vector (absent in payloads from older peers)
+        res.cost = {str(k): v for k, v in (r.value() or {}).items()}
     return res
 
 

@@ -123,6 +123,11 @@ class BrokerResponse:
     num_retries: int = 0
     num_hedges: int = 0
     time_used_ms: float = 0.0
+    # per-query cost vector (engine/results.py COST_KEYS): bytes
+    # touched, device vs host kernel ms, serving-tier segment counts,
+    # coalesce/cache hits — merged across scatter-gather so the totals
+    # equal the sum of the per-server totals exactly
+    cost: Dict[str, float] = field(default_factory=dict)
     trace_info: Dict[str, Any] = field(default_factory=dict)
     # broker-assigned globally-unique id echoed to the client so a
     # response correlates with traces and the slow-query log
@@ -150,6 +155,11 @@ class BrokerResponse:
             d["numRetries"] = self.num_retries
         if self.num_hedges:
             d["numHedges"] = self.num_hedges
+        if self.cost:
+            d["cost"] = {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in sorted(self.cost.items())
+            }
         d["timeUsedMs"] = round(self.time_used_ms, 3)
         if self.trace_info:
             d["traceInfo"] = self.trace_info

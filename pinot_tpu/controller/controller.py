@@ -47,7 +47,9 @@ class Controller:
             self.metrics.meter(m)
         from pinot_tpu.realtime.llc import RealtimeSegmentManager
 
-        self.realtime_manager = RealtimeSegmentManager(self.resources, self.store)
+        self.realtime_manager = RealtimeSegmentManager(
+            self.resources, self.store, metrics=self.metrics
+        )
         self.retention_manager = RetentionManager(self.resources, self.store)
         self.validation_manager = ValidationManager(
             self.resources, realtime_manager=self.realtime_manager
@@ -387,6 +389,96 @@ def collect_cluster_metrics(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[
     return out
 
 
+def collect_capacity(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, Any]:
+    """Cluster-wide capacity & cost rollup (``/debug/capacity``): every
+    alive server's HBM staging ledger and ingest lag next to every
+    broker's per-table cost rates — one page answering "who is burning
+    the cluster" and "how much headroom is left".
+
+    Sources: server ``/debug/metrics`` (= ``ServerInstance.status()``,
+    which carries the ``hbm`` ledger snapshot and the ``ingest.lag.*``
+    gauges) and broker ``/debug/metrics`` (whose ``table.*.docsScanned``
+    / ``table.*.bytesScanned`` meters are the per-table attribution).
+    Unreachable instances degrade to an ``error`` entry.  Note: the HBM
+    ledger is per-process, so in-process multi-server harnesses report
+    the same figure on each instance (networked servers are separate
+    processes and sum correctly)."""
+    cm = collect_cluster_metrics(ctrl, timeout_s=timeout_s)
+    servers: Dict[str, Any] = {}
+    tables: Dict[str, Dict[str, Any]] = {}
+    unreachable: Dict[str, Any] = {}
+    total_staged = 0
+    total_lag = 0
+    for name, entry in sorted((cm.get("instances") or {}).items()):
+        role = entry.get("role")
+        if entry.get("error"):
+            # EVERY unreachable instance is reported: a dead broker
+            # means the per-table attribution below is partial, and the
+            # page must say so rather than reading as "no cost recorded"
+            unreachable[name] = {"role": role, "error": entry["error"]}
+            if role == "server":
+                servers[name] = {"error": entry["error"]}
+            continue
+        payload = entry.get("metrics") or {}
+        if role == "server":
+            hbm = payload.get("hbm") or {}
+            snap = payload.get("metrics") or {}
+            gauges = snap.get("gauges") or {}
+            meters = snap.get("meters") or {}
+            lag = {
+                k[len("ingest.lag."):]: v
+                for k, v in gauges.items()
+                if k.startswith("ingest.lag.") and isinstance(v, (int, float))
+            }
+            rows = meters.get("ingest.rowsConsumed") or {}
+            cost_rows = meters.get("cost.docsScanned") or {}
+            cost_bytes = meters.get("cost.bytesScanned") or {}
+            servers[name] = {
+                "hbm": {
+                    k: hbm.get(k)
+                    for k in (
+                        "stagedBytes",
+                        "highWatermarkBytes",
+                        "stagedTables",
+                        "evictions",
+                        "evictedBytes",
+                        "qinputCacheBytes",
+                        "byTable",
+                    )
+                },
+                "ingestLag": lag,
+                "ingestRows": rows,
+                "costDocsScanned": cost_rows,
+                "costBytesScanned": cost_bytes,
+            }
+            total_staged += int(hbm.get("stagedBytes") or 0)
+            total_lag += int(sum(lag.values()))
+        elif role == "broker":
+            meters = (payload.get("meters") or {})
+            for mname, m in meters.items():
+                if not mname.startswith("table.") or "." not in mname[len("table."):]:
+                    continue
+                tname, metric = mname[len("table."):].rsplit(".", 1)
+                if metric not in ("docsScanned", "bytesScanned"):
+                    continue
+                t = tables.setdefault(tname, {})
+                t[metric] = t.get(metric, 0) + int(m.get("count") or 0)
+                t[f"{metric}Rate1m"] = round(
+                    t.get(f"{metric}Rate1m", 0.0) + float(m.get("rate1m") or 0.0), 3
+                )
+    return {
+        "totals": {
+            "stagedBytes": total_staged,
+            "ingestLagRows": total_lag,
+            "servers": len(servers),
+            "tables": len(tables),
+        },
+        "servers": servers,
+        "tables": tables,
+        "unreachable": unreachable,
+    }
+
+
 def _split_path(path: str) -> Optional[List[str]]:
     """URL-decoded path segments, or None for segments that would
     traverse the filesystem when joined into store paths (%2F / '..')."""
@@ -509,6 +601,12 @@ class ControllerHttpServer:
                         return self._respond(ctrl.metrics_snapshot())
                     if parts == ["debug", "clustermetrics"]:
                         return self._respond(collect_cluster_metrics(ctrl))
+                    if parts == ["debug", "capacity"]:
+                        return self._respond(collect_capacity(ctrl))
+                    if parts == ["dashboard", "capacity"]:
+                        return self._respond_html(
+                            dashboard.render_capacity(ctrl, collect_capacity(ctrl))
+                        )
                     if parts == ["debug", "stabilizer"]:
                         return self._respond(ctrl.stabilizer.debug_snapshot())
                     if len(parts) == 3 and parts[0] == "instances" and parts[2] == "drain":

@@ -40,6 +40,7 @@ _NAV = (
     "<nav><a href='/dashboard'>Cluster</a>"
     "<a href='/dashboard/query'>Query console</a>"
     "<a href='/dashboard/metrics'>Metrics</a>"
+    "<a href='/dashboard/capacity'>Capacity</a>"
     "<a href='/clusterstate'>Raw state (JSON)</a></nav>"
 )
 
@@ -247,6 +248,95 @@ def render_metrics(ctrl, cluster_metrics: dict) -> str:
                 body.append(f"<tr><td>{_esc(k)}</td><td>{_esc(heal[k])}</td></tr>")
             body.append("</table>")
     return _page("Cluster metrics", body)
+
+
+def _fmt_bytes(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return str(n)
+
+
+def render_capacity(ctrl, capacity: dict) -> str:
+    """Cluster capacity & cost page (``collect_capacity`` rollup): HBM
+    staging ledgers and ingest lag per server, per-table cost rates —
+    the one page that shows who is burning the cluster."""
+    totals = capacity.get("totals") or {}
+    body = ["<h1>Capacity &amp; cost</h1>"]
+    body.append(
+        f"<p>Staged HBM (all servers): <b>{_fmt_bytes(totals.get('stagedBytes', 0))}</b>"
+        f" &middot; ingest lag: <b>{totals.get('ingestLagRows', 0)}</b> rows"
+        f" &middot; raw JSON: <a href='/debug/capacity'>/debug/capacity</a></p>"
+    )
+    unreachable = capacity.get("unreachable") or {}
+    if unreachable:
+        names = ", ".join(
+            f"{_esc(n)} ({_esc(e.get('role', '?'))})"
+            for n, e in sorted(unreachable.items())
+        )
+        body.append(
+            f"<p class='bad'>Partial rollup — unreachable: {names}</p>"
+        )
+
+    body.append("<h2>Servers — HBM staging ledger &amp; ingest</h2>")
+    body.append(
+        "<table><tr><th>server</th><th>staged</th><th>high-water</th>"
+        "<th>tables</th><th>evicted</th><th>qinput cache</th>"
+        "<th>ingest lag (rows)</th><th>ingest rows/s (1m)</th></tr>"
+    )
+    for name, entry in sorted((capacity.get("servers") or {}).items()):
+        if entry.get("error"):
+            body.append(
+                f"<tr><td>{_esc(name)}</td><td colspan='7' class='bad'>"
+                f"unreachable: {_esc(entry['error'])}</td></tr>"
+            )
+            continue
+        hbm = entry.get("hbm") or {}
+        lag = entry.get("ingestLag") or {}
+        lag_str = (
+            ", ".join(f"{_esc(k)}={v}" for k, v in sorted(lag.items())) or "0"
+        )
+        rows = entry.get("ingestRows") or {}
+        body.append(
+            f"<tr><td>{_esc(name)}</td>"
+            f"<td>{_fmt_bytes(hbm.get('stagedBytes', 0))}</td>"
+            f"<td>{_fmt_bytes(hbm.get('highWatermarkBytes', 0))}</td>"
+            f"<td>{hbm.get('stagedTables', 0)}</td>"
+            f"<td>{_fmt_bytes(hbm.get('evictedBytes', 0))}</td>"
+            f"<td>{_fmt_bytes(hbm.get('qinputCacheBytes', 0))}</td>"
+            f"<td>{_esc(lag_str)}</td>"
+            f"<td>{rows.get('rate1m', 0)}</td></tr>"
+        )
+    body.append("</table>")
+
+    body.append("<h2>Per-table cost (broker-attributed)</h2>")
+    tables = capacity.get("tables") or {}
+    if not tables:
+        body.append("<p>No per-table cost recorded yet (no queries).</p>")
+    else:
+        body.append(
+            "<table><tr><th>table</th><th>docs scanned</th>"
+            "<th>docs/s (1m)</th><th>bytes scanned</th><th>bytes/s (1m)</th></tr>"
+        )
+        ordered = sorted(
+            tables.items(),
+            key=lambda kv: -float(kv[1].get("bytesScanned", 0) or 0),
+        )
+        for tname, t in ordered:
+            body.append(
+                f"<tr><td>{_esc(tname)}</td>"
+                f"<td>{t.get('docsScanned', 0)}</td>"
+                f"<td>{t.get('docsScannedRate1m', 0)}</td>"
+                f"<td>{_fmt_bytes(t.get('bytesScanned', 0))}</td>"
+                f"<td>{_fmt_bytes(t.get('bytesScannedRate1m', 0))}/s</td></tr>"
+            )
+        body.append("</table>")
+    return _page("Capacity & cost", body)
 
 
 def render_query_console() -> str:

@@ -256,6 +256,136 @@ def _stack_dict_vals(cols, S: int, card_pad: int, fdt) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# HBM staging ledger: byte-accurate accounting of what the staging
+# cache currently pins in device memory, per staged table / column /
+# role — the capacity signal multichip staging and broker admission
+# control consume.  One ledger per process (the staging cache is
+# process-global too: in-process multi-server harnesses share one
+# device, so their instances report the same process-wide figure).
+# ---------------------------------------------------------------------------
+
+# StagedColumn array attributes -> ledger role names
+_ROLE_ATTRS = (
+    ("fwd", "fwd"),
+    ("mv", "mv"),
+    ("mv_counts", "mvCounts"),
+    ("dict_vals", "dict"),
+    ("raw", "raw"),
+    ("gfwd", "gfwd"),
+    ("hll_bucket", "hll"),
+    ("hll_rho", "hll"),
+    ("mv_raw", "mvRaw"),
+)
+
+
+def _measure_staged(staged: StagedTable) -> Tuple[int, Dict[str, int], Dict[str, int]]:
+    """(total bytes, per-column bytes, per-role bytes) of a staged
+    table's device arrays — read straight off the jax arrays' nbytes,
+    so the ledger total matches the staged bytes exactly."""
+    total = int(getattr(staged.num_docs_arr, "nbytes", 0))
+    by_role: Dict[str, int] = {"meta": total}
+    if staged._valid is not None:
+        n = int(staged._valid.nbytes)
+        total += n
+        by_role["meta"] = by_role.get("meta", 0) + n
+    by_column: Dict[str, int] = {}
+    for name, sc in staged.columns.items():
+        col_bytes = 0
+        for attr, role in _ROLE_ATTRS:
+            arr = getattr(sc, attr)
+            if arr is None:
+                continue
+            n = int(arr.nbytes)
+            col_bytes += n
+            by_role[role] = by_role.get(role, 0) + n
+        by_column[name] = col_bytes
+        total += col_bytes
+    return total, by_column, by_role
+
+
+class StagingLedger:
+    """Ledger of HBM-resident staged tables: byte totals, per-table /
+    per-column-role breakdowns, a high-watermark, and eviction
+    visibility.  Entries key on the StagedTable's process-unique
+    ``token`` and are re-measured on role augmentation, so the totals
+    stay byte-accurate as arrays attach."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[int, Dict] = {}  # token -> entry
+        self.high_watermark = 0
+        self.evictions = 0
+        self.evicted_bytes = 0
+
+    def update(self, staged: StagedTable, table: str) -> None:
+        total, by_column, by_role = _measure_staged(staged)
+        with self._lock:
+            self._entries[staged.token] = {
+                "table": table,
+                "segments": list(staged.segment_names),
+                "bytes": total,
+                "columns": by_column,
+                "roles": by_role,
+            }
+            now = sum(e["bytes"] for e in self._entries.values())
+            if now > self.high_watermark:
+                self.high_watermark = now
+
+    def drop(self, staged: StagedTable) -> None:
+        with self._lock:
+            entry = self._entries.pop(staged.token, None)
+            if entry is not None:
+                self.evictions += 1
+                self.evicted_bytes += entry["bytes"]
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(e["bytes"] for e in self._entries.values())
+
+    def table_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> Dict:
+        """JSON-safe view served on server status() / /debug/metrics
+        and aggregated cluster-wide by the controller /debug/capacity."""
+        with self._lock:
+            by_table: Dict[str, int] = {}
+            by_role: Dict[str, int] = {}
+            entries = []
+            for e in self._entries.values():
+                by_table[e["table"]] = by_table.get(e["table"], 0) + e["bytes"]
+                for role, n in e["roles"].items():
+                    by_role[role] = by_role.get(role, 0) + n
+                entries.append(
+                    {
+                        "table": e["table"],
+                        "segments": list(e["segments"]),
+                        "bytes": e["bytes"],
+                        "columns": dict(e["columns"]),
+                    }
+                )
+            return {
+                "stagedBytes": sum(e["bytes"] for e in self._entries.values()),
+                "highWatermarkBytes": self.high_watermark,
+                "stagedTables": len(self._entries),
+                "evictions": self.evictions,
+                "evictedBytes": self.evicted_bytes,
+                "byTable": by_table,
+                "byRole": by_role,
+                "entries": entries,
+            }
+
+
+LEDGER = StagingLedger()
+
+
+def _table_of(segments: Sequence[ImmutableSegment]) -> str:
+    meta = getattr(segments[0], "metadata", None) if segments else None
+    return getattr(meta, "table_name", "") or ""
+
+
+# ---------------------------------------------------------------------------
 # Staging cache: segments are immutable, so staging is reusable per
 # (segment set, column set) — the HBM-residency analog of the reference
 # keeping segments mmap'd between queries.
@@ -269,6 +399,12 @@ _stage_cache: Dict[Tuple, StagedTable] = {}
 # stage concurrently and cache hits never wait on a cold stage
 _locks_guard = threading.Lock()
 _key_locks: Dict[Tuple, "threading.Lock"] = {}
+# cache-membership guard: insert/evict/clear AND the paired ledger
+# bookkeeping happen atomically under this lock (per-key locks don't
+# order distinct keys, so a size-cap clear racing another key's insert
+# could otherwise iterate a mutating dict or strand a ledger entry for
+# a table the cache no longer holds)
+_cache_guard = threading.Lock()
 
 
 def _lock_for(key: Tuple) -> "threading.Lock":
@@ -324,9 +460,15 @@ def get_staged(
                 ctx=ctx,
                 skip_base_columns=skip_base_columns,
             )
-            if len(_stage_cache) > 32:
-                _stage_cache.clear()
-            _stage_cache[key] = st
+            with _cache_guard:
+                if len(_stage_cache) > 32:
+                    # size-cap clear: count every victim into the ledger
+                    # so the eviction is visible, not a silent byte drop
+                    for old in list(_stage_cache.values()):
+                        LEDGER.drop(old)
+                    _stage_cache.clear()
+                _stage_cache[key] = st
+                LEDGER.update(st, _table_of(segments))
         else:
             _augment_staged(
                 st,
@@ -339,6 +481,13 @@ def get_staged(
                     c for c in column_names if c not in set(skip_base_columns)
                 ],
             )
+            # re-measure (augmentation attaches arrays) ONLY while still
+            # cache-resident: a concurrent size-cap clear already counted
+            # this table out, and updating after that would strand a
+            # ledger entry nothing will ever drop
+            with _cache_guard:
+                if _stage_cache.get(key) is st:
+                    LEDGER.update(st, _table_of(segments))
     return st
 
 
@@ -432,7 +581,10 @@ def _hll_streams(cols, S: int, n_pad: int):
 
 
 def clear_staging_cache() -> None:
-    _stage_cache.clear()
+    with _cache_guard:
+        for st in list(_stage_cache.values()):
+            LEDGER.drop(st)
+        _stage_cache.clear()
 
 
 def evict_staged_segment(segment_name: str) -> int:
@@ -442,13 +594,16 @@ def evict_staged_segment(segment_name: str) -> int:
     segment misses the cache); eviction just releases the quarantined
     copy's device arrays instead of waiting for the size-cap clear.
     Returns the number of cache entries dropped."""
-    victims = []
-    for key in list(_stage_cache):
-        if any(e[0] == segment_name for e in key[0]):
-            victims.append(key)
-    for key in victims:
-        _stage_cache.pop(key, None)
-    return len(victims)
+    with _cache_guard:
+        victims = []
+        for key in list(_stage_cache):
+            if any(e[0] == segment_name for e in key[0]):
+                victims.append(key)
+        for key in victims:
+            st = _stage_cache.pop(key, None)
+            if st is not None:
+                LEDGER.drop(st)
+        return len(victims)
 
 
 def to_device_inputs(tree):

@@ -281,8 +281,11 @@ class QueryExecutor:
         drained while queued there is shed, not executed."""
         total_docs = sum(s.num_docs for s in segments)
         live = prune_segments(segments, request)
+        pruned = len(segments) - len(live)
         if not live:
-            return self._empty_result(request, total_docs)
+            res = self._empty_result(request, total_docs)
+            res.add_cost(segmentsPruned=pruned)
+            return res
 
         # star-tree routing: eligible segments answer from their
         # pre-aggregated cube (startree/operator.py); the rest take the
@@ -299,10 +302,12 @@ class QueryExecutor:
             for p in parts[1:]:
                 merged.merge(p)
             merged.total_docs = total_docs
+            merged.add_cost(segmentsPruned=pruned)
             return merged
 
         result = self._execute_engine(live, request, deadline)
         result.total_docs = total_docs
+        result.add_cost(segmentsPruned=pruned)
         return result
 
     def _execute_engine(
@@ -488,9 +493,10 @@ class QueryExecutor:
 
         from pinot_tpu.engine.device import segment_arrays
 
+        cost: Dict[str, float] = {}  # per-query cost vector accumulator
         q_np = build_query_inputs(request, plan, ctx, staged, scratch=scratch)
         digest = self._inputs_digest(q_np)
-        q_inputs = self._to_device_inputs(q_np, plan=plan, digest=digest)
+        q_inputs = self._to_device_inputs(q_np, plan=plan, digest=digest, cost=cost)
         seg_arrays = segment_arrays(staged, needed)
         block_ids, scanned_rows = self._block_skip_ids(plan, q_np, live, staged)
         from pinot_tpu.engine.kernel import chunk_rows_limit
@@ -520,7 +526,8 @@ class QueryExecutor:
             kernel = self._kernel(plan, staged)
             args = (seg_arrays, q_inputs)
         outs = self._run_kernel(
-            kernel, args, plan, staged, digest, block_ids, deadline, pdigest
+            kernel, args, plan, staged, digest, block_ids, deadline, pdigest,
+            cost=cost,
         )
         t0 = time.perf_counter()  # laneWait/planExec timed inside _run_kernel
 
@@ -544,6 +551,19 @@ class QueryExecutor:
             # zone maps skipped non-candidate blocks: filter scan cost
             # is O(candidate rows), the point of the skipping path
             result.num_entries_scanned_in_filter = len(plan.leaves) * scanned_rows
+        # device-path cost vector: staged bytes the kernel read (the
+        # block path reads only the candidate fraction), the serving
+        # tier, and the dispatch-side hits recorded into ``cost``
+        dev_bytes = sum(getattr(a, "nbytes", 0) for a in seg_arrays.values())
+        if block_ids is not None and scanned_rows is not None and staged.total_docs:
+            dev_bytes = int(
+                dev_bytes * min(1.0, scanned_rows / staged.total_docs)
+            )
+        result.add_cost(bytesScanned=dev_bytes, **cost)
+        if block_ids is not None:
+            result.add_cost(segmentsZonemap=len(live))
+        else:
+            result.add_cost(segmentsFullScan=len(live))
         self._phase("finalize", t0)
         return result
 
@@ -778,7 +798,8 @@ class QueryExecutor:
         return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols)), tuple(sorted(hll_cols))
 
     def _run_kernel(
-        self, kernel, args, plan, staged, digest, block_ids, deadline, pdigest=None
+        self, kernel, args, plan, staged, digest, block_ids, deadline,
+        pdigest=None, cost: Optional[Dict[str, float]] = None,
     ) -> Dict[str, Any]:
         """DISPATCH + output fetch.  Serial mode (no lane): launch and
         fetch inline, the pre-pipeline behavior.  Pipelined: the launch
@@ -815,6 +836,8 @@ class QueryExecutor:
             # queue + coalesce wait only; the coalesced tag marks a
             # query that rode an identical in-flight dispatch
             t0 = self._phase("laneWait", t0, coalesced=ticket.coalesced)
+            if cost is not None and ticket.coalesced:
+                cost["coalesceHits"] = cost.get("coalesceHits", 0) + 1
         outs = fetch(handle) if fetch is not None else handle
         outs = {
             k: np.asarray(v)
@@ -826,6 +849,12 @@ class QueryExecutor:
         # covers launch (serial mode) + the blocking packed D2H fetch,
         # so the per-stage timers on status() sum to wall time instead
         # of double-counting the wait inside planExec
+        if cost is not None:
+            # the cost vector's deviceMs is this same window: device
+            # execution + the packed D2H fetch, not lane queueing
+            cost["deviceMs"] = cost.get("deviceMs", 0.0) + round(
+                (time.perf_counter() - t0) * 1000, 3
+            )
         self._phase("planExec", t0)
         return outs
 
@@ -849,7 +878,11 @@ class QueryExecutor:
         return h.hexdigest()
 
     def _to_device_inputs(
-        self, inputs: Dict[str, Any], plan=None, digest: Optional[str] = None
+        self,
+        inputs: Dict[str, Any],
+        plan=None,
+        digest: Optional[str] = None,
+        cost: Optional[Dict[str, float]] = None,
     ) -> Dict[str, Any]:
         """Device-resident query-inputs cache: a repeated query (same
         plan, same literal tables) reuses the arrays already in HBM
@@ -867,6 +900,8 @@ class QueryExecutor:
             cached = self._qinput_cache.get(key)
             if cached is not None:
                 self._qinput_cache.move_to_end(key)
+                if cost is not None:
+                    cost["qinputCacheHits"] = cost.get("qinputCacheHits", 0) + 1
                 return cached[0]
         dev = to_device_inputs(inputs)
         # Evict by HBM bytes, not entry count: one entry can hold

@@ -413,7 +413,57 @@ def _groupby_vectorized(
         res.groups[ktup] = [partial(st, int(i)) for st in states]
 
 
+def _referenced_column_bytes(
+    segments: List[ImmutableSegment], request: BrokerRequest
+) -> int:
+    """Column-data bytes the host path reads, upper bound: the full
+    forward index (SV) / MV value stream of every referenced column —
+    the default mask resolver scans every row for the filter, and value
+    columns gather through the same arrays.  Postings-backed callers
+    (engine/invindex_path.py) overwrite this with their O(matches)
+    figure."""
+    total = 0
+    cols = request.referenced_columns()
+    for seg in segments:
+        for name in cols:
+            col = seg.columns.get(name)
+            if col is None:
+                continue
+            fwd = getattr(col, "fwd", None)
+            if fwd is not None:
+                total += np.asarray(fwd).nbytes
+            mv = getattr(col, "mv_values", None)
+            if mv is not None:
+                total += np.asarray(mv).nbytes
+    return total
+
+
 def execute_host(
+    segments: List[ImmutableSegment],
+    ctx: TableContext,
+    request: BrokerRequest,
+    total_docs: int,
+    sel_columns: Optional[List[str]],
+    matched_rows=None,
+) -> IntermediateResult:
+    """Cost-accounted wrapper: every host-served query reports hostMs,
+    bytesScanned, and the host serving tier on its result's cost vector
+    (engine/results.py COST_KEYS)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    res = _execute_host_impl(
+        segments, ctx, request, total_docs, sel_columns, matched_rows
+    )
+    res.add_cost(
+        hostMs=round((_time.perf_counter() - t0) * 1000, 3),
+        bytesScanned=_referenced_column_bytes(segments, request),
+        segmentsHost=len(segments),
+    )
+    return res
+
+
+def _execute_host_impl(
     segments: List[ImmutableSegment],
     ctx: TableContext,
     request: BrokerRequest,

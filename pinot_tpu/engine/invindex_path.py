@@ -188,4 +188,9 @@ def try_index_path(
     # filter work was O(postings), not O(n): report candidate rows like
     # the zone-map path does (num_entries_scanned contract)
     res.num_entries_scanned_in_filter = est * max(1, len(residuals) + 1)
+    # cost re-attribution: this is the postings tier, and its bytes are
+    # O(matches) — the wrapper's full-column upper bound does not apply
+    res.cost.pop("segmentsHost", None)
+    res.cost["segmentsPostings"] = len(live)
+    res.cost["bytesScanned"] = est * max(1, len(residuals) + 1) * 8
     return res

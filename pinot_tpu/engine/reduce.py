@@ -64,6 +64,8 @@ def reduce_to_response(
     resp.num_segments_queried = merged.num_segments_queried
     resp.num_entries_scanned_in_filter = merged.num_entries_scanned_in_filter
     resp.num_entries_scanned_post_filter = merged.num_entries_scanned_post_filter
+    # broker totals == sum of server totals (additive merge invariant)
+    resp.cost = dict(merged.cost)
     resp.trace_info = merged.trace
 
     if request.is_group_by:

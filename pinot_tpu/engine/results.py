@@ -214,6 +214,43 @@ def make_partial(base_function: str) -> AggPartial:
 GroupKey = Tuple[str, ...]
 
 
+# Canonical per-query cost-vector keys (the execution-stats extension
+# beyond the reference's numDocsScanned/numEntriesScanned* — see
+# PARITY.md "Cost accounting").  Every value is additive, so the merge
+# is a plain key-wise sum and the broker's totals are exactly the sum
+# of the per-server totals (the invariant tests/test_cost.py holds):
+#
+#   bytesScanned       column bytes the serving path read (device: staged
+#                      array bytes handed to the kernel, scaled by the
+#                      zone-map candidate fraction; host: forward-index
+#                      bytes of referenced columns; postings: O(matches))
+#   deviceMs / hostMs  kernel-execution wall ms split by where the
+#                      filter/aggregate work actually ran
+#   coalesceHits       queries served by riding an identical in-flight
+#                      device dispatch (engine/dispatch.py)
+#   qinputCacheHits    device-resident query-input cache hits
+#   segmentsPruned     segments dropped by metadata pruning (pruner.py)
+#   segmentsPostings   segments answered from host postings (invindex)
+#   segmentsZonemap    segments scanned via the zone-map block kernel
+#   segmentsFullScan   segments scanned by the full device kernel
+#   segmentsHost       segments served by the host path (forced,
+#                      failover, or pair overflow)
+#   segmentsStarTree   segments answered from their star-tree cube
+COST_KEYS = (
+    "bytesScanned",
+    "deviceMs",
+    "hostMs",
+    "coalesceHits",
+    "qinputCacheHits",
+    "segmentsPruned",
+    "segmentsPostings",
+    "segmentsZonemap",
+    "segmentsFullScan",
+    "segmentsHost",
+    "segmentsStarTree",
+)
+
+
 class IntermediateResult:
     """One executor's (server's) partial answer for a query — the unit
     that flows broker-ward and merges with peers
@@ -233,6 +270,7 @@ class IntermediateResult:
         selection_columns: Optional[List[str]] = None,
         exceptions: Optional[List[Tuple[int, str]]] = None,
         unserved_segments: Optional[List[str]] = None,
+        cost: Optional[Dict[str, float]] = None,
     ) -> None:
         self.selection_columns = selection_columns
         self.exceptions: List[Tuple[int, str]] = exceptions or []
@@ -249,10 +287,23 @@ class IntermediateResult:
         self.num_entries_scanned_in_filter = num_entries_scanned_in_filter
         self.num_entries_scanned_post_filter = num_entries_scanned_post_filter
         self.trace = trace or {}
+        # per-query cost vector (COST_KEYS above): sparse — absent keys
+        # mean zero, so empty-path results stay cheap to build and ship
+        self.cost: Dict[str, float] = dict(cost or {})
+
+    def add_cost(self, **kv: float) -> None:
+        """Accumulate cost-vector components (key-wise add)."""
+        for k, v in kv.items():
+            if v:
+                self.cost[k] = self.cost.get(k, 0) + v
 
     def merge(self, other: "IntermediateResult") -> None:
         self.exceptions.extend(other.exceptions)
         self.unserved_segments.extend(other.unserved_segments)
+        # cost vectors are additive by construction: the broker's merged
+        # totals equal the sum of the per-server totals EXACTLY
+        for k, v in other.cost.items():
+            self.cost[k] = self.cost.get(k, 0) + v
         self.num_docs_scanned += other.num_docs_scanned
         self.total_docs += other.total_docs
         self.num_segments_queried += other.num_segments_queried

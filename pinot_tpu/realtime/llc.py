@@ -175,9 +175,15 @@ class RealtimeSegmentManager:
     (PinotLLCRealtimeSegmentManager analog): creates CONSUMING segments,
     persists commit metadata, opens the next sequence."""
 
-    def __init__(self, resources: ClusterResourceManager, store) -> None:
+    def __init__(self, resources: ClusterResourceManager, store, metrics=None) -> None:
         self.resources = resources
         self.store = store
+        # optional ControllerMetrics: realtime commit-plane series
+        # (segmentCommits meter + segmentCommitMs persistence timer)
+        self.metrics = metrics
+        if metrics is not None:
+            metrics.meter("segmentCommits")
+            metrics.timer("segmentCommitMs")
         self.completion = SegmentCompletionManager(self)
         self._tables: Dict[str, Dict[str, Any]] = {}  # physical -> {schema, stream, config}
         self._consumers: Dict[Tuple[str, str], "RealtimeSegmentDataManager"] = {}
@@ -504,6 +510,7 @@ class RealtimeSegmentManager:
 
     # -- commit --------------------------------------------------------
     def on_segment_committed(self, segment: str, committed) -> None:
+        t0 = time.perf_counter()
         physical, partition, seq = parse_segment_name(segment)
         path = self.store.save(physical, committed)
         end_offset = committed.metadata.custom.get("endOffset", 0)
@@ -527,6 +534,11 @@ class RealtimeSegmentManager:
             for key in [k for k in self._consumers if k[0] == segment]:
                 self._consumers[key].stop()
                 del self._consumers[key]
+        if self.metrics is not None:
+            self.metrics.meter("segmentCommits").mark()
+            self.metrics.timer("segmentCommitMs").update(
+                (time.perf_counter() - t0) * 1000
+            )
         # open the next consuming segment at the committed end offset;
         # a transient failure (no replica re-registered yet after a
         # controller restart) must NOT fail the commit itself — the
@@ -615,9 +627,40 @@ class RealtimeSegmentDataManager:
         # for this partition is known (columnar topics carry whole
         # binary blocks; row-JSON topics raise on fetchc misuse)
         self._columnar: Optional[bool] = None
+        # ingest observability: per-partition consumer-lag gauge (latest
+        # available stream offset − consumed offset; reads live via
+        # set_fn) + rows/s and commit-latency series on the hosting
+        # server's registry.  Rolling to the next sequence re-registers
+        # the same gauge name, so the series is continuous per
+        # (table, partition) across segment commits.
+        self._metrics = getattr(server, "metrics", None)
+        from pinot_tpu.realtime.stream import LagProbe
+
+        self._lag_probe = LagProbe(stream, partition, lambda: self.offset)
+        self._lag_gauge_name = f"ingest.lag.{table}.p{partition}"
+        if self._metrics is not None:
+            lag_key = f"{table}.p{partition}"
+            self._metrics.gauge(f"ingest.lag.{lag_key}").set_fn(self._lag_probe)
+
+    def lag(self) -> Optional[int]:
+        """Consumer lag in rows: latest available offset on this
+        partition minus the consumed offset (0 = fully caught up);
+        TTL-cached + failure-degrading (realtime/stream.py LagProbe)."""
+        return self._lag_probe()
 
     def stop(self) -> None:
         self._stopped = True
+        # detach the lag gauge: a stopped consumer's frozen offset must
+        # not keep reporting (phantom, ever-growing) lag when the
+        # partition's successor lands on another server.  The equality
+        # guard in clear_fn keeps this safe if a successor on THIS
+        # server already re-registered the same series.
+        if self._metrics is not None:
+            self._metrics.gauge(self._lag_gauge_name).clear_fn(self._lag_probe)
+
+    def _mark_rows(self, n: int) -> None:
+        if n and self._metrics is not None:
+            self._metrics.meter("ingest.rowsConsumed").mark(int(n))
 
     # -- consumption ---------------------------------------------------
     def _fetch_and_index(self, limit: int) -> int:
@@ -671,11 +714,13 @@ class RealtimeSegmentDataManager:
                     )
                 self.offset = next_offset
                 self.mutable.end_offset = next_offset
+                self._mark_rows(n)
                 return n
         rows, next_offset = self.stream.fetch(self.partition, self.offset, limit)
         self.mutable.index_batch(rows)
         self.offset = next_offset
         self.mutable.end_offset = next_offset
+        self._mark_rows(len(rows))
         return len(rows)
 
     def consume_step(self, max_rows: int = 1000) -> int:
@@ -706,8 +751,16 @@ class RealtimeSegmentDataManager:
                     break
             return resp
         if resp == RESP_COMMIT:
+            t0 = time.perf_counter()
             committed = self.mutable.to_committed_segment()
-            return completion.segment_commit(
+            out = completion.segment_commit(
                 self.segment_name, self.server.name, committed
             )
+            # commit latency: mutable->immutable conversion + the
+            # controller persistence round (the ingest stall window)
+            if self._metrics is not None:
+                self._metrics.timer("ingest.commitMs").update(
+                    (time.perf_counter() - t0) * 1000
+                )
+            return out
         return resp

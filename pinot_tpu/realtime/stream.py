@@ -19,6 +19,43 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 Row = Dict[str, Any]
 
 
+class LagProbe:
+    """TTL-cached consumer-lag measurement, shared by the in-process
+    (realtime/llc.py) and networked (server/network_starter.py)
+    consumers: latest available offset minus the consumed offset.
+
+    ``latest_offset`` can be a stream-broker RPC (netstream/kafka), and
+    the probe runs via a gauge ``set_fn`` on every metrics snapshot /
+    scrape — so the measurement is cached for ``TTL_S`` (invalidated
+    whenever the consumer advances, which is when the number changes on
+    our side) and a failed probe degrades to the last known value
+    instead of stalling the metrics surface behind a dead broker."""
+
+    TTL_S = 5.0
+
+    def __init__(self, stream: "StreamProvider", partition: int, offset_fn) -> None:
+        self.stream = stream
+        self.partition = partition
+        self.offset_fn = offset_fn  # () -> consumed offset, read live
+        self._cache: Optional[Tuple[Optional[int], float, int]] = None
+
+    def __call__(self) -> Optional[int]:
+        import time
+
+        now = time.monotonic()
+        offset = int(self.offset_fn())
+        c = self._cache
+        if c is not None and c[2] == offset and now - c[1] < self.TTL_S:
+            return c[0]
+        try:
+            latest = int(self.stream.latest_offset(self.partition))
+        except Exception:
+            return c[0] if c is not None else None  # last known / unknown
+        val = max(0, latest - offset)
+        self._cache = (val, now, offset)
+        return val
+
+
 class StreamProvider:
     """Offset-addressed partition reader."""
 

@@ -87,6 +87,31 @@ class ServerInstance:
         for m in ("queries", "queriesShed", "queriesAbandoned",
                   "segmentsMissedServing", "crcFailures", "quarantinedSegments"):
             self.metrics.meter(m)
+        # cost-accounting plane (PR 6): per-query cost totals summed
+        # into the registry, plus the HBM staging-ledger gauges — the
+        # capacity signal admission control / multichip staging consume.
+        # All pre-registered so /metrics shows zeros before first use.
+        for m in ("cost.docsScanned", "cost.bytesScanned"):
+            self.metrics.meter(m)
+        for t in ("cost.deviceMs", "cost.hostMs"):
+            self.metrics.timer(t)
+        for m in ("ingest.rowsConsumed",):
+            self.metrics.meter(m)
+        self.metrics.timer("ingest.commitMs")
+        from pinot_tpu.engine.device import LEDGER
+
+        # NOTE: the ledger (like the staging cache) is process-global —
+        # one device per process; in-process multi-server harnesses see
+        # the same figure on every instance
+        self.metrics.gauge("hbm.stagedBytes").set_fn(LEDGER.total_bytes)
+        self.metrics.gauge("hbm.highWatermarkBytes").set_fn(
+            lambda: LEDGER.high_watermark
+        )
+        self.metrics.gauge("hbm.stagedTables").set_fn(LEDGER.table_count)
+        self.metrics.gauge("hbm.evictedBytes").set_fn(lambda: LEDGER.evicted_bytes)
+        self.metrics.gauge("hbm.qinputCacheBytes").set_fn(
+            lambda: self.executor._qinput_cache_bytes
+        )
         self._table_schemas: dict = {}  # raw table name -> Schema
         # controller-acknowledged drain state (set from the heartbeat
         # reply by the networked starter): the instance keeps serving —
@@ -229,6 +254,17 @@ class ServerInstance:
             result = IntermediateResult(
                 exceptions=[(ErrorCode.QUERY_EXECUTION, f"{type(e).__name__}: {e}")]
             )
+        # per-query cost totals summed into the registry (the server
+        # half of the cost-accounting plane; the broker attributes the
+        # merged vector per table) — error results carry zero cost
+        self.metrics.meter("cost.docsScanned").mark(int(result.num_docs_scanned))
+        self.metrics.meter("cost.bytesScanned").mark(
+            int(result.cost.get("bytesScanned", 0))
+        )
+        for key, timer in (("deviceMs", "cost.deviceMs"), ("hostMs", "cost.hostMs")):
+            ms = result.cost.get(key)
+            if ms:
+                self.metrics.timer(timer).update(float(ms))
         self.metrics.timer("queryExecution").update((time.perf_counter() - t_start) * 1000)
         self.metrics.meter("queries").mark()
         return serialize_result(result)
@@ -244,12 +280,17 @@ class ServerInstance:
         heal["laneRestarts"] = 0 if self.lane is None else self.lane.restart_count
         heal["crcFailures"] = self.metrics.meter("crcFailures").count
         heal["quarantinedSegments"] = self.metrics.meter("quarantinedSegments").count
+        from pinot_tpu.engine.device import LEDGER
+
+        hbm = LEDGER.snapshot()
+        hbm["qinputCacheBytes"] = self.executor._qinput_cache_bytes
         return {
             "name": self.name,
             "draining": self.draining,
             "scheduler": self.scheduler.stats(),
             "lane": None if self.lane is None else self.lane.stats(),
             "selfHealing": heal,
+            "hbm": hbm,
             "metrics": self.metrics.snapshot(),
         }
 

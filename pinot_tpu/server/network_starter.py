@@ -21,6 +21,7 @@ import json
 import logging
 import os
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
@@ -129,6 +130,30 @@ class RemoteConsumer:
         self.mutable.start_offset = self.offset
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # ingest observability (same series as the in-process consumer,
+        # realtime/llc.py): per-partition lag gauge + rows/s meter.
+        # The TTL-cached probe (realtime/stream.py LagProbe) keeps the
+        # stream-broker RPC off the metrics-scrape path.
+        from pinot_tpu.realtime.stream import LagProbe
+
+        self._metrics = getattr(starter.server, "metrics", None)
+        self._lag_probe = LagProbe(self.stream, self.partition, lambda: self.offset)
+        self._lag_gauge_name = f"ingest.lag.{table}.p{self.partition}"
+        if self._metrics is not None:
+            lag_key = f"{table}.p{self.partition}"
+            self._metrics.gauge(f"ingest.lag.{lag_key}").set_fn(self._lag_probe)
+
+    def lag(self) -> Optional[int]:
+        return self._lag_probe()
+
+    def _detach_lag_gauge(self) -> None:
+        """Stop reporting lag once this consumer is done: a frozen
+        offset would otherwise read as phantom ever-growing lag when
+        the partition's successor lives on another server.  clear_fn's
+        equality guard makes this a no-op if a rolled successor on this
+        server already owns the series."""
+        if self._metrics is not None:
+            self._metrics.gauge(self._lag_gauge_name).clear_fn(self._lag_probe)
 
     def start(self) -> None:
         self.starter.server.add_segment(self.table, self.mutable)
@@ -137,6 +162,7 @@ class RemoteConsumer:
 
     def stop(self) -> None:
         self._stop.set()
+        self._detach_lag_gauge()
 
     # -- consume loop ---------------------------------------------------
     def _consume_to(self, limit_rows: int) -> int:
@@ -147,6 +173,8 @@ class RemoteConsumer:
         self.mutable.index_batch(rows)
         self.offset = next_offset
         self.mutable.end_offset = next_offset
+        if rows and self._metrics is not None:
+            self._metrics.meter("ingest.rowsConsumed").mark(len(rows))
         return len(rows)
 
     def _run(self) -> None:
@@ -165,6 +193,11 @@ class RemoteConsumer:
                     self._stop.wait(self.poll_interval_s)
         except Exception:
             logger.exception("remote consumer for %s died", self.segment)
+        finally:
+            # finished (committed/discarded) or died: this consumer's
+            # offset is frozen, so its lag series must not keep
+            # reporting; a rolled successor re-registers the same name
+            self._detach_lag_gauge()
 
     def _completion_round(self) -> bool:
         """One segmentConsumed exchange; True when this consumer is done."""
@@ -222,6 +255,7 @@ class RemoteConsumer:
         return False
 
     def _commit(self) -> bool:
+        t0 = time.perf_counter()
         committed = self.mutable.to_committed_segment()
         try:
             out = self.starter.upload_segment_bytes(
@@ -234,6 +268,10 @@ class RemoteConsumer:
             # NOT_LEADER / HOLD (commit already being persisted by a
             # prior attempt): retry via the next segmentConsumed round
             return False
+        if self._metrics is not None:
+            self._metrics.timer("ingest.commitMs").update(
+                (time.perf_counter() - t0) * 1000
+            )
         logger.info("committed %s at offset %d", self.segment, self.offset)
         return True
 

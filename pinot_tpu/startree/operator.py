@@ -197,6 +197,12 @@ def execute_star_tree(segment: ImmutableSegment, request: BrokerRequest) -> Inte
         total_docs=segment.num_docs,
         num_segments_queried=1,
     )
+    # cost vector: cube rows touched (dims + counts), star-tree tier
+    res.add_cost(
+        segmentsStarTree=1,
+        bytesScanned=int(rows.size)
+        * (tree.dims.shape[1] * tree.dims.itemsize + tree.counts.itemsize),
+    )
 
     def scalar_partial(agg, sel=slice(None)):
         base = agg.base_function

@@ -1,0 +1,203 @@
+"""Perf regression gate: fresh ``bench.py`` JSON vs a committed record.
+
+``bench.py`` prints one JSON document per run and the repo commits the
+round captures (``BENCH_r05.json`` & friends).  This gate compares a
+fresh run against a committed baseline with per-metric tolerance bands,
+so a perf regression fails CI instead of silently landing:
+
+- higher-is-better metrics (rows/s throughput) must stay above
+  ``baseline * min_ratio``;
+- lower-is-better metrics (latencies, per-query ms) must stay below
+  ``baseline * max_ratio``.
+
+Bands are deliberately wide (CI machines are noisy; the committed
+captures come from dedicated runs) — the gate catches the 2x cliff a
+bad merge introduces, not 5% jitter.  ``PINOT_TPU_PERF_GATE_SCALE``
+(or ``--tolerance-scale``) widens every band multiplicatively for even
+noisier environments.
+
+Runs are only comparable at the same workload size: when the two
+documents disagree on ``total_rows`` / ``num_segments`` / ``platform``
+the gate SKIPS (exit 0, verdict "skipped") rather than comparing apples
+to oranges — pass ``--allow-config-mismatch`` to force the comparison
+anyway (ratio semantics survive a platform change poorly; use only for
+exploration).
+
+Usage:
+  python -m pinot_tpu.tools.perf_gate current.json [--baseline BENCH_r05.json]
+  python bench.py > /tmp/fresh.json && \
+      python -m pinot_tpu.tools.perf_gate /tmp/fresh.json
+
+Exit codes: 0 pass/skip, 1 regression, 2 input error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# metric path -> (direction, default band).  direction "higher": value
+# must be >= baseline * band (band < 1).  direction "lower": value must
+# be <= baseline * band (band > 1).
+METRIC_SPECS: Dict[str, Tuple[str, float]] = {
+    "value": ("higher", 0.40),  # headline rows/s
+    "detail.vs_baseline_kernel_marginal": ("higher", 0.40),
+    "detail.per_query_ms": ("lower", 2.5),
+    "detail.batch_amortized_ms": ("lower", 2.5),
+    "detail.broker_p50_ms": ("lower", 2.5),
+    "detail.broker_p99_ms": ("lower", 3.0),
+    "detail.broker_rows_per_sec_p50": ("higher", 0.40),
+    "detail.sel_clustered_p50_ms_invindex": ("lower", 3.0),
+    "detail.sel_clustered_p50_ms_zonemap": ("lower", 3.0),
+    "detail.sel_clustered_p50_ms_fullscan": ("lower", 3.0),
+    "detail.sel_shuffled_p50_ms_invindex": ("lower", 3.0),
+    "detail.sel_shuffled_p50_ms_fullscan": ("lower", 3.0),
+    "detail.q6_p50_ms": ("lower", 3.0),
+    "detail.hll_groupby_p50_ms": ("lower", 3.0),
+}
+
+# config keys that must match for latency/throughput numbers to be
+# comparable at all
+CONFIG_KEYS = ("detail.total_rows", "detail.num_segments", "detail.platform")
+
+
+def _get(doc: Dict[str, Any], path: str) -> Any:
+    cur: Any = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def load_bench(source) -> Dict[str, Any]:
+    """A bench document from a dict, a path, or ``-`` (stdin).  Accepts
+    both the raw ``bench.py`` output line and the committed capture
+    wrapper (``{"parsed": {...}}``, the driver's record format); for a
+    multi-line file the LAST JSON-parseable line wins (bench.py logs
+    progress lines to stderr but belt-and-braces here)."""
+    if isinstance(source, dict):
+        doc = source
+    else:
+        text = sys.stdin.read() if source == "-" else open(source).read()
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError:
+            doc = None
+            for line in text.strip().splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        doc = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+            if doc is None:
+                raise
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        doc = doc["parsed"]
+    if doc.get("metric") is None:
+        raise ValueError("not a bench.py document (no 'metric' field)")
+    return doc
+
+
+def compare(
+    baseline: Dict[str, Any],
+    current: Dict[str, Any],
+    tolerance_scale: float = 1.0,
+    allow_config_mismatch: bool = False,
+) -> Dict[str, Any]:
+    """Gate verdict: ``{"verdict": "pass"|"fail"|"skipped", ...}`` with
+    one row per compared metric.  Pure — unit-testable without files."""
+    mismatches = {
+        k: {"baseline": _get(baseline, k), "current": _get(current, k)}
+        for k in CONFIG_KEYS
+        if _get(baseline, k) != _get(current, k)
+    }
+    if mismatches and not allow_config_mismatch:
+        return {
+            "verdict": "skipped",
+            "reason": "workload config mismatch (different scale/platform "
+            "runs are not comparable)",
+            "configMismatch": mismatches,
+            "metrics": [],
+        }
+    rows: List[Dict[str, Any]] = []
+    failures = 0
+    for path, (direction, band) in METRIC_SPECS.items():
+        b, c = _get(baseline, path), _get(current, path)
+        if not isinstance(b, (int, float)) or not isinstance(c, (int, float)):
+            continue  # metric absent in one doc: nothing to gate
+        if b <= 0:
+            continue
+        if direction == "higher":
+            limit = b * band / tolerance_scale
+            ok = c >= limit
+        else:
+            limit = b * band * tolerance_scale
+            ok = c <= limit
+        if not ok:
+            failures += 1
+        rows.append(
+            {
+                "metric": path,
+                "direction": direction,
+                "baseline": b,
+                "current": c,
+                "limit": round(limit, 4),
+                "ratio": round(c / b, 4),
+                "ok": ok,
+            }
+        )
+    return {
+        "verdict": "fail" if failures else "pass",
+        "failures": failures,
+        "compared": len(rows),
+        "toleranceScale": tolerance_scale,
+        **({"configMismatch": mismatches} if mismatches else {}),
+        "metrics": rows,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="pinot_tpu-perf-gate", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("current", help="fresh bench.py JSON (file or - for stdin)")
+    p.add_argument(
+        "--baseline",
+        default="BENCH_r05.json",
+        help="committed capture to gate against (default BENCH_r05.json)",
+    )
+    p.add_argument(
+        "--tolerance-scale",
+        type=float,
+        default=float(os.environ.get("PINOT_TPU_PERF_GATE_SCALE", "1.0")),
+        help="widen every band multiplicatively (noisy CI)",
+    )
+    p.add_argument(
+        "--allow-config-mismatch",
+        action="store_true",
+        help="compare even when workload size/platform differ",
+    )
+    args = p.parse_args(argv)
+    try:
+        baseline = load_bench(args.baseline)
+        current = load_bench(args.current)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(json.dumps({"verdict": "error", "error": str(e)}), file=sys.stderr)
+        return 2
+    out = compare(
+        baseline,
+        current,
+        tolerance_scale=max(args.tolerance_scale, 1e-9),
+        allow_config_mismatch=args.allow_config_mismatch,
+    )
+    print(json.dumps(out, indent=1))
+    return 1 if out["verdict"] == "fail" else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -102,6 +102,34 @@ def _load_trace(obj: Dict[str, Any]) -> Dict[str, Any]:
     return obj
 
 
+def render_cost(obj: Dict[str, Any]) -> str:
+    """One-line cost-vector summary from a full broker response JSON
+    (``cost`` + the scan stats) — empty string when the input is a bare
+    traceInfo with no cost to show.  Pure; unit-testable."""
+    if not isinstance(obj, dict) or "traceInfo" not in obj:
+        return ""
+    parts: List[str] = []
+    for key, label in (
+        ("numDocsScanned", "docs"),
+        ("numEntriesScannedInFilter", "entriesInFilter"),
+        ("numEntriesScannedPostFilter", "entriesPostFilter"),
+    ):
+        if key in obj:
+            parts.append(f"{label}={obj[key]}")
+    cost = obj.get("cost") or {}
+    for key in sorted(cost):
+        v = cost[key]
+        if key == "bytesScanned":
+            parts.append(f"bytes={v}")
+        elif key.endswith("Ms"):
+            parts.append(f"{key}={v}ms")
+        else:
+            parts.append(f"{key}={v}")
+    if not parts:
+        return ""
+    return "cost: " + "  ".join(parts) + "\n"
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="pinot_tpu-trace-dump", description=__doc__,
@@ -134,6 +162,9 @@ def main(argv=None) -> int:
         print("no traceInfo in input (was the query run with trace=true?)", file=sys.stderr)
         return 1
     sys.stdout.write(render_waterfall(trace_info, width=args.width))
+    # cost-vector footer: rows/bytes scanned, device vs host ms — the
+    # "why was this slow" companion to the waterfall above
+    sys.stdout.write(render_cost(obj))
     return 0
 
 

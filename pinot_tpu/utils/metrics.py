@@ -160,6 +160,16 @@ class Gauge:
         with self._lock:
             self._fn = fn
 
+    def clear_fn(self, fn) -> None:
+        """Detach a provider IF it is still the attached one (resets the
+        gauge to 0).  The equality guard makes detach safe against a
+        successor that already replaced the provider: last writer wins,
+        a stale owner's detach is a no-op."""
+        with self._lock:
+            if self._fn == fn:
+                self._fn = None
+                self._value = 0
+
     @property
     def value(self) -> Any:
         with self._lock:
@@ -364,6 +374,13 @@ BROKER_METRIC_CATALOG: Dict[str, str] = {
     "scatterGather": "scatter-gather wall time per query",
     "reduce": "partial-merge + finalize time per query",
     "serverLatency": "per-attempt server round-trip latency",
+    # cost-accounting plane (merged per-query cost vector totals)
+    "cost.docsScanned": "documents scanned, summed over merged responses",
+    "cost.bytesScanned": "column bytes touched, summed over merged responses",
+    "cost.deviceMs": "per-query device-kernel ms (merged cost vector)",
+    "cost.hostMs": "per-query host-path ms (merged cost vector)",
+    "table.*.docsScanned": "per-table documents scanned (cost attribution)",
+    "table.*.bytesScanned": "per-table column bytes touched (cost attribution)",
 }
 
 SERVER_METRIC_CATALOG: Dict[str, str] = {
@@ -391,6 +408,22 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "lane.shed": "lane waiters shed at dequeue (deadline expired)",
     "lane.deviceFailures": "launch failures surfaced by the lane",
     "lane.restarts": "lane threads restarted by the stall watchdog",
+    # cost-accounting plane: per-query cost totals on this server
+    "cost.docsScanned": "documents scanned by queries on this server",
+    "cost.bytesScanned": "column bytes touched by queries on this server",
+    "cost.deviceMs": "per-query device-kernel ms (cost vector)",
+    "cost.hostMs": "per-query host-path ms (cost vector)",
+    # HBM staging ledger (engine/device.py LEDGER; per-process)
+    "hbm.stagedBytes": "bytes of segment arrays currently staged in HBM",
+    "hbm.highWatermarkBytes": "high-watermark of staged HBM bytes",
+    "hbm.stagedTables": "staged-table cache entries currently resident",
+    "hbm.evictedBytes": "staged bytes released by cache evictions",
+    "hbm.qinputCacheBytes": "bytes pinned by the device query-input cache",
+    # ingest observability (realtime consumers hosted on this server)
+    "ingest.rowsConsumed": "stream rows consumed into mutable segments",
+    "ingest.commitMs": "segment commit latency (convert + persist round)",
+    "ingest.lag.*": "per-(table, partition) consumer lag in rows "
+    "(latest available offset - consumed offset)",
 }
 
 CONTROLLER_METRIC_CATALOG: Dict[str, str] = {
@@ -400,6 +433,8 @@ CONTROLLER_METRIC_CATALOG: Dict[str, str] = {
     "transitionAcks": "segment-transition acks processed",
     "clusterStatePolls": "full cluster-state snapshots served to brokers",
     "segmentUploads": "segments stored via the upload paths",
+    "segmentCommits": "realtime segments committed through the LLC FSM",
+    "segmentCommitMs": "controller-side commit persistence latency",
     "gateway.flaps": "dead->alive instance cycles admitted (flap hysteresis)",
     "manager.*.failures": "periodic-manager run_once failures, by manager",
     "stabilizer.rounds": "self-stabilizer convergence rounds executed",

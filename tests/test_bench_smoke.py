@@ -54,3 +54,16 @@ def test_bench_end_to_end_smoke(tmp_path):
     ref = j["tpu_capture_ref"]
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     assert os.path.exists(os.path.join(repo, ref)), ref
+
+    # perf regression gate (tools/perf_gate.py) on the fresh output:
+    # vs itself the bands must hold trivially (pass), and vs the
+    # committed full-scale capture the gate must detect the workload
+    # config mismatch and SKIP rather than compare apples to oranges
+    from pinot_tpu.tools.perf_gate import compare, load_bench
+
+    fresh = load_bench(j)
+    assert compare(fresh, fresh)["verdict"] == "pass"
+    committed = load_bench(os.path.join(repo, "BENCH_r05.json"))
+    gated = compare(committed, fresh)
+    assert gated["verdict"] == "skipped"  # tiny smoke config != capture
+    assert "detail.total_rows" in gated["configMismatch"]

@@ -24,6 +24,7 @@ from pinot_tpu.tools.scan_engine import ScanQueryProcessor
 
 STRIP = (
     "timeUsedMs",
+    "cost",
     "numEntriesScannedInFilter",
     "numEntriesScannedPostFilter",
     "numSegmentsQueried",

@@ -18,7 +18,7 @@ def run_both(schema, rows, segments, pql):
     req_o = optimize_request(parse_pql(pql))
     got = reduce_to_response(req_e, [EX.execute(segments, req_e)]).to_json()
     want = ScanQueryProcessor(schema, rows).execute(req_o).to_json()
-    for k in ("timeUsedMs", "numEntriesScannedInFilter", "numEntriesScannedPostFilter",
+    for k in ("timeUsedMs", "cost", "numEntriesScannedInFilter", "numEntriesScannedPostFilter",
               "numSegmentsQueried", "numServersQueried", "numServersResponded"):
         got.pop(k, None)
         want.pop(k, None)

@@ -226,9 +226,11 @@ def test_deadline_abandonment_with_lane_bottleneck():
 
 
 def _payload(resp) -> str:
+    # cost excluded like timeUsedMs: it records HOW the path executed
+    # (coalesce hits, device ms), which differs serial vs pipelined
     return json.dumps(
         {k: v for k, v in resp.to_json().items()
-         if k not in ("timeUsedMs", "requestId")},
+         if k not in ("timeUsedMs", "requestId", "cost")},
         sort_keys=True,
     )
 

@@ -34,6 +34,8 @@ _PATH_DEPENDENT = {
     "requestId",  # broker-assigned per query, never payload
     "numEntriesScannedInFilter",
     "numEntriesScannedPostFilter",
+    "cost",  # cost vector describes HOW a path executed (device vs host
+    # ms, serving tier) — path-dependent by construction
 }
 
 

@@ -43,6 +43,7 @@ QUERIES = [
 
 STRIP = (
     "timeUsedMs",
+    "cost",
     "numEntriesScannedInFilter",
     "numEntriesScannedPostFilter",
     "numSegmentsQueried",
