@@ -122,6 +122,10 @@ class BrokerRequestHandler:
         hedge_latency_percentile: float = 95.0,
         hedge_min_quota_headroom: float = 0.1,
         health: Optional[ServerHealthTracker] = None,
+        max_inflight_per_table: Optional[int] = None,
+        admission_window_init: Optional[float] = None,
+        admission_window_max: Optional[float] = None,
+        admission_pending_high_water: Optional[float] = None,
     ) -> None:
         self.transport = transport
         self.server_addresses = dict(server_addresses)
@@ -142,9 +146,21 @@ class BrokerRequestHandler:
         # NOT failures): routing views already exclude them; kept here so
         # /serverhealth can tell an operator drain from a sick circuit
         self.draining_servers: Set[str] = set()
+        from pinot_tpu.broker.admission import AdmissionController
         from pinot_tpu.broker.quota import QueryQuotaManager
 
         self.quota = QueryQuotaManager()
+        # adaptive admission: QPS bucket + per-table in-flight cap +
+        # AIMD per-server windows fed by reply backpressure snapshots
+        # (broker/admission.py) — ONE front door for every shed tier
+        self.admission = AdmissionController(
+            quota=self.quota,
+            max_inflight_per_table=max_inflight_per_table,
+            initial_window=admission_window_init,
+            max_window=admission_window_max,
+            pending_high_water=admission_pending_high_water,
+            metrics=self.metrics,
+        )
         self._request_id = 0
         self._id_lock = threading.Lock()
         # globally-unique request ids: broker name + a process-unique
@@ -181,6 +197,10 @@ class BrokerRequestHandler:
                 failure_threshold=conf.health_failure_threshold,
                 penalty_ms=conf.health_penalty_ms,
             ),
+            max_inflight_per_table=conf.admission_table_inflight,
+            admission_window_init=conf.admission_window_init,
+            admission_window_max=conf.admission_window_max,
+            admission_pending_high_water=conf.admission_pending_high_water,
         )
         kwargs.update(overrides)
         return cls(transport, server_addresses, **kwargs)
@@ -308,17 +328,35 @@ class BrokerRequestHandler:
             self.timeout_ms if timeout_ms is None else min(timeout_ms, self.timeout_ms)
         )
         table = request.table_name
-        if not self.quota.allow(table):
+        # adaptive admission front door: QPS bucket + per-table
+        # in-flight cap — both shed with a typed 429 naming the tier
+        decision = self.admission.try_admit(table)
+        if not decision.admitted:
             self.metrics.meter("queriesDropped").mark()
             return BrokerResponse(
                 exceptions=[
-                    QueryException(
-                        ErrorCode.TOO_MANY_REQUESTS,
-                        f"query rate on table {table} exceeds the configured quota",
-                    )
+                    QueryException(ErrorCode.TOO_MANY_REQUESTS, decision.message)
                 ],
                 request_id=request_id,
             )
+        try:
+            return self._handle_admitted(
+                request, pql, timeout_ms, request_id, ctx, table
+            )
+        finally:
+            # the in-flight slot frees when the query leaves the broker,
+            # whatever path it took out
+            self.admission.release(table)
+
+    def _handle_admitted(
+        self,
+        request: BrokerRequest,
+        pql: str,
+        timeout_ms: float,
+        request_id: str,
+        ctx: TraceContext,
+        table: str,
+    ) -> BrokerResponse:
         t_route = time.perf_counter()
         try:
             with ctx.span("route", table=table):
@@ -362,6 +400,21 @@ class BrokerRequestHandler:
             self.metrics.timer("phase.route").update(
                 (time.perf_counter() - t_route) * 1000
             )
+
+        # AIMD pre-scatter overload check: when EVERY server covering the
+        # table is past its congestion window, scattering could only end
+        # in 210s or timeouts — shed here, at the cheapest tier (429)
+        if batches:
+            cover = self.admission.check_cover(
+                table, sorted({b.server for b in batches})
+            )
+            if not cover.admitted:
+                self.metrics.meter("queriesDropped").mark()
+                return BrokerResponse(
+                    exceptions=exceptions
+                    + [QueryException(ErrorCode.TOO_MANY_REQUESTS, cover.message)],
+                    request_id=request_id,
+                )
 
         t_sg = time.perf_counter()
         with ctx.span("scatterGather", batches=len(batches)):
@@ -524,6 +577,14 @@ class BrokerRequestHandler:
                 remaining_ms,
                 attempt_ms,
                 request_id,
+            )
+            # AIMD window accounting: the done-callback observes EVERY
+            # attempt outcome exactly once — including attempts that
+            # outlive this query's gather loop (deadline-abandoned
+            # transports complete later and still decrement in-flight)
+            self.admission.on_attempt_start(server)
+            fut.add_done_callback(
+                lambda f, s=server: self._observe_attempt(f, s)
             )
             batch.inflight += 1
             if not hedge:
@@ -795,6 +856,27 @@ class BrokerRequestHandler:
             "server_traces": server_traces,
         }
 
+    def _observe_attempt(self, fut: concurrent.futures.Future, server: str) -> None:
+        """Feed one finished scatter attempt into the AIMD admission
+        windows: transport failures and retryable (210/220) refusals are
+        saturation evidence (multiplicative decrease); a healthy reply
+        grows the window additively unless its backpressure snapshot
+        shows the server's scheduler past the high-water mark."""
+        if fut.cancelled():
+            self.admission.on_attempt_cancelled(server)
+            return
+        exc = fut.exception()
+        if exc is not None:
+            self.admission.on_attempt_done(server, saturated=True)
+            return
+        result = fut.result()
+        refused = bool(result.exceptions) and all(
+            code in RETRYABLE_SERVER_CODES for code, _ in result.exceptions
+        )
+        self.admission.on_attempt_done(
+            server, saturated=refused, backpressure=result.backpressure
+        )
+
     # ------------------------------------------------------------------
     def _physical_tables(self, table: str, pql: str) -> List[Tuple[str, str]]:
         """Logical table -> [(physical table, sub-query pql)].
@@ -976,6 +1058,8 @@ class BrokerHttpServer:
                         return self._respond(broker.metrics.snapshot())
                     if url.path == "/debug/queries":
                         return self._respond(broker.querylog.snapshot())
+                    if url.path == "/debug/admission":
+                        return self._respond(broker.admission.snapshot())
                     if url.path == "/serverhealth":
                         return self._respond(
                             {

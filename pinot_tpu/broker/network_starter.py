@@ -116,6 +116,12 @@ class NetworkedBrokerStarter:
         )
         if state.get("unchanged"):
             return
+        self._apply_state(state)
+
+    def _apply_state(self, state: Dict[str, Any]) -> None:
+        """Apply one versioned cluster-state snapshot (split out of
+        ``_refresh`` so the quota/routing propagation rules are testable
+        against synthetic snapshots)."""
         self._version = state["version"]
         self._epoch = state.get("epoch", "")
         for server, addr in state["servers"].items():
@@ -143,5 +149,17 @@ class NetworkedBrokerStarter:
             self.handler.time_boundary.remove(stale)
         for table, (col, value) in state.get("timeBoundaries", {}).items():
             self.handler.time_boundary.set(table, col, value)
+        # quota propagation contract: an UPDATE reaches this broker on
+        # the next poll (set_quota reconfigures the live bucket in place
+        # — tokens preserved, so a poll can never act as a refill), and
+        # a REMOVAL clears the bucket (tables whose quota left the
+        # snapshot must stop being rate-limited)
+        quota_raw_names = set()
         for table, q in state.get("quotas", {}).items():
-            self.handler.quota.set_quota(q["rawName"], q.get("maxQueriesPerSecond"))
+            raw = q["rawName"]
+            quota_raw_names.add(raw)
+            self.handler.quota.set_quota(
+                raw, q.get("maxQueriesPerSecond"), q.get("burstQueries")
+            )
+        for stale in set(self.handler.quota.tables()) - quota_raw_names:
+            self.handler.quota.set_quota(stale, None)

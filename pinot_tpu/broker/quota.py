@@ -9,12 +9,46 @@ from typing import Dict, Optional
 
 
 class _TokenBucket:
-    def __init__(self, qps: float) -> None:
-        self.qps = qps
-        self.capacity = max(qps, 1.0)
+    """Token bucket with FRACTIONAL refill and configurable burst.
+
+    ``qps`` may be < 1.0 (e.g. 0.5 = one query per two seconds): the
+    r6 version rounded capacity up to 1.0 AND only admitted on a full
+    token, which is correct — but it also seeded a fresh bucket at full
+    capacity on every quota re-notify, and capacity==qps for qps >= 1
+    left no burst allowance at all.  Now:
+
+    - capacity = ``burst`` if given, else max(qps, 1.0) — a steady
+      sub-1-QPS client is admitted every 1/qps seconds, and an explicit
+      burst lets a bursty client spend saved-up headroom;
+    - ``reconfigure`` updates qps/burst IN PLACE, preserving spent
+      tokens (clamped to the new capacity) — a cluster-state re-notify
+      must not refill a flooding table's bucket.
+    """
+
+    @staticmethod
+    def _capacity(qps: float, burst: Optional[float]) -> float:
+        # capacity floor of 1.0: acquiring costs a whole token, so a
+        # sub-1 burst (misconfigured) would otherwise block EVERY query
+        if burst and burst > 0:
+            return max(float(burst), 1.0)
+        return max(qps, 1.0)
+
+    def __init__(self, qps: float, burst: Optional[float] = None) -> None:
+        self.qps = float(qps)
+        self.burst = burst
+        self.capacity = self._capacity(qps, burst)
         self.tokens = self.capacity
         self.last = time.monotonic()
         self._lock = threading.Lock()
+
+    def reconfigure(self, qps: float, burst: Optional[float] = None) -> None:
+        """Apply a quota UPDATE without resetting spent tokens."""
+        with self._lock:
+            self._refill()
+            self.qps = float(qps)
+            self.burst = burst
+            self.capacity = self._capacity(qps, burst)
+            self.tokens = min(self.tokens, self.capacity)
 
     def _refill(self) -> None:
         # caller holds self._lock
@@ -42,12 +76,29 @@ class QueryQuotaManager:
         self._buckets: Dict[str, _TokenBucket] = {}
         self._lock = threading.Lock()
 
-    def set_quota(self, table: str, qps: Optional[float]) -> None:
+    def set_quota(
+        self, table: str, qps: Optional[float], burst: Optional[float] = None
+    ) -> None:
+        """Install/update/remove a table's QPS quota.  An UPDATE of an
+        existing bucket reconfigures it in place (tokens preserved) so
+        the periodic cluster-state re-notify cannot act as a refill;
+        ``qps`` None/<=0 removes the bucket entirely."""
         with self._lock:
             if qps and qps > 0:
-                self._buckets[table] = _TokenBucket(qps)
+                bucket = self._buckets.get(table)
+                if bucket is None:
+                    self._buckets[table] = _TokenBucket(qps, burst)
+                elif bucket.qps != qps or bucket.burst != burst:
+                    bucket.reconfigure(qps, burst)
             else:
                 self._buckets.pop(table, None)
+
+    def tables(self) -> list:
+        """Tables that currently carry a quota (propagation bookkeeping:
+        the networked broker clears buckets for tables whose quota left
+        the cluster-state snapshot)."""
+        with self._lock:
+            return list(self._buckets)
 
     def allow(self, table: str) -> bool:
         with self._lock:

@@ -51,8 +51,13 @@ class BrokerStarter:
         self.broker.routing.update(table, view)
         config = self.resources.table_configs.get(table)
         if config is not None:
+            # idempotent for an unchanged quota (tokens preserved — a
+            # view-change re-notify must not refill a drained bucket);
+            # None clears the bucket when the quota was removed
             self.broker.quota.set_quota(
-                config.raw_name, config.quota.max_queries_per_second
+                config.raw_name,
+                config.quota.max_queries_per_second,
+                config.quota.burst_queries,
             )
         if table.endswith(OFFLINE_SUFFIX):
             metas = []

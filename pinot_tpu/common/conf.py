@@ -20,6 +20,18 @@ from typing import Any, Dict, Type, TypeVar
 T = TypeVar("T", bound="BaseConf")
 
 
+def env_float(name: str, default: float = 0.0) -> float:
+    """Float from the environment, falling back on absent OR junk
+    values (a malformed knob must degrade to the default, not crash
+    the role at construction)."""
+    import os
+
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 def parse_properties(text: str) -> Dict[str, str]:
     """Parse java-properties-style ``key=value`` lines (# comments)."""
     out: Dict[str, str] = {}
@@ -92,6 +104,11 @@ class BrokerConf(BaseConf):
     hedge_min_quota_headroom: float = 0.1  # skip hedging when the table is near its QPS quota
     health_failure_threshold: int = 3  # consecutive failures before the penalty box
     health_penalty_ms: float = 5_000.0  # circuit-open duration before a half-open probe
+    # -- adaptive admission (broker/admission.py overload front door)
+    admission_table_inflight: int = 32  # per-table in-flight concurrency cap
+    admission_window_init: float = 8.0  # AIMD per-server window start
+    admission_window_max: float = 64.0  # AIMD window additive-increase ceiling
+    admission_pending_high_water: float = 0.8  # backpressure saturation fraction
 
 
 @dataclass

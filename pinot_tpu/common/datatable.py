@@ -286,6 +286,11 @@ def serialize_result(res: IntermediateResult) -> bytes:
     # written sorted so identical costs serialize byte-identically.
     w.value({k: res.cost[k] for k in sorted(res.cost)})
 
+    # trailing optional backpressure snapshot (scheduler/lane saturation
+    # of the answering server — the broker's AIMD admission signal):
+    # same mixed-version contract, one more trailing value after cost
+    w.value({k: res.backpressure[k] for k in sorted(res.backpressure)})
+
     payload = w.getvalue()
     return MAGIC + struct.pack("<Q", len(payload)) + payload
 
@@ -324,6 +329,9 @@ def deserialize_result(data: bytes) -> IntermediateResult:
     if r.pos < len(r.data):
         # trailing cost vector (absent in payloads from older peers)
         res.cost = {str(k): v for k, v in (r.value() or {}).items()}
+    if r.pos < len(r.data):
+        # trailing backpressure snapshot (absent from older peers)
+        res.backpressure = {str(k): v for k, v in (r.value() or {}).items()}
     return res
 
 

@@ -72,7 +72,11 @@ class StreamConfig:
 @dataclass
 class QuotaConfig:
     storage: Optional[str] = None
+    # fractional values (< 1.0) are honored: 0.5 = one query per 2s
     max_queries_per_second: Optional[float] = None
+    # token-bucket burst capacity (queries); None = max(qps, 1) — a
+    # bursty-but-in-budget client can spend saved-up headroom at once
+    burst_queries: Optional[float] = None
 
     _UNITS = {"": 1, "K": 2**10, "M": 2**20, "G": 2**30, "T": 2**40}
 
@@ -90,7 +94,10 @@ class QuotaConfig:
         return int(float(m.group(1)) * self._UNITS[m.group(2).upper()])
 
     def to_json(self) -> Dict[str, Any]:
-        return {"storage": self.storage, "maxQueriesPerSecond": self.max_queries_per_second}
+        d = {"storage": self.storage, "maxQueriesPerSecond": self.max_queries_per_second}
+        if self.burst_queries is not None:
+            d["burstQueries"] = self.burst_queries
+        return d
 
 
 @dataclass
@@ -168,6 +175,7 @@ class TableConfig:
             quota=QuotaConfig(
                 storage=quota_json.get("storage"),
                 max_queries_per_second=quota_json.get("maxQueriesPerSecond"),
+                burst_queries=quota_json.get("burstQueries"),
             ),
             retention=RetentionConfig(
                 retention_time_unit=seg.get("retentionTimeUnit", "DAYS"),

@@ -789,6 +789,21 @@ class ControllerHttpServer:
                             body["name"], body.get("role", "server"), int(body.get("count", 1))
                         )
                         return self._respond({"status": "ok", "instances": tagged})
+                    if len(parts) == 3 and parts[0] == "tables" and parts[2] == "quota":
+                        # live quota update/removal: bumps the cluster-
+                        # state version so running brokers (in-process
+                        # AND networked) converge on the new rate —
+                        # {"maxQueriesPerSecond": null} removes the quota
+                        body = self._read_json()
+                        try:
+                            ctrl.resources.update_table_quota(
+                                parts[1],
+                                body.get("maxQueriesPerSecond"),
+                                body.get("burstQueries"),
+                            )
+                        except KeyError as e:
+                            return self._respond({"error": str(e)}, 404)
+                        return self._respond({"status": "ok", "table": parts[1]})
                     if len(parts) == 3 and parts[0] == "tables" and parts[2] == "rebalance":
                         qs = parse_qs(url.query)
                         dry = (qs.get("dryRun") or ["false"])[0].lower() == "true"

@@ -416,6 +416,7 @@ class ParticipantGateway:
                 quotas[table] = {
                     "rawName": config.raw_name,
                     "maxQueriesPerSecond": config.quota.max_queries_per_second,
+                    "burstQueries": config.quota.burst_queries,
                 }
             if table.endswith("_OFFLINE"):
                 from pinot_tpu.broker.time_boundary import compute_boundary

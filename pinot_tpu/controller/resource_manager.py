@@ -516,6 +516,36 @@ class ClusterResourceManager:
         self._notify_view(physical)
         return physical
 
+    def update_table_quota(
+        self,
+        physical: str,
+        max_queries_per_second,
+        burst_queries=None,
+    ) -> None:
+        """Live quota update/removal for a running table.  Persists the
+        changed config, bumps the cluster-state version (so networked
+        brokers pick it up on their next poll), and re-notifies the view
+        (so in-process brokers re-apply the quota immediately).  Passing
+        None removes the quota — brokers must CLEAR the bucket, not keep
+        enforcing a stale one."""
+        from pinot_tpu.common.tableconfig import QuotaConfig
+
+        with self._lock:
+            config = self.table_configs.get(physical)
+            if config is None:
+                raise KeyError(f"no such table {physical}")
+            config.quota = QuotaConfig(
+                storage=config.quota.storage,
+                max_queries_per_second=max_queries_per_second,
+                burst_queries=burst_queries,
+            )
+        if self.property_store is not None:
+            self.property_store.put("tables", physical, config.to_json())
+        # _notify_view bumps the version, re-sends routing AND re-applies
+        # quota via BrokerStarter.on_view_change in-process; networked
+        # brokers see the bumped version on their next clusterstate poll
+        self._notify_view(physical)
+
     def delete_table(self, physical: str) -> None:
         with self._lock:
             segs = list(self.ideal_states.get(physical, {}).keys())

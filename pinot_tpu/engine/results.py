@@ -290,6 +290,13 @@ class IntermediateResult:
         # per-query cost vector (COST_KEYS above): sparse — absent keys
         # mean zero, so empty-path results stay cheap to build and ship
         self.cost: Dict[str, float] = dict(cost or {})
+        # per-REPLY saturation snapshot of the answering server (NOT
+        # additive — never merged): {"pending", "maxPending", "laneDepth"}
+        # set by ServerInstance.handle_request; the broker's admission
+        # controller reads it to drive the per-server AIMD concurrency
+        # window (shed early with 429 instead of feeding a saturated
+        # server until 210s appear)
+        self.backpressure: Dict[str, float] = {}
 
     def add_cost(self, **kv: float) -> None:
         """Accumulate cost-vector components (key-wise add)."""

@@ -638,9 +638,17 @@ class RealtimeSegmentDataManager:
 
         self._lag_probe = LagProbe(stream, partition, lambda: self.offset)
         self._lag_gauge_name = f"ingest.lag.{table}.p{partition}"
+        # ingest backpressure: the hosting server's watermark governor
+        # (pause above the HBM/mutable high watermark, resume below the
+        # low) + a per-consumer paused gauge for per-partition visibility
+        self._governor = getattr(server, "ingest_backpressure", None)
+        self._paused = False
+        self._paused_gauge_name = f"ingest.paused.{table}.p{partition}"
+        self._paused_fn = lambda: 1 if self._paused else 0
         if self._metrics is not None:
             lag_key = f"{table}.p{partition}"
             self._metrics.gauge(f"ingest.lag.{lag_key}").set_fn(self._lag_probe)
+            self._metrics.gauge(f"ingest.paused.{lag_key}").set_fn(self._paused_fn)
 
     def lag(self) -> Optional[int]:
         """Consumer lag in rows: latest available offset on this
@@ -657,6 +665,7 @@ class RealtimeSegmentDataManager:
         # server already re-registered the same series.
         if self._metrics is not None:
             self._metrics.gauge(self._lag_gauge_name).clear_fn(self._lag_probe)
+            self._metrics.gauge(self._paused_gauge_name).clear_fn(self._paused_fn)
 
     def _mark_rows(self, n: int) -> None:
         if n and self._metrics is not None:
@@ -724,9 +733,18 @@ class RealtimeSegmentDataManager:
         return len(rows)
 
     def consume_step(self, max_rows: int = 1000) -> int:
-        """Fetch + index one batch; returns rows consumed."""
+        """Fetch + index one (bounded) batch; returns rows consumed.
+        Returns 0 WITHOUT touching the stream while the server's ingest
+        governor holds consumption above a memory watermark — the offset
+        freezes, lag grows visibly, nothing is dropped or skipped."""
         if self._stopped:
             return 0
+        if self._governor is not None:
+            allowed = self._governor.consume_allowed()
+            self._paused = not allowed
+            if not allowed:
+                return 0
+            max_rows = self._governor.clamp_batch(max_rows)
         budget = self.rows_per_segment - self.mutable.num_docs
         if budget <= 0:
             return 0

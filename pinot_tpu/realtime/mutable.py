@@ -204,6 +204,24 @@ class MutableSegment:
     def num_docs(self) -> int:
         return self._num_docs
 
+    def approx_bytes(self) -> int:
+        """Rough host-memory footprint of the consuming state (growable
+        dictId arrays + dictionaries + encode indexes) — the ingest
+        backpressure watermark input.  Conservative rather than exact:
+        the cached query snapshot (rebuilt per watermark) is not
+        counted, so set watermarks with ~2x headroom."""
+        with self._lock:
+            total = 0
+            for mc in self._columns.values():
+                if mc.single:
+                    total += mc.ids.nbytes
+                else:
+                    total += 4 * len(mc.flat_ids) + 8 * len(mc.offsets)
+                total += 64 * len(mc.id_to_value)  # dict entries (rough)
+                if mc._sorted_vals is not None:
+                    total += mc._sorted_vals.nbytes + mc._sorted_ids.nbytes
+            return total
+
     def index(self, row: Row) -> None:
         """Append one row (RealtimeSegmentImpl.index :185); visible to
         queries at the next snapshot."""

@@ -112,6 +112,20 @@ class ServerInstance:
         self.metrics.gauge("hbm.qinputCacheBytes").set_fn(
             lambda: self.executor._qinput_cache_bytes
         )
+        # ingest backpressure governor (realtime/backpressure.py):
+        # watermark pause/resume against the HBM staging ledger and the
+        # instance's consuming-segment memory, shared by every realtime
+        # consumer hosted here (in-process llc.py + networked
+        # RemoteConsumer).  Watermarks default off; env-configured.
+        from pinot_tpu.realtime.backpressure import (
+            IngestBackpressure,
+            instance_mutable_bytes,
+        )
+
+        self.ingest_backpressure = IngestBackpressure(
+            metrics=self.metrics,
+            mutable_bytes_fn=lambda: instance_mutable_bytes(self),
+        )
         self._table_schemas: dict = {}  # raw table name -> Schema
         # controller-acknowledged drain state (set from the heartbeat
         # reply by the networked starter): the instance keeps serving —
@@ -214,10 +228,14 @@ class ServerInstance:
         deadline = time.monotonic() + timeout_s
         t_enqueue = time.monotonic()
         try:
+            # fair-share scheduling: each table queues separately and the
+            # DRR dequeue guarantees a flooding tenant cannot starve the
+            # others (server/scheduler.py)
             result = self.scheduler.run(
                 lambda: self._process(req, deadline, t_enqueue),
                 timeout_s=timeout_s,
                 deadline=deadline,
+                table=req["table"],
             )
         except SchedulerSaturatedError as e:
             # overload shed: fast typed rejection, no stack spam — the
@@ -267,6 +285,16 @@ class ServerInstance:
                 self.metrics.timer(timer).update(float(ms))
         self.metrics.timer("queryExecution").update((time.perf_counter() - t_start) * 1000)
         self.metrics.meter("queries").mark()
+        # backpressure snapshot on EVERY reply (including sheds): the
+        # broker's AIMD admission window reads it to back off before
+        # this server has to shed with 210s
+        result.backpressure = {
+            "pending": self.scheduler.pending,
+            "maxPending": self.scheduler.max_pending,
+            "laneDepth": 0
+            if self.lane is None
+            else self.lane.stats().get("depth", 0),
+        }
         return serialize_result(result)
 
     def status(self) -> dict:
@@ -291,6 +319,7 @@ class ServerInstance:
             "lane": None if self.lane is None else self.lane.stats(),
             "selfHealing": heal,
             "hbm": hbm,
+            "ingest": self.ingest_backpressure.snapshot(),
             "metrics": self.metrics.snapshot(),
         }
 

@@ -139,9 +139,17 @@ class RemoteConsumer:
         self._metrics = getattr(starter.server, "metrics", None)
         self._lag_probe = LagProbe(self.stream, self.partition, lambda: self.offset)
         self._lag_gauge_name = f"ingest.lag.{table}.p{self.partition}"
+        # ingest backpressure: the hosting server's watermark governor
+        # pauses consumption above the HBM/mutable high watermark; the
+        # per-consumer paused gauge makes the held partition visible
+        self._governor = getattr(starter.server, "ingest_backpressure", None)
+        self._paused = False
+        self._paused_gauge_name = f"ingest.paused.{table}.p{self.partition}"
+        self._paused_fn = lambda: 1 if self._paused else 0
         if self._metrics is not None:
             lag_key = f"{table}.p{self.partition}"
             self._metrics.gauge(f"ingest.lag.{lag_key}").set_fn(self._lag_probe)
+            self._metrics.gauge(f"ingest.paused.{lag_key}").set_fn(self._paused_fn)
 
     def lag(self) -> Optional[int]:
         return self._lag_probe()
@@ -154,6 +162,7 @@ class RemoteConsumer:
         server already owns the series."""
         if self._metrics is not None:
             self._metrics.gauge(self._lag_gauge_name).clear_fn(self._lag_probe)
+            self._metrics.gauge(self._paused_gauge_name).clear_fn(self._paused_fn)
 
     def start(self) -> None:
         self.starter.server.add_segment(self.table, self.mutable)
@@ -169,6 +178,11 @@ class RemoteConsumer:
         budget = limit_rows - self.mutable.num_docs
         if budget <= 0:
             return 0
+        if self._governor is not None:
+            # bounded in-flight batches: one governor decision covers at
+            # most max_batch_rows of exposure (the r6 path fetched a
+            # whole segment budget in ONE call)
+            budget = self._governor.clamp_batch(budget)
         rows, next_offset = self.stream.fetch(self.partition, self.offset, budget)
         self.mutable.index_batch(rows)
         self.offset = next_offset
@@ -180,6 +194,15 @@ class RemoteConsumer:
     def _run(self) -> None:
         try:
             while not self._stop.is_set():
+                if self._governor is not None:
+                    allowed = self._governor.consume_allowed()
+                    self._paused = not allowed
+                    if not allowed:
+                        # held above a memory watermark: offset freezes,
+                        # lag grows on the gauge, nothing is lost —
+                        # consumption resumes below the low watermark
+                        self._stop.wait(self.poll_interval_s)
+                        continue
                 try:
                     got = self._consume_to(self.rows_per_segment)
                 except Exception as e:

@@ -39,6 +39,7 @@ class InProcessCluster:
         mesh=None,
         http: bool = False,
         timeout_ms: float = 15_000.0,
+        max_pending: int = 64,
     ) -> None:
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="pinot_tpu_cluster_")
         self.controller = Controller(self.data_dir)
@@ -48,7 +49,7 @@ class InProcessCluster:
         self.server_starters: List[ServerStarter] = []
         addresses: Dict[str, tuple] = {}
         for i in range(num_servers):
-            server = ServerInstance(f"server{i}", mesh=mesh)
+            server = ServerInstance(f"server{i}", mesh=mesh, max_pending=max_pending)
             starter = ServerStarter(server, self.controller.resources)
             starter.start()
             address = (server.name, 0)
@@ -174,7 +175,9 @@ class ClosedLoopLoad:
     """N client threads issuing the same query back-to-back, classifying
     every response: ok (complete + correct), partial (transient
     ``partialResponse`` — allowed during healing), failed (wrong count
-    or exceptions on a response claiming to be complete)."""
+    or exceptions on a response claiming to be complete).  Per-query
+    latencies are recorded so overload scenarios can compare a tenant's
+    loaded percentiles against its unloaded baseline."""
 
     def __init__(
         self, cluster: "InProcessCluster", pql: str, expected_docs: int,
@@ -192,9 +195,11 @@ class ClosedLoopLoad:
         self.partials = 0
         self.failed = 0
         self.failures: List[str] = []  # first few failure descriptions
+        self.latencies_ms: List[float] = []
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            t0 = time.perf_counter()
             try:
                 resp = self.cluster.broker.handle_pql(self.pql)
             except Exception as e:  # a raised handler is always a failure
@@ -204,8 +209,10 @@ class ClosedLoopLoad:
                     if len(self.failures) < 8:
                         self.failures.append(f"{type(e).__name__}: {e}")
                 continue
+            ms = (time.perf_counter() - t0) * 1000.0
             with self._lock:
                 self.total += 1
+                self.latencies_ms.append(ms)
                 if resp.partial_response:
                     self.partials += 1
                 elif resp.exceptions or resp.num_docs_scanned != self.expected_docs:
@@ -225,6 +232,104 @@ class ClosedLoopLoad:
             self._threads.append(t)
         return self
 
+    @staticmethod
+    def _pct(sorted_ms: List[float], p: float) -> float:
+        if not sorted_ms:
+            return 0.0
+        i = min(len(sorted_ms) - 1, int(round(p / 100.0 * (len(sorted_ms) - 1))))
+        return sorted_ms[i]
+
+    def stop(self) -> Dict[str, Any]:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+        lat = sorted(self.latencies_ms)
+        return {
+            "queries": self.total,
+            "okQueries": self.ok,
+            "partialQueries": self.partials,
+            "failedQueries": self.failed,
+            "failures": list(self.failures),
+            "p50Ms": round(self._pct(lat, 50), 3),
+            "p99Ms": round(self._pct(lat, 99), 3),
+        }
+
+
+class FloodLoad:
+    """Open-throttle tenant: N threads hammering one table back-to-back,
+    classifying every reply by SHED TIER — the noisy neighbor whose
+    overflow must come back as typed 429/210, never as timeouts."""
+
+    def __init__(self, cluster: "InProcessCluster", pql: str, clients: int = 4) -> None:
+        self.cluster = cluster
+        self.pql = pql
+        self.clients = clients
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self.total = 0
+        self.ok = 0
+        self.shed_429 = 0  # broker admission (quota / concurrency / overload)
+        self.shed_210 = 0  # server scheduler saturation (incl. 220 drain)
+        self.timeouts = 0  # the failure mode overload protection must prevent
+        self.other_failures = 0
+        self.samples: List[str] = []
+
+    def _classify(self, codes) -> str:
+        from pinot_tpu.common.response import ErrorCode
+
+        if ErrorCode.TOO_MANY_REQUESTS in codes:
+            return "429"
+        if (
+            ErrorCode.SERVER_SCHEDULER_DOWN in codes
+            or ErrorCode.SERVER_SHUTTING_DOWN in codes
+        ):
+            return "210"
+        if (
+            ErrorCode.EXECUTION_TIMEOUT in codes
+            or ErrorCode.BROKER_TIMEOUT in codes
+        ):
+            return "timeout"
+        return "other"
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                resp = self.cluster.broker.handle_pql(self.pql)
+            except Exception as e:
+                with self._lock:
+                    self.total += 1
+                    self.other_failures += 1
+                    if len(self.samples) < 8:
+                        self.samples.append(f"{type(e).__name__}: {e}")
+                continue
+            with self._lock:
+                self.total += 1
+                if not resp.exceptions:
+                    self.ok += 1
+                    continue
+                kind = self._classify({e.error_code for e in resp.exceptions})
+                if kind == "429":
+                    self.shed_429 += 1
+                elif kind == "210":
+                    self.shed_210 += 1
+                elif kind == "timeout":
+                    self.timeouts += 1
+                else:
+                    self.other_failures += 1
+                    if len(self.samples) < 8:
+                        self.samples.append(
+                            f"codes={[e.error_code for e in resp.exceptions]} "
+                            f"{resp.exceptions[0].message[:120]}"
+                        )
+
+    def start(self) -> "FloodLoad":
+        for i in range(self.clients):
+            t = threading.Thread(target=self._loop, name=f"flood-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
     def stop(self) -> Dict[str, Any]:
         self._stop.set()
         for t in self._threads:
@@ -232,9 +337,11 @@ class ClosedLoopLoad:
         return {
             "queries": self.total,
             "okQueries": self.ok,
-            "partialQueries": self.partials,
-            "failedQueries": self.failed,
-            "failures": list(self.failures),
+            "shed429": self.shed_429,
+            "shed210": self.shed_210,
+            "timeouts": self.timeouts,
+            "otherFailures": self.other_failures,
+            "samples": list(self.samples),
         }
 
 
@@ -416,10 +523,234 @@ def run_rolling_restart_scenario(
         cluster.stop()
 
 
+# ---------------------------------------------------------------------------
+# Overload-protection scenarios (ISSUE 7): multi-tenant noisy neighbor
+# and ingest backpressure — shared by the CLI and tests/test_overload.py.
+# ---------------------------------------------------------------------------
+
+
+def _tenant_schema(name: str):
+    from pinot_tpu.tools.datagen import make_test_schema
+
+    schema = make_test_schema(with_mv=False)
+    schema.schema_name = name
+    return schema
+
+
+def run_noisy_neighbor_scenario(
+    num_servers: int = 2,
+    replication: int = 1,
+    num_segments: int = 3,
+    clients: int = 3,
+    flood_clients: int = 4,
+    quota_qps: float = 8.0,
+    baseline_s: float = 1.0,
+    flood_s: float = 2.5,
+    max_pending: int = 16,
+    data_dir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Tenant A floods its table while tenant B runs a steady closed
+    loop.  The overload plane must contain A end to end:
+
+    - tenant B suffers ZERO failed queries and its p99 stays within a
+      fixed multiple of its unloaded baseline (measured first);
+    - tenant A's overflow is shed with TYPED errors (429 at the broker
+      admission tiers, 210 at the server fair-share scheduler) — never
+      client-visible timeouts;
+    - the quota lands through the LIVE update path
+      (``update_table_quota``), as a production operator would apply it.
+    """
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.tools.datagen import random_rows
+
+    cluster = InProcessCluster(
+        num_servers=num_servers, data_dir=data_dir, max_pending=max_pending
+    )
+    try:
+        totals: Dict[str, int] = {}
+        physicals: Dict[str, str] = {}
+        for tenant in ("tenantA", "tenantB"):
+            schema = _tenant_schema(tenant)
+            physical = cluster.add_offline_table(schema, replication=replication)
+            physicals[tenant] = physical
+            rows = random_rows(schema, 240, seed=7)
+            total = 0
+            for i in range(num_segments):
+                n = 40 + 30 * (i % 3)
+                cluster.upload(
+                    physical, build_segment(schema, rows[:n], physical, f"{tenant}s{i}")
+                )
+                total += n
+            totals[tenant] = total
+
+        pql_a = "SELECT count(*) FROM tenantA"
+        pql_b = "SELECT count(*) FROM tenantB"
+        # warm both paths (staging + plan build) before measuring
+        for pql in (pql_a, pql_b):
+            r = cluster.broker.handle_pql(pql)
+            assert not r.exceptions, r.exceptions
+
+        # phase 1: tenant B's unloaded baseline
+        base_load = ClosedLoopLoad(cluster, pql_b, totals["tenantB"], clients).start()
+        time.sleep(baseline_s)
+        baseline = base_load.stop()
+
+        # phase 2: quota lands on tenant A through the LIVE update path
+        cluster.controller.resources.update_table_quota(
+            physicals["tenantA"], quota_qps
+        )
+
+        # phase 3: A floods (open throttle, >> 10x quota offered) while
+        # B keeps its steady closed loop
+        b_load = ClosedLoopLoad(cluster, pql_b, totals["tenantB"], clients).start()
+        a_flood = FloodLoad(cluster, pql_a, clients=flood_clients).start()
+        time.sleep(flood_s)
+        a_summary = a_flood.stop()
+        b_summary = b_load.stop()
+
+        baseline_p99 = baseline["p99Ms"]
+        loaded_p99 = b_summary["p99Ms"]
+        # absolute floor absorbs scheduler jitter on a near-zero
+        # baseline: 3x of 2ms is not a meaningful isolation bar
+        p99_limit = 3.0 * max(baseline_p99, 25.0)
+        offered_qps = a_summary["queries"] / max(flood_s, 1e-9)
+        return {
+            "scenario": "noisy-neighbor",
+            "quotaQps": quota_qps,
+            "offeredQpsA": round(offered_qps, 1),
+            "offeredMultiple": round(offered_qps / quota_qps, 1),
+            "tenantA": a_summary,
+            "tenantB": b_summary,
+            "tenantBBaseline": baseline,
+            "tenantBLoadedP99Ms": loaded_p99,
+            "tenantBP99LimitMs": round(p99_limit, 3),
+            "tenantBP99Within": loaded_p99 <= p99_limit,
+            "sheddingTyped": a_summary["timeouts"] == 0
+            and a_summary["otherFailures"] == 0,
+            "admission": cluster.broker.admission.snapshot(),
+            "scheduler": {
+                s.name: s.scheduler.stats() for s in cluster.servers
+            },
+            # main()'s exit-code contract: any tenant-B failure OR any
+            # untyped tenant-A overflow fails the scenario
+            "failedQueries": b_summary["failedQueries"]
+            + a_summary["timeouts"]
+            + a_summary["otherFailures"],
+        }
+    finally:
+        cluster.stop()
+
+
+def run_ingest_backpressure_scenario(
+    rows: int = 400,
+    rows_per_segment: int = 1000,
+    hbm_high_bytes: float = 256.0,
+    data_dir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Prove the ingest watermark contract end to end: a consumer
+    pauses when the HBM staging ledger crosses the high watermark
+    (query-driven staging — the 'query flood squeezes ingest' shape),
+    its offset freezes while lag stays visible, and after the pressure
+    clears it resumes and drains lag to 0 — no rows lost or skipped."""
+    from pinot_tpu.engine.device import LEDGER, clear_staging_cache
+    from pinot_tpu.realtime.backpressure import IngestBackpressure
+    from pinot_tpu.realtime.llc import make_segment_name
+    from pinot_tpu.realtime.stream import MemoryStreamProvider
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.tools.datagen import make_test_schema, random_rows
+
+    clear_staging_cache()  # start from a known-empty ledger
+    cluster = InProcessCluster(num_servers=1, data_dir=data_dir)
+    try:
+        server = cluster.servers[0]
+        # tight watermarks wired to the REAL staging ledger, installed
+        # BEFORE the consumer exists so it binds to this governor
+        server.ingest_backpressure = IngestBackpressure(
+            metrics=server.metrics,
+            hbm_high_bytes=hbm_high_bytes,
+            hbm_low_bytes=hbm_high_bytes / 2.0,
+            poll_interval_s=0.0,
+        )
+
+        # an offline table whose staging will push the ledger over the
+        # high watermark (the query side of the squeeze)
+        offline_schema = _tenant_schema("pressure")
+        offline_physical = cluster.add_offline_table(offline_schema)
+        cluster.upload(
+            offline_physical,
+            build_segment(
+                offline_schema, random_rows(offline_schema, 200, seed=3),
+                offline_physical, "p0",
+            ),
+        )
+
+        rt_schema = _tenant_schema("rtTable")
+        stream = MemoryStreamProvider(num_partitions=1)
+        physical = cluster.add_realtime_table(
+            rt_schema, stream, rows_per_segment=rows_per_segment
+        )
+        for row in random_rows(rt_schema, rows, seed=5):
+            stream.produce(row)
+        dm = cluster.controller.realtime_manager.consumers_of(
+            make_segment_name(physical, 0, 0)
+        )[0]
+
+        # phase 1: unpressured consumption advances
+        consumed_free = dm.consume_step(max_rows=100)
+
+        # phase 2: a query stages the offline table's columns -> ledger
+        # crosses the high watermark -> the consumer PAUSES (offset
+        # frozen).  A group-by aggregation stages forward + dictionary
+        # arrays (a bare count(*) would stage only the doc counts).
+        cluster.query("SELECT sum(metInt) FROM pressure GROUP BY dimStr TOP 5")
+        staged_bytes = LEDGER.total_bytes()
+        paused_consumed = dm.consume_step(max_rows=100)
+        offset_at_pause = dm.offset
+        dm.consume_step(max_rows=100)  # still paused: offset must not move
+        paused_state = {
+            "paused": server.ingest_backpressure.paused,
+            "reason": server.ingest_backpressure.reason,
+            "lagWhilePaused": dm.lag(),
+            "offsetFrozen": dm.offset == offset_at_pause,
+        }
+
+        # phase 3: pressure clears -> resume -> lag drains to 0
+        clear_staging_cache()
+        drained = 0
+        for _ in range(200):
+            got = dm.consume_step(max_rows=100)
+            drained += got
+            if dm.lag() == 0:
+                break
+        return {
+            "scenario": "ingest-backpressure",
+            "hbmHighBytes": hbm_high_bytes,
+            "stagedBytesAtPause": staged_bytes,
+            "consumedBeforePressure": consumed_free,
+            "consumedWhilePaused": paused_consumed,
+            **paused_state,
+            "resumed": not server.ingest_backpressure.paused,
+            "consumedAfterResume": drained,
+            "finalLag": dm.lag(),
+            "governor": server.ingest_backpressure.snapshot(),
+            "failedQueries": 0
+            if (
+                paused_state["offsetFrozen"]
+                and paused_consumed == 0
+                and dm.lag() == 0
+            )
+            else 1,
+        }
+    finally:
+        cluster.stop()
+
+
 SCENARIOS = {
     "kill-server": run_kill_server_scenario,
     "drain": run_drain_scenario,
     "rolling-restart": run_rolling_restart_scenario,
+    "noisy-neighbor": run_noisy_neighbor_scenario,
+    "ingest-backpressure": run_ingest_backpressure_scenario,
 }
 
 
@@ -433,13 +764,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--replication", type=int, default=2)
     p.add_argument("--segments", type=int, default=6)
     p.add_argument("--clients", type=int, default=3)
+    p.add_argument("--quota-qps", type=float, default=8.0)
+    p.add_argument("--flood-clients", type=int, default=4)
     args = p.parse_args(argv)
-    out = SCENARIOS[args.scenario](
-        num_servers=args.servers,
-        replication=args.replication,
-        num_segments=args.segments,
-        clients=args.clients,
-    )
+    if args.scenario == "ingest-backpressure":
+        out = SCENARIOS[args.scenario]()
+    elif args.scenario == "noisy-neighbor":
+        out = SCENARIOS[args.scenario](
+            num_servers=min(args.servers, 2),
+            replication=args.replication,
+            num_segments=args.segments,
+            clients=args.clients,
+            flood_clients=args.flood_clients,
+            quota_qps=args.quota_qps,
+        )
+    else:
+        out = SCENARIOS[args.scenario](
+            num_servers=args.servers,
+            replication=args.replication,
+            num_segments=args.segments,
+            clients=args.clients,
+        )
     print(json.dumps(out, indent=2))
     return 0 if out["failedQueries"] == 0 else 1
 

@@ -155,6 +155,146 @@ def run_curve(
     }
 
 
+def run_two_tenant_ladder(
+    segments_a,
+    segments_b,
+    qps_ladder: List[float],
+    duration_s: float,
+    quota_qps: float = 8.0,
+    max_pending: int = 16,
+    b_clients: int = 2,
+) -> dict:
+    """Two-tenant overload ladder: tenant A's offered QPS climbs the
+    ladder (10x+ past its quota at the top) while tenant B holds a
+    steady closed loop on the same server.  Per step, records each
+    tenant's shed/quota counters and latency percentiles — the curve
+    that shows WHERE A's overflow is shed (429 quota / 429 admission /
+    210 fair-share) and that B's percentiles hold flat."""
+    import threading as _threading
+
+    from pinot_tpu.broker.broker import BrokerRequestHandler
+    from pinot_tpu.broker.routing import RoutingTableProvider
+    from pinot_tpu.server.instance import ServerInstance
+    from pinot_tpu.tools.query_runner import QueryRunner
+    from pinot_tpu.transport.local import LocalTransport
+
+    server = ServerInstance("benchServer", max_pending=max_pending)
+    routing = RoutingTableProvider()
+    for table, segs in (("tenantA", segments_a), ("tenantB", segments_b)):
+        for seg in segs:
+            server.add_segment(table, seg)
+        routing.update(
+            table, {s.segment_name: {"benchServer": "ONLINE"} for s in segs}
+        )
+    transport = LocalTransport()
+    transport.register(("benchServer", 0), server.handle_request)
+    broker = BrokerRequestHandler(
+        transport,
+        {"benchServer": ("benchServer", 0)},
+        routing=routing,
+        timeout_ms=30_000.0,
+    )
+    broker.quota.set_quota("tenantA", quota_qps)
+
+    pql_a = "SELECT sum(l_quantity), count(*) FROM tenantA GROUP BY l_returnflag TOP 5"
+    pql_b = "SELECT sum(l_extendedprice), count(*) FROM tenantB GROUP BY l_linestatus TOP 5"
+
+    counters = {"a_quota": 0, "a_shed": 0, "a_error": 0, "a_ok": 0}
+    clock = threading.Lock()
+
+    def run_a(pql: str) -> None:
+        resp = broker.handle_pql(pql)
+        codes = {e.error_code for e in resp.exceptions}
+        with clock:
+            if not codes:
+                counters["a_ok"] += 1
+            elif ErrorCode.TOO_MANY_REQUESTS in codes:
+                counters["a_quota"] += 1
+            elif ErrorCode.SERVER_SCHEDULER_DOWN in codes:
+                counters["a_shed"] += 1
+            else:
+                counters["a_error"] += 1
+
+    runner = QueryRunner(run_a)
+    for pql in (pql_a, pql_b):  # warm staging + compile for both shapes
+        broker.handle_pql(pql)
+
+    def admission_counts() -> dict:
+        return {
+            name.split(".", 1)[1]: broker.metrics.meter(name).count
+            for name in (
+                "admission.shedQuota",
+                "admission.shedConcurrency",
+                "admission.shedOverload",
+            )
+        }
+
+    steps = []
+    for qps in qps_ladder:
+        with clock:
+            counters.update(a_quota=0, a_shed=0, a_error=0, a_ok=0)
+        adm_before = admission_counts()
+        b_lat: List[float] = []
+        b_errors = [0]
+        stop = _threading.Event()
+
+        def b_loop():
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                resp = broker.handle_pql(pql_b)
+                ms = (time.perf_counter() - t0) * 1000.0
+                with clock:
+                    b_lat.append(ms)
+                    if resp.exceptions:
+                        b_errors[0] += 1
+
+        b_threads = [
+            _threading.Thread(target=b_loop, daemon=True) for _ in range(b_clients)
+        ]
+        for t in b_threads:
+            t.start()
+        report = runner.target_qps([pql_a], qps=qps, duration_s=duration_s)
+        stop.set()
+        for t in b_threads:
+            t.join(timeout=10)
+        rj = report.to_json()
+        lat = sorted(b_lat)
+        adm_after = admission_counts()
+        steps.append(
+            {
+                "a_target_qps": qps,
+                "a_offered_multiple": round(qps / quota_qps, 2),
+                "a_achieved_qps": rj["qps"],
+                "a_ok": counters["a_ok"],
+                "a_quota_rejects": counters["a_quota"],
+                "a_shed_210": counters["a_shed"],
+                "a_errors": counters["a_error"],
+                "admission_sheds": {
+                    k: adm_after[k] - adm_before[k] for k in adm_after
+                },
+                "b_queries": len(lat),
+                "b_errors": b_errors[0],
+                "b_p50_ms": round(lat[len(lat) // 2], 3) if lat else 0.0,
+                "b_p99_ms": round(lat[min(len(lat) - 1, int(0.99 * len(lat)))], 3)
+                if lat
+                else 0.0,
+            }
+        )
+        print(json.dumps({"two_tenant_step": steps[-1]}), flush=True)
+
+    return {
+        "mode": "two-tenant-ladder",
+        "quota_qps": quota_qps,
+        "max_pending": max_pending,
+        "overload_policy": "broker: adaptive admission (QPS bucket + "
+        "per-table inflight + AIMD windows) sheds 429; server: per-table "
+        "DRR fair-share queues shed 210 (see README Overload protection)",
+        "steps": steps,
+        "admission": broker.admission.snapshot(),
+        "scheduler": server.scheduler.stats(),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("-segments", type=int, default=None)
@@ -162,6 +302,14 @@ def main() -> None:
     ap.add_argument("-qps", type=str, default="2,4,8,16,32,64")
     ap.add_argument("-duration", type=float, default=15.0)
     ap.add_argument("-out", type=str, default="")
+    ap.add_argument(
+        "-two-tenant",
+        action="store_true",
+        dest="two_tenant",
+        help="two-tenant overload ladder: tenant A climbs the -qps ladder "
+        "against its quota while tenant B runs a steady closed loop",
+    )
+    ap.add_argument("-quota-qps", type=float, default=8.0, dest="quota_qps")
     args = ap.parse_args()
 
     import jax
@@ -180,7 +328,17 @@ def main() -> None:
     print(json.dumps({"datagen_s": round(time.perf_counter() - t0, 1)}), flush=True)
 
     ladder = [float(x) for x in args.qps.split(",")]
-    doc = run_curve(segments, ladder, args.duration)
+    if args.two_tenant:
+        half = max(1, len(segments) // 2)
+        doc = run_two_tenant_ladder(
+            segments[:half],
+            segments[half:] or segments[:half],
+            ladder,
+            args.duration,
+            quota_qps=args.quota_qps,
+        )
+    else:
+        doc = run_curve(segments, ladder, args.duration)
     doc["platform"] = jax.devices()[0].platform
     out = json.dumps(doc, indent=1)
     print(out)

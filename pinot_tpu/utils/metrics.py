@@ -364,7 +364,16 @@ def prometheus_text(registries, prefix: str = "pinot_tpu") -> str:
 
 BROKER_METRIC_CATALOG: Dict[str, str] = {
     "queries": "queries received (post-parse routing attempts included)",
-    "queriesDropped": "queries rejected by the per-table QPS quota",
+    "queriesDropped": "queries rejected by the admission front door "
+    "(any tier: quota / concurrency / overload)",
+    # adaptive admission plane (broker/admission.py)
+    "admission.shedQuota": "queries shed by the per-table QPS token bucket",
+    "admission.shedConcurrency": "queries shed by the per-table in-flight cap",
+    "admission.shedOverload": "queries shed pre-scatter because every "
+    "covering server's AIMD window was exhausted",
+    "admission.windowDecreases": "AIMD multiplicative window decreases "
+    "(saturation evidence observed)",
+    "admission.inflight": "queries currently inside the broker, all tables",
     "slowQueries": "queries recorded into the slow-query log",
     "failoverRetries": "scatter batches re-issued to an alternate replica",
     "hedgesSent": "speculative duplicate attempts sent to a second replica",
@@ -393,6 +402,10 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "queryExecution": "end-to-end server handle_request latency",
     "scheduler.pending": "queries queued-or-running on the scheduler",
     "phase.schedulerWait": "time from submit to worker dequeue",
+    # fair-share scheduling plane (per-table DRR queues)
+    "fairshare.activeTables": "tables with a non-empty scheduler queue",
+    "fairshare.shed": "submits shed by the global or per-table "
+    "fair-share pending cap (210 on the wire)",
     "phase.*": "per-stage executor phase timers (staging, planBuild, "
     "laneWait, planExec, finalize, indexPath, hostPath, hostFailover, "
     "laneDispatch)",
@@ -424,6 +437,13 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "ingest.commitMs": "segment commit latency (convert + persist round)",
     "ingest.lag.*": "per-(table, partition) consumer lag in rows "
     "(latest available offset - consumed offset)",
+    # ingest backpressure plane (realtime/backpressure.py governor)
+    "ingest.paused": "1 while the ingest governor holds consumption "
+    "above a memory watermark",
+    "ingest.paused.*": "per-(table, partition) consumer pause flag "
+    "(1 = held by the backpressure governor)",
+    "ingest.pauses": "ingest pause events (high watermark crossed)",
+    "ingest.resumes": "ingest resume events (back under low watermarks)",
 }
 
 CONTROLLER_METRIC_CATALOG: Dict[str, str] = {

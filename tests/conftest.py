@@ -54,6 +54,24 @@ def _no_leaked_lane_threads():
 
 
 @pytest.fixture(autouse=True)
+def _no_leaked_scheduler_threads():
+    """Fair-share scheduler workers (server/scheduler.py): a shut-down
+    scheduler's workers must drain their queues and exit — this guard
+    catches any worker that survived shutdown().  Workers of schedulers
+    still serving (module fixtures) are exempt."""
+    yield
+    from pinot_tpu.server.scheduler import leaked_scheduler_threads
+
+    # grace covers a worker still draining a query whose client already
+    # timed out (e.g. the 2s sleep in test_scheduler_run_timeout)
+    leaked = leaked_scheduler_threads(grace_s=4.0)
+    assert not leaked, (
+        f"scheduler worker threads leaked past shutdown(): "
+        f"{[t.name for t in leaked]}"
+    )
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_manager_threads():
     """Controller periodic managers (retention/validation/status/
     stabilizer): a stopped manager's worker must actually exit —

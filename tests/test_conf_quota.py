@@ -82,6 +82,174 @@ def test_token_bucket_quota():
     assert qm.allow("t")
 
 
+def test_token_bucket_fractional_qps():
+    """A sub-1.0 quota must admit its steady rate: capacity stays 1.0
+    (one whole query spendable) and refill accrues at the fractional
+    rate — 0.5 qps admits exactly one query per two seconds."""
+    from pinot_tpu.broker.quota import _TokenBucket
+
+    b = _TokenBucket(0.5)
+    assert b.capacity == 1.0
+    assert b.try_acquire()  # the seeded token
+    assert not b.try_acquire()  # drained
+    # one second later: half a token — still not enough
+    b.last -= 1.0
+    assert not b.try_acquire()
+    # two seconds after the drain: a full token accrued
+    b.last -= 1.5
+    assert b.try_acquire()
+
+
+def test_token_bucket_burst_capacity():
+    from pinot_tpu.broker.quota import _TokenBucket
+
+    b = _TokenBucket(2.0, burst=5.0)
+    assert b.capacity == 5.0
+    for _ in range(5):
+        assert b.try_acquire()  # full burst spendable at once
+    assert not b.try_acquire()
+    # refill still runs at qps (not burst): 1s -> 2 tokens, never past cap
+    b.last -= 1.0
+    assert b.try_acquire() and b.try_acquire()
+    assert not b.try_acquire()
+    b.last -= 60.0
+    assert b.headroom() == 1.0  # refill capped at burst
+
+
+def test_token_bucket_sub_one_burst_cannot_block_table():
+    """Regression: a misconfigured burst < 1.0 must not set capacity
+    below one whole token (acquire costs 1.0) — that would shed 100%
+    of the table's queries forever."""
+    from pinot_tpu.broker.quota import _TokenBucket
+
+    b = _TokenBucket(4.0, burst=0.5)
+    assert b.capacity == 1.0
+    assert b.try_acquire()
+    b2 = _TokenBucket(4.0)
+    b2.reconfigure(4.0, burst=0.25)
+    assert b2.capacity == 1.0 and b2.try_acquire()
+
+
+def test_token_bucket_reconfigure_preserves_tokens():
+    """A quota UPDATE (cluster-state re-notify) must not refill a
+    drained bucket — only capacity/rate change, spent tokens stay
+    spent (clamped when the new capacity is smaller)."""
+    from pinot_tpu.broker.quota import _TokenBucket
+
+    b = _TokenBucket(2.0)  # capacity 2
+    assert b.try_acquire() and b.try_acquire()
+    b.reconfigure(10.0)
+    assert not b.try_acquire()  # still drained: no refill on update
+    assert b.qps == 10.0
+    # shrink below current tokens: clamped to the new capacity
+    b2 = _TokenBucket(4.0, burst=8.0)
+    b2.reconfigure(1.0)
+    assert b2.tokens == 1.0 == b2.capacity
+
+
+def test_quota_manager_set_quota_idempotent_no_refill():
+    qm = QueryQuotaManager()
+    qm.set_quota("t", 2.0)
+    assert qm.allow("t") and qm.allow("t") and not qm.allow("t")
+    qm.set_quota("t", 2.0)  # unchanged re-notify: same bucket, no refill
+    assert not qm.allow("t")
+    qm.set_quota("t", 5.0)  # update: reconfigure in place, no refill
+    assert not qm.allow("t")
+    assert qm.tables() == ["t"]
+    qm.set_quota("t", None)  # removal clears the bucket
+    assert qm.allow("t") and qm.tables() == []
+
+
+def test_quota_headroom_edges():
+    qm = QueryQuotaManager()
+    qm.set_quota("t", 1.0)
+    assert qm.headroom("t") == 1.0
+    qm.allow("t")
+    assert qm.headroom("t") < 0.1  # fully drained (modulo refill)
+    qm.set_quota("b", 2.0, burst=10.0)
+    qm.allow("b")
+    assert 0.85 < qm.headroom("b") < 0.95  # ~9/10 of the burst left
+
+
+def test_networked_quota_propagation_update_and_removal():
+    """Regression (ISSUE 7 satellite): a table-config quota UPDATE
+    reaches a running networked broker on its next cluster-state poll
+    without refilling the bucket, and a quota REMOVAL clears the
+    bucket instead of leaving a stale limiter behind."""
+    from pinot_tpu.broker.network_starter import NetworkedBrokerStarter
+
+    starter = NetworkedBrokerStarter("http://127.0.0.1:9")  # never polled
+    try:
+        quota = starter.handler.quota
+
+        def snap(version, quotas):
+            return {
+                "version": version,
+                "epoch": "e1",
+                "servers": {},
+                "tables": {},
+                "quotas": quotas,
+            }
+
+        starter._apply_state(
+            snap(1, {"T_OFFLINE": {"rawName": "T", "maxQueriesPerSecond": 2.0}})
+        )
+        assert quota.allow("T") and quota.allow("T") and not quota.allow("T")
+
+        # identical snapshot re-applied (poll after an unrelated version
+        # bump): the drained bucket must NOT refill
+        starter._apply_state(
+            snap(2, {"T_OFFLINE": {"rawName": "T", "maxQueriesPerSecond": 2.0}})
+        )
+        assert not quota.allow("T")
+
+        # quota UPDATE lands on the next poll (tokens preserved)
+        starter._apply_state(
+            snap(
+                3,
+                {
+                    "T_OFFLINE": {
+                        "rawName": "T",
+                        "maxQueriesPerSecond": 50.0,
+                        "burstQueries": 60.0,
+                    }
+                },
+            )
+        )
+        assert not quota.allow("T")  # still drained right after the update
+
+        # quota REMOVAL clears the bucket entirely
+        starter._apply_state(snap(4, {}))
+        assert quota.allow("T") and quota.tables() == []
+    finally:
+        starter.http._httpd.server_close()
+
+
+def test_quota_live_update_reaches_inprocess_broker(tmp_path):
+    """update_table_quota: the operator-facing live path — a running
+    in-process broker enforces the new rate on the next query, and a
+    removal stops enforcement."""
+    cluster = InProcessCluster(num_servers=1, data_dir=str(tmp_path))
+    schema = make_test_schema(with_mv=False)
+    physical = cluster.add_offline_table(schema)
+    cluster.upload(
+        physical,
+        build_segment(schema, random_rows(schema, 10, seed=1), physical, "q1"),
+    )
+    assert not cluster.query("SELECT count(*) FROM testTable").exceptions
+
+    cluster.controller.resources.update_table_quota(physical, 1.0)
+    ok = cluster.query("SELECT count(*) FROM testTable")
+    assert not ok.exceptions
+    limited = cluster.query("SELECT count(*) FROM testTable")
+    assert limited.exceptions and limited.exceptions[0].error_code == 429
+
+    cluster.controller.resources.update_table_quota(physical, None)
+    cleared = cluster.query("SELECT count(*) FROM testTable")
+    assert not cleared.exceptions
+    cluster.stop()
+
+
 def test_quota_enforced_end_to_end(tmp_path):
     cluster = InProcessCluster(num_servers=1, data_dir=str(tmp_path))
     schema = make_test_schema(with_mv=False)

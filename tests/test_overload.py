@@ -1,0 +1,376 @@
+"""Multi-tenant overload protection: broker adaptive admission, server
+fair-share scheduling, ingest backpressure — unit tiers plus the
+tier-1 noisy-neighbor chaos acceptance (ISSUE 7).
+
+The scenario functions live in ``tools/cluster_harness.py`` so the SAME
+code drives manual CLI chaos runs and these deterministic tests."""
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.broker.admission import AdmissionController
+from pinot_tpu.broker.quota import QueryQuotaManager
+from pinot_tpu.realtime.backpressure import IngestBackpressure
+from pinot_tpu.server.scheduler import QueryScheduler, SchedulerSaturatedError
+
+
+# ------------------------------------------------------------ admission units
+def test_admission_quota_tier_shed():
+    quota = QueryQuotaManager()
+    quota.set_quota("t", 1.0)
+    adm = AdmissionController(quota=quota)
+    d1 = adm.try_admit("t")
+    assert d1.admitted
+    adm.release("t")
+    d2 = adm.try_admit("t")  # bucket (capacity 1) drained
+    assert not d2.admitted and d2.tier == "quota"
+    assert "quota" in d2.message
+
+
+def test_admission_concurrency_tier_shed_and_release():
+    adm = AdmissionController(max_inflight_per_table=2)
+    assert adm.try_admit("t").admitted
+    assert adm.try_admit("t").admitted
+    d = adm.try_admit("t")
+    assert not d.admitted and d.tier == "concurrency"
+    # other tables are unaffected — the cap is per table
+    assert adm.try_admit("other").admitted
+    adm.release("t")
+    assert adm.try_admit("t").admitted  # slot freed
+    for _ in range(2):
+        adm.release("t")
+    adm.release("other")
+    assert adm.table_inflight("t") == 0
+
+
+def test_admission_aimd_window_decrease_and_recovery():
+    adm = AdmissionController(initial_window=8, min_window=1, max_window=16)
+    # saturation evidence (210 reply / transport failure) halves the window
+    adm.on_attempt_start("s1")
+    adm.on_attempt_done("s1", saturated=True)
+    assert adm.window_of("s1") == 4.0
+    adm.on_attempt_start("s1")
+    adm.on_attempt_done("s1", saturated=True)
+    assert adm.window_of("s1") == 2.0
+    # healthy replies grow it back additively
+    for _ in range(4):
+        adm.on_attempt_start("s1")
+        adm.on_attempt_done("s1", saturated=False)
+    assert adm.window_of("s1") == 4.0
+    # the floor holds
+    for _ in range(10):
+        adm.on_attempt_start("s1")
+        adm.on_attempt_done("s1", saturated=True)
+    assert adm.window_of("s1") == 1.0
+
+
+def test_admission_backpressure_snapshot_counts_as_saturation():
+    """A healthy (non-210) reply whose backpressure snapshot shows the
+    scheduler past the high-water fraction decreases the window — the
+    broker backs off BEFORE the server has to shed."""
+    adm = AdmissionController(initial_window=8, pending_high_water=0.8)
+    adm.on_attempt_start("s1")
+    adm.on_attempt_done(
+        "s1", saturated=False, backpressure={"pending": 60, "maxPending": 64}
+    )
+    assert adm.window_of("s1") == 4.0
+    # below the high water: additive increase
+    adm.on_attempt_start("s1")
+    adm.on_attempt_done(
+        "s1", saturated=False, backpressure={"pending": 3, "maxPending": 64}
+    )
+    assert adm.window_of("s1") == 4.5
+
+
+def test_admission_check_cover_sheds_only_when_all_windows_full():
+    adm = AdmissionController(initial_window=1)
+    adm.on_attempt_start("s1")  # s1 now at its window
+    assert adm.check_cover("t", ["s1", "s2"]).admitted  # s2 has headroom
+    adm.on_attempt_start("s2")
+    d = adm.check_cover("t", ["s1", "s2"])
+    assert not d.admitted and d.tier == "overload"
+    adm.on_attempt_cancelled("s1")
+    assert adm.check_cover("t", ["s1", "s2"]).admitted
+
+
+# ----------------------------------------------------- fair-share scheduler
+def test_fairshare_flooder_cannot_fill_queue_when_others_wait():
+    """Per-table pending caps: alone, a table may use the whole queue;
+    once another table holds pending work the flooder's submits shed at
+    its weighted share while the other table keeps being admitted."""
+    sched = QueryScheduler(num_workers=1, max_pending=8)
+    gate = threading.Event()
+    futs = []
+    # worker occupied by the first entry; A fills the rest of the queue
+    futs.append(sched.submit(lambda: gate.wait(5), table="A"))
+    for _ in range(7):
+        futs.append(sched.submit(lambda: 1, table="A"))
+    assert sched.pending == 8
+    with pytest.raises(SchedulerSaturatedError):
+        sched.submit(lambda: 1, table="A")  # global cap
+    # B was idle so far: A's flood cannot lock B out — B's first submit
+    # is admitted ONLY after A's backlog drains below the global cap,
+    # so release the gate and let capacity free up
+    gate.set()
+    for f in futs:
+        f.result(timeout=5)
+    fb = sched.submit(lambda: "b", table="B")
+    assert fb.result(timeout=5) == "b"
+    sched.shutdown()
+
+
+def test_fairshare_share_cap_with_other_table_waiting():
+    """With B pending, A is capped at its share (max_pending/2 for two
+    equal-weight tables) instead of the whole queue."""
+    sched = QueryScheduler(num_workers=1, max_pending=8)
+    gate = threading.Event()
+    running = sched.submit(lambda: gate.wait(5), table="B")  # occupies worker
+    # B holds pending work; A's fair share is 8/2 = 4
+    admitted = 0
+    shed_at = None
+    for i in range(8):
+        try:
+            sched.submit(lambda: 1, table="A")
+            admitted += 1
+        except SchedulerSaturatedError as e:
+            shed_at = i
+            assert "fair-share" in str(e) and "table A" in str(e)
+            break
+    assert admitted == 4 and shed_at == 4
+    # B itself is still admitted (it is under ITS share)
+    fb = sched.submit(lambda: "b", table="B")
+    gate.set()
+    running.result(timeout=5)
+    assert fb.result(timeout=5) == "b"
+    sched.shutdown()
+
+
+def test_fairshare_drr_interleaves_starved_table():
+    """DRR dequeue: a table with ONE query behind a 6-deep flood queue
+    is served on the next DRR cycle, not after the whole flood."""
+    sched = QueryScheduler(num_workers=1, max_pending=32)
+    order = []
+    gate = threading.Event()
+
+    def job(tag):
+        def run():
+            gate.wait(5)
+            order.append(tag)
+
+        return run
+
+    blocker = sched.submit(job("warm"), table="A")
+    time.sleep(0.05)  # let the worker claim the blocker
+    futs = [sched.submit(job(f"A{i}"), table="A") for i in range(6)]
+    fb = sched.submit(job("B0"), table="B")
+    gate.set()
+    fb.result(timeout=5)
+    for f in futs:
+        f.result(timeout=5)
+    blocker.result(timeout=5)
+    # B0 ran among the FIRST queued entries (DRR alternates A/B), never
+    # last; FCFS would have run it after all six A entries
+    assert order.index("B0") <= 2, order
+    sched.shutdown()
+
+
+def test_fairshare_weights_skew_share():
+    sched = QueryScheduler(num_workers=1, max_pending=9)
+    sched.set_weight("A", 2.0)
+    gate = threading.Event()
+    running = sched.submit(lambda: gate.wait(5), table="B")
+    # active tables: A (w=2), B (w=1) -> A's share = 9 * 2/3 = 6
+    admitted = 0
+    for _ in range(9):
+        try:
+            sched.submit(lambda: 1, table="A")
+            admitted += 1
+        except SchedulerSaturatedError:
+            break
+    assert admitted == 6
+    gate.set()
+    running.result(timeout=5)
+    sched.shutdown()
+
+
+# --------------------------------------------------- ingest governor units
+def test_ingest_governor_hysteresis_latch():
+    reading = {"hbm": 0.0}
+    gov = IngestBackpressure(
+        hbm_high_bytes=100.0,
+        hbm_low_bytes=50.0,
+        hbm_bytes_fn=lambda: reading["hbm"],
+        poll_interval_s=0.0,
+    )
+    assert gov.consume_allowed()
+    reading["hbm"] = 150.0
+    assert not gov.consume_allowed() and gov.paused
+    assert "high watermark" in gov.reason
+    # between low and high: STAYS paused (no flapping at the boundary)
+    reading["hbm"] = 80.0
+    assert not gov.consume_allowed()
+    reading["hbm"] = 40.0
+    assert gov.consume_allowed() and not gov.paused
+    snap = gov.snapshot()
+    assert snap["pauses"] == 1 and snap["resumes"] == 1
+    assert [e["event"] for e in snap["events"]] == ["pause", "resume"]
+
+
+def test_ingest_governor_mutable_watermark_and_batch_clamp():
+    reading = {"mut": 0.0}
+    gov = IngestBackpressure(
+        mutable_high_bytes=1000.0,
+        mutable_low_bytes=500.0,
+        hbm_bytes_fn=lambda: 0.0,
+        mutable_bytes_fn=lambda: reading["mut"],
+        poll_interval_s=0.0,
+        max_batch_rows=64,
+    )
+    assert gov.clamp_batch(10_000) == 64
+    reading["mut"] = 2000.0
+    assert not gov.consume_allowed()
+    reading["mut"] = 100.0
+    assert gov.consume_allowed()
+
+
+def test_ingest_governor_disabled_and_fail_open():
+    # no watermarks configured -> never pauses, never polls
+    gov = IngestBackpressure(hbm_high_bytes=0.0, mutable_high_bytes=0.0)
+    assert not gov.enabled and gov.consume_allowed()
+
+    # a broken probe fails OPEN: ingest must not wedge on a bad gauge
+    def boom():
+        raise RuntimeError("probe broken")
+
+    gov2 = IngestBackpressure(
+        hbm_high_bytes=10.0, hbm_bytes_fn=boom, poll_interval_s=0.0
+    )
+    assert gov2.consume_allowed()
+
+
+# -------------------------------------------------------- wire compatibility
+def test_backpressure_rides_result_wire_and_old_payloads_still_read():
+    from pinot_tpu.common.datatable import deserialize_result, serialize_result
+    from pinot_tpu.engine.results import IntermediateResult
+
+    res = IntermediateResult(num_docs_scanned=7)
+    res.cost = {"bytesScanned": 42}
+    res.backpressure = {"pending": 3, "maxPending": 64, "laneDepth": 1}
+    data = serialize_result(res)
+    out = deserialize_result(data)
+    assert out.backpressure == {"pending": 3, "maxPending": 64, "laneDepth": 1}
+    assert out.cost == {"bytesScanned": 42}
+
+    # an old-format payload (no backpressure trailer) still deserializes
+    res2 = IntermediateResult(num_docs_scanned=1)
+    data2 = serialize_result(res2)
+    out2 = deserialize_result(data2)
+    assert out2.backpressure == {}
+
+
+# --------------------------------------------------- end-to-end shed typing
+def test_broker_concurrency_cap_sheds_typed_429():
+    """A tenant flooding with SLOW queries is capped by in-flight
+    concurrency (not QPS): overflow comes back as a typed 429."""
+    from pinot_tpu.common.response import ErrorCode
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.tools.cluster_harness import single_server_broker
+    from pinot_tpu.tools.datagen import make_test_schema, random_rows
+
+    schema = make_test_schema(with_mv=False)
+    seg = build_segment(schema, random_rows(schema, 30, seed=2), "tt", "s0")
+    broker = single_server_broker("tt", [seg])
+    broker.admission.max_inflight_per_table = 2
+    server = broker.local_servers[0]
+    gate = threading.Event()
+    real_execute = server.executor.execute
+
+    def slow_execute(segs, req, **kwargs):
+        gate.wait(5)
+        return real_execute(segs, req, **kwargs)
+
+    server.executor.execute = slow_execute
+    results = {}
+
+    def q(i):
+        results[i] = broker.handle_pql("SELECT count(*) FROM tt")
+
+    threads = [threading.Thread(target=q, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for _ in range(100):
+        if broker.admission.table_inflight("tt") >= 2:
+            break
+        time.sleep(0.01)
+    shed = broker.handle_pql("SELECT count(*) FROM tt")
+    assert shed.exceptions
+    assert shed.exceptions[0].error_code == ErrorCode.TOO_MANY_REQUESTS
+    assert "in flight" in shed.exceptions[0].message
+    gate.set()
+    for t in threads:
+        t.join(timeout=10)
+    for r in results.values():
+        assert not r.exceptions
+    assert broker.admission.table_inflight("tt") == 0
+    server.shutdown()
+
+
+def test_broker_aimd_shed_recovers_after_server_drains():
+    """check_cover opens back up once windows regrow: AIMD shedding is
+    adaptive, not a latched circuit."""
+    adm = AdmissionController(initial_window=2, min_window=1)
+    for _ in range(3):
+        adm.on_attempt_start("s1")
+        adm.on_attempt_done("s1", saturated=True)
+    assert adm.window_of("s1") == 1.0
+    adm.on_attempt_start("s1")
+    assert not adm.check_cover("t", ["s1"]).admitted
+    # the inflight attempt completes healthy -> window grows, cover opens
+    adm.on_attempt_done("s1", saturated=False)
+    assert adm.check_cover("t", ["s1"]).admitted
+
+
+# ------------------------------------------------------- chaos acceptance
+@pytest.mark.chaos
+def test_noisy_neighbor_tenant_isolation(tmp_path):
+    """ISSUE 7 acceptance: tenant A flooding at >=10x its quota cannot
+    fail a single tenant-B query; B's p99 stays within 3x of its
+    unloaded baseline (floored); every bit of A's overflow is shed with
+    typed 429/210 — no client-visible timeouts."""
+    from pinot_tpu.tools.cluster_harness import run_noisy_neighbor_scenario
+
+    out = run_noisy_neighbor_scenario(
+        num_servers=2,
+        baseline_s=0.7,
+        flood_s=1.5,
+        data_dir=str(tmp_path),
+    )
+    assert out["tenantB"]["failedQueries"] == 0, out["tenantB"]
+    assert out["offeredMultiple"] >= 10.0, out
+    assert out["sheddingTyped"], out["tenantA"]
+    assert out["tenantA"]["timeouts"] == 0
+    shed = out["tenantA"]["shed429"] + out["tenantA"]["shed210"]
+    assert shed > 0  # the flood actually overflowed and was shed
+    assert out["tenantBP99Within"], (
+        out["tenantBLoadedP99Ms"],
+        out["tenantBP99LimitMs"],
+    )
+    assert out["failedQueries"] == 0
+
+
+@pytest.mark.chaos
+def test_ingest_backpressure_pauses_and_drains(tmp_path):
+    """ISSUE 7 acceptance: consumers provably pause when the HBM ledger
+    crosses the high watermark (offset frozen, lag visible, zero rows
+    consumed while held) and drain lag to 0 after resume."""
+    from pinot_tpu.tools.cluster_harness import run_ingest_backpressure_scenario
+
+    out = run_ingest_backpressure_scenario(data_dir=str(tmp_path))
+    assert out["paused"], out
+    assert out["offsetFrozen"], out
+    assert out["consumedWhilePaused"] == 0
+    assert out["lagWhilePaused"] > 0
+    assert out["resumed"] and out["finalLag"] == 0, out
+    assert out["governor"]["pauses"] == 1 and out["governor"]["resumes"] == 1
+    assert out["failedQueries"] == 0

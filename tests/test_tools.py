@@ -98,6 +98,28 @@ def test_serving_curve_smoke():
     assert step["p99_ms"] >= step["p50_ms"] > 0
 
 
+def test_serving_curve_two_tenant_smoke():
+    """The two-tenant ladder drives tenant A past its quota while
+    tenant B's closed loop stays clean, and records per-tenant shed /
+    quota counters per step."""
+    from pinot_tpu.tools.datagen import synthetic_lineitem_segment
+    from pinot_tpu.tools.serving_curve import run_two_tenant_ladder
+
+    seg_a = [synthetic_lineitem_segment(15000, seed=6, name="ta0")]
+    seg_b = [synthetic_lineitem_segment(15000, seed=7, name="tb0")]
+    doc = run_two_tenant_ladder(
+        seg_a, seg_b, [40.0], duration_s=1.5, quota_qps=4.0
+    )
+    assert len(doc["steps"]) == 1
+    step = doc["steps"][0]
+    assert step["a_offered_multiple"] == 10.0
+    assert step["a_quota_rejects"] > 0  # A's overflow shed at the quota
+    assert step["a_errors"] == 0  # ...and ONLY with typed errors
+    assert step["b_errors"] == 0  # B untouched by A's flood
+    assert step["b_p99_ms"] >= step["b_p50_ms"] > 0
+    assert step["admission_sheds"]["shedQuota"] == step["a_quota_rejects"]
+
+
 def test_admin_create_and_show_segment(tmp_path, capsys):
     from pinot_tpu.tools.admin import main
 
