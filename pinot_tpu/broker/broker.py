@@ -178,6 +178,16 @@ class BrokerRequestHandler:
             self.metrics.meter(m)
         for t in ("cost.deviceMs", "cost.hostMs"):
             self.metrics.timer(t)
+        # workload-introspection plane: per-plan-digest roll-up of every
+        # merged response (utils/planstats.py) behind /debug/workload —
+        # top-K by frequency and by cost, the "which plan shapes should
+        # we batch?" answer.  Series pre-registered.
+        from pinot_tpu.utils.planstats import PlanStatsStore
+
+        self.planstats = PlanStatsStore()
+        for m in ("workload.recorded", "explain.queries"):
+            self.metrics.meter(m)
+        self.metrics.gauge("workload.digests").set_fn(self.planstats.digest_count)
 
     @classmethod
     def from_conf(cls, transport, server_addresses, conf, **overrides) -> "BrokerRequestHandler":
@@ -234,6 +244,8 @@ class BrokerRequestHandler:
         )
         resp: Optional[BrokerResponse] = None
         request = None
+        plan_digest = ""
+        plan_summary = ""
         with ctx.span("query", requestId=request_id, pql=pql[:200]):
             t_parse = time.perf_counter()
             try:
@@ -242,6 +254,17 @@ class BrokerRequestHandler:
                     if debug_options:
                         request.debug_options = dict(debug_options)
                     request = optimize_request(request)
+                from pinot_tpu.engine.plandigest import (
+                    plan_shape_digest,
+                    plan_shape_summary,
+                )
+
+                # the literal-erased shape digest rides EVERY response
+                # (cross-links /debug/queries -> /debug/plans/workload)
+                plan_digest = plan_shape_digest(request)
+                plan_summary = plan_shape_summary(request)
+                if request.explain:
+                    self.metrics.meter("explain.queries").mark()
             except PqlParseError as e:
                 # InvalidQueryOptionsError subclasses this; internal
                 # ValueErrors now propagate instead of masquerading as
@@ -263,6 +286,27 @@ class BrokerRequestHandler:
         resp.request_id = request_id
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
         self.metrics.timer("queryTotal").update(resp.time_used_ms)
+        if plan_digest:
+            resp.plan_digest = plan_digest
+            if request is None or request.explain != "plan":
+                # workload roll-up: every executed query lands in the
+                # per-digest registry (plain EXPLAIN excluded — it did
+                # no work and must not skew frequency/cost rankings)
+                shed = any(
+                    e.error_code == ErrorCode.TOO_MANY_REQUESTS
+                    for e in resp.exceptions
+                )
+                self.planstats.record(
+                    plan_digest,
+                    summary=plan_summary,
+                    table=getattr(request, "table_name", "") or "",
+                    latency_ms=resp.time_used_ms,
+                    cost=resp.cost,
+                    num_docs=resp.num_docs_scanned,
+                    shed=shed,
+                    failed=bool(resp.exceptions) and not shed,
+                )
+                self.metrics.meter("workload.recorded").mark()
         if ctx.enabled:
             # merge the per-server span trees under their scatter
             # attempts, next to this broker's own tree — ONE waterfall
@@ -277,6 +321,8 @@ class BrokerRequestHandler:
             {
                 "requestId": request_id,
                 "pql": pql[:500],
+                # cross-link key into /debug/plans and /debug/workload
+                "planDigest": plan_digest,
                 "table": getattr(request, "table_name", None),
                 "timeUsedMs": round(resp.time_used_ms, 3),
                 "phasesMs": phases,
@@ -429,11 +475,25 @@ class BrokerRequestHandler:
         for p in parts:
             for code, msg in p.exceptions:
                 exceptions.append(QueryException(code, msg))
-        with ctx.span("reduce", parts=len(parts)):
-            resp = reduce_to_response(request, parts, exceptions)
+        # plan nodes collected BEFORE reduce: the merge below folds
+        # parts in place, and per-server attribution must survive it
+        plan_nodes = (
+            [n for p in parts for n in (p.plan_info or [])]
+            if request.explain
+            else []
+        )
+        if request.explain == "plan":
+            # EXPLAIN returns the plan INSTEAD of results: nothing to
+            # reduce (servers executed nothing, partials are empty)
+            resp = BrokerResponse(exceptions=exceptions)
+        else:
+            with ctx.span("reduce", parts=len(parts)):
+                resp = reduce_to_response(request, parts, exceptions)
         red_ms = (time.perf_counter() - t_red) * 1000
         self.metrics.timer("reduce").update(red_ms)
         resp.request_id = request_id
+        if request.explain:
+            resp.explain = self._assemble_explain(request, plan_nodes, resp)
         # per-table cost attribution into the metrics registry: who is
         # burning the cluster, by logical table (rendered cluster-wide
         # on the controller's /debug/capacity rollup)
@@ -466,6 +526,61 @@ class BrokerRequestHandler:
             "reduce": round(red_ms, 3),
         }
         return resp
+
+    def _assemble_explain(
+        self,
+        request: BrokerRequest,
+        nodes: List[Dict[str, Any]],
+        resp: BrokerResponse,
+    ) -> Dict[str, Any]:
+        """Broker-side EXPLAIN tree: the per-server plan nodes under one
+        roof, with summed tier counts and estimates.  For ANALYZE the
+        top level carries the merged actuals (== BrokerResponse.cost,
+        exactly: only merged replies' nodes reach here)."""
+        from pinot_tpu.engine.plandigest import (
+            plan_shape_digest,
+            plan_shape_summary,
+        )
+
+        tier_counts: Dict[str, int] = {}
+        est_bytes = 0.0
+        for n in nodes:
+            for k, v in (n.get("tierCounts") or {}).items():
+                tier_counts[k] = tier_counts.get(k, 0) + int(v)
+            est = n.get("estimatedCost") or {}
+            if est.get("source") == "history":
+                est_bytes += float((est.get("perQuery") or {}).get("bytesScanned", 0))
+            else:
+                est_bytes += float(est.get("bytesScanned", 0))
+        out: Dict[str, Any] = {
+            "mode": request.explain,
+            "planDigest": plan_shape_digest(request),
+            "summary": plan_shape_summary(request),
+            "numServers": len(nodes),
+            "tierCounts": tier_counts,
+            "estimatedCost": {"bytesScanned": int(est_bytes)},
+            "servers": nodes,
+        }
+        if request.explain == "analyze":
+            out["actualCost"] = {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in sorted(resp.cost.items())
+            }
+            out["actualDocsScanned"] = resp.num_docs_scanned
+        return out
+
+    def workload_snapshot(self, top: int = 20) -> Dict[str, Any]:
+        """``/debug/workload``: the per-plan-digest roll-up, top-K by
+        frequency AND by total cost (the batching-candidate ranking).
+        ``top`` at the registry capacity returns the FULL registry —
+        the controller's fleet roll-up fetches that so cross-broker
+        merging never ranks on truncated slices."""
+        return {
+            "digests": self.planstats.digest_count(),
+            "totalRecorded": self.planstats.total_recorded,
+            "topByCount": self.planstats.top(top, by="count"),
+            "topByCost": self.planstats.top(top, by="cost"),
+        }
 
     # ------------------------------------------------------------------
     # resilient scatter-gather
@@ -1060,6 +1175,15 @@ class BrokerHttpServer:
                         return self._respond(broker.querylog.snapshot())
                     if url.path == "/debug/admission":
                         return self._respond(broker.admission.snapshot())
+                    if url.path == "/debug/workload":
+                        qs = parse_qs(url.query)
+                        try:
+                            top = int((qs.get("top") or ["20"])[0])
+                        except ValueError:
+                            top = 20
+                        return self._respond(
+                            broker.workload_snapshot(top=max(1, top))
+                        )
                     if url.path == "/serverhealth":
                         return self._respond(
                             {

@@ -291,6 +291,12 @@ def serialize_result(res: IntermediateResult) -> bytes:
     # same mixed-version contract, one more trailing value after cost
     w.value({k: res.backpressure[k] for k in sorted(res.backpressure)})
 
+    # trailing optional plan-tree list (EXPLAIN / EXPLAIN ANALYZE
+    # introspection nodes, engine/explain.py): JSON-safe dicts through
+    # the tagged codec; empty for every normal query, absent for peers
+    # predating the introspection plane
+    w.value(list(res.plan_info))
+
     payload = w.getvalue()
     return MAGIC + struct.pack("<Q", len(payload)) + payload
 
@@ -332,6 +338,9 @@ def deserialize_result(data: bytes) -> IntermediateResult:
     if r.pos < len(r.data):
         # trailing backpressure snapshot (absent from older peers)
         res.backpressure = {str(k): v for k, v in (r.value() or {}).items()}
+    if r.pos < len(r.data):
+        # trailing EXPLAIN plan-tree list (absent from older peers)
+        res.plan_info = [dict(n) for n in (r.value() or [])]
     return res
 
 

@@ -207,6 +207,11 @@ class BrokerRequest:
     enable_trace: bool = False
     query_options: Dict[str, str] = field(default_factory=dict)
     debug_options: Dict[str, str] = field(default_factory=dict)
+    # introspection mode from an EXPLAIN prefix: None (execute),
+    # "plan" (return the physical plan, NO execution), or "analyze"
+    # (execute AND annotate the plan with actuals).  Rides the wire
+    # inside the PQL text itself, so servers re-derive it on re-parse.
+    explain: Optional[str] = None
 
     @property
     def is_aggregation(self) -> bool:

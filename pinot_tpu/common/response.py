@@ -132,6 +132,12 @@ class BrokerResponse:
     # broker-assigned globally-unique id echoed to the client so a
     # response correlates with traces and the slow-query log
     request_id: str = ""
+    # workload-introspection plane: the literal-erased plan-shape digest
+    # (engine/plandigest.py) on EVERY response, cross-linking a query to
+    # /debug/plans and /debug/workload; ``explain`` is populated only
+    # for EXPLAIN / EXPLAIN ANALYZE queries (the structured plan tree)
+    plan_digest: str = ""
+    explain: Optional[Dict[str, Any]] = None
 
     def to_json(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
@@ -161,6 +167,10 @@ class BrokerResponse:
                 for k, v in sorted(self.cost.items())
             }
         d["timeUsedMs"] = round(self.time_used_ms, 3)
+        if self.plan_digest:
+            d["planDigest"] = self.plan_digest
+        if self.explain is not None:
+            d["explain"] = self.explain
         if self.trace_info:
             d["traceInfo"] = self.trace_info
         return d

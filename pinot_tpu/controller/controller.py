@@ -8,6 +8,7 @@ here over ``ClusterResourceManager`` + ``SegmentStore``.
 """
 from __future__ import annotations
 
+import concurrent.futures
 import json
 import logging
 import os
@@ -479,6 +480,92 @@ def collect_capacity(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, An
     }
 
 
+def collect_workload(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, Any]:
+    """Cluster-wide workload roll-up (``/debug/workload``): every alive
+    broker's per-plan-digest registry merged by digest — counts and
+    cost sums add, summaries/tables are first-writer — then re-ranked
+    by frequency and by cost.  The fleet-level answer to "which plan
+    shapes dominate, and which should batched serving target first?"
+    Unreachable brokers degrade to an ``unreachable`` entry."""
+    import urllib.error
+    import urllib.request
+
+    merged: Dict[str, Dict[str, Any]] = {}
+    unreachable: Dict[str, str] = {}
+    brokers = [
+        i
+        for i in ctrl.resources.instances_snapshot()
+        if i.role == "broker" and i.alive and i.url
+    ]
+
+    def fetch(inst):
+        try:
+            # top=1024 (above the registry capacity) returns the FULL
+            # per-broker registry: merging truncated top-20 slices
+            # would undercount any digest outside one broker's head
+            with urllib.request.urlopen(
+                inst.url.rstrip("/") + "/debug/workload?top=1024",
+                timeout=timeout_s,
+            ) as r:
+                return json.loads(r.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            return {"_error": str(e)}
+
+    results = []
+    if brokers:
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(8, len(brokers))
+        ) as pool:
+            results = list(pool.map(fetch, brokers))
+    total_recorded = 0
+    for inst, snap in zip(brokers, results):
+        if "_error" in snap:
+            unreachable[inst.name] = snap["_error"]
+            continue
+        total_recorded += int(snap.get("totalRecorded") or 0)
+        seen: set = set()
+        for plan in (snap.get("topByCount") or []) + (snap.get("topByCost") or []):
+            digest = plan.get("digest")
+            if not digest or digest in seen:
+                continue  # a digest appears in both rankings: merge once
+            seen.add(digest)
+            m = merged.get(digest)
+            if m is None:
+                m = merged[digest] = {
+                    "digest": digest,
+                    "summary": plan.get("summary", ""),
+                    "table": plan.get("table", ""),
+                    "count": 0,
+                    "shedCount": 0,
+                    "failedCount": 0,
+                    "docsScanned": 0,
+                    "cost": {},
+                    "brokers": [],
+                }
+            m["count"] += int(plan.get("count") or 0)
+            m["shedCount"] += int(plan.get("shedCount") or 0)
+            m["failedCount"] += int(plan.get("failedCount") or 0)
+            m["docsScanned"] += int(plan.get("docsScanned") or 0)
+            for k, v in (plan.get("cost") or {}).items():
+                m["cost"][k] = m["cost"].get(k, 0) + v
+            m["brokers"].append(inst.name)
+
+    # the ONE cost-ranking formula, shared with the broker's registry
+    from pinot_tpu.utils.planstats import PlanStatsStore
+
+    cost_key = PlanStatsStore._cost_key
+
+    plans = list(merged.values())
+    return {
+        "brokers": len(brokers),
+        "digests": len(plans),
+        "totalRecorded": total_recorded,
+        "topByCount": sorted(plans, key=lambda d: -d["count"])[:20],
+        "topByCost": sorted(plans, key=cost_key, reverse=True)[:20],
+        "unreachable": unreachable,
+    }
+
+
 def _split_path(path: str) -> Optional[List[str]]:
     """URL-decoded path segments, or None for segments that would
     traverse the filesystem when joined into store paths (%2F / '..')."""
@@ -606,6 +693,12 @@ class ControllerHttpServer:
                     if parts == ["dashboard", "capacity"]:
                         return self._respond_html(
                             dashboard.render_capacity(ctrl, collect_capacity(ctrl))
+                        )
+                    if parts == ["debug", "workload"]:
+                        return self._respond(collect_workload(ctrl))
+                    if parts == ["dashboard", "workload"]:
+                        return self._respond_html(
+                            dashboard.render_workload(ctrl, collect_workload(ctrl))
                         )
                     if parts == ["debug", "stabilizer"]:
                         return self._respond(ctrl.stabilizer.debug_snapshot())

@@ -41,6 +41,7 @@ _NAV = (
     "<a href='/dashboard/query'>Query console</a>"
     "<a href='/dashboard/metrics'>Metrics</a>"
     "<a href='/dashboard/capacity'>Capacity</a>"
+    "<a href='/dashboard/workload'>Workload</a>"
     "<a href='/clusterstate'>Raw state (JSON)</a></nav>"
 )
 
@@ -337,6 +338,59 @@ def render_capacity(ctrl, capacity: dict) -> str:
             )
         body.append("</table>")
     return _page("Capacity & cost", body)
+
+
+def _workload_table(body: List[str], plans: List[dict], title: str) -> None:
+    body.append(f"<h2>{_esc(title)}</h2>")
+    if not plans:
+        body.append("<p>No plans recorded yet (no queries).</p>")
+        return
+    body.append(
+        "<table><tr><th>digest</th><th>shape</th><th>table</th>"
+        "<th>execs</th><th>shed</th><th>failed</th><th>docs</th>"
+        "<th>bytes</th><th>device ms</th><th>host ms</th><th>tier mix</th></tr>"
+    )
+    for p in plans:
+        cost = p.get("cost") or {}
+        tiers = ", ".join(
+            f"{k[len('segments'):]}={int(v)}"
+            for k, v in sorted(cost.items())
+            if k.startswith("segments")
+        )
+        body.append(
+            f"<tr><td><code>{_esc(p.get('digest'))}</code></td>"
+            f"<td>{_esc(p.get('summary', ''))}</td>"
+            f"<td>{_esc(p.get('table', ''))}</td>"
+            f"<td>{p.get('count', 0)}</td>"
+            f"<td>{p.get('shedCount', 0)}</td>"
+            f"<td>{p.get('failedCount', 0)}</td>"
+            f"<td>{p.get('docsScanned', 0)}</td>"
+            f"<td>{_fmt_bytes(cost.get('bytesScanned', 0))}</td>"
+            f"<td>{round(float(cost.get('deviceMs', 0)), 1)}</td>"
+            f"<td>{round(float(cost.get('hostMs', 0)), 1)}</td>"
+            f"<td>{_esc(tiers)}</td></tr>"
+        )
+    body.append("</table>")
+
+
+def render_workload(ctrl, workload: dict) -> str:
+    """Cluster workload page (``collect_workload`` roll-up): the plan
+    shapes dominating the fleet by frequency and by cost — the direct
+    input to "which plan shapes should batched serving target?"."""
+    body = ["<h1>Workload — plan shapes</h1>"]
+    body.append(
+        f"<p>Brokers polled: <b>{workload.get('brokers', 0)}</b>"
+        f" &middot; distinct shapes: <b>{workload.get('digests', 0)}</b>"
+        f" &middot; responses recorded: <b>{workload.get('totalRecorded', 0)}</b>"
+        f" &middot; raw JSON: <a href='/debug/workload'>/debug/workload</a></p>"
+    )
+    unreachable = workload.get("unreachable") or {}
+    if unreachable:
+        names = ", ".join(_esc(n) for n in sorted(unreachable))
+        body.append(f"<p class='bad'>Partial roll-up — unreachable: {names}</p>")
+    _workload_table(body, workload.get("topByCount") or [], "Top by frequency")
+    _workload_table(body, workload.get("topByCost") or [], "Top by cost")
+    return _page("Workload", body)
 
 
 def render_query_console() -> str:

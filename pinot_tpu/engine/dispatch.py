@@ -282,13 +282,23 @@ class DeviceLane:
         self.device_failure_count = 0
         self.restart_count = 0
         self.stale_completions = 0
+        # compile timeline (workload introspection): per device-plan
+        # digest, the FIRST launch's wall ms — on a cold jit cache that
+        # launch pays trace + XLA compile (the ~25s cold figure PARITY.md
+        # cites on a tunneled chip), so firstCallMs IS the measured
+        # compile cost; later launches of the same digest are warm.
+        # Read by EXPLAIN (cold/warm verdict + measured ms) and exposed
+        # as compile.* metrics + lane.stats()["compiledPlans"].
+        self._compile: Dict[str, Dict[str, float]] = {}
         if metrics is not None:
             # pre-register the lane series (depth/inflight gauges,
             # dispatch/coalesce/shed/restart meters) so /metrics shows
             # them at zero before the first device query
             for name in ("lane.dispatches", "lane.coalesced", "lane.shed",
-                         "lane.deviceFailures", "lane.restarts"):
+                         "lane.deviceFailures", "lane.restarts",
+                         "compile.cold", "compile.warm"):
                 metrics.meter(name)
+            metrics.timer("compile.firstCallMs")
             metrics.gauge("lane.depth").set(0)
             metrics.gauge("lane.open").set(0)
             metrics.gauge("lane.inflight").set(0)
@@ -359,7 +369,18 @@ class DeviceLane:
             "deviceFailures": self.device_failure_count,
             "restarts": self.restart_count,
             "staleCompletions": self.stale_completions,
+            "compiledPlans": len(self._compile),
         }
+
+    def compile_info(self, digest: Optional[str]) -> Optional[Dict[str, float]]:
+        """Compile-timeline entry for a device-plan digest: None when
+        the digest has never launched here (a query would compile cold),
+        else {firstCallMs, firstAt, launches}."""
+        if digest is None:
+            return None
+        with self._cv:
+            entry = self._compile.get(digest)
+            return dict(entry) if entry is not None else None
 
     def close(self) -> None:
         """Idempotent: stop accepting submits, fail queued waiters, and
@@ -561,6 +582,8 @@ class DeviceLane:
                 error = e
             finally:
                 self._set_inflight(0)
+            launch_ms = (time.perf_counter() - t0) * 1000
+            cold = False
             with self._cv:
                 stale = gen != self._generation
                 if not stale and self._inflight is not None and self._inflight[0] is d:
@@ -572,6 +595,29 @@ class DeviceLane:
                     self.stale_completions += 1
                     return
                 self.dispatch_count += 1
+                if error is None and d.plan_digest is not None:
+                    # compile timeline: first successful launch of this
+                    # digest measured cold (trace + XLA compile included)
+                    entry = self._compile.get(d.plan_digest)
+                    if entry is None:
+                        cold = True
+                        if len(self._compile) > 4096:
+                            # bounded registry: evict the OLDEST entry
+                            # only — a full clear would re-record every
+                            # still-jit-cached plan as "cold" with a
+                            # warm-speed firstCallMs, corrupting the
+                            # compile series this registry exists for
+                            victim = min(
+                                self._compile, key=lambda k: self._compile[k]["firstAt"]
+                            )
+                            self._compile.pop(victim, None)
+                        self._compile[d.plan_digest] = {
+                            "firstCallMs": round(launch_ms, 3),
+                            "firstAt": round(time.time(), 3),
+                            "launches": 1,
+                        }
+                    else:
+                        entry["launches"] += 1
                 if error is not None:
                     self.device_failure_count += 1
                 d.completed = True
@@ -588,8 +634,12 @@ class DeviceLane:
                 self.metrics.meter("lane.dispatches").mark()
                 if error is not None:
                     self.metrics.meter("lane.deviceFailures").mark()
-                self.metrics.timer("phase.laneDispatch").update(
-                    (time.perf_counter() - t0) * 1000
-                )
+                elif d.plan_digest is not None:
+                    if cold:
+                        self.metrics.meter("compile.cold").mark()
+                        self.metrics.timer("compile.firstCallMs").update(launch_ms)
+                    else:
+                        self.metrics.meter("compile.warm").mark()
+                self.metrics.timer("phase.laneDispatch").update(launch_ms)
             for w in waiters:
                 w._deliver(value=value, error=error)

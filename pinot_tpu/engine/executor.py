@@ -242,6 +242,18 @@ class QueryExecutor:
                 return False
             return True
 
+    def poisoned_entry(self, key: Any) -> Optional[Dict[str, Any]]:
+        """Live quarantine record for a (plan digest, segment set) key,
+        or None — the EXPLAIN plane's honesty hook: a poisoned plan's
+        EXPLAIN must report the host tier it will ACTUALLY serve from,
+        not the device tier it would have picked."""
+        now = time.monotonic()
+        with self._heal_lock:
+            entry = self._poisoned.get(key)
+            if entry is None or now >= entry[1]:
+                return None
+            return {"reason": entry[0], "ttlRemainingS": round(entry[1] - now, 3)}
+
     def _poison(self, key: Any, reason: str) -> None:
         expiry = time.monotonic() + self._poison_ttl_s
         with self._heal_lock:

@@ -1,0 +1,385 @@
+"""EXPLAIN: the serving-tier decision records, computed WITHOUT serving.
+
+``build_explain_node`` walks the exact decision order the executor
+applies (``executor.execute`` -> ``_execute_engine``) — prune verdicts,
+star-tree routing, the postings/scan operator choice, planner
+host-forcing, poison quarantine, and the zone-map/full-scan split — and
+returns a JSON-safe per-server plan node instead of results.
+
+The device-path decisions (StaticPlan shape, its digest, the zone-map
+candidate fraction) normally require a staged table; EXPLAIN must never
+stage (a cold EXPLAIN of a 1B-row table must not trigger a multi-GB H2D
+transfer) and never launch kernels.  ``_phantom_staged`` therefore
+builds a metadata-only ``StagedTable`` twin: the same n_pad/card_pad
+bucketing, per-segment cards, and role-array PRESENCE (zero-length
+sentinels) that real staging would produce — ``build_static_plan`` and
+``build_query_inputs`` read only those, so the phantom yields the
+IDENTICAL ``StaticPlan`` (hence the identical plan digest and poison
+key) the executor would compile, with zero device bytes moved.
+
+The safety contract (tier-1 guarded): plain EXPLAIN performs zero lane
+submissions and marks zero cost meters.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pinot_tpu.common.request import BrokerRequest
+from pinot_tpu.engine import config
+from pinot_tpu.engine.context import get_table_context
+from pinot_tpu.engine.device import LEDGER, StagedColumn, StagedTable
+from pinot_tpu.engine.dispatch import plan_digest
+from pinot_tpu.engine.invindex_path import index_path_decision
+from pinot_tpu.engine.plan import (
+    build_query_inputs,
+    build_static_plan,
+    plan_forced_host,
+)
+from pinot_tpu.engine.plandigest import plan_shape_digest, plan_shape_summary
+from pinot_tpu.engine.pruner import prune_explain
+from pinot_tpu.segment.immutable import ImmutableSegment
+
+# serving-tier name (as it appears in per-segment records) -> cost-
+# vector count key, derived from the ONE mapping in engine/results.py
+# so EXPLAIN ANALYZE's estimated-vs-actual comparison lines up
+# key-for-key with the cost vector ("fullScan" -> "segmentsFullScan")
+from pinot_tpu.engine.results import SEGMENT_TIER_NAMES
+
+TIER_COST_KEYS = {name: key for key, name in SEGMENT_TIER_NAMES.items()}
+
+
+def _json_safe(v: Any) -> Any:
+    """numpy scalars/arrays -> plain Python, recursively (the plan node
+    rides the tagged wire codec, which knows no numpy)."""
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray):
+        return [_json_safe(x) for x in v.tolist()]
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    return v
+
+
+_SENTINEL = np.zeros(0, dtype=np.int8)
+
+
+def _phantom_staged(
+    segments: Sequence[ImmutableSegment],
+    column_names: Sequence[str],
+    raw_cols: Sequence[str],
+    gfwd_cols: Sequence[str],
+    hll_cols: Sequence[str],
+    pad_segments_to: int = 0,
+) -> StagedTable:
+    """Metadata-only StagedTable twin (module docstring): identical
+    shape bucketing + role presence, zero device arrays.  MUST mirror
+    ``device.stage_segments``'s metadata computation exactly — the
+    resulting StaticPlan (and therefore its digest and poison key) has
+    to match what a real execution would build."""
+    S = max(len(segments), pad_segments_to)
+    n_pad = config.pad_docs(max(seg.num_docs for seg in segments))
+    st = StagedTable(
+        segment_names=tuple(s.segment_name for s in segments),
+        num_segments=S,
+        n_pad=n_pad,
+        num_docs=tuple(s.num_docs for s in segments) + (0,) * (S - len(segments)),
+        num_docs_arr=np.asarray(
+            [s.num_docs for s in segments] + [0] * (S - len(segments)),
+            dtype=np.int32,
+        ),
+    )
+    for name in sorted(set(column_names)):
+        cols = [seg.column(name) for seg in segments]
+        meta0 = cols[0].metadata
+        cards = tuple(c.dictionary.cardinality for c in cols)
+        card_pad = config.pad_card(max(cards))
+        sc = StagedColumn(
+            name=name,
+            stored_type=meta0.data_type.stored_type,
+            single_value=meta0.single_value,
+            card_pad=card_pad,
+            mv_pad=0,
+            cards=cards,
+        )
+        if meta0.single_value:
+            # role-array PRESENCE must match stage_segments' conditions:
+            # the planner reads only `is not None`
+            if name in raw_cols and sc.is_numeric:
+                sc.raw = _SENTINEL
+            if name in gfwd_cols:
+                sc.gfwd = _SENTINEL
+            if name in hll_cols:
+                sc.hll_rho = _SENTINEL
+                sc.hll_bucket = _SENTINEL
+        else:
+            mv_pad = max(1, max(c.metadata.max_num_multi_values for c in cols))
+            sc.mv_pad = config.pad_card(mv_pad)
+            if name in raw_cols and sc.is_numeric:
+                sc.mv_raw = _SENTINEL
+        st.columns[name] = sc
+    return st
+
+
+def _estimate_scan_bytes(
+    segments: Sequence[ImmutableSegment], columns: Sequence[str], fraction: float
+) -> int:
+    """Static byte estimate for a device scan: per-column forward-index
+    bytes at the staged integer width, scaled by the zone-map candidate
+    fraction (1.0 for a full scan) — the same shape the actual cost
+    vector reports."""
+    total = 0
+    for seg in segments:
+        for name in columns:
+            col = seg.columns.get(name)
+            if col is None:
+                continue
+            meta = col.metadata
+            itemsize = np.dtype(
+                config.index_dtype(config.pad_card(max(meta.cardinality, 1)))
+            ).itemsize
+            rows = seg.num_docs
+            if meta.single_value:
+                total += rows * itemsize
+            else:
+                total += rows * max(1, meta.max_num_multi_values) * itemsize
+    return int(total * min(max(fraction, 0.0), 1.0))
+
+
+def _staged_snapshot(table: str, segment_names: Sequence[str]) -> Dict[str, Any]:
+    """What of this query's segments is ALREADY resident in HBM, read
+    off the PR 6 staging ledger (never stages anything new).  Entries
+    must match on BOTH table and segment names: segment names are only
+    unique within a table, so name intersection alone would attribute
+    another table's staged bytes to this query."""
+    from pinot_tpu.engine.plandigest import _raw_table
+
+    wanted = set(segment_names)
+    raw = _raw_table(table)
+    bytes_total = 0
+    columns: set = set()
+    entries = 0
+    for e in LEDGER.snapshot()["entries"]:
+        etable = e.get("table") or ""
+        # ledger tables come from segment metadata (physical names);
+        # an empty one (metadata without table_name) can only match on
+        # segments
+        if etable and _raw_table(etable) != raw:
+            continue
+        if not wanted.intersection(e.get("segments") or ()):
+            continue
+        entries += 1
+        bytes_total += int(e.get("bytes") or 0)
+        columns.update((e.get("columns") or {}).keys())
+    return {
+        "hbmBytes": bytes_total,
+        "stagedTables": entries,
+        "columns": sorted(columns),
+    }
+
+
+def build_explain_node(
+    executor,
+    segments: Sequence[ImmutableSegment],
+    request: BrokerRequest,
+    table: str,
+    server_name: str,
+    plan_stats=None,
+) -> Dict[str, Any]:
+    """One server's EXPLAIN plan node (module docstring).  ``executor``
+    supplies the decision helpers AND the live poison-quarantine state;
+    ``plan_stats`` (utils/planstats.py) supplies historical estimates."""
+    total_docs = sum(s.num_docs for s in segments)
+    records: List[Dict[str, Any]] = []
+    tier_counts: Dict[str, int] = {}
+
+    def record(seg: ImmutableSegment, tier: str, reason: str, **extra) -> None:
+        tier_counts[TIER_COST_KEYS[tier]] = tier_counts.get(TIER_COST_KEYS[tier], 0) + 1
+        records.append(
+            dict({"segment": seg.segment_name, "tier": tier, "reason": reason}, **extra)
+        )
+
+    verdicts = prune_explain(segments, request)
+    live = [seg for seg, reason in verdicts if reason is None]
+    for seg, reason in verdicts:
+        if reason is not None:
+            record(seg, "pruned", reason)
+
+    device_info: Optional[Dict[str, Any]] = None
+    est_bytes = 0
+    normal: List[ImmutableSegment] = []
+    if live:
+        from pinot_tpu.startree.operator import is_fit_for_star_tree
+
+        star = [s for s in live if is_fit_for_star_tree(request, s)]
+        normal = [s for s in live if s not in star]
+        for seg in star:
+            record(
+                seg,
+                "starTree",
+                "conjunctive-EQ dims + aggregations covered by the "
+                "segment's star-tree cube",
+            )
+
+    if normal:
+        needed = set(request.referenced_columns())
+        sel_columns: Optional[List[str]] = None
+        if request.is_selection:
+            sel_columns = executor._resolve_selection_columns(request, normal[0])
+            needed.update(sel_columns)
+        pad_to = 0
+        if executor.mesh is not None:
+            n = int(executor.mesh.devices.size)
+            pad_to = -(-len(normal) // n) * n
+        needed -= executor._docrange_only_columns(request, normal, sel_columns)
+        ctx = get_table_context(normal)
+
+        decision, state = index_path_decision(request, normal, ctx, total_docs)
+        if state is not None:
+            est_bytes = int(decision.get("estMatches", 0)) * (
+                decision.get("residuals", 0) + 1
+            ) * 8
+            for seg in normal:
+                record(
+                    seg, "postings", decision["reason"],
+                    drivingColumn=decision.get("column"),
+                )
+        elif plan_forced_host(request, ctx):
+            est_bytes = _estimate_scan_bytes(normal, sorted(needed), 1.0)
+            for seg in normal:
+                record(
+                    seg,
+                    "host",
+                    "planner forces host before staging (group capacity "
+                    "or guaranteed sort-pair overflow)",
+                )
+        else:
+            raw_cols, gfwd_cols, hll_cols = executor._role_columns(
+                request, normal, ctx
+            )
+            phantom = _phantom_staged(
+                normal,
+                list(needed) + list(request.referenced_columns()),
+                raw_cols, gfwd_cols, hll_cols,
+                pad_segments_to=pad_to,
+            )
+            scratch: Dict[Any, Any] = {}
+            plan = build_static_plan(request, ctx, phantom, scratch=scratch)
+            if not plan.on_device:
+                est_bytes = _estimate_scan_bytes(normal, sorted(needed), 1.0)
+                for seg in normal:
+                    record(
+                        seg,
+                        "host",
+                        "StaticPlan is device-ineligible (group capacity, "
+                        "MV expansion, or pair-overflow guard)",
+                    )
+            else:
+                pdigest = plan_digest(plan)
+                poison = executor.poisoned_entry((pdigest, phantom.segment_names))
+                lane = getattr(executor, "lane", None)
+                compile_entry = (
+                    lane.compile_info(pdigest) if lane is not None else None
+                )
+                compile_info = (
+                    {"state": "warm", **compile_entry}
+                    if compile_entry is not None
+                    else {"state": "cold"}
+                )
+                device_info = {
+                    "planDigest": pdigest,
+                    "compile": compile_info,
+                    "quarantined": poison is not None,
+                }
+                if poison is not None:
+                    # HONESTY: the device plan is quarantined, so this
+                    # query will ACTUALLY serve from the host path — the
+                    # explain must say so, not report the device tier
+                    est_bytes = _estimate_scan_bytes(normal, sorted(needed), 1.0)
+                    for seg in normal:
+                        record(
+                            seg,
+                            "host",
+                            "device plan quarantined (poisoned): "
+                            f"{poison['reason']} — serving via host "
+                            f"fallback for {poison['ttlRemainingS']}s more",
+                        )
+                else:
+                    q_np = build_query_inputs(
+                        request, plan, ctx, phantom, scratch=scratch
+                    )
+                    block_ids, scanned_rows = executor._block_skip_ids(
+                        plan, q_np, normal, phantom
+                    )
+                    from pinot_tpu.engine.kernel import chunk_rows_limit
+
+                    _limit = chunk_rows_limit()
+                    if (
+                        block_ids is not None
+                        and _limit
+                        and phantom.num_segments * phantom.n_pad > _limit
+                    ):
+                        block_ids = None  # mirrors the executor's guard
+                    if block_ids is not None and scanned_rows is not None:
+                        frac = (
+                            min(1.0, scanned_rows / phantom.total_docs)
+                            if phantom.total_docs
+                            else 1.0
+                        )
+                        est_bytes = _estimate_scan_bytes(
+                            normal, sorted(needed), frac
+                        )
+                        for seg in normal:
+                            record(
+                                seg,
+                                "zonemap",
+                                "zone-map block pruning engages: candidate "
+                                f"fraction {frac:.4f} of the table",
+                                candidateFraction=round(frac, 4),
+                            )
+                    else:
+                        est_bytes = _estimate_scan_bytes(normal, sorted(needed), 1.0)
+                        for seg in normal:
+                            record(
+                                seg,
+                                "fullScan",
+                                "no selective tier applies: full vmapped "
+                                "device scan",
+                            )
+
+    digest = plan_shape_digest(request)
+    estimated: Dict[str, Any] = {
+        "source": "static",
+        "bytesScanned": int(est_bytes),
+    }
+    estimated.update({k: v for k, v in tier_counts.items()})
+    if plan_stats is not None:
+        hist = plan_stats.estimate(digest)
+        if hist is not None:
+            estimated = dict(hist)
+            estimated["source"] = "history"
+
+    node: Dict[str, Any] = {
+        "server": server_name,
+        "table": table,
+        "planDigest": digest,
+        "summary": plan_shape_summary(request),
+        "numSegments": len(segments),
+        "totalDocs": int(total_docs),
+        "tierCounts": tier_counts,
+        "segments": records,
+        "staged": _staged_snapshot(table, [s.segment_name for s in segments]),
+        "estimatedCost": estimated,
+        "generatedAtMs": round(time.time() * 1000, 3),
+    }
+    if device_info is not None:
+        node["device"] = device_info
+    return _json_safe(node)

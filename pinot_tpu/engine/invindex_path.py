@@ -108,22 +108,31 @@ def _decompose(tree: FilterQueryTree):
     return (cands, list(tree.children)) if cands else None
 
 
-def try_index_path(
+def index_path_decision(
     request: BrokerRequest,
     live: List[ImmutableSegment],
     ctx: TableContext,
     total_docs: int,
-    sel_columns: Optional[List[str]],
-) -> Optional[IntermediateResult]:
-    """O(matches) host path, or None to take the device scan."""
+):
+    """The operator-choice verdict, separated from execution so the
+    EXPLAIN plane can report it without serving the query.
+
+    Returns ``(decision, state)``: ``decision`` is a JSON-safe record
+    (``taken`` plus the reason/estimates that justify it); ``state`` is
+    the resolved ``(best leaf, indexes, residuals, est)`` execution
+    handoff, present only when ``taken`` is True."""
     if os.environ.get("PINOT_TPU_INVINDEX") == "0":
-        return None
+        return {"taken": False, "reason": "postings path disabled (PINOT_TPU_INVINDEX=0)"}, None
     tree = request.filter
     if tree is None:
-        return None
+        return {"taken": False, "reason": "no filter: nothing selective to drive postings"}, None
     dec = _decompose(tree)
     if dec is None:
-        return None
+        return {
+            "taken": False,
+            "reason": "filter shape not postings-drivable (needs a root-level "
+            "AND / single positive leaf)",
+        }, None
     cands, conjuncts = dec
     live_docs = sum(s.num_docs for s in live)
     limit = _max_matches(live_docs)
@@ -154,7 +163,15 @@ def try_index_path(
         if ok and (best_frac is None or frac < best_frac):
             best, best_frac, best_tables = leaf, frac, tables
     if best is None or best_frac * live_docs > limit:
-        return None
+        return {
+            "taken": False,
+            "reason": "estimated matches above the postings/scan crossover",
+            "column": None if best is None else best.column,
+            "estMatches": None
+            if best is None
+            else int(best_frac * live_docs),
+            "maxMatches": int(limit),
+        }, None
 
     # real postings counts confirm (skew can defeat the uniform guess)
     indexes = []
@@ -162,13 +179,47 @@ def try_index_path(
     for seg, t in zip(live, best_tables):
         idx = inverted_index(seg, best.column)
         if idx is None:
-            return None
+            return {
+                "taken": False,
+                "reason": f"no inverted index for driving column {best.column!r}",
+                "column": best.column,
+            }, None
         est += idx.count_for_table(t)
         indexes.append((idx, t))
     if est > limit:
-        return None
+        return {
+            "taken": False,
+            "reason": "postings count above the postings/scan crossover "
+            "(skew defeated the uniform estimate)",
+            "column": best.column,
+            "estMatches": int(est),
+            "maxMatches": int(limit),
+        }, None
 
     residuals = [c for c in conjuncts if c is not best]
+    decision = {
+        "taken": True,
+        "reason": "selective driving leaf answers from host postings in O(matches)",
+        "column": best.column,
+        "estMatches": int(est),
+        "maxMatches": int(limit),
+        "residuals": len(residuals),
+    }
+    return decision, (best, indexes, residuals, est)
+
+
+def try_index_path(
+    request: BrokerRequest,
+    live: List[ImmutableSegment],
+    ctx: TableContext,
+    total_docs: int,
+    sel_columns: Optional[List[str]],
+) -> Optional[IntermediateResult]:
+    """O(matches) host path, or None to take the device scan."""
+    decision, state = index_path_decision(request, live, ctx, total_docs)
+    if state is None:
+        return None
+    best, indexes, residuals, est = state
 
     def matched_rows(si: int, seg: ImmutableSegment) -> np.ndarray:
         idx, t = indexes[si]

@@ -52,22 +52,43 @@ def _time_bounds(
     return (lo, hi) if found else None
 
 
+def _prune_reason(
+    seg: ImmutableSegment, request: BrokerRequest, needed: Sequence[str]
+) -> Optional[str]:
+    """Why this segment is pruned, or None to keep it — the ONE verdict
+    prune_segments and the EXPLAIN decision records share."""
+    if seg.num_docs == 0:  # ValidSegmentPruner
+        return "empty segment (ValidSegmentPruner)"
+    missing = [c for c in needed if not seg.has_column(c)]
+    if missing:  # DataSchemaSegmentPruner
+        return f"missing columns {sorted(missing)} (DataSchemaSegmentPruner)"
+    meta = seg.metadata
+    if meta.time_column and meta.start_time is not None and meta.end_time is not None:
+        bounds = _time_bounds(request.filter, meta.time_column)
+        if bounds is not None:
+            lo, hi = bounds
+            if hi < meta.start_time or lo > meta.end_time:  # TimeSegmentPruner
+                return (
+                    f"time range [{meta.start_time},{meta.end_time}] outside "
+                    f"predicate [{lo},{hi}] (TimeSegmentPruner)"
+                )
+    return None
+
+
+def prune_explain(
+    segments: Sequence[ImmutableSegment], request: BrokerRequest
+) -> List[Tuple[ImmutableSegment, Optional[str]]]:
+    """Per-segment prune verdicts in input order: (segment, reason) —
+    reason None means the segment survives to planning.  The EXPLAIN
+    plane's view of the pruning stage."""
+    needed = request.referenced_columns()
+    return [(seg, _prune_reason(seg, request, needed)) for seg in segments]
+
+
 def prune_segments(
     segments: Sequence[ImmutableSegment], request: BrokerRequest
 ) -> List[ImmutableSegment]:
     needed = request.referenced_columns()
-    out: List[ImmutableSegment] = []
-    for seg in segments:
-        if seg.num_docs == 0:  # ValidSegmentPruner
-            continue
-        if any(not seg.has_column(c) for c in needed):  # DataSchemaSegmentPruner
-            continue
-        meta = seg.metadata
-        if meta.time_column and meta.start_time is not None and meta.end_time is not None:
-            bounds = _time_bounds(request.filter, meta.time_column)
-            if bounds is not None:
-                lo, hi = bounds
-                if hi < meta.start_time or lo > meta.end_time:  # TimeSegmentPruner
-                    continue
-        out.append(seg)
-    return out
+    return [
+        seg for seg in segments if _prune_reason(seg, request, needed) is None
+    ]

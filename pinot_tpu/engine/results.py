@@ -250,6 +250,20 @@ COST_KEYS = (
     "segmentsStarTree",
 )
 
+# Serving-tier subset of COST_KEYS — THE single source the introspection
+# plane derives from (server cost.tier.* meters, EXPLAIN tier records,
+# trace_dump's tier footer): a tier added here propagates everywhere.
+# All but segmentsPruned partition numSegmentsQueried exactly.
+SEGMENT_TIER_KEYS = tuple(k for k in COST_KEYS if k.startswith("segments"))
+
+# cost-vector key -> short display tier name ("segmentsFullScan" ->
+# "fullScan"), shared by EXPLAIN records and trace_dump's footer so the
+# two surfaces can never render the same tier differently
+SEGMENT_TIER_NAMES = {
+    k: k[len("segments"):][0].lower() + k[len("segments"):][1:]
+    for k in SEGMENT_TIER_KEYS
+}
+
 
 class IntermediateResult:
     """One executor's (server's) partial answer for a query — the unit
@@ -271,6 +285,7 @@ class IntermediateResult:
         exceptions: Optional[List[Tuple[int, str]]] = None,
         unserved_segments: Optional[List[str]] = None,
         cost: Optional[Dict[str, float]] = None,
+        plan_info: Optional[List[Dict[str, Any]]] = None,
     ) -> None:
         self.selection_columns = selection_columns
         self.exceptions: List[Tuple[int, str]] = exceptions or []
@@ -297,6 +312,11 @@ class IntermediateResult:
         # window (shed early with 429 instead of feeding a saturated
         # server until 210s appear)
         self.backpressure: Dict[str, float] = {}
+        # EXPLAIN / EXPLAIN ANALYZE plan trees: one JSON-safe node per
+        # answering server (engine/explain.py), concatenated on merge
+        # like traces (never summed) — the broker collects them into
+        # BrokerResponse.explain["servers"]
+        self.plan_info: List[Dict[str, Any]] = list(plan_info or [])
 
     def add_cost(self, **kv: float) -> None:
         """Accumulate cost-vector components (key-wise add)."""
@@ -307,6 +327,7 @@ class IntermediateResult:
     def merge(self, other: "IntermediateResult") -> None:
         self.exceptions.extend(other.exceptions)
         self.unserved_segments.extend(other.unserved_segments)
+        self.plan_info.extend(other.plan_info)
         # cost vectors are additive by construction: the broker's merged
         # totals equal the sum of the per-server totals EXACTLY
         for k, v in other.cost.items():

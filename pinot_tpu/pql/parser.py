@@ -130,6 +130,16 @@ class _Parser:
 
     # -- grammar -------------------------------------------------------
     def parse(self) -> BrokerRequest:
+        # EXPLAIN [ANALYZE] [PLAN FOR] SELECT ... — the introspection
+        # prefix (reference later grew ``EXPLAIN PLAN FOR``, see
+        # PARITY.md).  EXPLAIN returns the physical plan without
+        # executing; EXPLAIN ANALYZE executes and annotates the plan
+        # nodes with actuals from the cost vector.
+        explain: Optional[str] = None
+        if self.accept_kw("EXPLAIN"):
+            explain = "analyze" if self.accept_kw("ANALYZE") else "plan"
+            if self.accept_kw("PLAN"):
+                self.expect_kw("FOR")
         self.expect_kw("SELECT")
         top_n: Optional[int] = None
         if self.accept_kw("TOP"):
@@ -187,6 +197,7 @@ class _Parser:
             raise PqlParseError("cannot mix aggregation functions and plain columns in SELECT")
 
         req = BrokerRequest(table_name=table)
+        req.explain = explain
         req.filter = filter_tree
         req.having = having
         if aggregations:

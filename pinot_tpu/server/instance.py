@@ -22,7 +22,7 @@ from pinot_tpu.common.datatable import (
 )
 from pinot_tpu.common.response import ErrorCode
 from pinot_tpu.engine.executor import QueryExecutor
-from pinot_tpu.engine.results import IntermediateResult
+from pinot_tpu.engine.results import SEGMENT_TIER_KEYS, IntermediateResult
 from pinot_tpu.pql import optimize_request, parse_pql
 from pinot_tpu.segment.immutable import ImmutableSegment
 from pinot_tpu.server.datamanager import InstanceDataManager
@@ -98,6 +98,18 @@ class ServerInstance:
         for m in ("ingest.rowsConsumed",):
             self.metrics.meter(m)
         self.metrics.timer("ingest.commitMs")
+        # workload-introspection plane: per-plan-digest rolling stats
+        # (utils/planstats.py) behind /debug/plans + status()["plans"],
+        # with the plan.* series and the per-tier cost counters the
+        # /debug/plans tier mixes reconcile against — all pre-registered
+        from pinot_tpu.utils.planstats import PlanStatsStore
+
+        self.plan_stats = PlanStatsStore()
+        for m in ("plan.recorded", "plan.explains"):
+            self.metrics.meter(m)
+        self.metrics.gauge("plan.digests").set_fn(self.plan_stats.digest_count)
+        for k in self._TIER_KEYS:
+            self.metrics.meter(f"cost.tier.{k}")
         from pinot_tpu.engine.device import LEDGER
 
         # NOTE: the ledger (like the staging cache) is process-global —
@@ -132,6 +144,11 @@ class ServerInstance:
         # brokers simply stop routing new covers here — but ops can see
         # the drain in status()/debug output
         self.draining = False
+
+    # serving-tier cost-vector keys mirrored into cost.tier.* meters —
+    # the ONE source in engine/results.py, so a new tier cannot
+    # silently miss the reconciliation surfaces
+    _TIER_KEYS = SEGMENT_TIER_KEYS
 
     # -- segment lifecycle -------------------------------------------
     @staticmethod
@@ -227,6 +244,7 @@ class ServerInstance:
         timeout_s = req["timeoutMs"] / 1000.0
         deadline = time.monotonic() + timeout_s
         t_enqueue = time.monotonic()
+        outcome = "ok"  # vs "shed" / "failed": the plan-stats verdict
         try:
             # fair-share scheduling: each table queues separately and the
             # DRR dequeue guarantees a flooding tenant cannot starve the
@@ -241,12 +259,14 @@ class ServerInstance:
             # overload shed: fast typed rejection, no stack spam — the
             # broker treats 210 as retryable and fails over to a replica
             self.metrics.meter("queriesShed").mark()
+            outcome = "shed"
             result = IntermediateResult(
                 exceptions=[(ErrorCode.SERVER_SCHEDULER_DOWN, str(e))]
             )
         except SchedulerShutdownError as e:
             # draining for restart: typed 220 so the broker retries the
             # segment set on a replica instead of failing the query
+            outcome = "shed"
             result = IntermediateResult(
                 exceptions=[(ErrorCode.SERVER_SHUTTING_DOWN, str(e))]
             )
@@ -254,11 +274,13 @@ class ServerInstance:
             # the broker-propagated deadline expired while this query sat
             # in the FCFS queue; reply cheaply without executing
             self.metrics.meter("queriesAbandoned").mark()
+            outcome = "shed"
             result = IntermediateResult(
                 exceptions=[(ErrorCode.EXECUTION_TIMEOUT, f"server {self.name}: {e}")]
             )
         except (concurrent.futures.TimeoutError, TimeoutError):
             logger.warning("query %s timed out", req.get("requestId"))
+            outcome = "failed"
             result = IntermediateResult(
                 exceptions=[
                     (
@@ -269,6 +291,7 @@ class ServerInstance:
             )
         except Exception as e:  # execution error
             logger.exception("query %s failed", req.get("requestId"))
+            outcome = "failed"
             result = IntermediateResult(
                 exceptions=[(ErrorCode.QUERY_EXECUTION, f"{type(e).__name__}: {e}")]
             )
@@ -283,7 +306,16 @@ class ServerInstance:
             ms = result.cost.get(key)
             if ms:
                 self.metrics.timer(timer).update(float(ms))
-        self.metrics.timer("queryExecution").update((time.perf_counter() - t_start) * 1000)
+        # serving-tier counters: the cost-vector segment counts mirrored
+        # into per-tier meters so /debug/plans tier mixes reconcile with
+        # a registry-level series (all zero for plain EXPLAIN)
+        for key in self._TIER_KEYS:
+            n = result.cost.get(key)
+            if n:
+                self.metrics.meter(f"cost.tier.{key}").mark(int(n))
+        exec_ms = (time.perf_counter() - t_start) * 1000
+        self._record_plan_stats(req, result, outcome, exec_ms)
+        self.metrics.timer("queryExecution").update(exec_ms)
         self.metrics.meter("queries").mark()
         # backpressure snapshot on EVERY reply (including sheds): the
         # broker's AIMD admission window reads it to back off before
@@ -296,6 +328,50 @@ class ServerInstance:
             else self.lane.stats().get("depth", 0),
         }
         return serialize_result(result)
+
+    def _record_plan_stats(
+        self, req: dict, result: IntermediateResult, outcome: str, exec_ms: float
+    ) -> None:
+        """Fold one handled request into the per-plan-digest registry.
+        Plain EXPLAIN is excluded (it executed nothing and must mark no
+        cost).  A result without a digest never got parsed: for SHED
+        outcomes that is the overload fast-rejection path — re-parsing
+        there would spend CPU exactly when the server is saturated, so
+        un-keyed sheds are simply not per-digest-attributed (the
+        aggregate queriesShed meter still counts them).  Failed
+        outcomes (exceptional by definition) re-derive the digest so
+        failures cross-link to their shape."""
+        digest = getattr(result, "_plan_digest", None)
+        summary = getattr(result, "_plan_summary", "")
+        explain_mode = getattr(result, "_explain_mode", None)
+        if digest is None:
+            if outcome == "shed":
+                return  # never parse on the overload fast path
+            try:
+                from pinot_tpu.engine.plandigest import (
+                    plan_shape_digest,
+                    plan_shape_summary,
+                )
+
+                preq = optimize_request(parse_pql(req["pql"]))
+                digest = plan_shape_digest(preq)
+                summary = plan_shape_summary(preq)
+                explain_mode = preq.explain
+            except Exception:
+                return  # unparseable request: nothing to key on
+        if explain_mode == "plan":
+            return
+        self.plan_stats.record(
+            digest,
+            summary=summary,
+            table=req["table"],
+            latency_ms=exec_ms,
+            cost=result.cost,
+            num_docs=result.num_docs_scanned,
+            shed=(outcome == "shed"),
+            failed=(outcome == "failed"),
+        )
+        self.metrics.meter("plan.recorded").mark()
 
     def status(self) -> dict:
         """Serving-surface snapshot: scheduler depth/shed, device-lane
@@ -320,6 +396,7 @@ class ServerInstance:
             "selfHealing": heal,
             "hbm": hbm,
             "ingest": self.ingest_backpressure.snapshot(),
+            "plans": self.plan_stats.snapshot(top=20),
             "metrics": self.metrics.snapshot(),
         }
 
@@ -357,9 +434,17 @@ class ServerInstance:
             trace = NULL_TRACE
         token = set_current(trace if trace.enabled else None)
         try:
-            return self._process_traced(req, request, trace, deadline, t_enqueue)
+            result = self._process_traced(req, request, trace, deadline, t_enqueue)
         finally:
             reset_current(token)
+        # plan-stats keying, computed where the parsed request exists so
+        # handle_request's recording path needs no second parse
+        from pinot_tpu.engine.plandigest import plan_shape_digest, plan_shape_summary
+
+        result._plan_digest = plan_shape_digest(request)
+        result._plan_summary = plan_shape_summary(request)
+        result._explain_mode = request.explain
+        return result
 
     def _process_traced(
         self,
@@ -405,10 +490,50 @@ class ServerInstance:
                     missing = [n for n in names if n not in held]
                     if missing:
                         self.metrics.meter("segmentsMissedServing").mark(len(missing))
-                with trace.span("planAndExecute", segments=len(acquired)):
-                    result = self.executor.execute(
-                        [a.query_view() for a in acquired], request, deadline=deadline
+                views = [a.query_view() for a in acquired]
+                if request.explain == "plan":
+                    # EXPLAIN: the physical plan INSTEAD of execution —
+                    # zero lane submissions, zero cost (safe to call in
+                    # production; tier-1 guarded)
+                    from pinot_tpu.engine.explain import build_explain_node
+
+                    with trace.span("explainPlan", segments=len(acquired)):
+                        node = build_explain_node(
+                            self.executor, views, request, req["table"],
+                            self.name, plan_stats=self.plan_stats,
+                        )
+                    node["mode"] = "plan"
+                    self.metrics.meter("plan.explains").mark()
+                    result = IntermediateResult(
+                        total_docs=int(node.get("totalDocs") or 0),
+                        plan_info=[node],
                     )
+                else:
+                    with trace.span("planAndExecute", segments=len(acquired)):
+                        result = self.executor.execute(
+                            views, request, deadline=deadline
+                        )
+                    if request.explain == "analyze":
+                        # EXPLAIN ANALYZE: the prediction is built AFTER
+                        # execution (so quarantine/compile state reflects
+                        # what just happened) and annotated with actuals
+                        # straight off this reply's cost vector — the
+                        # per-node actuals sum EXACTLY to the broker's
+                        # merged cost because only merged replies'
+                        # plan nodes survive the gather
+                        from pinot_tpu.engine.explain import (
+                            _json_safe,
+                            build_explain_node,
+                        )
+
+                        node = build_explain_node(
+                            self.executor, views, request, req["table"],
+                            self.name, plan_stats=self.plan_stats,
+                        )
+                        node["mode"] = "analyze"
+                        node["actualCost"] = _json_safe(dict(result.cost))
+                        node["actualDocsScanned"] = int(result.num_docs_scanned)
+                        result.plan_info = [node]
                 result.unserved_segments = missing
             finally:
                 tdm.release_segments(acquired)

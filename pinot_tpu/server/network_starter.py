@@ -78,6 +78,21 @@ class ServerAdminHttpServer:
                         json.dumps(inst.status()).encode("utf-8"),
                         "application/json",
                     )
+                from urllib.parse import parse_qs, urlparse
+
+                url = urlparse(self.path)
+                if url.path == "/debug/plans":
+                    # per-plan-digest workload stats (utils/planstats.py);
+                    # ?by=cost reorders the top-K by total work instead
+                    # of frequency
+                    qs = parse_qs(url.query)
+                    by = (qs.get("by") or ["count"])[0]
+                    return self._send(
+                        json.dumps(
+                            inst.plan_stats.snapshot(top=50, by=by)
+                        ).encode("utf-8"),
+                        "application/json",
+                    )
                 self._send(b'{"error": "not found"}', "application/json", 404)
 
         self._httpd = ThreadingHTTPServer((host, port), _Handler)

@@ -130,6 +130,30 @@ def render_cost(obj: Dict[str, Any]) -> str:
     return "cost: " + "  ".join(parts) + "\n"
 
 
+def render_tiers(obj: Dict[str, Any]) -> str:
+    """One-line serving-tier footer from a full broker response JSON:
+    which tier served how many segments (the cost vector's segment
+    counts) plus the plan-shape digest cross-linking this query to
+    ``/debug/plans`` / ``/debug/workload``.  Empty for a bare traceInfo.
+    Pure; unit-testable."""
+    if not isinstance(obj, dict) or "traceInfo" not in obj:
+        return ""
+    from pinot_tpu.engine.results import SEGMENT_TIER_NAMES
+
+    parts: List[str] = []
+    cost = obj.get("cost") or {}
+    for key, name in SEGMENT_TIER_NAMES.items():
+        v = cost.get(key)
+        if v:
+            parts.append(f"{name}={int(v)}")
+    digest = obj.get("planDigest")
+    if digest:
+        parts.append(f"planDigest={digest}")
+    if not parts:
+        return ""
+    return "tiers: " + "  ".join(parts) + "\n"
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="pinot_tpu-trace-dump", description=__doc__,
@@ -165,6 +189,9 @@ def main(argv=None) -> int:
     # cost-vector footer: rows/bytes scanned, device vs host ms — the
     # "why was this slow" companion to the waterfall above
     sys.stdout.write(render_cost(obj))
+    # tier-decision footer: which serving tier answered how many
+    # segments, and the plan digest that cross-links to /debug/plans
+    sys.stdout.write(render_tiers(obj))
     return 0
 
 

@@ -36,6 +36,22 @@ _EWMA_TICK_S = 5.0
 _EWMA_ALPHA_1M = 1.0 - math.exp(-_EWMA_TICK_S / 60.0)
 
 
+def interpolated_percentile(s: Sequence[float], p: float) -> float:
+    """Linear-interpolated percentile over a SORTED sample sequence —
+    shared by Timer and the plan-stats registry (utils/planstats.py) so
+    /metrics and /debug/plans percentiles can never drift apart."""
+    if not s:
+        return 0.0
+    if len(s) == 1:
+        return s[0]
+    rank = (len(s) - 1) * min(max(p, 0.0), 100.0) / 100.0
+    lo = int(rank)
+    frac = rank - lo
+    if lo + 1 >= len(s):
+        return s[-1]
+    return s[lo] + frac * (s[lo + 1] - s[lo])
+
+
 class Meter:
     def __init__(self) -> None:
         self.count = 0
@@ -115,19 +131,9 @@ class Timer:
             self._sorted = sorted(self._samples)
         return self._sorted
 
-    @staticmethod
-    def _interp(s: Sequence[float], p: float) -> float:
-        """Linear-interpolated percentile over a sorted sample list."""
-        if not s:
-            return 0.0
-        if len(s) == 1:
-            return s[0]
-        rank = (len(s) - 1) * min(max(p, 0.0), 100.0) / 100.0
-        lo = int(rank)
-        frac = rank - lo
-        if lo + 1 >= len(s):
-            return s[-1]
-        return s[lo] + frac * (s[lo + 1] - s[lo])
+    # the ONE percentile implementation (module level above): timers
+    # and the plan-stats registry must never drift apart
+    _interp = staticmethod(interpolated_percentile)
 
     def percentile(self, p: float) -> float:
         with self._lock:
@@ -390,6 +396,11 @@ BROKER_METRIC_CATALOG: Dict[str, str] = {
     "cost.hostMs": "per-query host-path ms (merged cost vector)",
     "table.*.docsScanned": "per-table documents scanned (cost attribution)",
     "table.*.bytesScanned": "per-table column bytes touched (cost attribution)",
+    # workload-introspection plane (utils/planstats.py, /debug/workload)
+    "workload.recorded": "responses folded into the per-plan-digest "
+    "workload registry",
+    "workload.digests": "distinct plan-shape digests currently tracked",
+    "explain.queries": "EXPLAIN / EXPLAIN ANALYZE queries handled",
 }
 
 SERVER_METRIC_CATALOG: Dict[str, str] = {
@@ -426,6 +437,21 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "cost.bytesScanned": "column bytes touched by queries on this server",
     "cost.deviceMs": "per-query device-kernel ms (cost vector)",
     "cost.hostMs": "per-query host-path ms (cost vector)",
+    "cost.tier.*": "per-serving-tier segment counts from the cost vector "
+    "(segmentsPruned/Postings/Zonemap/FullScan/Host/StarTree) — the "
+    "series /debug/plans tier mixes reconcile against",
+    # workload-introspection plane (utils/planstats.py, /debug/plans)
+    "plan.recorded": "instance requests folded into the per-plan-digest "
+    "stats registry",
+    "plan.explains": "EXPLAIN plan requests answered without execution",
+    "plan.digests": "distinct plan-shape digests currently tracked",
+    # compile timeline (engine/dispatch.py lane registry): first-call
+    # launch of a device-plan digest pays trace + XLA compile
+    "compile.cold": "device-plan digests launched for the first time "
+    "(cold compile measured)",
+    "compile.warm": "device launches that reused an already-compiled plan",
+    "compile.firstCallMs": "first-call (compile-inclusive) launch wall ms "
+    "per device-plan digest",
     # HBM staging ledger (engine/device.py LEDGER; per-process)
     "hbm.stagedBytes": "bytes of segment arrays currently staged in HBM",
     "hbm.highWatermarkBytes": "high-watermark of staged HBM bytes",

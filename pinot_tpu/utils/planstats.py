@@ -1,0 +1,207 @@
+"""Per-plan-digest workload statistics registry.
+
+One rolling entry per plan SHAPE (``engine/plandigest.py`` — literals
+erased), accumulating: execution count, a latency sample window (for
+percentiles), the additive cost-vector sums (so per-digest tier mixes
+reconcile exactly with the cost meters), coalesce/shed/failure counts,
+and first/last-seen timestamps.
+
+Two deployments of the same class:
+
+- **server** (``ServerInstance.plan_stats``): records every executed
+  instance request; served at ``/debug/plans`` and in ``status()``.
+- **broker** (``BrokerRequestHandler.planstats``): records every merged
+  response; served at ``/debug/workload`` as top-K by frequency and by
+  cost — the direct input to the ROADMAP's "which plan shapes should we
+  batch?" question (cross-query batched serving wants the highest
+  frequency x cost shapes first).
+
+Plain EXPLAIN queries are never recorded (they execute nothing);
+EXPLAIN ANALYZE is (it did the work).  Eviction is least-recently-seen
+beyond ``capacity`` — a bounded registry, not a log.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+from pinot_tpu.utils.metrics import interpolated_percentile as _percentile
+
+_LAT_WINDOW = 256  # latency samples kept per digest
+
+
+class _Entry:
+    __slots__ = (
+        "digest", "summary", "table", "count", "shed_count", "failed_count",
+        "coalesce_hits", "docs_scanned", "cost", "latency", "first_seen",
+        "last_seen",
+    )
+
+    def __init__(self, digest: str, summary: str, table: str, now: float) -> None:
+        self.digest = digest
+        self.summary = summary
+        self.table = table
+        self.count = 0
+        self.shed_count = 0
+        self.failed_count = 0
+        self.coalesce_hits = 0
+        self.docs_scanned = 0
+        self.cost: Dict[str, float] = {}
+        self.latency: Deque[float] = deque(maxlen=_LAT_WINDOW)
+        self.first_seen = now
+        self.last_seen = now
+
+
+class PlanStatsStore:
+    def __init__(self, capacity: int = 512) -> None:
+        self.capacity = max(8, capacity)
+        self._entries: Dict[str, _Entry] = {}
+        self._lock = threading.Lock()
+        self.total_recorded = 0
+
+    # -- write side ----------------------------------------------------
+    def record(
+        self,
+        digest: str,
+        summary: str = "",
+        table: str = "",
+        latency_ms: float = 0.0,
+        cost: Optional[Dict[str, float]] = None,
+        num_docs: int = 0,
+        shed: bool = False,
+        failed: bool = False,
+    ) -> None:
+        now = time.time()
+        with self._lock:
+            e = self._entries.get(digest)
+            if e is None:
+                if len(self._entries) >= self.capacity:
+                    # evict least-recently-seen: the workload head stays
+                    victim = min(self._entries.values(), key=lambda x: x.last_seen)
+                    self._entries.pop(victim.digest, None)
+                e = self._entries[digest] = _Entry(digest, summary, table, now)
+            if summary and not e.summary:
+                e.summary = summary
+            if table and not e.table:
+                e.table = table
+            e.last_seen = now
+            self.total_recorded += 1
+            if shed:
+                e.shed_count += 1
+                return
+            e.count += 1
+            if failed:
+                e.failed_count += 1
+            e.latency.append(float(latency_ms))
+            e.docs_scanned += int(num_docs)
+            for k, v in (cost or {}).items():
+                e.cost[k] = e.cost.get(k, 0) + v
+            if (cost or {}).get("coalesceHits"):
+                e.coalesce_hits += int(cost["coalesceHits"])
+
+    # -- read side -----------------------------------------------------
+    def digest_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def _entry_dict(self, e: _Entry) -> Dict[str, Any]:
+        lat = sorted(e.latency)
+        per_query_cost = {
+            k: round(v / e.count, 3) if e.count else 0 for k, v in e.cost.items()
+        }
+        # tier mix straight from the additive cost sums: reconciles with
+        # the cost-vector tier counters by construction
+        tier_mix = {
+            k: int(v) for k, v in e.cost.items() if k.startswith("segments")
+        }
+        return {
+            "digest": e.digest,
+            "summary": e.summary,
+            "table": e.table,
+            "count": e.count,
+            "shedCount": e.shed_count,
+            "failedCount": e.failed_count,
+            "coalesceHits": e.coalesce_hits,
+            "docsScanned": e.docs_scanned,
+            "cost": {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in sorted(e.cost.items())
+            },
+            "tierMix": tier_mix,
+            "perQueryCost": per_query_cost,
+            "latencyMs": {
+                "p50": round(_percentile(lat, 50), 3),
+                "p95": round(_percentile(lat, 95), 3),
+                "p99": round(_percentile(lat, 99), 3),
+                "samples": len(lat),
+            },
+            "firstSeen": round(e.first_seen, 3),
+            "lastSeen": round(e.last_seen, 3),
+        }
+
+    @staticmethod
+    def _cost_key(d: Dict[str, Any]) -> float:
+        c = d.get("cost") or {}
+        # total work proxy: bytes + ms-weighted kernel time; the ROADMAP
+        # batching question ranks by frequency x unit cost, both served
+        return float(c.get("bytesScanned", 0)) + 1e6 * (
+            float(c.get("deviceMs", 0)) + float(c.get("hostMs", 0))
+        )
+
+    def top(self, k: int = 20, by: str = "count") -> List[Dict[str, Any]]:
+        # record() sits on the per-query response path and shares this
+        # lock, so the O(digests) ranking runs on cheap scalar keys and
+        # the expensive dicts (percentiles over the sample window) are
+        # built only for the k survivors
+        with self._lock:
+            if by == "cost":
+                keyed = [
+                    (self._cost_key({"cost": e.cost}), e)
+                    for e in self._entries.values()
+                ]
+            else:
+                keyed = [
+                    ((e.count, e.last_seen), e) for e in self._entries.values()
+                ]
+        keyed.sort(key=lambda pair: pair[0], reverse=True)
+        survivors = [e for _, e in keyed[:k]]
+        with self._lock:
+            return [
+                self._entry_dict(e)
+                for e in survivors
+                if self._entries.get(e.digest) is e  # evicted between locks
+            ]
+
+    def get(self, digest: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            e = self._entries.get(digest)
+            return self._entry_dict(e) if e is not None else None
+
+    def estimate(self, digest: str) -> Optional[Dict[str, Any]]:
+        """Historical per-query estimate for EXPLAIN's estimatedCost:
+        mean cost vector + latency percentiles over the rolling window,
+        or None when this shape has never executed here."""
+        with self._lock:
+            e = self._entries.get(digest)
+            if e is None or e.count == 0:
+                return None
+            lat = sorted(e.latency)
+            return {
+                "execCount": e.count,
+                "latencyP50Ms": round(_percentile(lat, 50), 3),
+                "latencyP95Ms": round(_percentile(lat, 95), 3),
+                "perQuery": {
+                    k: round(v / e.count, 3) for k, v in sorted(e.cost.items())
+                },
+            }
+
+    def snapshot(self, top: int = 50, by: str = "count") -> Dict[str, Any]:
+        return {
+            "digests": self.digest_count(),
+            "totalRecorded": self.total_recorded,
+            "capacity": self.capacity,
+            "orderedBy": by,
+            "plans": self.top(top, by=by),
+        }

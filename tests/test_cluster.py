@@ -143,9 +143,10 @@ def test_cluster_matches_oracle(cluster, pql):
     broker, oracle, _ = cluster
     got = broker.handle_pql(pql).to_json()
     want = oracle.execute(optimize_request(parse_pql(pql))).to_json()
-    # requestId is broker-assigned (the oracle issues none); cost is
-    # path-dependent execution accounting the oracle doesn't produce
-    for k in ("timeUsedMs", "requestId", "cost", "numEntriesScannedInFilter",
+    # requestId/planDigest are broker-assigned (the oracle issues
+    # neither); cost is path-dependent execution accounting
+    for k in ("timeUsedMs", "requestId", "planDigest", "cost",
+              "numEntriesScannedInFilter",
               "numEntriesScannedPostFilter", "numSegmentsQueried",
               "numServersQueried", "numServersResponded"):
         got.pop(k, None)

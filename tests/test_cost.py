@@ -161,6 +161,84 @@ def test_cost_invariant_under_replica_failover(cost_cluster):
         spy.inner.set_down((victim, 0), down=False)
 
 
+def _sum_node_actuals(resp):
+    summed = {}
+    for node in resp.explain["servers"]:
+        for k, v in (node.get("actualCost") or {}).items():
+            summed[k] = summed.get(k, 0) + v
+    return summed
+
+
+def test_explain_analyze_actuals_sum_to_merged_cost(cost_cluster):
+    """EXPLAIN ANALYZE per-server plan-node actuals sum EXACTLY to the
+    merged BrokerResponse.cost (the introspection plane's core honesty
+    invariant, sibling of the broker == Σ servers cost invariant)."""
+    cluster, spy, total = cost_cluster
+    resp = cluster.query("EXPLAIN ANALYZE SELECT count(*) FROM testTable")
+    assert not resp.exceptions
+    assert resp.explain["mode"] == "analyze"
+    summed = _sum_node_actuals(resp)
+    assert set(summed) == set(resp.cost)
+    for k, v in resp.cost.items():
+        assert math.isclose(summed[k], v, rel_tol=1e-9), k
+    assert resp.explain["actualDocsScanned"] == resp.num_docs_scanned == total
+
+
+def test_explain_analyze_actuals_sum_under_replica_failover(cost_cluster):
+    """A dead replica's attempts deliver no data (and no plan node):
+    after failover only the MERGED replies' nodes survive, so the
+    actuals still sum exactly to the merged cost."""
+    cluster, spy, total = cost_cluster
+    victim = cluster.servers[0].name
+    spy.inner.set_down((victim, 0))
+    try:
+        spy.replies.clear()
+        resp = cluster.query("EXPLAIN ANALYZE SELECT count(*) FROM testTable")
+        assert not resp.exceptions
+        assert resp.num_retries >= 1 and not resp.partial_response
+        summed = _sum_node_actuals(resp)
+        assert set(summed) == set(resp.cost)
+        for k, v in resp.cost.items():
+            assert math.isclose(summed[k], v, rel_tol=1e-9), k
+        assert resp.explain["actualDocsScanned"] == total
+        # exactly the merged replies carry nodes: no phantom/duplicate
+        # attribution from the failed attempts
+        assert len(resp.explain["servers"]) == len(spy.replies)
+    finally:
+        spy.inner.set_down((victim, 0), down=False)
+
+
+def test_explain_analyze_actuals_sum_under_partial_response(tmp_path):
+    """Replication=1 with a dead server: the response degrades honestly
+    AND the surviving servers' plan-node actuals still equal the merged
+    cost — unserved segments attribute to nobody."""
+    cluster = InProcessCluster(num_servers=2, data_dir=str(tmp_path))
+    try:
+        schema = make_test_schema(with_mv=False)
+        physical = cluster.add_offline_table(schema, replication=1)
+        rows = random_rows(schema, 1200, seed=17)
+        for i in range(4):
+            cluster.upload(
+                physical,
+                build_segment(
+                    schema, rows[i * 300 : (i + 1) * 300], physical, f"xseg{i}"
+                ),
+            )
+        spy = _SpyTransport(cluster.transport)
+        cluster.broker.transport = spy
+        victim = cluster.servers[0].name
+        spy.inner.set_down((victim, 0))
+        resp = cluster.query("EXPLAIN ANALYZE SELECT count(*) FROM testTable")
+        assert resp.partial_response and resp.num_segments_unserved > 0
+        summed = _sum_node_actuals(resp)
+        assert set(summed) == set(resp.cost)
+        for k, v in resp.cost.items():
+            assert math.isclose(summed[k], v, rel_tol=1e-9), k
+        assert 0 < resp.explain["actualDocsScanned"] < 1200
+    finally:
+        cluster.stop()
+
+
 def test_cost_invariant_under_hedging(cost_cluster):
     """A hedged attempt's winner covers the identical segment set: the
     response cost must match the steady-state answer exactly for the
