@@ -36,16 +36,33 @@ class NetworkedBrokerStarter:
         heartbeat_interval_s: float = 1.0,
         poll_interval_s: float = 0.3,
         conf=None,
+        fault_injector=None,
     ) -> None:
         self.controller_url = controller_url.rstrip("/")
         self.name = name
+        # link-level chaos hook (common/faults.py): the clusterstate
+        # poll/heartbeat ride link (name -> "controller"), and the
+        # scatter transport consults the injector per server link
+        self.fault_injector = fault_injector
+        transport = TcpTransport()
+        if fault_injector is not None:
+            from pinot_tpu.common.faults import LinkFaultTransport
+
+            transport = LinkFaultTransport(
+                transport, fault_injector, src=name,
+                resolve=self._server_of_address,
+            )
         if conf is not None:
             # BrokerConf resilience knobs (retry/hedge/circuit-breaker)
             self.handler = BrokerRequestHandler.from_conf(
-                TcpTransport(), {}, conf, name=name
+                transport, {}, conf, name=name
             )
         else:
-            self.handler = BrokerRequestHandler(TcpTransport(), {}, name=name)
+            self.handler = BrokerRequestHandler(transport, {}, name=name)
+        if fault_injector is not None:
+            # netfaults.* attribution on THIS broker's registry (the
+            # handler — and so the registry — exists only now)
+            transport.metrics = self.handler.metrics
         self.http = BrokerHttpServer(self.handler, host=host, port=port)
         self.heartbeat_interval_s = heartbeat_interval_s
         self.poll_interval_s = poll_interval_s
@@ -54,19 +71,66 @@ class NetworkedBrokerStarter:
         self._dead_servers: set = set()
         self._stop = threading.Event()
         self._threads: list = []
+        # partition observability + jittered retry cadence: while the
+        # controller is unreachable this broker keeps serving from its
+        # last versioned snapshot and says so on a gauge
+        from pinot_tpu.utils.retry import FullJitterBackoff
 
-    def _post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
-        req = urllib.request.Request(
-            self.controller_url + path,
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
+        self._poll_backoff = FullJitterBackoff(
+            initial_s=max(0.1, poll_interval_s), cap_s=10.0
         )
-        with urllib.request.urlopen(req, timeout=10) as r:
-            return json.loads(r.read())
+        # heartbeat backoff stays under typical liveness timeouts: under
+        # an asymmetric partition (replies lost, requests arriving) a
+        # deep backoff would flap this live broker dead at the controller
+        self._hb_backoff = FullJitterBackoff(
+            initial_s=max(0.1, heartbeat_interval_s), cap_s=2.0
+        )
+        # per-request timeout for heartbeats, tightened with the backoff
+        # cap (_register) so a blackholed request fails well before the
+        # liveness window elapses
+        self._hb_timeout_s = 10.0
+        self.handler.metrics.gauge("controller.unreachable").set(0)
+        self.handler.metrics.meter("controller.pollFailures")
+        self.handler.metrics.meter("controller.allDeadSnapshotsHeld")
+
+    def _server_of_address(self, address) -> str:
+        """Reverse-resolve a TCP address to the server's instance name
+        for link-injection (falls back to ``host:port``)."""
+        addr = (address[0], int(address[1]))
+        # snapshot: the poll thread mutates this dict via
+        # set_server_address while scatter calls resolve concurrently
+        for server, known in list(self.handler.server_addresses.items()):
+            if (known[0], int(known[1])) == addr:
+                return server
+        return f"{address[0]}:{address[1]}"
+
+    def _link(self, fn):
+        from pinot_tpu.common.faults import call_on_controller_link
+
+        return call_on_controller_link(
+            self.fault_injector, self.name, fn, metrics=self.handler.metrics
+        )
+
+    def _post(
+        self, path: str, payload: Dict[str, Any], timeout_s: float = 10.0
+    ) -> Dict[str, Any]:
+        def send():
+            req = urllib.request.Request(
+                self.controller_url + path,
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return json.loads(r.read())
+
+        return self._link(send)
 
     def _get(self, path: str) -> Dict[str, Any]:
-        with urllib.request.urlopen(self.controller_url + path, timeout=10) as r:
-            return json.loads(r.read())
+        def send():
+            with urllib.request.urlopen(self.controller_url + path, timeout=10) as r:
+                return json.loads(r.read())
+
+        return self._link(send)
 
     def start(self) -> None:
         self.http.start()
@@ -84,30 +148,71 @@ class NetworkedBrokerStarter:
         self.http.stop()
 
     def _register(self) -> None:
-        self._post(
+        # rides the heartbeat loop on reregister: must respect the same
+        # tightened request timeout as the heartbeats themselves (a 10s
+        # blackholed POST would blow the liveness window on its own)
+        out = self._post(
             "/instances",
             {
                 "name": self.name,
                 "role": "broker",
                 "url": f"http://{self.http.host}:{self.http.port}",
             },
+            timeout_s=self._hb_timeout_s,
         )
+        # keep the worst-case heartbeat gap under the controller's
+        # advertised liveness timeout (same reasoning as the server
+        # starter: an asymmetric partition must not flap us dead) —
+        # backoff cap and per-request timeout each take a third of the
+        # window
+        timeout = out.get("heartbeatTimeoutSeconds")
+        if timeout:
+            from pinot_tpu.utils.retry import tighten_liveness_budget
+
+            self._hb_timeout_s = tighten_liveness_budget(
+                self._hb_backoff, float(timeout), self._hb_timeout_s
+            )
 
     def _heartbeat_loop(self) -> None:
-        while not self._stop.wait(self.heartbeat_interval_s):
+        wait_s = self.heartbeat_interval_s
+        while not self._stop.wait(wait_s):
             try:
-                out = self._post(f"/instances/{self.name}/heartbeat", {})
+                out = self._post(
+                    f"/instances/{self.name}/heartbeat",
+                    {},
+                    timeout_s=self._hb_timeout_s,
+                )
                 if out.get("reregister"):
                     self._register()
+                self._hb_backoff.reset()
+                wait_s = self.heartbeat_interval_s
             except Exception as e:
-                logger.warning("heartbeat to controller failed: %s", e)
+                wait_s = self._hb_backoff.next_delay()
+                logger.warning(
+                    "heartbeat to controller failed (retry in %.2fs): %s",
+                    wait_s, e,
+                )
 
     def _poll_loop(self) -> None:
-        while not self._stop.wait(self.poll_interval_s):
+        wait_s = self.poll_interval_s
+        unreachable = self.handler.metrics.gauge("controller.unreachable")
+        while not self._stop.wait(wait_s):
             try:
                 self._refresh()
+                self._poll_backoff.reset()
+                unreachable.set(0)
+                wait_s = self.poll_interval_s
             except Exception as e:
-                logger.warning("cluster-state poll failed: %s", e)
+                # partitioned from the controller: this broker keeps
+                # routing from its last VERSIONED snapshot (already
+                # applied atomically) and retries with full jitter —
+                # visible on the controller.unreachable gauge
+                self.handler.metrics.meter("controller.pollFailures").mark()
+                unreachable.set(1)
+                wait_s = self._poll_backoff.next_delay()
+                logger.warning(
+                    "cluster-state poll failed (retry in %.2fs): %s", wait_s, e
+                )
 
     def _refresh(self, force: bool = False) -> None:
         state = self._get(
@@ -122,6 +227,22 @@ class NetworkedBrokerStarter:
         """Apply one versioned cluster-state snapshot (split out of
         ``_refresh`` so the quota/routing propagation rules are testable
         against synthetic snapshots)."""
+        if not state.get("servers") and self.handler.server_addresses:
+            # the controller says EVERY server is gone while we hold
+            # live routing.  That is epistemically indistinguishable
+            # from the CONTROLLER having been the partitioned one
+            # (e.g. the whole fleet's heartbeats are still in their
+            # post-heal backoff): keep serving from the last snapshot —
+            # if the fleet is truly down the scatter fails identically,
+            # and if the controller is wrong we stay available.  The
+            # version is NOT advanced, so every poll refetches until
+            # the controller sees servers again.
+            self.handler.metrics.meter("controller.allDeadSnapshotsHeld").mark()
+            logger.warning(
+                "cluster-state snapshot lists no live servers; holding "
+                "the previous routing (version %d)", self._version,
+            )
+            return
         self._version = state["version"]
         self._epoch = state.get("epoch", "")
         for server, addr in state["servers"].items():

@@ -29,6 +29,18 @@ faults — failed launches (retryable or poison), stalls that wedge the
 lane thread (the watchdog trigger), and per-plan-digest poisoning — so
 the self-healing path (device retry, watchdog restart, host failover,
 poison quarantine) runs deterministically on a CPU test rig.
+
+``NetworkFaultInjector`` is the partition layer: it models the NETWORK
+between roles as directed links keyed by instance NAME (``src -> dst``)
+rather than one server's address, so a single injector shared by every
+role-pair transport (broker<->server scatter, server<->controller
+heartbeat/commit/fetch, broker<->controller clusterstate poll) can cut,
+delay, duplicate, or one-way-partition any link in the cluster.  The
+physical model is per-DIRECTION packet loss: cutting ``a -> b`` loses
+a's requests before they reach b (and b's replies to a ride ``b -> a``,
+so cutting only that direction delivers a's request — side effects
+happen at b! — and then loses the reply, which is exactly the
+asymmetric-partition shape that makes lease fencing necessary).
 """
 from __future__ import annotations
 
@@ -238,3 +250,206 @@ class DeviceFaultInjector:
             # sleep OUTSIDE the injector lock, inside the lane thread:
             # this is the wedge the watchdog must detect
             time.sleep(stall)
+
+
+# ---------------------------------------------------------------------------
+# Link-level network fault injection (the partition-tolerance chaos hook)
+# ---------------------------------------------------------------------------
+
+# the controller's link name: every role-pair link has instance names at
+# both ends, and the controller is a singleton role
+CONTROLLER_LINK = "controller"
+
+
+class PartitionedLinkError(TransportError):
+    """Injected: the packet (request or reply) died on a cut link."""
+
+
+@dataclass
+class LinkSpec:
+    """Quality degradation for one directed link (``src -> dst``).
+    A cut link is tracked separately (``NetworkFaultInjector.cut``)."""
+
+    delay_s: float = 0.0
+    duplicate: bool = False  # deliver the request twice (at-least-once wire)
+    error_rate: float = 0.0  # flaky link: seeded per-call loss probability
+
+
+@dataclass
+class LinkEvent:
+    src: str
+    dst: str
+    # "ok" | "dropped" | "replyDropped" | "delayed" | "duplicated" | "flaky"
+    outcome: str
+
+
+class NetworkFaultInjector:
+    """Seedable, name-keyed link-fault programming for EVERY role pair.
+
+    One injector instance is shared by all the transports/HTTP clients
+    of a cluster under test; each call site identifies itself with
+    ``(src, dst)`` instance names and routes its RPC through ``call``:
+
+    - ``cut(a, b)``                — packets a->b are dropped: a's
+      requests to b raise ``PartitionedLinkError`` WITHOUT reaching b.
+    - ``cut(b, a)`` (reply path)   — a's requests reach b (side effects
+      happen!), but the reply is lost: a still sees a transport error.
+      This is the one-way partition that distinguishes a live-but-
+      unreachable server from a dead one.
+    - ``partition(a, b)``          — both directions (symmetric cut).
+    - ``set_link(a, b, ...)``      — delay / duplicate / seeded flaky
+      loss on a live link.
+    - ``heal(...)``                — clear one link, every link touching
+      a node, or everything.
+
+    Every decision is recorded in ``events`` (and optionally marked on a
+    per-role metrics registry as ``netfaults.*``) so chaos tests can
+    assert exactly which links absorbed the injected weather.
+    """
+
+    _EVENT_RING = 4096  # bounded: long harness runs must not grow RAM
+
+    def __init__(self, seed: int = 0, metrics=None) -> None:
+        from collections import deque
+
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._cuts: set = set()  # directed (src, dst) pairs
+        self._links: Dict[Tuple[str, str], LinkSpec] = {}
+        self.events = deque(maxlen=self._EVENT_RING)
+        # fallback registry; call sites pass their ROLE's registry per
+        # call so netfaults.* lands on the role that saw the weather
+        self.metrics = metrics
+
+    # -- fault programming --------------------------------------------
+    def cut(self, src: str, dst: str) -> None:
+        """Drop packets flowing ``src -> dst`` (one direction only)."""
+        with self._lock:
+            self._cuts.add((src, dst))
+
+    def partition(self, a: str, b: str) -> None:
+        """Symmetric partition: no packets flow between ``a`` and ``b``."""
+        with self._lock:
+            self._cuts.add((a, b))
+            self._cuts.add((b, a))
+
+    def set_link(self, src: str, dst: str, **kwargs: Any) -> LinkSpec:
+        spec = LinkSpec(**kwargs)
+        with self._lock:
+            self._links[(src, dst)] = spec
+        return spec
+
+    def heal(self, src: Optional[str] = None, dst: Optional[str] = None) -> None:
+        """``heal()`` clears everything; ``heal(node)`` clears every cut
+        and spec touching ``node``; ``heal(src, dst)`` clears that one
+        directed link."""
+        with self._lock:
+            if src is None:
+                self._cuts.clear()
+                self._links.clear()
+            elif dst is None:
+                self._cuts = {c for c in self._cuts if src not in c}
+                self._links = {
+                    k: v for k, v in self._links.items() if src not in k
+                }
+            else:
+                self._cuts.discard((src, dst))
+                self._links.pop((src, dst), None)
+
+    def is_cut(self, src: str, dst: str) -> bool:
+        with self._lock:
+            return (src, dst) in self._cuts
+
+    def events_for(self, src: str, dst: str) -> List[LinkEvent]:
+        with self._lock:
+            return [e for e in self.events if e.src == src and e.dst == dst]
+
+    def _record(self, src: str, dst: str, outcome: str, metrics=None) -> None:
+        with self._lock:
+            self.events.append(LinkEvent(src, dst, outcome))
+        m = metrics if metrics is not None else self.metrics
+        if m is not None and outcome != "ok":
+            m.meter(f"netfaults.{outcome}").mark()
+
+    # -- the one call-site hook ----------------------------------------
+    def call(self, src: str, dst: str, fn, metrics=None):
+        """Run one RPC (``fn``) over the ``src -> dst`` link.
+
+        May raise ``PartitionedLinkError`` WITHOUT invoking ``fn``
+        (request lost), may invoke ``fn`` and then raise (reply lost on
+        the cut ``dst -> src`` direction — the asymmetric case), may
+        sleep first (delay), may invoke ``fn`` twice and return the
+        SECOND reply (duplicate delivery: upstream handlers must be
+        idempotent — exactly what the at-least-once message board and
+        the epoch/lease commit fences are for).  ``metrics`` is the
+        CALLING role's registry for the ``netfaults.*`` attribution."""
+        with self._lock:
+            request_cut = (src, dst) in self._cuts
+            reply_cut = (dst, src) in self._cuts
+            spec = self._links.get((src, dst))
+            flaky = (
+                spec is not None
+                and spec.error_rate > 0.0
+                and self._rng.random() < spec.error_rate
+            )
+        if request_cut:
+            self._record(src, dst, "dropped", metrics)
+            raise PartitionedLinkError(f"injected: link {src}->{dst} is cut")
+        if flaky:
+            self._record(src, dst, "flaky", metrics)
+            raise PartitionedLinkError(f"injected: flaky link {src}->{dst}")
+        if spec is not None and spec.delay_s > 0.0:
+            self._record(src, dst, "delayed", metrics)
+            time.sleep(spec.delay_s)
+        if spec is not None and spec.duplicate:
+            # duplicate delivery: the first invocation's reply is
+            # discarded, as a retransmitted request's would be
+            self._record(src, dst, "duplicated", metrics)
+            fn()
+        reply = fn()
+        if reply_cut:
+            # the request executed at dst; the caller never learns
+            self._record(src, dst, "replyDropped", metrics)
+            raise PartitionedLinkError(
+                f"injected: reply lost on cut link {dst}->{src}"
+            )
+        self._record(src, dst, "ok", metrics)
+        return reply
+
+
+def call_on_controller_link(injector, src: str, fn, metrics=None):
+    """Shared call-site helper: run one controller-bound RPC through
+    ``injector`` as link ``src -> controller`` (plain call when no
+    injector is wired).  Used by both networked starters and the
+    gateway edge so the link contract lives in one place."""
+    if injector is None:
+        return fn()
+    return injector.call(src, CONTROLLER_LINK, fn, metrics=metrics)
+
+
+class LinkFaultTransport:
+    """Transport decorator consulting a ``NetworkFaultInjector`` per
+    request — the broker<->server scatter hook.  ``resolve`` maps a
+    transport address to the destination's instance name; the default
+    takes ``address[0]``, which IS the name for ``LocalTransport``
+    addresses (networked brokers pass a reverse lookup over their
+    server-address map)."""
+
+    def __init__(
+        self, inner, injector: NetworkFaultInjector, src: str, resolve=None,
+        metrics=None,
+    ) -> None:
+        self.inner = inner
+        self.injector = injector
+        self.src = src
+        self.metrics = metrics  # the owning role's registry (netfaults.*)
+        self._resolve = resolve or (lambda address: str(address[0]))
+
+    def request(self, address: Address, payload: bytes, timeout: float = 15.0) -> bytes:
+        dst = self._resolve(address)
+        return self.injector.call(
+            self.src,
+            dst,
+            lambda: self.inner.request(address, payload, timeout=timeout),
+            metrics=self.metrics,
+        )

@@ -1,0 +1,144 @@
+"""Fencing primitives for partition tolerance: epochs and leases.
+
+Reference Pinot outsources "who is alive and who may write" to
+Helix/ZooKeeper: a participant's authority is its ZK session (expires
+when the node is partitioned away), and a controller's authority is its
+leadership generation.  This module is the bespoke-controller analog:
+
+- **Controller epoch** — a monotonically increasing incarnation number
+  persisted in the property store (``cluster/epoch``).  Every store
+  write and every state-changing RPC is fenced on it: a restarted or
+  partitioned-away controller still holding an old epoch gets a typed
+  ``StaleEpochError`` instead of silently clobbering the live
+  controller's state (the ZK leader-generation fence).
+
+- **Serving lease** (``ServingLease``) — the server-side half of the ZK
+  session.  Heartbeat replies carry a controller-signed lease
+  ``{epoch, durationS}``; a server that cannot renew it within the
+  window (``PINOT_TPU_LEASE_S``) loses WRITE authority — no new
+  consuming roles, no segment commits — while the read path stays up
+  (in-flight and new queries keep serving from local data; routing
+  degradation is the broker's business).  A server that never received
+  a lease (in-process harness, no gateway) holds implicit authority:
+  the fence only arms once a controller has granted a lease.
+
+Both clocks are injectable so chaos tests advance time explicitly.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+def default_lease_s() -> float:
+    """Lease duration granted on each heartbeat (seconds)."""
+    return float(os.environ.get("PINOT_TPU_LEASE_S", "10"))
+
+
+class StaleEpochError(Exception):
+    """A write carried an epoch older than the cluster's current one:
+    the writer is a fenced-off former authority (restarted controller,
+    partitioned-away committer) and must not mutate anything."""
+
+    def __init__(self, message: str, stale: Any = None, current: Any = None) -> None:
+        super().__init__(message)
+        self.stale = stale
+        self.current = current
+
+
+def epoch_int(value: Any) -> int:
+    """Parse an epoch from wire/json forms (int, numeric string).
+    Unparseable/absent values come back as -1 — always stale, so an
+    epoch-less legacy caller can never fence OUT a real epoch holder."""
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return -1
+
+
+class ServingLease:
+    """The server's view of its controller-granted serving lease.
+
+    States:
+    - *unleased* (never granted): ``held()`` is True — implicit local
+      authority, the in-process/back-compat mode.
+    - *held*: renewed within the window.
+    - *expired*: the renewal stopped arriving (partition, controller
+      outage); write authority is gone until the next successful renew.
+    """
+
+    def __init__(self, clock=None, metrics=None) -> None:
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._granted = False
+        self._expires_at = 0.0
+        self._epoch = -1
+        self._was_held = False
+        self.metrics = metrics
+        if metrics is not None:
+            for m in ("lease.renewals", "lease.expiries"):
+                metrics.meter(m)
+            metrics.gauge("lease.held").set_fn(lambda: 1 if self.held() else 0)
+
+    def renew(self, lease: Optional[Dict[str, Any]]) -> None:
+        """Apply the ``lease`` block of a heartbeat reply
+        (``{"epoch": ..., "durationS": ...}``); None is ignored (a
+        legacy controller grants nothing — fence stays unarmed)."""
+        if not lease:
+            return
+        duration = float(lease.get("durationS") or default_lease_s())
+        with self._lock:
+            self._granted = True
+            self._epoch = epoch_int(lease.get("epoch"))
+            self._expires_at = self._clock() + duration
+            self._was_held = True
+        if self.metrics is not None:
+            self.metrics.meter("lease.renewals").mark()
+
+    def held(self) -> bool:
+        with self._lock:
+            if not self._granted:
+                return True  # unleased: implicit local authority
+            held = self._clock() < self._expires_at
+            if not held and self._was_held:
+                self._was_held = False
+                if self.metrics is not None:
+                    self.metrics.meter("lease.expiries").mark()
+            return held
+
+    def remaining_s(self) -> float:
+        with self._lock:
+            if not self._granted:
+                return float("inf")
+            return max(0.0, self._expires_at - self._clock())
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    @property
+    def granted(self) -> bool:
+        with self._lock:
+            return self._granted
+
+    def expire(self) -> None:
+        """Force-expire (tests / explicit self-fencing)."""
+        with self._lock:
+            self._expires_at = 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        held = self.held()  # outside the lock: held() takes it
+        with self._lock:
+            return {
+                "granted": self._granted,
+                "held": held,
+                "epoch": self._epoch,
+                "remainingS": (
+                    None
+                    if not self._granted
+                    else round(max(0.0, self._expires_at - self._clock()), 3)
+                ),
+            }

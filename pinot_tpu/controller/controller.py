@@ -17,6 +17,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
+from pinot_tpu.common.fencing import StaleEpochError
 from pinot_tpu.common.schema import Schema
 from pinot_tpu.common.tableconfig import TableConfig
 from pinot_tpu.controller import dashboard
@@ -34,23 +35,40 @@ logger = logging.getLogger(__name__)
 
 
 class Controller:
-    def __init__(self, data_dir: str, start_managers: bool = False) -> None:
+    def __init__(
+        self,
+        data_dir: str,
+        start_managers: bool = False,
+        lease_s: Optional[float] = None,
+        fault_injector=None,
+    ) -> None:
         from pinot_tpu.controller.property_store import PropertyStore
 
         self.property_store = PropertyStore(os.path.join(data_dir, "property_store"))
+        # claim the cluster-wide fencing epoch (ZK leader-generation
+        # analog): this incarnation owns the store from here on; any
+        # previously-constructed controller over the same store becomes
+        # a fenced zombie whose writes raise StaleEpochError
+        self.epoch = self.property_store.claim_epoch()
         self.resources = ClusterResourceManager(property_store=self.property_store)
         self.store = SegmentStore(os.path.join(data_dir, "segments"))
         self.metrics = ControllerMetrics("controller")
         # pre-register the control-plane series so /metrics exposes
         # them at zero from process start
         for m in ("instanceRegistrations", "heartbeats", "instancesMarkedDead",
-                  "transitionAcks", "clusterStatePolls", "segmentUploads"):
+                  "transitionAcks", "clusterStatePolls", "segmentUploads",
+                  "lease.granted", "fence.staleEpochRejections",
+                  "fence.leaseRejections", "fence.committerReElections"):
             self.metrics.meter(m)
+        self.metrics.gauge("fence.epoch").set(self.epoch)
         from pinot_tpu.realtime.llc import RealtimeSegmentManager
 
         self.realtime_manager = RealtimeSegmentManager(
             self.resources, self.store, metrics=self.metrics
         )
+        # arm the commit-plane fence: segmentConsumed/segmentCommit
+        # carry the caller's lease epoch; a mismatch is typed-rejected
+        self.realtime_manager.epoch = self.epoch
         self.retention_manager = RetentionManager(self.resources, self.store)
         self.validation_manager = ValidationManager(
             self.resources, realtime_manager=self.realtime_manager
@@ -68,9 +86,20 @@ class Controller:
         from pinot_tpu.controller.network import ParticipantGateway
 
         # remote-instance control plane (started by ControllerHttpServer)
-        self.gateway = ParticipantGateway(self.resources, metrics=self.metrics)
+        self.gateway = ParticipantGateway(
+            self.resources,
+            metrics=self.metrics,
+            epoch=self.epoch,
+            lease_s=lease_s,
+            fault_injector=fault_injector,
+        )
         self.gateway.on_server_available = (
             self.realtime_manager.ensure_consuming_segments
+        )
+        # committer liveness for the completion FSM: a committer whose
+        # lease expired (partitioned away mid-upload) is re-electable
+        self.realtime_manager.completion.lease_checker = (
+            self.gateway.server_lease_valid
         )
 
         self._recover()
@@ -660,6 +689,20 @@ class ControllerHttpServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _respond_stale(self, e: StaleEpochError) -> None:
+                # typed fencing rejection (409 Conflict): the caller —
+                # or this controller — is a fenced-off former
+                # authority; nothing was mutated
+                return self._respond(
+                    {
+                        "error": str(e),
+                        "errorType": "StaleEpochError",
+                        "staleEpoch": e.stale,
+                        "currentEpoch": e.current,
+                    },
+                    409,
+                )
+
             def do_GET(self):
                 url = urlparse(self.path)
                 parts = _split_path(url.path)
@@ -848,17 +891,21 @@ class ControllerHttpServer:
                         return self._respond({"status": "ok", "table": physical})
                     if parts == ["realtime", "consumed"]:
                         # LLC completion protocol: segmentConsumed
-                        # (SegmentCompletionProtocol responses)
+                        # (SegmentCompletionProtocol responses); the
+                        # caller's lease epoch rides the payload and is
+                        # fence-checked (typed 409 on mismatch)
                         body = self._read_json()
                         resp, target = ctrl.realtime_manager.completion.segment_consumed(
-                            body["segment"], body["server"], int(body["offset"])
+                            body["segment"], body["server"], int(body["offset"]),
+                            epoch=body.get("epoch"),
                         )
                         return self._respond(
                             {"response": resp, "targetOffset": target}
                         )
                     if len(parts) == 4 and parts[:2] == ["realtime", "commit"]:
                         # committer upload: POST /realtime/commit/{segment}/{server}
-                        # body = segment file bytes (segmentCommit)
+                        # body = segment file bytes (segmentCommit);
+                        # ?epoch= carries the committer's lease epoch
                         import tempfile
 
                         from pinot_tpu.segment.format import (
@@ -866,14 +913,34 @@ class ControllerHttpServer:
                             read_segment,
                         )
 
+                        qs = parse_qs(url.query)
+                        epoch = (qs.get("epoch") or [None])[0]
                         n = int(self.headers.get("Content-Length", "0"))
+                        completion = ctrl.realtime_manager.completion
+                        # fence BEFORE buffering/parsing the upload: a
+                        # fenced-off committer (stale epoch -> typed
+                        # 409, expired lease -> NOT_LEADER) retrying in
+                        # a storm must not cost O(segment bytes) per
+                        # rejection.  The body is still drained so the
+                        # client reads the verdict instead of hitting a
+                        # connection reset mid-send.
+                        try:
+                            fenced = completion.commit_fence_check(
+                                parts[2], parts[3], epoch=epoch
+                            )
+                        except StaleEpochError:
+                            self.rfile.read(n)
+                            raise
+                        if fenced is not None:
+                            self.rfile.read(n)
+                            return self._respond({"response": fenced})
                         data = self.rfile.read(n)
                         with tempfile.TemporaryDirectory() as td:
                             with open(os.path.join(td, SEGMENT_FILE_NAME), "wb") as f:
                                 f.write(data)
                             committed = read_segment(td)
-                        resp = ctrl.realtime_manager.completion.segment_commit(
-                            parts[2], parts[3], committed
+                        resp = completion.segment_commit(
+                            parts[2], parts[3], committed, epoch=epoch
                         )
                         return self._respond({"response": resp})
                     if parts == ["tenants"]:
@@ -919,6 +986,8 @@ class ControllerHttpServer:
                         )
                         return self._respond({"status": "ok", "segment": seg})
                     return self._respond({"error": "not found"}, 404)
+                except StaleEpochError as e:
+                    return self._respond_stale(e)
                 except Exception as e:
                     logger.warning("REST handler error", exc_info=True)
                     return self._respond({"error": str(e)}, 400)
@@ -936,6 +1005,10 @@ class ControllerHttpServer:
                         ctrl.delete_segment(parts[1], parts[3])
                         return self._respond({"status": "ok"})
                     return self._respond({"error": "not found"}, 404)
+                except StaleEpochError as e:
+                    # same typed 409 as do_POST: deletes hit the fenced
+                    # property-store path too on a zombie controller
+                    return self._respond_stale(e)
                 except Exception as e:
                     logger.warning("REST handler error", exc_info=True)
                     return self._respond({"error": str(e)}, 400)

@@ -163,7 +163,12 @@ class ParticipantGateway:
         flap_hold_base_s: float = 5.0,
         flap_hold_max_s: float = 300.0,
         clock=None,
+        epoch: Optional[int] = None,
+        lease_s: Optional[float] = None,
+        fault_injector=None,
     ) -> None:
+        from pinot_tpu.common.fencing import default_lease_s
+
         self.resources = resources
         self.board = MessageBoard()
         # optional ControllerMetrics: control-plane traffic counters
@@ -171,6 +176,15 @@ class ParticipantGateway:
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self._check_interval_s = check_interval_s
         self._heartbeats: Dict[str, float] = {}
+        # serving leases (common/fencing.py): every heartbeat reply
+        # grants write authority for lease_s; the stabilizer will not
+        # move a dead server's replicas before its lease window closes
+        self.lease_s = lease_s if lease_s is not None else default_lease_s()
+        # link-level chaos hook (common/faults.py NetworkFaultInjector):
+        # instance-named control-plane calls consult it at the
+        # controller edge, so a cut server->controller link drops
+        # heartbeats even when the client was not injector-wired
+        self.fault_injector = fault_injector
         # flap hysteresis: dead->alive cycles inside flap_window_s; at
         # flap_threshold the re-admit is HELD for an escalating window
         # (doubling per extra flap, capped) so the stabilizer never
@@ -189,8 +203,15 @@ class ParticipantGateway:
         # realtime manager's ensure_consuming_segments
         self.on_server_available = None
         # incarnation id: cluster-state versions are only comparable
-        # within one controller process lifetime (see /clusterstate)
-        self.epoch = f"{os.getpid()}-{time.monotonic_ns()}"
+        # within one controller process lifetime (see /clusterstate).
+        # Wired from the Controller this is the PERSISTED integer
+        # fencing epoch (property store cluster/epoch) — the cluster-
+        # wide write-fencing token; standalone gateways fall back to a
+        # process-unique string (snapshot identity only, fence unarmed).
+        if epoch is not None:
+            self.epoch = str(int(epoch))
+        else:
+            self.epoch = f"{os.getpid()}-{time.monotonic_ns()}"
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -268,8 +289,55 @@ class ParticipantGateway:
             self.metrics.meter("gateway.flaps").mark()
         return None
 
+    # -- leases --------------------------------------------------------
+    def _grant_lease(self, name: str) -> Dict[str, Any]:
+        """Record + serialize a serving lease for one instance.  The
+        lease rides every heartbeat/registration reply; its epoch is the
+        controller's fencing incarnation, so a commit sent under an old
+        controller's lease is typed-rejected after a failover."""
+        now = self._clock()
+        inst = self.resources.instances.get(name)
+        if inst is not None:
+            inst.lease_until = now + self.lease_s
+        if self.metrics is not None:
+            self.metrics.meter("lease.granted").mark()
+        return {"epoch": self.fencing_epoch, "durationS": self.lease_s}
+
+    def server_lease_valid(self, name: str) -> bool:
+        """True while ``name`` holds an unexpired serving lease.  An
+        instance that was never granted one (in-process participant, no
+        heartbeats) keeps implicit authority — the fence only arms once
+        leases are being issued for it."""
+        inst = self.resources.instances.get(name)
+        if inst is None or inst.lease_until is None:
+            return inst is not None
+        return self._clock() < inst.lease_until
+
+    @property
+    def fencing_epoch(self) -> int:
+        # derived from the string epoch when it is an integer
+        # incarnation (Controller-wired); -1 disarms the fence
+        from pinot_tpu.common.fencing import epoch_int
+
+        return epoch_int(self.epoch)
+
+    def _linked(self, src: str, fn):
+        """Route one instance-named control-plane call through the link
+        injector (no-op without one).  This is the CONTROLLER-EDGE
+        hook, for harnesses that cannot wire the client processes; an
+        in-process harness that injector-wires its clients must NOT
+        also wire the gateway, or faults double-apply on these links."""
+        from pinot_tpu.common.faults import call_on_controller_link
+
+        return call_on_controller_link(
+            self.fault_injector, src, fn, metrics=self.metrics
+        )
+
     # -- instance API (called from HTTP handlers) ----------------------
     def register(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        return self._linked(payload["name"], lambda: self._register(payload))
+
+    def _register(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         name = payload["name"]
         role = payload.get("role", "server")
         if self.metrics is not None:
@@ -322,9 +390,13 @@ class ParticipantGateway:
             "status": "ok",
             "heartbeatTimeoutSeconds": self.heartbeat_timeout_s,
             "draining": state.draining,
+            "lease": self._grant_lease(name),
         }
 
     def heartbeat(self, name: str) -> Dict[str, Any]:
+        return self._linked(name, lambda: self._heartbeat(name))
+
+    def _heartbeat(self, name: str) -> Dict[str, Any]:
         if self.metrics is not None:
             self.metrics.meter("heartbeats").mark()
         inst = self.resources.instances.get(name)
@@ -337,7 +409,8 @@ class ParticipantGateway:
             if hold is not None:
                 # flapping: stays out of routing until the hold expires
                 # (the heartbeat is still recorded so the monitor loop
-                # doesn't pile a fresh death on top)
+                # doesn't pile a fresh death on top) — and NO lease: a
+                # held instance has no write authority either
                 return {
                     "status": "held",
                     "holdSeconds": round(hold, 3),
@@ -347,7 +420,7 @@ class ParticipantGateway:
             self._kick_server_available()
         # drain ack rides the heartbeat reply: a draining server learns
         # its state without a dedicated poll and surfaces it in status()
-        return {"status": "ok", "draining": inst.draining}
+        return {"status": "ok", "draining": inst.draining, "lease": self._grant_lease(name)}
 
     def _kick_server_available(self) -> None:
         """A server just became available: run deferred repairs (e.g.
@@ -367,9 +440,12 @@ class ParticipantGateway:
         threading.Thread(target=run, daemon=True).start()
 
     def messages(self, name: str) -> List[Dict[str, Any]]:
-        return self.board.fetch(name)
+        return self._linked(name, lambda: self.board.fetch(name))
 
     def ack(self, name: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        return self._linked(name, lambda: self._ack(name, payload))
+
+    def _ack(self, name: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         if self.metrics is not None:
             self.metrics.meter("transitionAcks").mark()
         self.board.remove(name, payload.get("msgId"))

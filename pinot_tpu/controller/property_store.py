@@ -17,15 +17,31 @@ Namespaces:
                                download dir + realtime partition/offset
   streams/<physical>.json      stream-provider descriptor for realtime
                                tables (so consumption resumes)
+  cluster/epoch.json           the controller-incarnation fencing token
+
+Epoch fencing (the ZK leader-generation analog): a controller claims
+authority at construction by bumping ``cluster/epoch`` and becomes the
+store's writer; every subsequent ``put``/``delete`` re-reads the stored
+epoch and raises a typed ``StaleEpochError`` when a NEWER incarnation
+has claimed the store since — so a partitioned-away or zombie
+controller cannot clobber the live one's state (split-brain safety).
+A store without a writer epoch (bare/test use) is unfenced.
 """
 from __future__ import annotations
 
+import fcntl
 import json
 import os
 import threading
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
+from pinot_tpu.common.fencing import StaleEpochError
 from pinot_tpu.utils.fileio import atomic_write
+
+CLUSTER_NS = "cluster"
+EPOCH_KEY = "epoch"
+_FENCE_LOCK_FILE = ".fence.lock"  # never matches an encoded record name
 
 _SAFE = "-_"  # NOT '.', or a '..' component would survive encoding
 
@@ -46,6 +62,13 @@ class PropertyStore:
     def __init__(self, base_dir: str) -> None:
         self.base_dir = base_dir
         self._lock = threading.Lock()
+        # None = unfenced (bare/test stores); set via claim_epoch()
+        self._writer_epoch: Optional[int] = None
+        # persistent fence-lock fd (opened on first fenced use): flock
+        # is per open-file-description, so one long-lived fd gives
+        # cross-PROCESS exclusion without 3 syscalls per write; the
+        # thread lock above covers threads sharing this fd
+        self._fence_fd = None
         os.makedirs(base_dir, exist_ok=True)
 
     def _ns_dir(self, namespace: str) -> str:
@@ -57,10 +80,77 @@ class PropertyStore:
     def _path(self, namespace: str, key: str) -> str:
         return os.path.join(self._ns_dir(namespace), _encode_key(key))
 
+    # -- epoch fencing -------------------------------------------------
+    @contextmanager
+    def _exclusive(self, force_flock: bool = False):
+        """Thread lock + cross-PROCESS file lock over the store: the
+        fence check and the write it guards must be one atomic unit, or
+        a zombie's in-flight write could land just after a newer
+        incarnation claims the store (check-then-act race).  Unfenced
+        stores (no claimed epoch — bare/test use) skip the file lock:
+        their fence check is a no-op, so the thread lock alone is the
+        pre-fencing behavior."""
+        with self._lock:
+            if self._writer_epoch is None and not force_flock:
+                yield
+                return
+            if self._fence_fd is None:
+                self._fence_fd = open(
+                    os.path.join(self.base_dir, _FENCE_LOCK_FILE), "a+b"
+                )
+            fcntl.flock(self._fence_fd, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(self._fence_fd, fcntl.LOCK_UN)
+
+    def stored_epoch(self) -> int:
+        """The incarnation currently holding the store (0 = unclaimed).
+        Read from disk every time: the whole point is seeing a NEWER
+        claimant that may live in another process."""
+        path = self._path(CLUSTER_NS, EPOCH_KEY)
+        if not os.path.exists(path):
+            return 0
+        try:
+            with open(path) as f:
+                return int(json.load(f).get("epoch", 0))
+        except (ValueError, OSError):
+            return 0
+
+    @property
+    def writer_epoch(self) -> Optional[int]:
+        return self._writer_epoch
+
+    def claim_epoch(self) -> int:
+        """Claim write authority: bump ``cluster/epoch`` and become the
+        store's writer.  Every OLDER incarnation's writes are rejected
+        from this moment (their next ``put``/``delete`` raises
+        ``StaleEpochError``)."""
+        with self._exclusive(force_flock=True):
+            epoch = self.stored_epoch() + 1
+            path = self._path(CLUSTER_NS, EPOCH_KEY)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            atomic_write(path, json.dumps({"epoch": epoch}))
+            self._writer_epoch = epoch
+        return epoch
+
+    def _check_fence(self) -> None:
+        if self._writer_epoch is None:
+            return
+        stored = self.stored_epoch()
+        if stored > self._writer_epoch:
+            raise StaleEpochError(
+                f"property store claimed by epoch {stored}; this writer "
+                f"holds stale epoch {self._writer_epoch}",
+                stale=self._writer_epoch,
+                current=stored,
+            )
+
     def put(self, namespace: str, key: str, record: Dict[str, Any]) -> None:
         path = self._path(namespace, key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        with self._lock:
+        with self._exclusive():
+            self._check_fence()
             atomic_write(path, json.dumps(record))
 
     def get(self, namespace: str, key: str) -> Optional[Dict[str, Any]]:
@@ -72,7 +162,8 @@ class PropertyStore:
 
     def delete(self, namespace: str, key: str) -> None:
         path = self._path(namespace, key)
-        with self._lock:
+        with self._exclusive():
+            self._check_fence()
             if os.path.exists(path):
                 os.unlink(path)
 
@@ -105,6 +196,7 @@ class PropertyStore:
         import shutil
 
         d = self._ns_dir(namespace)
-        with self._lock:
+        with self._exclusive():
+            self._check_fence()
             if os.path.isdir(d):
                 shutil.rmtree(d)

@@ -54,6 +54,13 @@ class InstanceState:
     # segment placement; the SelfStabilizer migrates its replicas off
     # so a rolling restart is drain -> restart -> rejoin (undrain)
     draining: bool = False
+    # serving-lease expiry (monotonic deadline, ParticipantGateway
+    # clock): None = never leased (in-process participant — implicit
+    # authority, and the stabilizer applies only its grace window).
+    # While ``now < lease_until`` a heartbeat-missing server may STILL
+    # be alive-but-partitioned and serving from its last snapshot, so
+    # the stabilizer must not move its replicas yet (lease fence).
+    lease_until: Optional[float] = None
 
 
 class Participant:

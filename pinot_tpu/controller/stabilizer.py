@@ -84,6 +84,7 @@ class SelfStabilizer(_PeriodicManager):
             "stabilizer.replicasDropped",
             "stabilizer.consumingReassigned",
             "stabilizer.graceDeferrals",
+            "stabilizer.leaseDeferrals",
         ):
             self.metrics.meter(m)
         for g in (
@@ -152,6 +153,11 @@ class SelfStabilizer(_PeriodicManager):
                 for n, i in res.instances.items()
                 if i.role == "server"
             }
+            lease_until = {
+                n: i.lease_until
+                for n, i in res.instances.items()
+                if i.role == "server"
+            }
         healthy = {n for n, (a, d, _) in server_state.items() if a and not d}
         draining = {n for n, (a, d, _) in server_state.items() if a and d}
 
@@ -164,8 +170,12 @@ class SelfStabilizer(_PeriodicManager):
         def actionable_dead(s: str) -> bool:
             """Dead AND past the grace window (tracking starts at first
             observation, so a controller restarted mid-outage re-waits
-            the window rather than acting on a stale clock).  Memoized
-            per round: the deferral meter counts servers, not replicas."""
+            the window rather than acting on a stale clock) AND past its
+            serving lease — a heartbeat-missing server whose lease has
+            not expired may be alive-but-partitioned and still serving,
+            so replicas move only after the lease window, never on a
+            single missed heartbeat.  Memoized per round: the deferral
+            meters count servers, not replicas."""
             if s in _actionable:
                 return _actionable[s]
             if not is_dead(s):
@@ -177,6 +187,17 @@ class SelfStabilizer(_PeriodicManager):
             ok = now - since >= self.grace_s
             if not ok:
                 self.metrics.meter("stabilizer.graceDeferrals").mark()
+            else:
+                until = lease_until.get(s)
+                if until is not None and now < until:
+                    # lease fence: confirmed-dead is "lease expired";
+                    # until then this is only "unreachable from here"
+                    ok = False
+                    self.metrics.meter("stabilizer.leaseDeferrals").mark()
+                    self._event(
+                        "leaseDeferral", server=s,
+                        remainingS=round(until - now, 3),
+                    )
             _actionable[s] = ok
             return ok
 

@@ -22,10 +22,12 @@ Segment naming: ``{table}__{partition}__{seq}`` (LLCSegmentName analog).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from pinot_tpu.common.fencing import StaleEpochError, epoch_int
 from pinot_tpu.common.schema import Schema
 from pinot_tpu.common.tableconfig import StreamConfig, TableConfig
 from pinot_tpu.controller.resource_manager import (
@@ -39,6 +41,17 @@ from pinot_tpu.realtime.stream import StreamProvider
 logger = logging.getLogger(__name__)
 
 MAX_HOLD_TIME_MS = 3000  # SegmentCompletionProtocol.java:50
+
+
+def _commit_stall_ms() -> float:
+    """How long an elected committer may go protocol-silent (no
+    segmentConsumed/segmentCommit calls) before the FSM re-elects a
+    caught-up replica (the reference's max-segment-commit-time,
+    ``controller.realtime.segment.commit.timeoutSeconds``).  Lease
+    validity alone cannot catch this: under a ONE-WAY partition the
+    victim's heartbeats keep renewing its controller-side lease while
+    its self-fenced commit plane is frozen."""
+    return float(os.environ.get("PINOT_TPU_COMMIT_STALL_S", "120")) * 1000.0
 
 # FSM states (SegmentCompletionManager.java:48-54)
 HOLDING = "HOLDING"
@@ -74,15 +87,36 @@ class _SegmentFsm:
         self.final_offset: Optional[int] = None
         self.first_report_ms: Optional[float] = None
         self.commit_inflight = False  # an upload is being persisted
+        # last protocol call from the elected committer (stall detector)
+        self.committer_activity_ms: Optional[float] = None
 
 
 class SegmentCompletionManager:
-    """Controller-side commit FSM (SegmentCompletionManager.java:45)."""
+    """Controller-side commit FSM (SegmentCompletionManager.java:45).
+
+    Partition fencing: every protocol call may carry the caller's
+    serving-lease ``epoch`` (the controller incarnation that granted
+    it); a mismatch against this controller's epoch raises a typed
+    ``StaleEpochError`` — a committer leased by a dead controller
+    cannot commit into a live one, and a zombie controller cannot
+    accept commits leased by its successor.  ``lease_checker`` (wired
+    by the Controller to ``ParticipantGateway.server_lease_valid``)
+    lets the FSM re-elect when the chosen committer's lease expires
+    mid-protocol (partitioned away mid-upload) instead of holding the
+    partition's commit hostage forever; the commit-stall window
+    (``PINOT_TPU_COMMIT_STALL_S``) re-elects a committer whose lease
+    stays controller-side valid but whose commit plane went silent
+    (one-way partition: heartbeats arrive, replies are lost)."""
 
     def __init__(self, realtime_manager: "RealtimeSegmentManager") -> None:
         self.rm = realtime_manager
         self._fsm: Dict[str, _SegmentFsm] = {}
         self._lock = threading.Lock()
+        # (server) -> bool: does this replica still hold a valid
+        # serving lease?  None = no lease plane (in-process harness).
+        self.lease_checker = None
+        self.commit_stall_ms = _commit_stall_ms()
+        self.clock = time.time  # injectable for stall/hold tests
 
     def _get(self, segment: str) -> _SegmentFsm:
         fsm = self._fsm.get(segment)
@@ -94,12 +128,56 @@ class SegmentCompletionManager:
             self._fsm[segment] = fsm
         return fsm
 
-    def segment_consumed(self, segment: str, server: str, offset: int) -> Tuple[str, Optional[int]]:
+    def _mark(self, name: str) -> None:
+        metrics = getattr(self.rm, "metrics", None)
+        if metrics is not None:
+            metrics.meter(name).mark()
+
+    def _check_epoch(self, epoch) -> None:
+        """Reject a protocol call fenced off by controller failover.
+        Unarmed when either side has no epoch (legacy / in-process)."""
+        current = getattr(self.rm, "epoch", None)
+        if current is None or epoch is None:
+            return
+        e = epoch_int(epoch)
+        if e == -1:
+            return
+        if e != int(current):
+            self._mark("fence.staleEpochRejections")
+            # direction-aware message (fields keep their wire meaning:
+            # staleEpoch = caller's, currentEpoch = this controller's):
+            # an operator debugging the 409 must be pointed at the side
+            # that is actually fenced off
+            if e < int(current):
+                msg = (
+                    f"commit-plane call under stale lease epoch {e}; "
+                    f"controller epoch is {current}"
+                )
+            else:
+                msg = (
+                    f"commit-plane call under lease epoch {e} from a "
+                    f"newer controller incarnation; this controller "
+                    f"(epoch {current}) is the fenced-off zombie"
+                )
+            raise StaleEpochError(msg, stale=e, current=int(current))
+
+    def _committer_leased(self, fsm: _SegmentFsm) -> bool:
+        if self.lease_checker is None or fsm.committer is None:
+            return True
+        try:
+            return bool(self.lease_checker(fsm.committer))
+        except Exception:  # a broken probe must not wedge the protocol
+            return True
+
+    def segment_consumed(
+        self, segment: str, server: str, offset: int, epoch=None
+    ) -> Tuple[str, Optional[int]]:
         """A replica hit its threshold at ``offset``. Returns
         (response, target_offset)."""
+        self._check_epoch(epoch)
         with self._lock:
             fsm = self._get(segment)
-            now = time.time() * 1000
+            now = self.clock() * 1000
 
             if fsm.state == COMMITTED:
                 if offset == fsm.final_offset:
@@ -118,12 +196,46 @@ class SegmentCompletionManager:
                 # decide committer: max offset wins (ties -> name order)
                 fsm.committer = max(fsm.offsets, key=lambda s: (fsm.offsets[s], s))
                 fsm.target_offset = fsm.offsets[fsm.committer]
+                fsm.committer_activity_ms = now
                 fsm.state = COMMITTER_DECIDED
 
             if fsm.state in (COMMITTER_DECIDED, COMMITTER_UPLOADING):
                 assert fsm.target_offset is not None
+                if server == fsm.committer:
+                    fsm.committer_activity_ms = now
                 if offset < fsm.target_offset:
                     return RESP_CATCH_UP, fsm.target_offset
+                stalled = (
+                    fsm.committer_activity_ms is not None
+                    and now - fsm.committer_activity_ms > self.commit_stall_ms
+                )
+                if (
+                    server != fsm.committer
+                    and not fsm.commit_inflight
+                    and (stalled or not self._committer_leased(fsm))
+                ):
+                    # committer failover: the elected committer's
+                    # serving lease expired (partitioned away / died
+                    # mid-upload), OR it went protocol-silent past the
+                    # commit-stall window — under a ONE-WAY partition
+                    # its heartbeats keep the controller-side lease
+                    # alive while its self-fenced commit plane freezes,
+                    # so lease validity alone cannot detect it.  No
+                    # upload is being persisted — re-elect this
+                    # caught-up replica.  The old committer's late
+                    # segmentCommit lands on ``committer != server``
+                    # below: NOT_LEADER, no double commit.
+                    logger.warning(
+                        "committer %s for %s %s; re-electing %s",
+                        fsm.committer, segment,
+                        "stalled past the commit window" if stalled
+                        else "lost its lease",
+                        server,
+                    )
+                    self._mark("fence.committerReElections")
+                    fsm.committer = server
+                    fsm.committer_activity_ms = now
+                    fsm.state = COMMITTER_DECIDED
                 if server == fsm.committer and not fsm.commit_inflight:
                     # COMMITTER_UPLOADING here (not inflight) means a
                     # previous commit attempt FAILED (e.g. the
@@ -136,7 +248,33 @@ class SegmentCompletionManager:
                 return RESP_HOLD, fsm.target_offset
         return RESP_HOLD, None
 
-    def segment_commit(self, segment: str, server: str, committed) -> str:
+    def commit_fence_check(self, segment: str, server: str, epoch=None):
+        """Cheap pre-upload fence: raises the typed ``StaleEpochError``
+        or returns ``NOT_LEADER`` for a caller with no write authority,
+        so the HTTP surface can reject a fenced upload before buffering
+        and parsing megabytes of segment body.  Advisory only — the
+        authoritative fences re-run under the lock in
+        ``segment_commit`` (a lease can expire between the two)."""
+        self._check_epoch(epoch)
+        with self._lock:
+            fsm = self._fsm.get(segment)
+            if fsm is not None and fsm.committer == server:
+                # upload starting: the body transfer that follows can
+                # legitimately outlast the commit-stall window — stamp
+                # activity NOW so a slow upload isn't mistaken for a
+                # silent (partitioned) committer and re-elected away
+                fsm.committer_activity_ms = self.clock() * 1000
+        if self.lease_checker is not None:
+            try:
+                leased = bool(self.lease_checker(server))
+            except Exception:
+                leased = True
+            if not leased:
+                self._mark("fence.leaseRejections")
+                return RESP_NOT_LEADER
+        return None
+
+    def segment_commit(self, segment: str, server: str, committed, epoch=None) -> str:
         """Committer uploads its converted segment (segmentCommit).
 
         The FSM flips to COMMITTED only AFTER the metadata/ideal-state
@@ -144,9 +282,30 @@ class SegmentCompletionManager:
         replica not re-registered yet) leaves the FSM in
         COMMITTER_UPLOADING so the committer's next segmentConsumed
         retries the commit rather than wedging on KEEP/HOLD.
+
+        Fencing order: stale epoch raises (typed), an expired lease is
+        NOT_LEADER (the replica may retry after renewing), and a
+        non-committer is NOT_LEADER — so a committer partitioned away
+        mid-upload can never land a second copy after re-election.
         """
+        self._check_epoch(epoch)
         with self._lock:
             fsm = self._get(segment)
+            if server == fsm.committer:
+                fsm.committer_activity_ms = self.clock() * 1000
+            if self.lease_checker is not None:
+                try:
+                    leased = bool(self.lease_checker(server))
+                except Exception:
+                    leased = True
+                if not leased:
+                    # lease fence FIRST (even over the COMMITTED
+                    # short-circuit): an upload arriving without write
+                    # authority is always rejected — the replica must
+                    # renew its lease and learn the final verdict via
+                    # segmentConsumed (KEEP/DISCARD) instead
+                    self._mark("fence.leaseRejections")
+                    return RESP_NOT_LEADER
             if fsm.state == COMMITTED:
                 return RESP_KEEP  # duplicate upload after a lost reply
             if fsm.committer != server or fsm.state != COMMITTER_UPLOADING:
@@ -181,6 +340,9 @@ class RealtimeSegmentManager:
         # optional ControllerMetrics: realtime commit-plane series
         # (segmentCommits meter + segmentCommitMs persistence timer)
         self.metrics = metrics
+        # controller fencing incarnation (set by the Controller): arms
+        # the commit-plane epoch fence in SegmentCompletionManager
+        self.epoch: Optional[int] = None
         if metrics is not None:
             metrics.meter("segmentCommits")
             metrics.timer("segmentCommitMs")
@@ -756,12 +918,24 @@ class RealtimeSegmentDataManager:
 
     def try_commit(self) -> str:
         """Run the completion protocol once
-        (segmentConsumed -> maybe segmentCommit)."""
+        (segmentConsumed -> maybe segmentCommit).  A server whose
+        serving lease expired has no write authority: the round is
+        frozen (HOLD) — offsets keep, nothing is lost — until the
+        lease renews."""
         if self._stopped:
             return RESP_DISCARD
+        lease = getattr(self.server, "lease", None)
+        epoch = None
+        if lease is not None:
+            if not lease.held():
+                if self._metrics is not None:
+                    self._metrics.meter("lease.blockedCommits").mark()
+                return RESP_HOLD
+            if lease.granted:
+                epoch = lease.epoch
         completion = self.manager.completion
         resp, target = completion.segment_consumed(
-            self.segment_name, self.server.name, self.offset
+            self.segment_name, self.server.name, self.offset, epoch=epoch
         )
         if resp == RESP_CATCH_UP and target is not None:
             while self.offset < target and not self._stopped:
@@ -772,7 +946,7 @@ class RealtimeSegmentDataManager:
             t0 = time.perf_counter()
             committed = self.mutable.to_committed_segment()
             out = completion.segment_commit(
-                self.segment_name, self.server.name, committed
+                self.segment_name, self.server.name, committed, epoch=epoch
             )
             # commit latency: mutable->immutable conversion + the
             # controller persistence round (the ingest stall window)

@@ -144,6 +144,23 @@ class ServerInstance:
         # brokers simply stop routing new covers here — but ops can see
         # the drain in status()/debug output
         self.draining = False
+        # serving lease (common/fencing.py): renewed from heartbeat
+        # replies by the networked starter.  While expired this server
+        # keeps SERVING (read path up) but has no WRITE authority —
+        # consumers freeze their completion rounds and new CONSUMING
+        # transitions are deferred.  Unleased (in-process, no gateway)
+        # means implicit authority.  Registers lease.held/renewals/
+        # expiries; the blocked-write counters are pre-registered here.
+        from pinot_tpu.common.fencing import ServingLease
+
+        self.lease = ServingLease(metrics=self.metrics)
+        for m in ("lease.blockedCommits", "lease.blockedTransitions"):
+            self.metrics.meter(m)
+        # controller reachability (set by the networked starter's
+        # heartbeat loop): 1 while consecutive heartbeats are failing —
+        # the "partitioned but riding it out" observable
+        self.metrics.gauge("controller.unreachable").set(0)
+        self.metrics.meter("controller.heartbeatFailures")
 
     # serving-tier cost-vector keys mirrored into cost.tier.* meters —
     # the ONE source in engine/results.py, so a new tier cannot
@@ -391,6 +408,7 @@ class ServerInstance:
         return {
             "name": self.name,
             "draining": self.draining,
+            "lease": self.lease.snapshot(),
             "scheduler": self.scheduler.stats(),
             "lane": None if self.lane is None else self.lane.stats(),
             "selfHealing": heal,

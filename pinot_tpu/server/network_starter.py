@@ -145,6 +145,15 @@ class RemoteConsumer:
         self.mutable.start_offset = self.offset
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # controller unreachability is a FREEZE, not a failure: offsets
+        # hold, the thread survives, and retries back off with full
+        # jitter (utils/retry.py) so a healing controller is not
+        # stampeded by every frozen consumer at once
+        from pinot_tpu.utils.retry import FullJitterBackoff
+
+        self._ctrl_backoff = FullJitterBackoff(
+            initial_s=max(0.1, poll_interval_s), cap_s=5.0
+        )
         # ingest observability (same series as the in-process consumer,
         # realtime/llc.py): per-partition lag gauge + rows/s meter.
         # The TTL-cached probe (realtime/stream.py LagProbe) keeps the
@@ -237,8 +246,28 @@ class RemoteConsumer:
             # reporting; a rolled successor re-registers the same name
             self._detach_lag_gauge()
 
+    def _freeze(self, why: str, err) -> bool:
+        """Controller unreachable (or authority lost) mid-protocol:
+        freeze the round — offset untouched, consumer thread alive —
+        and retry after a full-jitter backoff."""
+        delay = self._ctrl_backoff.next_delay()
+        logger.warning(
+            "%s for %s frozen (retry in %.2fs): %s", why, self.segment, delay, err
+        )
+        self._stop.wait(delay)
+        return False
+
     def _completion_round(self) -> bool:
         """One segmentConsumed exchange; True when this consumer is done."""
+        lease = self.starter.server.lease
+        if not lease.held():
+            # write authority expired (partitioned past the lease
+            # window): no segmentConsumed/commit until it renews — the
+            # live controller may be re-electing a committer right now
+            if self._metrics is not None:
+                self._metrics.meter("lease.blockedCommits").mark()
+            return self._freeze("completion round", "serving lease expired")
+        epoch = lease.epoch if lease.granted else None
         try:
             out = self.starter._post(
                 "/realtime/consumed",
@@ -246,17 +275,17 @@ class RemoteConsumer:
                     "segment": self.segment,
                     "server": self.starter.name,
                     "offset": self.offset,
+                    "epoch": epoch,
                 },
             )
         except Exception as e:
-            logger.warning("segmentConsumed failed for %s: %s", self.segment, e)
-            self._stop.wait(self.poll_interval_s)
-            return False
+            return self._freeze("segmentConsumed", e)
+        self._ctrl_backoff.reset()
         resp = out.get("response")
         target = out.get("targetOffset")
         if resp == "COMMIT":
             try:
-                return self._commit()
+                return self._commit(epoch)
             except Exception as e:
                 # conversion/serialization failure: stay alive and retry
                 # via the next segmentConsumed round
@@ -292,19 +321,26 @@ class RemoteConsumer:
         self._stop.wait(self.poll_interval_s)
         return False
 
-    def _commit(self) -> bool:
+    def _commit(self, epoch=None) -> bool:
         t0 = time.perf_counter()
         committed = self.mutable.to_committed_segment()
+        path = f"/realtime/commit/{self.segment}/{self.starter.name}"
+        if epoch is not None:
+            # the lease epoch fences this upload: a controller failover
+            # mid-upload typed-rejects it (409 StaleEpochError) instead
+            # of double-committing into the new incarnation
+            path += f"?epoch={epoch}"
         try:
-            out = self.starter.upload_segment_bytes(
-                f"/realtime/commit/{self.segment}/{self.starter.name}", committed
-            )
+            out = self.starter.upload_segment_bytes(path, committed)
         except Exception as e:
-            logger.warning("segmentCommit failed for %s: %s", self.segment, e)
-            return False
+            # unreachable mid-upload: freeze-and-retry (the controller
+            # may have persisted the copy and lost only the reply — the
+            # next segmentConsumed answers KEEP/DISCARD idempotently)
+            return self._freeze("segmentCommit", e)
         if out.get("response") != "KEEP":
             # NOT_LEADER / HOLD (commit already being persisted by a
-            # prior attempt): retry via the next segmentConsumed round
+            # prior attempt, or our lease/leadership was fenced away):
+            # retry via the next segmentConsumed round
             return False
         if self._metrics is not None:
             self._metrics.timer("ingest.commitMs").update(
@@ -491,6 +527,7 @@ class NetworkedServerStarter:
         heartbeat_interval_s: float = 1.0,
         poll_interval_s: float = 0.3,
         admin_port: int = 0,
+        fault_injector=None,
     ) -> None:
         self.controller_url = controller_url.rstrip("/")
         self.name = name
@@ -501,12 +538,50 @@ class NetworkedServerStarter:
         self.data_dir = data_dir
         self.heartbeat_interval_s = heartbeat_interval_s
         self.poll_interval_s = poll_interval_s
+        # link-level chaos hook: every controller-bound HTTP call routes
+        # through the injector as link (name -> "controller"), so a chaos
+        # harness can cut/delay/duplicate this server's control plane
+        self.fault_injector = fault_injector
+        # partition-riding backoffs (full jitter, utils/retry.py): the
+        # heartbeat and message loops keep their cadence while healthy
+        # and back off jittered while the controller is unreachable, so
+        # a healing controller is not hammered by the fleet in lockstep.
+        # The HEARTBEAT backoff is capped below the controller's
+        # advertised liveness timeout (tightened from the register
+        # reply): under an ASYMMETRIC partition our requests still
+        # arrive even though replies are lost, and backing off past the
+        # timeout would flap this live server dead at the controller.
+        from pinot_tpu.utils.retry import FullJitterBackoff
+
+        self._hb_backoff = FullJitterBackoff(
+            initial_s=max(0.1, heartbeat_interval_s), cap_s=2.0
+        )
+        # per-request timeout for heartbeat-loop RPCs, tightened with
+        # the backoff cap (_tighten_hb_backoff) so a blackholed request
+        # fails well before the liveness window elapses
+        self._hb_timeout_s = 10.0
+        self._msg_backoff = FullJitterBackoff(
+            initial_s=max(0.1, poll_interval_s), cap_s=10.0
+        )
         self._local_crcs: Dict[str, int] = {}
         self._consumers: Dict[str, RemoteConsumer] = {}  # segment -> consumer
         self._stop = threading.Event()
+        # cross-signal wake: a heartbeat SUCCEEDING while the message
+        # poll is deep in backoff means the controller is reachable
+        # again — poll now instead of sleeping out the backoff window
+        # (bounds recovery time: pending ONLINE transitions re-ack fast)
+        self._msg_wake = threading.Event()
         self._threads: list = []
 
     # -- HTTP helpers --------------------------------------------------
+    def _link(self, fn):
+        """Run one controller-bound RPC through the link injector."""
+        from pinot_tpu.common.faults import call_on_controller_link
+
+        return call_on_controller_link(
+            self.fault_injector, self.name, fn, metrics=self.server.metrics
+        )
+
     def upload_segment_bytes(self, path: str, segment) -> Dict[str, Any]:
         """Serialize a committed segment and POST it to the controller
         (shared by the LLC committer and HLC seal paths)."""
@@ -518,26 +593,38 @@ class NetworkedServerStarter:
             write_segment(segment, td)
             with open(os.path.join(td, SEGMENT_FILE_NAME), "rb") as f:
                 data = f.read()
-        req = urllib.request.Request(
-            self.controller_url + path,
-            data=data,
-            headers={"Content-Type": "application/octet-stream"},
-        )
-        with urllib.request.urlopen(req, timeout=120) as r:
-            return json.loads(r.read())
 
-    def _post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
-        req = urllib.request.Request(
-            self.controller_url + path,
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=10) as r:
-            return json.loads(r.read())
+        def send():
+            req = urllib.request.Request(
+                self.controller_url + path,
+                data=data,
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return json.loads(r.read())
+
+        return self._link(send)
+
+    def _post(
+        self, path: str, payload: Dict[str, Any], timeout_s: float = 10.0
+    ) -> Dict[str, Any]:
+        def send():
+            req = urllib.request.Request(
+                self.controller_url + path,
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return json.loads(r.read())
+
+        return self._link(send)
 
     def _get(self, path: str) -> Dict[str, Any]:
-        with urllib.request.urlopen(self.controller_url + path, timeout=10) as r:
-            return json.loads(r.read())
+        def send():
+            with urllib.request.urlopen(self.controller_url + path, timeout=10) as r:
+                return json.loads(r.read())
+
+        return self._link(send)
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -561,7 +648,7 @@ class NetworkedServerStarter:
             )
         self.tcp.start()
         self.admin.start()
-        self._post(
+        out = self._post(
             "/instances",
             {
                 "name": self.name,
@@ -572,6 +659,9 @@ class NetworkedServerStarter:
                 "url": self.admin.url,
             },
         )
+        # first serving lease rides the registration reply
+        self.server.lease.renew(out.get("lease"))
+        self._tighten_hb_backoff(out)
         for fn in (self._heartbeat_loop, self._message_loop):
             t = threading.Thread(target=fn, daemon=True)
             t.start()
@@ -579,6 +669,7 @@ class NetworkedServerStarter:
 
     def stop(self) -> None:
         self._stop.set()
+        self._msg_wake.set()  # unblock a message loop deep in backoff
         for consumer in list(self._consumers.values()):
             consumer.stop()
         for t in self._threads:
@@ -586,16 +677,40 @@ class NetworkedServerStarter:
         self.tcp.stop()
         self.admin.stop()
 
+    def _tighten_hb_backoff(self, reply: Dict[str, Any]) -> None:
+        """Keep the worst-case heartbeat gap under the controller's
+        liveness timeout (see __init__ on asymmetric partitions): the
+        backoff cap AND the per-request timeout each take a third of
+        the advertised window — a blackholed request blocking for the
+        default 10s would exceed the 6s default window on its own."""
+        timeout = reply.get("heartbeatTimeoutSeconds")
+        if timeout:
+            from pinot_tpu.utils.retry import tighten_liveness_budget
+
+            self._hb_timeout_s = tighten_liveness_budget(
+                self._hb_backoff, float(timeout), self._hb_timeout_s
+            )
+
     def _heartbeat_loop(self) -> None:
-        while not self._stop.wait(self.heartbeat_interval_s):
+        wait_s = self.heartbeat_interval_s
+        unreachable = self.server.metrics.gauge("controller.unreachable")
+        while not self._stop.wait(wait_s):
             try:
-                out = self._post(f"/instances/{self.name}/heartbeat", {})
+                out = self._post(
+                    f"/instances/{self.name}/heartbeat",
+                    {},
+                    timeout_s=self._hb_timeout_s,
+                )
                 # drain ack: the controller tells us (on the heartbeat it
                 # already makes) that an operator is draining this host;
                 # surfaced in status() so ops tooling sees the ack
                 self.server.draining = bool(out.get("draining"))
+                # serving-lease renewal rides the same reply: write
+                # authority extends lease_s from NOW.  A "held" reply
+                # (flap hysteresis) carries no lease — correctly so.
+                self.server.lease.renew(out.get("lease"))
                 if out.get("reregister"):
-                    self._post(
+                    reg = self._post(
                         "/instances",
                         {
                             "name": self.name,
@@ -603,16 +718,48 @@ class NetworkedServerStarter:
                             "addr": [self.tcp.address[0], self.tcp.address[1]],
                             "url": self.admin.url,
                         },
+                        timeout_s=self._hb_timeout_s,
                     )
+                    self.server.lease.renew(reg.get("lease"))
+                if self._hb_backoff.failures:
+                    # controller back after an outage: wake the message
+                    # loop out of its backoff so queued transitions
+                    # (e.g. pending ONLINE re-acks) land immediately
+                    self._msg_backoff.reset()
+                    self._msg_wake.set()
+                self._hb_backoff.reset()
+                unreachable.set(0)
+                wait_s = self.heartbeat_interval_s
             except Exception as e:
-                logger.warning("heartbeat to controller failed: %s", e)
+                # partitioned from the controller: ride it out — serve
+                # from local state, let the lease run down (write
+                # authority self-fences), and retry with full jitter so
+                # the fleet doesn't stampede the healing controller
+                self.server.metrics.meter("controller.heartbeatFailures").mark()
+                unreachable.set(1)
+                wait_s = self._hb_backoff.next_delay()
+                logger.warning(
+                    "heartbeat to controller failed (%d consecutive, "
+                    "retry in %.2fs): %s", self._hb_backoff.failures, wait_s, e,
+                )
 
     def _message_loop(self) -> None:
-        while not self._stop.wait(self.poll_interval_s):
+        wait_s = self.poll_interval_s
+        while True:
+            if self._msg_wake.wait(timeout=wait_s):
+                self._msg_wake.clear()
+                wait_s = self.poll_interval_s
+            if self._stop.is_set():
+                return
             try:
                 msgs = self._get(f"/instances/{self.name}/messages")["messages"]
+                self._msg_backoff.reset()
+                wait_s = self.poll_interval_s
             except Exception as e:
-                logger.warning("message poll failed: %s", e)
+                wait_s = self._msg_backoff.next_delay()
+                logger.warning(
+                    "message poll failed (retry in %.2fs): %s", wait_s, e
+                )
                 continue
             for msg in msgs:
                 self._handle(msg)
@@ -620,6 +767,18 @@ class NetworkedServerStarter:
     # -- transitions ---------------------------------------------------
     def _handle(self, msg: Dict[str, Any]) -> None:
         table, segment, target = msg["table"], msg["segment"], msg["target"]
+        if target == CONSUMING and not self.server.lease.held():
+            # lease fence on WRITE authority: a server that cannot renew
+            # its lease must not take on NEW consuming roles (another
+            # replica may already own this partition as far as the live
+            # controller is concerned).  Don't ack: the at-least-once
+            # board redelivers once the lease renews.
+            self.server.metrics.meter("lease.blockedTransitions").mark()
+            logger.warning(
+                "deferring CONSUMING %s/%s: serving lease expired",
+                table, segment,
+            )
+            return
         try:
             if target == ONLINE:
                 # CONSUMING -> ONLINE: retire the consumer before the

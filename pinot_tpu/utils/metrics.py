@@ -401,6 +401,17 @@ BROKER_METRIC_CATALOG: Dict[str, str] = {
     "workload registry",
     "workload.digests": "distinct plan-shape digests currently tracked",
     "explain.queries": "EXPLAIN / EXPLAIN ANALYZE queries handled",
+    # partition-tolerance plane (ISSUE 9): a partitioned broker keeps
+    # serving from its last versioned snapshot and says so
+    "controller.unreachable": "1 while cluster-state polls are failing "
+    "(serving from the last versioned snapshot)",
+    "controller.pollFailures": "failed cluster-state polls (partition / "
+    "controller outage; full-jitter retried)",
+    "controller.allDeadSnapshotsHeld": "cluster-state snapshots listing "
+    "NO live servers ignored in favor of the last routing (the "
+    "controller may be the partitioned one)",
+    "netfaults.*": "injected link faults observed by this role's "
+    "transports (dropped/replyDropped/delayed/duplicated/flaky)",
 }
 
 SERVER_METRIC_CATALOG: Dict[str, str] = {
@@ -470,6 +481,23 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "(1 = held by the backpressure governor)",
     "ingest.pauses": "ingest pause events (high watermark crossed)",
     "ingest.resumes": "ingest resume events (back under low watermarks)",
+    # partition-tolerance plane (ISSUE 9): serving-lease fence on write
+    # authority + controller reachability while riding out a partition
+    "lease.held": "1 while this server holds (or never needed) a "
+    "serving lease — write authority",
+    "lease.renewals": "serving-lease renewals from heartbeat replies",
+    "lease.expiries": "serving-lease expiries (partitioned past the "
+    "lease window; write authority self-fenced)",
+    "lease.blockedCommits": "completion/commit rounds frozen because "
+    "the serving lease expired",
+    "lease.blockedTransitions": "CONSUMING transitions deferred "
+    "(unacked) while the serving lease was expired",
+    "controller.unreachable": "1 while heartbeats to the controller "
+    "are failing (riding out a partition on local state)",
+    "controller.heartbeatFailures": "failed controller heartbeats "
+    "(full-jitter retried)",
+    "netfaults.*": "injected link faults observed by this role's "
+    "transports (dropped/replyDropped/delayed/duplicated/flaky)",
 }
 
 CONTROLLER_METRIC_CATALOG: Dict[str, str] = {
@@ -491,6 +519,9 @@ CONTROLLER_METRIC_CATALOG: Dict[str, str] = {
     "re-creation on a live server at the committed offset",
     "stabilizer.graceDeferrals": "dead servers whose re-replication was "
     "deferred inside the grace window",
+    "stabilizer.leaseDeferrals": "dead-looking servers whose replicas "
+    "were NOT moved because their serving lease had not expired "
+    "(possibly alive-but-partitioned)",
     "stabilizer.underReplicatedSegments": "segments currently below target "
     "replication on live servers",
     "stabilizer.drainingInstances": "instances currently draining",
@@ -499,6 +530,20 @@ CONTROLLER_METRIC_CATALOG: Dict[str, str] = {
     "aliveBrokers": "registered broker instances currently alive",
     "deadInstances": "registered instances currently marked dead",
     "tables": "physical tables managed",
+    # partition-tolerance plane (ISSUE 9): serving leases + the
+    # cluster-wide epoch fence on the commit plane / property store
+    "lease.granted": "serving leases granted on heartbeat/registration "
+    "replies",
+    "fence.epoch": "this controller's fencing incarnation (property "
+    "store cluster/epoch)",
+    "fence.staleEpochRejections": "commit-plane calls typed-rejected "
+    "for carrying a stale controller epoch",
+    "fence.leaseRejections": "segmentCommit uploads rejected because "
+    "the committer's serving lease had expired",
+    "fence.committerReElections": "LLC committers re-elected after the "
+    "elected one lost its serving lease mid-protocol",
+    "netfaults.*": "injected link faults observed by this role's "
+    "transports (dropped/replyDropped/delayed/duplicated/flaky)",
     "*.missingReplicas": "per-table replicas missing from the external view",
     "*.errorReplicas": "per-table replicas in ERROR state",
     "*.percentSegmentsAvailable": "per-table % of segments with a live replica",

@@ -74,3 +74,73 @@ class ExponentialBackoffRetryPolicy(RetryPolicy):
         if self._rng is not None:
             return self._rng.uniform(0.0, cap)
         return cap
+
+
+class FullJitterBackoff:
+    """Stateful full-jitter backoff for long-lived retry LOOPS (vs the
+    bounded-attempt policies above): heartbeat/poll/consumer loops that
+    must ride out a dependency outage of unknown length.
+
+    ``next_delay()`` grows the window exponentially up to ``cap_s`` and
+    draws uniformly from [floor_s, window] (full jitter: a fleet of
+    partitioned consumers must not re-converge on the recovering
+    controller in lockstep); ``reset()`` on success re-arms the fast
+    first retry.  ``failures`` counts consecutive failures, which the
+    callers surface as a ``controller.unreachable`` gauge."""
+
+    def __init__(
+        self,
+        initial_s: float = 0.25,
+        cap_s: float = 5.0,
+        factor: float = 2.0,
+        floor_s: float = 0.05,
+        seed: Optional[int] = None,
+    ) -> None:
+        self.initial = initial_s
+        self.cap = cap_s
+        self.factor = factor
+        self.floor = floor_s
+        self.failures = 0
+        self._rng = random.Random(seed)
+
+    def reset(self) -> None:
+        self.failures = 0
+
+    def tighten_cap(self, liveness_timeout_s: float) -> float:
+        """Keep the worst-case delay well under a liveness window:
+        loops whose REQUESTS feed a failure detector (heartbeats) call
+        this with the detector's advertised timeout so backoff can
+        never push the inter-request gap past it — under an asymmetric
+        partition requests still arrive while replies are lost, and a
+        deep backoff would flap the live sender dead.  The cap takes a
+        THIRD of the window; returns that share so the caller can clamp
+        its per-request timeout to the same budget (a blackholed
+        request that blocks for urlopen's default 10s would blow the
+        window on its own): request timeout + one full backoff delay
+        stays at most two thirds of the window.  Tightening only — a
+        share above the constructed cap must never LOOSEN it (an
+        initial_s bigger than the share would otherwise win the clamp
+        and blow the very window this enforces)."""
+        share = float(liveness_timeout_s) / 3.0
+        self.cap = min(self.cap, max(self.floor, share))
+        return share
+
+    def next_delay(self) -> float:
+        window = min(self.cap, self.initial * (self.factor ** self.failures))
+        self.failures += 1
+        return self._rng.uniform(min(self.floor, window), window)
+
+
+def tighten_liveness_budget(
+    backoff: FullJitterBackoff,
+    liveness_timeout_s: float,
+    request_timeout_s: float,
+    floor_s: float = 0.5,
+) -> float:
+    """One liveness-budget computation for every heartbeating role:
+    caps ``backoff`` at a third of the detector's window (see
+    ``tighten_cap``) and returns the per-request timeout clamped to the
+    same share — the two MUST shrink together, or a blackholed request
+    alone can outlast the window the backoff was capped for."""
+    share = backoff.tighten_cap(float(liveness_timeout_s))
+    return min(request_timeout_s, max(floor_s, share))

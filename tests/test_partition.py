@@ -1,0 +1,703 @@
+"""Network-partition tolerance tests (ISSUE 9).
+
+Chaos acceptance (``-m chaos``, tier-1, same scenario code as the
+``cluster_harness`` CLI):
+
+- ``partition-server``: a server severed from the controller for longer
+  than its lease loses NO queries; its replicas move only AFTER the
+  lease window (never on a missed heartbeat) and it rejoins cleanly.
+- ``partition-controller``: the controller cut off from every role —
+  the data plane rides it out on versioned snapshots, nothing moves,
+  everything re-admits on heal.
+- ``asymmetric-partition``: one-way reply loss on the realtime commit
+  plane — the victim self-fences write authority while the controller
+  still sees it alive; exactly one committed segment, replicas
+  byte-identical, zero lost/duplicated rows.
+- ``split-brain``: a zombie controller's every durable write is
+  typed-rejected (``StaleEpochError``); the live controller converges.
+
+Plus unit coverage: link injector semantics, serving-lease state
+machine, property-store epoch fencing, gateway lease grants, the
+stabilizer's lease fence, committer failover in the completion FSM,
+and the RemoteConsumer freeze-and-retry contract.
+"""
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.common.faults import (
+    CONTROLLER_LINK,
+    LinkFaultTransport,
+    NetworkFaultInjector,
+    PartitionedLinkError,
+)
+from pinot_tpu.common.fencing import ServingLease, StaleEpochError
+from pinot_tpu.controller.property_store import PropertyStore
+from pinot_tpu.server.instance import ServerInstance
+from pinot_tpu.tools.cluster_harness import (
+    InProcessCluster,
+    run_asymmetric_partition_scenario,
+    run_partition_controller_scenario,
+    run_partition_server_scenario,
+    run_split_brain_scenario,
+)
+from pinot_tpu.transport.local import LocalTransport
+from pinot_tpu.transport.tcp import TransportError
+
+
+# ------------------------------------------------------------------
+# chaos acceptance — the same scenario code the CLI runs
+# ------------------------------------------------------------------
+@pytest.mark.chaos
+def test_partition_server_acceptance(tmp_path):
+    out = run_partition_server_scenario(data_dir=str(tmp_path))
+    assert out["failedQueries"] == 0, out["failures"]
+    # replicas held through the lease window, moved only after it
+    assert out["heldThroughLeaseWindow"], out
+    assert not out["movedOnFirstMissedHeartbeat"], out
+    assert out["leaseDeferrals"] > 0, out
+    assert out["victimSelfFenced"], out
+    assert out["replicationRestored"], out
+    assert out["noDuplicateReplicas"], out
+    assert out["victimReadmitted"], out
+    assert out["finalComplete"] and out["finalDocs"] == out["expectedDocs"]
+
+
+@pytest.mark.chaos
+def test_partition_controller_acceptance(tmp_path):
+    out = run_partition_controller_scenario(data_dir=str(tmp_path))
+    assert out["failedQueries"] == 0, out["failures"]
+    assert out["idealUnchangedDuringOutage"], out
+    assert out["idealUnchangedAfterHeal"], out
+    assert out["finalComplete"] and out["finalDocs"] == out["expectedDocs"]
+
+
+@pytest.mark.chaos
+def test_asymmetric_partition_acceptance(tmp_path):
+    out = run_asymmetric_partition_scenario(data_dir=str(tmp_path))
+    assert out["failedQueries"] == 0, out
+    assert out["victimSelfFenced"], out
+    assert out["controllerSawVictimAlive"], out
+    assert out["noReplicaMovement"], out
+    assert out["committedByteIdentical"], out
+    assert out["finalDocs"] == out["expectedDocs"], out
+
+
+@pytest.mark.chaos
+def test_split_brain_acceptance(tmp_path):
+    out = run_split_brain_scenario(data_dir=str(tmp_path))
+    assert out["failedQueries"] == 0, out
+    assert out["allStaleWritesRejected"], out["staleRejections"]
+    assert out["durableStoreUnchangedByZombie"], out
+    assert out["liveControllerConverged"], out
+    assert out["epochB"] > out["epochA"]
+
+
+# ------------------------------------------------------------------
+# NetworkFaultInjector semantics
+# ------------------------------------------------------------------
+def test_injector_cut_drops_request_before_delivery():
+    inj = NetworkFaultInjector()
+    calls = []
+    inj.cut("a", "b")
+    with pytest.raises(PartitionedLinkError):
+        inj.call("a", "b", lambda: calls.append(1))
+    assert calls == []  # never delivered
+    # the reverse RPC delivers (request rides b->a, which is open) but
+    # its reply rides the cut a->b direction: physical one-way semantics
+    delivered = []
+    with pytest.raises(PartitionedLinkError):
+        inj.call("b", "a", lambda: delivered.append(1))
+    assert delivered == [1]
+    inj.heal("a", "b")
+    assert inj.call("a", "b", lambda: "ok") == "ok"
+    assert inj.call("b", "a", lambda: "ok") == "ok"
+
+
+def test_injector_one_way_cut_delivers_then_loses_reply():
+    """Cutting only dst->src models the asymmetric partition: the
+    request EXECUTES at the destination, the caller still errors."""
+    inj = NetworkFaultInjector()
+    inj.cut("b", "a")  # replies b->a lost
+    delivered = []
+    with pytest.raises(PartitionedLinkError):
+        inj.call("a", "b", lambda: delivered.append(1))
+    assert delivered == [1]  # side effects happened
+    assert [e.outcome for e in inj.events_for("a", "b")] == ["replyDropped"]
+
+
+def test_injector_duplicate_and_flaky_and_partition():
+    inj = NetworkFaultInjector(seed=7)
+    inj.set_link("a", "b", duplicate=True)
+    n = [0]
+
+    def fn():
+        n[0] += 1
+        return n[0]
+
+    assert inj.call("a", "b", fn) == 2  # delivered twice, second reply
+    assert n[0] == 2
+
+    inj.heal()
+    inj.set_link("a", "b", error_rate=1.0)
+    with pytest.raises(PartitionedLinkError):
+        inj.call("a", "b", lambda: "ok")
+
+    inj.heal()
+    inj.partition("a", "b")
+    for src, dst in (("a", "b"), ("b", "a")):
+        with pytest.raises(PartitionedLinkError):
+            inj.call(src, dst, lambda: "ok")
+    inj.heal("a")  # heal everything touching a
+    assert inj.call("a", "b", lambda: "ok") == "ok"
+
+
+def test_link_fault_transport_over_local_transport():
+    transport = LocalTransport()
+    transport.register(("s0", 0), lambda payload: b"pong")
+    inj = NetworkFaultInjector()
+    linked = LinkFaultTransport(transport, inj, src="brk")
+    assert linked.request(("s0", 0), b"ping") == b"pong"
+    inj.cut("brk", "s0")
+    with pytest.raises(TransportError):
+        linked.request(("s0", 0), b"ping")
+    assert [e.outcome for e in inj.events_for("brk", "s0")] == ["ok", "dropped"]
+
+
+def test_gateway_edge_injection_and_netfaults_attribution():
+    """The controller-edge hook (for harnesses that cannot wire client
+    processes): a cut server->controller link drops heartbeats at the
+    gateway, and the fault lands on the consulted role's netfaults.*
+    series."""
+    from pinot_tpu.controller.network import ParticipantGateway
+    from pinot_tpu.controller.resource_manager import ClusterResourceManager
+    from pinot_tpu.utils.metrics import ControllerMetrics
+
+    inj = NetworkFaultInjector()
+    metrics = ControllerMetrics("controller")
+    gw = ParticipantGateway(
+        ClusterResourceManager(), metrics=metrics, epoch=1, fault_injector=inj
+    )
+    assert gw.register({"name": "s1", "role": "server"})["status"] == "ok"
+    inj.cut("s1", CONTROLLER_LINK)
+    with pytest.raises(PartitionedLinkError):
+        gw.heartbeat("s1")
+    assert metrics.meter("netfaults.dropped").count == 1
+    inj.heal()
+    assert gw.heartbeat("s1")["status"] == "ok"
+
+
+# ------------------------------------------------------------------
+# ServingLease state machine
+# ------------------------------------------------------------------
+def test_lease_unleased_means_implicit_authority():
+    lease = ServingLease()
+    assert lease.held() and not lease.granted
+    assert lease.remaining_s() == float("inf")
+    assert lease.epoch == -1
+
+
+def test_lease_renew_expire_renew_cycle():
+    clock = [100.0]
+    lease = ServingLease(clock=lambda: clock[0])
+    lease.renew({"epoch": 3, "durationS": 2.0})
+    assert lease.held() and lease.granted and lease.epoch == 3
+    assert lease.remaining_s() == pytest.approx(2.0)
+    clock[0] = 101.9
+    assert lease.held()
+    clock[0] = 102.1  # past the window: write authority gone
+    assert not lease.held()
+    assert lease.remaining_s() == 0.0
+    lease.renew({"epoch": 4, "durationS": 2.0})
+    assert lease.held() and lease.epoch == 4
+    # a legacy controller reply without a lease block changes nothing
+    lease.renew(None)
+    assert lease.held()
+
+
+def test_lease_metrics_and_snapshot():
+    from pinot_tpu.utils.metrics import ServerMetrics
+
+    clock = [0.0]
+    metrics = ServerMetrics("srvX")
+    lease = ServingLease(clock=lambda: clock[0], metrics=metrics)
+    assert metrics.gauge("lease.held").value == 1  # unleased = authority
+    lease.renew({"epoch": 1, "durationS": 1.0})
+    assert metrics.meter("lease.renewals").count == 1
+    clock[0] = 2.0
+    assert not lease.held()
+    assert metrics.meter("lease.expiries").count == 1
+    assert not lease.held()  # expiry metered once, not per poll
+    assert metrics.meter("lease.expiries").count == 1
+    snap = lease.snapshot()
+    assert snap == {
+        "granted": True, "held": False, "epoch": 1, "remainingS": 0.0
+    }
+
+
+# ------------------------------------------------------------------
+# property-store epoch fencing
+# ------------------------------------------------------------------
+def test_property_store_epoch_fence(tmp_path):
+    a = PropertyStore(str(tmp_path))
+    assert a.stored_epoch() == 0
+    assert a.claim_epoch() == 1
+    a.put("tables", "t1", {"x": 1})
+
+    b = PropertyStore(str(tmp_path))
+    assert b.claim_epoch() == 2
+    # the old writer is fenced from every mutation...
+    with pytest.raises(StaleEpochError) as ei:
+        a.put("tables", "t1", {"x": 2})
+    assert ei.value.stale == 1 and ei.value.current == 2
+    with pytest.raises(StaleEpochError):
+        a.delete("tables", "t1")
+    with pytest.raises(StaleEpochError):
+        a.delete_namespace("tables")
+    # ...but reads still work (a zombie may observe, never mutate)
+    assert a.get("tables", "t1") == {"x": 1}
+    # the live writer is unaffected
+    b.put("tables", "t1", {"x": 3})
+    assert b.get("tables", "t1") == {"x": 3}
+    # an unfenced store (no claim) keeps working — bare/test usage
+    c = PropertyStore(str(tmp_path / "other"))
+    c.put("tables", "t", {"ok": True})
+
+
+# ------------------------------------------------------------------
+# gateway lease grants + stabilizer lease fence
+# ------------------------------------------------------------------
+def test_gateway_grants_lease_on_register_and_heartbeat():
+    from pinot_tpu.controller.network import ParticipantGateway
+    from pinot_tpu.controller.resource_manager import ClusterResourceManager
+
+    clock = [50.0]
+    res = ClusterResourceManager()
+    gw = ParticipantGateway(
+        res, epoch=7, lease_s=3.0, clock=lambda: clock[0]
+    )
+    out = gw.register({"name": "s1", "role": "server"})
+    assert out["lease"] == {"epoch": 7, "durationS": 3.0}
+    assert res.instances["s1"].lease_until == pytest.approx(53.0)
+    assert gw.server_lease_valid("s1")
+
+    clock[0] = 52.0
+    out = gw.heartbeat("s1")
+    assert out["lease"]["epoch"] == 7
+    assert res.instances["s1"].lease_until == pytest.approx(55.0)
+
+    clock[0] = 55.5  # lease ran out: confirmed-dead territory
+    assert not gw.server_lease_valid("s1")
+    # an instance that never heartbeat (in-process) keeps authority
+    res.register_instance(
+        __import__(
+            "pinot_tpu.controller.resource_manager",
+            fromlist=["InstanceState"],
+        ).InstanceState("local0", role="server")
+    )
+    assert gw.server_lease_valid("local0")
+    assert not gw.server_lease_valid("ghost")  # unknown: no authority
+
+
+def test_stabilizer_lease_fence_defers_until_lease_expiry(tmp_path):
+    """A dead-looking server whose serving lease has not expired may be
+    alive-but-partitioned: nothing moves until the lease window closes
+    (even with a zero grace window)."""
+    from pinot_tpu.controller.stabilizer import SelfStabilizer
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.tools.datagen import make_test_schema, random_rows
+
+    cluster = InProcessCluster(num_servers=3, data_dir=str(tmp_path))
+    schema = make_test_schema(with_mv=False)
+    physical = cluster.add_offline_table(schema, replication=2)
+    rows = random_rows(schema, 60, seed=3)
+    for i in range(3):
+        cluster.upload(physical, build_segment(schema, rows, physical, f"g{i}"))
+    res = cluster.controller.resources
+    clock = [200.0]
+    st = SelfStabilizer(res, grace_s=0.0, now=lambda: clock[0])
+    before = res.get_ideal_state(physical)
+
+    # server0 held a lease until T=210 when it went dark
+    res.instances["server0"].lease_until = 210.0
+    res.set_instance_alive("server0", False)
+    st.run_once()
+    assert res.get_ideal_state(physical) == before  # lease fence held
+    assert st.metrics.meter("stabilizer.leaseDeferrals").count == 1
+    clock[0] = 209.9
+    st.run_once()
+    assert res.get_ideal_state(physical) == before
+
+    clock[0] = 210.1  # lease expired: confirmed dead, movement allowed
+    st.run_once()
+    ideal = res.get_ideal_state(physical)
+    for seg, replicas in ideal.items():
+        assert len([s for s in replicas if s != "server0"]) == 2
+    cluster.stop()
+
+
+# ------------------------------------------------------------------
+# committer failover in the completion FSM (satellite)
+# ------------------------------------------------------------------
+def _rt_cluster(tmp_path, replication=2):
+    from pinot_tpu.common.schema import (
+        DataType, FieldSpec, FieldType, Schema, TimeFieldSpec,
+    )
+    from pinot_tpu.realtime.stream import MemoryStreamProvider
+
+    cluster = InProcessCluster(num_servers=2, data_dir=str(tmp_path))
+    schema = Schema(
+        "meetupRsvp",
+        dimensions=[FieldSpec("venue_name", DataType.STRING)],
+        metrics=[FieldSpec("rsvp_count", DataType.INT, FieldType.METRIC)],
+        time_field=TimeFieldSpec("mtime", DataType.LONG, time_unit="MILLISECONDS"),
+    )
+    stream = MemoryStreamProvider(num_partitions=1)
+    physical = cluster.add_realtime_table(
+        schema, stream, rows_per_segment=50, replication=replication
+    )
+    for i in range(50):
+        stream.produce(
+            {"venue_name": f"v{i % 3}", "rsvp_count": i % 5, "mtime": 10_000 + i}
+        )
+    return cluster, physical, stream
+
+
+def test_committer_partitioned_mid_upload_fails_over(tmp_path):
+    """Acceptance (c): committer elected, partitioned away mid-upload,
+    lease expires -> a caught-up replica is re-elected and commits;
+    the old committer's late segmentCommit is rejected by the lease/
+    leadership fence; exactly one committed copy, byte-identical on
+    every replica, zero lost or duplicated rows."""
+    from pinot_tpu.realtime.llc import make_segment_name
+
+    cluster, physical, stream = _rt_cluster(tmp_path)
+    rm = cluster.controller.realtime_manager
+    completion = rm.completion
+    res = cluster.controller.resources
+    seg0 = make_segment_name(physical, 0, 0)
+    dms = {dm.server.name: dm for dm in rm.consumers_of(seg0)}
+    assert set(dms) == {"server0", "server1"}
+    for dm in dms.values():
+        dm.consume_step(max_rows=1000)
+        assert dm.offset == 50
+
+    # lease plane: both replicas leased, then the elected committer's
+    # lease expires (it is partitioned away)
+    leases = {"server0": True, "server1": True}
+    completion.lease_checker = lambda s: leases[s]
+
+    # both report; max-offset tie -> name order picks server1
+    resp, _ = completion.segment_consumed(seg0, "server0", 50)
+    assert resp == "HOLD"
+    resp, _ = completion.segment_consumed(seg0, "server1", 50)
+    assert resp == "COMMIT"  # server1 elected, told to upload...
+
+    # ...and vanishes mid-upload: its lease expires before the bytes land
+    leases["server1"] = False
+    committed_late = dms["server1"].mutable.to_committed_segment()
+
+    # the surviving replica's next round re-elects it
+    resp, _ = completion.segment_consumed(seg0, "server0", 50)
+    assert resp == "COMMIT"
+    meters = cluster.controller.metrics
+    assert meters.meter("fence.committerReElections").count == 1
+    committed = dms["server0"].mutable.to_committed_segment()
+    assert completion.segment_commit(seg0, "server0", committed) == "KEEP"
+
+    # the old committer's LATE upload bounces off the fence
+    assert completion.segment_commit(seg0, "server1", committed_late) == "NOT_LEADER"
+    assert meters.meter("fence.leaseRejections").count == 1
+    # ... and its next consumed round learns the final verdict (KEEP:
+    # it consumed exactly the committed range)
+    resp, target = completion.segment_consumed(seg0, "server1", 50)
+    assert resp == "KEEP" and target == 50
+
+    # exactly one committed copy at the committed offset
+    info = res.get_segment_metadata(physical, seg0)
+    assert info["metadata"].custom.get("endOffset") == 50
+    ideal = res.get_ideal_state(physical)
+    assert all(st == "ONLINE" for st in ideal[seg0].values())
+    # replicas serve byte-identical committed bytes
+    crcs = set()
+    for server in cluster.servers:
+        tdm = server.data_manager.table(physical)
+        acquired = tdm.acquire_segments([seg0])
+        try:
+            crcs.update(d.segment.metadata.crc for d in acquired)
+        finally:
+            tdm.release_segments(acquired)
+    assert len(crcs) == 1
+    # zero lost, zero duplicated rows vs consumed offsets
+    result = cluster.query("SELECT count(*) FROM meetupRsvp")
+    assert result.num_docs_scanned == 50 and not result.exceptions
+    cluster.stop()
+
+
+def test_committer_stall_reelects_despite_valid_controller_side_lease(tmp_path):
+    """ONE-WAY partition on the commit plane: the victim committer's
+    heartbeats still reach the controller (its controller-side lease
+    keeps renewing) while its self-fenced commit plane goes silent —
+    lease validity alone cannot detect this.  The commit-stall window
+    re-elects a caught-up replica, and the old committer's late upload
+    is answered idempotently (no double commit)."""
+    from pinot_tpu.realtime.llc import make_segment_name
+
+    cluster, physical, stream = _rt_cluster(tmp_path)
+    rm = cluster.controller.realtime_manager
+    completion = rm.completion
+    seg0 = make_segment_name(physical, 0, 0)
+    dms = {dm.server.name: dm for dm in rm.consumers_of(seg0)}
+    for dm in dms.values():
+        dm.consume_step(max_rows=1000)
+        assert dm.offset == 50
+
+    # the controller-side lease plane sees BOTH replicas alive forever
+    completion.lease_checker = lambda s: True
+    fake_now = [1000.0]
+    completion.clock = lambda: fake_now[0]
+
+    resp, _ = completion.segment_consumed(seg0, "server0", 50)
+    assert resp == "HOLD"
+    resp, _ = completion.segment_consumed(seg0, "server1", 50)
+    assert resp == "COMMIT"  # server1 elected committer
+    late = dms["server1"].mutable.to_committed_segment()
+
+    # server1 goes protocol-silent.  Inside the stall window the
+    # survivor just holds...
+    fake_now[0] += completion.commit_stall_ms / 1000.0 / 2.0
+    resp, _ = completion.segment_consumed(seg0, "server0", 50)
+    assert resp == "HOLD"
+    # ...past it, the survivor is re-elected and commits
+    fake_now[0] += completion.commit_stall_ms / 1000.0
+    resp, _ = completion.segment_consumed(seg0, "server0", 50)
+    assert resp == "COMMIT"
+    meters = cluster.controller.metrics
+    assert meters.meter("fence.committerReElections").count == 1
+    committed = dms["server0"].mutable.to_committed_segment()
+    assert completion.segment_commit(seg0, "server0", committed) == "KEEP"
+
+    # the old committer's late upload cannot double-commit: it lands on
+    # the COMMITTED short-circuit (its lease is still valid, and it
+    # consumed exactly the committed range, so KEEP is the idempotent
+    # duplicate-upload answer) — persisted exactly once
+    assert completion.segment_commit(seg0, "server1", late) == "KEEP"
+    assert meters.meter("segmentCommits").count == 1
+    result = cluster.query("SELECT count(*) FROM meetupRsvp")
+    assert result.num_docs_scanned == 50 and not result.exceptions
+    cluster.stop()
+
+
+def test_completion_epoch_fence_rejects_stale_epochs(tmp_path):
+    """Commit-plane calls carrying the WRONG incarnation's lease epoch
+    raise the typed StaleEpochError (both too-old and too-new: a zombie
+    controller must not act on its successor's committers either)."""
+    from pinot_tpu.realtime.llc import make_segment_name
+
+    cluster, physical, stream = _rt_cluster(tmp_path, replication=1)
+    completion = cluster.controller.realtime_manager.completion
+    seg0 = make_segment_name(physical, 0, 0)
+    current = cluster.controller.epoch
+
+    with pytest.raises(StaleEpochError):
+        completion.segment_consumed(seg0, "server0", 50, epoch=current - 1)
+    with pytest.raises(StaleEpochError):
+        completion.segment_consumed(seg0, "server0", 50, epoch=current + 1)
+    with pytest.raises(StaleEpochError):
+        completion.segment_commit(seg0, "server0", None, epoch=current - 1)
+    assert (
+        cluster.controller.metrics.meter("fence.staleEpochRejections").count == 3
+    )
+    # current epoch and epoch-less legacy callers pass the fence
+    resp, _ = completion.segment_consumed(seg0, "server0", 10, epoch=current)
+    assert resp in ("HOLD", "CATCH_UP", "COMMIT")
+    resp, _ = completion.segment_consumed(seg0, "server0", 10)
+    assert resp in ("HOLD", "CATCH_UP", "COMMIT")
+    cluster.stop()
+
+
+def test_inprocess_try_commit_freezes_without_lease(tmp_path):
+    """The in-process consumer's write path honors the lease fence too:
+    an expired lease freezes try_commit (HOLD, offset intact)."""
+    from pinot_tpu.realtime.llc import make_segment_name
+
+    cluster, physical, stream = _rt_cluster(tmp_path, replication=1)
+    rm = cluster.controller.realtime_manager
+    seg0 = make_segment_name(physical, 0, 0)
+    dm = rm.consumers_of(seg0)[0]
+    dm.consume_step(max_rows=1000)
+    server = dm.server
+
+    clock = [0.0]
+    server.lease = ServingLease(clock=lambda: clock[0])
+    server.lease.renew({"epoch": cluster.controller.epoch, "durationS": 1.0})
+    clock[0] = 5.0  # expired: no write authority
+    assert dm.try_commit() == "HOLD"
+    assert dm.offset == 50  # frozen, not reset
+    blocked = server.metrics.meter("lease.blockedCommits").count
+    assert blocked == 1
+
+    clock[0] = 5.5
+    server.lease.renew({"epoch": cluster.controller.epoch, "durationS": 10.0})
+    assert dm.try_commit() == "KEEP"  # committed once authority returned
+    cluster.stop()
+
+
+# ------------------------------------------------------------------
+# RemoteConsumer freeze-and-retry (satellite)
+# ------------------------------------------------------------------
+class _StubStarter:
+    """Just enough NetworkedServerStarter surface for a RemoteConsumer."""
+
+    def __init__(self, name="srvX"):
+        self.name = name
+        self.server = ServerInstance(name)
+        self.posts = []
+        self.fail_posts = False
+        self.post_reply = {"response": "HOLD", "targetOffset": None}
+
+    def _post(self, path, payload):
+        self.posts.append((path, payload))
+        if self.fail_posts:
+            raise OSError("connection refused")
+        return dict(self.post_reply)
+
+    def upload_segment_bytes(self, path, segment):
+        raise OSError("connection refused")
+
+
+def _remote_consumer(starter):
+    from pinot_tpu.server.network_starter import RemoteConsumer
+
+    schema_json = {
+        "schemaName": "t",
+        "dimensionFieldSpecs": [{"name": "d", "dataType": "STRING"}],
+        "metricFieldSpecs": [{"name": "m", "dataType": "INT"}],
+    }
+    msg = {
+        "streamDescriptor": {"type": "memory", "partitions": 1},
+        "schemaJson": schema_json,
+        "partition": 0,
+        "startOffset": 17,
+        "rowsPerSegment": 100,
+    }
+    return RemoteConsumer(starter, "t_REALTIME", "t_REALTIME__0__0", msg,
+                          poll_interval_s=0.01)
+
+
+def test_remote_consumer_freezes_on_unreachable_controller():
+    """Controller unreachability mid-protocol = freeze-and-retry: the
+    round returns False, the offset is untouched, the backoff escalates
+    with full jitter, and a later success resets it."""
+    starter = _StubStarter()
+    consumer = _remote_consumer(starter)
+    consumer.stop()  # no thread: we drive rounds by hand
+
+    starter.fail_posts = True
+    t0 = time.monotonic()
+    assert consumer._completion_round() is False
+    assert consumer._completion_round() is False
+    assert consumer.offset == 17  # frozen
+    assert consumer._ctrl_backoff.failures == 2
+    assert time.monotonic() - t0 < 5.0  # jittered, bounded waits
+
+    starter.fail_posts = False
+    assert consumer._completion_round() is False  # HOLD reply
+    assert consumer._ctrl_backoff.failures == 0  # reset on success
+    # the protocol payload carries the server's lease epoch slot
+    assert starter.posts[-1][1]["segment"] == "t_REALTIME__0__0"
+    assert "epoch" in starter.posts[-1][1]
+    starter.server.shutdown()
+
+
+def test_remote_consumer_commit_unreachable_freezes_not_fails():
+    """A commit upload that cannot reach the controller freezes the
+    round (the copy may have landed with only the reply lost — the next
+    segmentConsumed resolves it idempotently)."""
+    starter = _StubStarter()
+    consumer = _remote_consumer(starter)
+    consumer.stop()
+    starter.post_reply = {"response": "COMMIT", "targetOffset": 17}
+    assert consumer._completion_round() is False  # upload raised -> frozen
+    assert consumer.offset == 17
+    assert consumer._ctrl_backoff.failures >= 1
+    starter.server.shutdown()
+
+
+def test_remote_consumer_lease_expiry_blocks_round():
+    starter = _StubStarter()
+    consumer = _remote_consumer(starter)
+    consumer.stop()
+    clock = [0.0]
+    starter.server.lease = ServingLease(clock=lambda: clock[0])
+    starter.server.lease.renew({"epoch": 5, "durationS": 1.0})
+    clock[0] = 2.0  # expired
+    assert consumer._completion_round() is False
+    assert starter.posts == []  # never reached the controller
+    clock[0] = 2.5
+    starter.server.lease.renew({"epoch": 6, "durationS": 5.0})
+    assert consumer._completion_round() is False  # HOLD reply flows again
+    assert starter.posts[-1][1]["epoch"] == 6
+    starter.server.shutdown()
+
+
+# ------------------------------------------------------------------
+# broker snapshot hold (all-dead snapshots are suspect)
+# ------------------------------------------------------------------
+def test_broker_holds_routing_on_all_dead_snapshot():
+    """A snapshot claiming EVERY server is dead is indistinguishable
+    from the controller having been the partitioned one (post-heal,
+    the fleet's heartbeats may simply not have landed yet): the broker
+    keeps its last routing and refetches until servers reappear."""
+    from pinot_tpu.broker.network_starter import NetworkedBrokerStarter
+
+    starter = NetworkedBrokerStarter("http://127.0.0.1:9")  # never polled
+    h = starter.handler
+    base = {
+        "epoch": "1", "drainingServers": [], "quotas": {},
+        "timeBoundaries": {},
+    }
+    starter._apply_state(
+        dict(
+            base, version=5, servers={"s0": ["127.0.0.1", 1234]},
+            deadServers=[], tables={"t_OFFLINE": {"seg0": {"s0": "ONLINE"}}},
+        )
+    )
+    assert starter._version == 5 and "t_OFFLINE" in h.routing.tables()
+
+    starter._apply_state(
+        dict(
+            base, version=6, servers={}, deadServers=["s0"],
+            tables={"t_OFFLINE": {"seg0": {}}},
+        )
+    )
+    assert starter._version == 5  # held: version NOT advanced
+    assert "t_OFFLINE" in h.routing.tables()  # routing intact
+    assert h.metrics.meter("controller.allDeadSnapshotsHeld").count == 1
+
+    # a snapshot with live servers applies normally again
+    starter._apply_state(
+        dict(
+            base, version=7, servers={"s0": ["127.0.0.1", 1234]},
+            deadServers=[], tables={"t_OFFLINE": {"seg0": {"s0": "ONLINE"}}},
+        )
+    )
+    assert starter._version == 7
+
+
+# ------------------------------------------------------------------
+# jittered backoff helper
+# ------------------------------------------------------------------
+def test_full_jitter_backoff_escalates_and_resets():
+    from pinot_tpu.utils.retry import FullJitterBackoff
+
+    b = FullJitterBackoff(initial_s=0.1, cap_s=1.0, seed=42)
+    delays = [b.next_delay() for _ in range(8)]
+    assert all(0.0 <= d <= 1.0 for d in delays)
+    assert b.failures == 8
+    # the window is capped
+    assert max(delays) <= 1.0
+    b.reset()
+    assert b.failures == 0
+    assert b.next_delay() <= 0.1  # back to the fast first retry
