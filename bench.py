@@ -304,22 +304,68 @@ def _serving_main() -> None:
         "modes": {},
     }
     brokers = {}
+    doc["utilization"] = {}
+    from pinot_tpu.engine.device import TRANSFERS
+
     for mode, pipelined in (("serial", False), ("pipelined", True)):
         broker = single_server_broker("lineitem", segments, pipeline=pipelined)
         brokers[mode] = broker
+        server = broker.local_servers[0]
         # warm every shape (staging + compile) before any measurement
         for q in queries_mixed + [Q1_PQL]:
             for _ in range(2):
                 resp = broker.handle_pql(q)
                 assert not resp.exceptions, resp.exceptions
+        # utilization plane (ISSUE 10): window the occupancy + transfer
+        # + achieved-rate accounting to the MEASURED ladder — warmup
+        # staging/compile must not inflate busy-fraction, bandwidth, or
+        # roofline figures
+        if server.lane is not None:
+            server.lane.occupancy_read("bench")
+        transfers0 = TRANSFERS.snapshot()
+        ladder_t0 = time.monotonic()
         curves = {}
         for wname, qs in workloads.items():
             curves[wname] = [_closed_loop(broker, qs, c, duration_s) for c in ladder]
-        server = broker.local_servers[0]
+        occ = (
+            server.lane.occupancy_read("bench")
+            if server.lane is not None
+            else None
+        )
+        transfers1 = TRANSFERS.snapshot()
+        transfers = {
+            k: transfers1[k] - v
+            for k, v in transfers0.items()
+            if isinstance(v, (int, float))  # skip processToken identity
+        }
+        device = server.device_utilization(roofline_since=ladder_t0)
         doc["modes"][mode] = {
             "curves": curves,
             "lane": None if server.lane is None else server.lane.stats(),
             "scheduler": server.scheduler.stats(),
+            "device": {
+                "occupancy": occ,
+                "transfers": transfers,
+                "recent": device.get("recent"),
+                "platform": device.get("platform"),
+            },
+        }
+        recent = device.get("recent") or {}
+        doc["utilization"][mode] = {
+            # flat paths for tools/perf_gate.py's serving spec bands
+            **(
+                {
+                    "busyFraction": occ["busyFraction"],
+                    "avgQueueDepth": occ["avgQueueDepth"],
+                }
+                if occ is not None
+                else {}
+            ),
+            "achievedBytesPerSec": recent.get("achievedBytesPerSec", 0.0),
+            "achievedFlopsPerSec": recent.get("achievedFlopsPerSec", 0.0),
+            "rooflineFraction": recent.get("rooflineFraction"),
+            "d2hBytes": transfers.get("d2hBytes", 0),
+            "h2dBytes": transfers.get("h2dBytes", 0),
         }
         print(json.dumps({"mode_done": mode}), file=__import__("sys").stderr, flush=True)
 

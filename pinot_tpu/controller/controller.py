@@ -595,6 +595,160 @@ def collect_workload(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, An
     }
 
 
+def collect_utilization(
+    ctrl: "Controller", timeout_s: float = 3.0, top_k: int = 10
+) -> Dict[str, Any]:
+    """Fleet device-utilization rollup (``/debug/utilization``): every
+    alive server's ``/debug/device`` snapshot included VERBATIM under
+    ``servers.<name>.device`` — the totals below are computed from
+    exactly those snapshots, so the rollup always equals what it
+    fetched (the consistency the tier-1 acceptance test asserts) —
+    plus fleet aggregates (summed transfers, combined achieved rates
+    over the recent windows, occupancy spread) and the top-K
+    UNDERutilized executed plan shapes across every server's
+    ``/debug/plans`` registry.  A shape with heavy device time and a
+    low roofline fraction is exactly what the upcoming batched-serving
+    and multichip PRs should target first; this is their gating
+    measurement substrate.  Unreachable servers degrade to an
+    ``unreachable`` entry (partial rollups say so)."""
+    import urllib.error
+    import urllib.request
+
+    targets = [
+        i
+        for i in ctrl.resources.instances_snapshot()
+        if i.role == "server" and i.alive and i.url
+    ]
+
+    def fetch(inst):
+        # the two GETs degrade independently: a server whose plans
+        # registry times out still contributes its device snapshot
+        # (only a failed DEVICE fetch marks it unreachable)
+        out: Dict[str, Any] = {}
+        base = inst.url.rstrip("/")
+        try:
+            with urllib.request.urlopen(
+                base + "/debug/device", timeout=timeout_s
+            ) as r:
+                out["device"] = json.loads(r.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            out["_error"] = str(e)
+            return out
+        # full registry head ranked by cost: the underutilized-shape
+        # scan wants the expensive shapes, not the frequent ones
+        try:
+            with urllib.request.urlopen(
+                base + "/debug/plans?by=cost&top=1024", timeout=timeout_s
+            ) as r:
+                out["plans"] = json.loads(r.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            out["plansError"] = str(e)
+        return out
+
+    results = []
+    if targets:
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(16, len(targets))
+        ) as pool:
+            results = list(pool.map(fetch, targets))
+
+    servers: Dict[str, Any] = {}
+    unreachable: Dict[str, str] = {}
+    totals = {
+        "h2dBytes": 0,
+        "d2hBytes": 0,
+        "deviceMs": 0.0,
+        "deviceBytes": 0,
+        "queries": 0,
+    }
+    busy: List[float] = []
+    fractions: List[float] = []
+    profiles_active = 0
+    shapes: List[Dict[str, Any]] = []
+    # transfer counters are per-PROCESS (like the staging cache they
+    # instrument): servers sharing one process all report the same
+    # cumulative numbers, so the fleet total counts each processToken
+    # once instead of multiplying by co-resident servers
+    seen_transfer_tokens: set = set()
+    for inst, snap in zip(targets, results):
+        if "_error" in snap:
+            unreachable[inst.name] = snap["_error"]
+            continue
+        dev = snap.get("device") or {}
+        servers[inst.name] = {"device": dev}
+        if "plansError" in snap:
+            servers[inst.name]["plansError"] = snap["plansError"]
+        occ = dev.get("occupancy") or {}
+        if occ:
+            busy.append(float(occ.get("busyFraction") or 0.0))
+        tr = dev.get("transfers") or {}
+        token = tr.get("processToken") or f"_anon-{inst.name}"
+        if token not in seen_transfer_tokens:
+            seen_transfer_tokens.add(token)
+            totals["h2dBytes"] += int(tr.get("h2dBytes") or 0)
+            totals["d2hBytes"] += int(tr.get("d2hBytes") or 0)
+        recent = dev.get("recent") or {}
+        totals["deviceMs"] = round(
+            totals["deviceMs"] + float(recent.get("deviceMs") or 0.0), 3
+        )
+        totals["deviceBytes"] += int(recent.get("deviceBytes") or 0)
+        totals["queries"] += int(recent.get("queries") or 0)
+        if recent.get("rooflineFraction") is not None:
+            fractions.append(float(recent["rooflineFraction"]))
+        if (dev.get("profiler") or {}).get("active"):
+            profiles_active += 1
+        for plan in (snap.get("plans") or {}).get("plans") or []:
+            roof = plan.get("roofline")
+            if not roof:
+                continue  # never ran on device: nothing to rank
+            shapes.append(
+                {
+                    "server": inst.name,
+                    "digest": plan.get("digest"),
+                    "summary": plan.get("summary", ""),
+                    "table": plan.get("table", ""),
+                    "count": plan.get("count", 0),
+                    "deviceMs": roof.get("deviceMs", 0),
+                    "deviceBytes": roof.get("deviceBytes", 0),
+                    "achievedBytesPerSec": roof.get("achievedBytesPerSec", 0),
+                    "rooflineFraction": roof.get("rooflineFraction"),
+                }
+            )
+
+    # least-utilized first: shapes with a declared-peak fraction rank
+    # before unknown-peak shapes (ranked by raw achieved bytes/s) —
+    # ties broken toward the shapes burning the most device time,
+    # which are the ones worth fixing first
+    def _under_key(s: Dict[str, Any]):
+        f = s.get("rooflineFraction")
+        if f is not None:
+            return (0, f, -float(s.get("deviceMs") or 0))
+        return (1, float(s.get("achievedBytesPerSec") or 0),
+                -float(s.get("deviceMs") or 0))
+
+    ms = totals["deviceMs"]
+    return {
+        "servers": servers,
+        "totals": dict(
+            totals,
+            achievedBytesPerSec=(
+                round(totals["deviceBytes"] * 1000.0 / ms, 3) if ms > 0 else 0.0
+            ),
+        ),
+        "occupancy": {
+            "servers": len(busy),
+            "meanBusyFraction": (
+                round(sum(busy) / len(busy), 6) if busy else 0.0
+            ),
+            "maxBusyFraction": round(max(busy), 6) if busy else 0.0,
+        },
+        "rooflineFraction": round(max(fractions), 6) if fractions else None,
+        "profilesActive": profiles_active,
+        "underutilizedPlans": sorted(shapes, key=_under_key)[:top_k],
+        "unreachable": unreachable,
+    }
+
+
 def _split_path(path: str) -> Optional[List[str]]:
     """URL-decoded path segments, or None for segments that would
     traverse the filesystem when joined into store paths (%2F / '..')."""
@@ -739,6 +893,14 @@ class ControllerHttpServer:
                         )
                     if parts == ["debug", "workload"]:
                         return self._respond(collect_workload(ctrl))
+                    if parts == ["debug", "utilization"]:
+                        return self._respond(collect_utilization(ctrl))
+                    if parts == ["dashboard", "utilization"]:
+                        return self._respond_html(
+                            dashboard.render_utilization(
+                                ctrl, collect_utilization(ctrl)
+                            )
+                        )
                     if parts == ["dashboard", "workload"]:
                         return self._respond_html(
                             dashboard.render_workload(ctrl, collect_workload(ctrl))

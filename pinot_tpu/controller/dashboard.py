@@ -42,6 +42,7 @@ _NAV = (
     "<a href='/dashboard/metrics'>Metrics</a>"
     "<a href='/dashboard/capacity'>Capacity</a>"
     "<a href='/dashboard/workload'>Workload</a>"
+    "<a href='/dashboard/utilization'>Utilization</a>"
     "<a href='/clusterstate'>Raw state (JSON)</a></nav>"
 )
 
@@ -391,6 +392,97 @@ def render_workload(ctrl, workload: dict) -> str:
     _workload_table(body, workload.get("topByCount") or [], "Top by frequency")
     _workload_table(body, workload.get("topByCost") or [], "Top by cost")
     return _page("Workload", body)
+
+
+def _fmt_frac(v) -> str:
+    if v is None:
+        return "n/a (no peak declared)"
+    try:
+        return f"{float(v) * 100.0:.2f}%"
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def render_utilization(ctrl, util: dict) -> str:
+    """Fleet device-utilization page (``collect_utilization`` rollup):
+    per-server lane occupancy, transfer totals, achieved-vs-peak
+    roofline rates, profiler state, and the top-K underutilized plan
+    shapes — the page the throughput arc (multichip, batched serving,
+    bit-sliced kernels) is gated on."""
+    totals = util.get("totals") or {}
+    occ = util.get("occupancy") or {}
+    body = ["<h1>Device utilization</h1>"]
+    body.append(
+        f"<p>Fleet achieved: <b>{_fmt_bytes(totals.get('achievedBytesPerSec', 0))}/s</b>"
+        f" over {totals.get('queries', 0)} recent device queries"
+        f" &middot; roofline: <b>{_fmt_frac(util.get('rooflineFraction'))}</b>"
+        f" &middot; mean busy: <b>{_fmt_frac(occ.get('meanBusyFraction', 0))}</b>"
+        f" &middot; active profiles: <b>{util.get('profilesActive', 0)}</b>"
+        f" &middot; raw JSON: <a href='/debug/utilization'>/debug/utilization</a></p>"
+    )
+    unreachable = util.get("unreachable") or {}
+    if unreachable:
+        names = ", ".join(_esc(n) for n in sorted(unreachable))
+        body.append(f"<p class='bad'>Partial rollup — unreachable: {names}</p>")
+
+    body.append("<h2>Servers</h2>")
+    servers = util.get("servers") or {}
+    if not servers:
+        body.append("<p>No servers with an admin HTTP surface registered.</p>")
+    else:
+        body.append(
+            "<table><tr><th>server</th><th>platform</th><th>busy</th>"
+            "<th>avg queue</th><th>H2D</th><th>D2H</th>"
+            "<th>achieved B/s</th><th>roofline</th><th>profiler</th></tr>"
+        )
+        for name, entry in sorted(servers.items()):
+            dev = entry.get("device") or {}
+            plat = dev.get("platform") or {}
+            o = dev.get("occupancy") or {}
+            tr = dev.get("transfers") or {}
+            recent = dev.get("recent") or {}
+            prof = dev.get("profiler") or {}
+            prof_str = (
+                "<span class='warn'>capturing</span>"
+                if prof.get("active")
+                else "idle"
+            )
+            body.append(
+                f"<tr><td>{_esc(name)}</td>"
+                f"<td>{_esc(plat.get('deviceKind') or plat.get('platform') or '?')}</td>"
+                f"<td>{_fmt_frac(o.get('busyFraction', 0))}</td>"
+                f"<td>{o.get('avgQueueDepth', 0)}</td>"
+                f"<td>{_fmt_bytes(tr.get('h2dBytes', 0))}</td>"
+                f"<td>{_fmt_bytes(tr.get('d2hBytes', 0))}</td>"
+                f"<td>{_fmt_bytes(recent.get('achievedBytesPerSec', 0))}/s</td>"
+                f"<td>{_fmt_frac(recent.get('rooflineFraction'))}</td>"
+                f"<td>{prof_str}</td></tr>"
+            )
+        body.append("</table>")
+
+    body.append("<h2>Most underutilized plan shapes (device-executed)</h2>")
+    plans = util.get("underutilizedPlans") or []
+    if not plans:
+        body.append("<p>No device-executed plan shapes recorded yet.</p>")
+    else:
+        body.append(
+            "<table><tr><th>server</th><th>digest</th><th>shape</th>"
+            "<th>table</th><th>execs</th><th>device ms</th>"
+            "<th>achieved B/s</th><th>roofline</th></tr>"
+        )
+        for p in plans:
+            body.append(
+                f"<tr><td>{_esc(p.get('server'))}</td>"
+                f"<td><code>{_esc(p.get('digest'))}</code></td>"
+                f"<td>{_esc(p.get('summary', ''))}</td>"
+                f"<td>{_esc(p.get('table', ''))}</td>"
+                f"<td>{p.get('count', 0)}</td>"
+                f"<td>{round(float(p.get('deviceMs', 0)), 1)}</td>"
+                f"<td>{_fmt_bytes(p.get('achievedBytesPerSec', 0))}/s</td>"
+                f"<td>{_fmt_frac(p.get('rooflineFraction'))}</td></tr>"
+            )
+        body.append("</table>")
+    return _page("Device utilization", body)
 
 
 def render_query_console() -> str:

@@ -27,9 +27,10 @@ the jit cache stays bounded; padding docs carry dictId 0.
 """
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -317,7 +318,7 @@ class StagingLedger:
         self.evictions = 0
         self.evicted_bytes = 0
 
-    def update(self, staged: StagedTable, table: str) -> None:
+    def update(self, staged: StagedTable, table: str) -> int:
         total, by_column, by_role = _measure_staged(staged)
         with self._lock:
             self._entries[staged.token] = {
@@ -330,6 +331,7 @@ class StagingLedger:
             now = sum(e["bytes"] for e in self._entries.values())
             if now > self.high_watermark:
                 self.high_watermark = now
+        return total
 
     def drop(self, staged: StagedTable) -> None:
         with self._lock:
@@ -378,6 +380,56 @@ class StagingLedger:
 
 
 LEDGER = StagingLedger()
+
+
+class TransferStats:
+    """Cumulative host<->device transfer accounting — the measured-
+    bandwidth half of the utilization plane (the staging ledger above
+    tracks what is RESIDENT; this tracks what MOVED).  H2D marks come
+    from the staging paths (``get_staged`` cache misses / role
+    augmentation) and the batched query-input upload
+    (``to_device_inputs``); D2H marks come from the packed result fetch
+    (``engine/packing.py``) and the executor's raw-output fallback.
+    Per-process, like the staging cache it instruments."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.h2d_bytes = 0
+        self.h2d_transfers = 0
+        self.d2h_bytes = 0
+        self.d2h_transfers = 0
+        # process identity in every snapshot: servers sharing a process
+        # (in-process clusters, the chaos harness) all report THIS one
+        # counter, and fleet rollups dedupe on the token instead of
+        # multiply-counting the same bytes per server
+        self.process_token = f"{os.getpid():x}-{id(self):x}"
+
+    def record_h2d(self, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        with self._lock:
+            self.h2d_bytes += int(nbytes)
+            self.h2d_transfers += 1
+
+    def record_d2h(self, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        with self._lock:
+            self.d2h_bytes += int(nbytes)
+            self.d2h_transfers += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "h2dBytes": self.h2d_bytes,
+                "h2dTransfers": self.h2d_transfers,
+                "d2hBytes": self.d2h_bytes,
+                "d2hTransfers": self.d2h_transfers,
+                "processToken": self.process_token,
+            }
+
+
+TRANSFERS = TransferStats()
 
 
 def _table_of(segments: Sequence[ImmutableSegment]) -> str:
@@ -468,9 +520,12 @@ def get_staged(
                         LEDGER.drop(old)
                     _stage_cache.clear()
                 _stage_cache[key] = st
-                LEDGER.update(st, _table_of(segments))
+                staged_bytes = LEDGER.update(st, _table_of(segments))
+            # a cold stage IS one H2D transfer burst of the measured
+            # array bytes (the utilization plane's upload accounting)
+            TRANSFERS.record_h2d(staged_bytes)
         else:
-            _augment_staged(
+            attached = _augment_staged(
                 st,
                 segments,
                 raw_columns,
@@ -481,13 +536,19 @@ def get_staged(
                     c for c in column_names if c not in set(skip_base_columns)
                 ],
             )
-            # re-measure (augmentation attaches arrays) ONLY while still
-            # cache-resident: a concurrent size-cap clear already counted
-            # this table out, and updating after that would strand a
-            # ledger entry nothing will ever drop
-            with _cache_guard:
-                if _stage_cache.get(key) is st:
-                    LEDGER.update(st, _table_of(segments))
+            if attached:
+                # re-measure (augmentation attached arrays) ONLY while
+                # still cache-resident: a concurrent size-cap clear
+                # already counted this table out, and updating after
+                # that would strand a ledger entry nothing will ever
+                # drop.  A plain hit (attached == 0 — the overwhelmingly
+                # common case) walks no arrays at all on this path.
+                with _cache_guard:
+                    if _stage_cache.get(key) is st:
+                        LEDGER.update(st, _table_of(segments))
+                # augmentation's newly-attached role arrays ARE the H2D
+                # delta (zero on a plain cache hit — no phantom transfers)
+                TRANSFERS.record_h2d(attached)
     return st
 
 
@@ -499,8 +560,11 @@ def _augment_staged(
     hll_columns: Sequence[str],
     ctx,
     base_columns: Sequence[str] = (),
-) -> None:
-    """Attach missing role arrays to an already-staged table."""
+) -> int:
+    """Attach missing role arrays to an already-staged table.  Returns
+    the bytes newly uploaded (0 on a plain hit) so the caller can record
+    the exact H2D delta without re-walking every staged array."""
+    attached = 0
     fdt = config.np_float_dtype()
     S, n_pad = st.num_segments, st.n_pad
     for name in base_columns:
@@ -512,10 +576,12 @@ def _augment_staged(
         sc.fwd = jnp.asarray(
             _stack_fwd(cols, S, n_pad, config.index_dtype(sc.card_pad))
         )
+        attached += int(sc.fwd.nbytes)
         if sc.is_numeric and sc.dict_vals is None:
             sc.dict_vals = jnp.asarray(
                 _stack_dict_vals(cols, S, sc.card_pad, fdt)
             )
+            attached += int(sc.dict_vals.nbytes)
     for name in raw_columns:
         sc = st.columns.get(name)
         if sc is None or sc.raw is not None or not sc.is_numeric or not sc.single_value:
@@ -526,6 +592,7 @@ def _augment_staged(
             vals = np.asarray(c.dictionary.values, dtype=fdt)
             raw[i, : c.fwd.size] = vals[c.fwd]
         sc.raw = jnp.asarray(raw)
+        attached += int(sc.raw.nbytes)
     for name in gfwd_columns:
         sc = st.columns.get(name)
         if sc is None or sc.gfwd is not None or not sc.single_value or ctx is None:
@@ -537,6 +604,7 @@ def _augment_staged(
             c = seg.column(name)
             gf[i, : c.fwd.size] = remaps[i][c.fwd]
         sc.gfwd = jnp.asarray(gf)
+        attached += int(sc.gfwd.nbytes)
     for name in raw_columns:
         sc = st.columns.get(name)
         if (
@@ -553,6 +621,7 @@ def _augment_staged(
             vals = np.asarray(c.dictionary.values, dtype=fdt)
             _csr_scatter(vals[c.mv_values], c.mv_offsets, mvr[i])
         sc.mv_raw = jnp.asarray(mvr)
+        attached += int(sc.mv_raw.nbytes)
     for name in hll_columns:
         sc = st.columns.get(name)
         if sc is None or sc.hll_bucket is not None or not sc.single_value:
@@ -562,6 +631,8 @@ def _augment_staged(
         # hll_bucket, so both must be visible once bucket is
         sc.hll_rho = jnp.asarray(hr)
         sc.hll_bucket = jnp.asarray(hb)
+        attached += int(sc.hll_rho.nbytes) + int(sc.hll_bucket.nbytes)
+    return attached
 
 
 def _hll_streams(cols, S: int, n_pad: int):
@@ -615,6 +686,7 @@ def to_device_inputs(tree):
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     idx = [i for i, leaf in enumerate(leaves) if isinstance(leaf, np.ndarray)]
     if idx:
+        TRANSFERS.record_h2d(sum(leaves[i].nbytes for i in idx))
         put = jax.device_put([leaves[i] for i in idx])
         for i, v in zip(idx, put):
             leaves[i] = v

@@ -56,6 +56,7 @@ timer for time spent inside launches.
 """
 from __future__ import annotations
 
+import atexit
 import os
 import threading
 import time
@@ -76,6 +77,37 @@ _SWEEP_S = 0.005
 # every lane ever constructed, for the test-suite thread-leak check
 # (tests/conftest.py): a CLOSED lane must not keep threads alive
 _all_lanes: "weakref.WeakSet[DeviceLane]" = weakref.WeakSet()
+
+# Zero-overhead contract counter for the occupancy plane (the PR 4
+# SPAN_ALLOCATIONS analog): incremented ONLY when an OccupancySampler
+# records a sample.  The lane's own busy/depth accounting is plain
+# float accumulation on state transitions — with no sampler running, a
+# launch allocates nothing occupancy-related, and the tests hold this
+# counter at zero to prove it.
+OCCUPANCY_ALLOCATIONS = 0
+
+# Interpreter-shutdown fence for the cost-analysis helper threads: a
+# daemon thread mid-XLA-trace while the runtime's C++ statics destruct
+# can abort the whole process (std::terminate), so at exit we stop
+# spawning new analyses and drain the in-flight ones (bounded join —
+# an analysis is a trace, not a compile, so this is fast).
+_shutting_down = False
+_cost_threads_lock = threading.Lock()
+_cost_threads: List[threading.Thread] = []
+
+
+def _drain_cost_analysis_threads() -> None:
+    global _shutting_down
+    _shutting_down = True
+    with _cost_threads_lock:
+        pending = [t for t in _cost_threads if t.is_alive()]
+        _cost_threads.clear()
+    deadline = time.monotonic() + 10.0
+    for t in pending:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+
+atexit.register(_drain_cost_analysis_threads)
 
 
 class DeviceExecutionError(RuntimeError):
@@ -217,7 +249,7 @@ class LaneTicket:
 class _Dispatch:
     __slots__ = (
         "key", "launch", "pending", "waiters", "completed", "value",
-        "error", "plan_digest",
+        "error", "plan_digest", "cost_provider",
     )
 
     def __init__(
@@ -226,11 +258,13 @@ class _Dispatch:
         launch: Callable[[], Any],
         pending: Callable[[Any], bool],
         plan_digest: Optional[str] = None,
+        cost_provider: Optional[Callable[[], Optional[dict]]] = None,
     ) -> None:
         self.key = key
         self.launch = launch
         self.pending = pending
         self.plan_digest = plan_digest
+        self.cost_provider = cost_provider
         self.waiters: List[LaneTicket] = []
         self.completed = False
         self.value: Any = None
@@ -269,7 +303,11 @@ class DeviceLane:
         self._open: Deque[_Dispatch] = deque()  # launched, program still running
         self._thread: Optional[threading.Thread] = None
         self._watchdog: Optional[threading.Thread] = None
-        self._threads: List[threading.Thread] = []  # all ever spawned (leak check)
+        # spawned threads still of interest to the leak check; dead
+        # entries are pruned at each registration so repeated profile
+        # captures / cost-analysis spawns don't grow this without bound
+        self._threads: List[threading.Thread] = []
+        self._threads_lock = threading.Lock()
         # restart fencing: a wedged thread that finally returns compares
         # its spawn-time generation against this and, when stale, drops
         # its result and exits without touching lane state
@@ -289,14 +327,32 @@ class DeviceLane:
         # compile cost; later launches of the same digest are warm.
         # Read by EXPLAIN (cold/warm verdict + measured ms) and exposed
         # as compile.* metrics + lane.stats()["compiledPlans"].
+        # Entries also accumulate per-digest launch timers
+        # (launchMsTotal) and, once the async analysis lands, the
+        # static XLA cost analysis ("costAnalysis": {flops,
+        # bytesAccessed, ...}) — the roofline numerator.
         self._compile: Dict[str, Dict[str, float]] = {}
+        # -- occupancy accounting (utilization plane) ----------------
+        # Plain float accumulation at state transitions — NO per-launch
+        # allocations (OCCUPANCY_ALLOCATIONS contract above).  busy =
+        # wall seconds inside launch calls; depth-seconds integrates
+        # queue depth over time.  Windowed readers (gauges, status,
+        # sampler) each diff against their own last checkpoint.
+        self._busy_s = 0.0
+        self._busy_since: Optional[float] = None
+        self._depth_s = 0.0
+        self._depth_mark = time.monotonic()
+        self._created_at = self._depth_mark
+        self._occ_reads: Dict[str, tuple] = {}  # reader key -> (t, busy, depth_s, last_result)
         if metrics is not None:
             # pre-register the lane series (depth/inflight gauges,
             # dispatch/coalesce/shed/restart meters) so /metrics shows
             # them at zero before the first device query
             for name in ("lane.dispatches", "lane.coalesced", "lane.shed",
                          "lane.deviceFailures", "lane.restarts",
-                         "compile.cold", "compile.warm"):
+                         "compile.cold", "compile.warm",
+                         "compile.costAnalyses",
+                         "compile.costAnalysisUnavailable"):
                 metrics.meter(name)
             metrics.timer("compile.firstCallMs")
             metrics.gauge("lane.depth").set(0)
@@ -312,11 +368,18 @@ class DeviceLane:
         deadline: Optional[float] = None,
         pending: Callable[[Any], bool] = outputs_pending,
         plan_digest: Optional[str] = None,
+        cost_provider: Optional[Callable[[], Optional[dict]]] = None,
     ) -> LaneTicket:
         """Enqueue a kernel launch, or coalesce onto an identical one
         that is queued, launching, or still executing on device.
         Returns immediately; the caller blocks on ``ticket.result`` when
-        FINALIZE actually needs the outputs."""
+        FINALIZE actually needs the outputs.
+
+        ``cost_provider`` (optional, utilization plane): a zero-arg
+        callable returning the plan's static XLA cost analysis (or
+        None).  Invoked ONCE per plan digest on an async helper thread
+        after the digest's first successful launch — never on the lane
+        thread, so a slow analysis cannot stall serving."""
         ticket = LaneTicket(deadline)
         with self._cv:
             if self._closed:
@@ -338,9 +401,10 @@ class DeviceLane:
                 ticket.coalesced = True
                 self._hit()
             else:
-                d = _Dispatch(key, launch, pending, plan_digest)
+                d = _Dispatch(key, launch, pending, plan_digest, cost_provider)
                 d.waiters.append(ticket)
                 self._by_key[key] = d
+                self._depth_tick_locked()
                 self._queue.append(d)
                 self._set_depth()
                 # notify_all: the WATCHDOG also sleeps on this condition
@@ -375,12 +439,75 @@ class DeviceLane:
     def compile_info(self, digest: Optional[str]) -> Optional[Dict[str, float]]:
         """Compile-timeline entry for a device-plan digest: None when
         the digest has never launched here (a query would compile cold),
-        else {firstCallMs, firstAt, launches}."""
+        else {firstCallMs, firstAt, launches, launchMsTotal[,
+        costAnalysis]}.  ``costAnalysis`` is absent while the async
+        analysis is still running, a dict once it landed, and None when
+        the backend reported nothing (the explicit "unavailable")."""
         if digest is None:
             return None
         with self._cv:
             entry = self._compile.get(digest)
             return dict(entry) if entry is not None else None
+
+    # -- occupancy (utilization plane) --------------------------------
+    def _depth_tick_locked(self, now: Optional[float] = None) -> None:
+        """Integrate queue depth over time (lock held, called BEFORE
+        every queue mutation): pure float accumulation, no
+        allocations."""
+        if now is None:
+            now = time.monotonic()
+        self._depth_s += len(self._queue) * (now - self._depth_mark)
+        self._depth_mark = now
+
+    def occupancy_read(
+        self, key: str = "default", min_interval_s: float = 0.0
+    ) -> Dict[str, float]:
+        """Windowed occupancy read: busy-fraction and time-weighted
+        average queue depth since THIS reader's previous call (first
+        call windows from lane construction).  Distinct readers (the
+        device.util gauges, status(), a sampler) pass distinct keys so
+        their windows never clobber each other; ``min_interval_s``
+        returns the cached last result for rapid re-reads (two gauges
+        sharing one key read one consistent window).  Idle lanes read
+        0.0 — there is no decay to wait out."""
+        now = time.monotonic()
+        with self._cv:
+            prev = self._occ_reads.get(key)
+            if (
+                prev is not None
+                and min_interval_s > 0
+                and now - prev[0] < min_interval_s
+            ):
+                return dict(prev[3])
+            busy = self._busy_s
+            if self._busy_since is not None:
+                # count the in-flight launch's elapsed time as busy so a
+                # long cold compile doesn't read as an idle device
+                busy += max(0.0, now - self._busy_since)
+            self._depth_tick_locked(now)
+            depth_s = self._depth_s
+            if prev is None:
+                prev_t, prev_busy, prev_depth = self._created_at, 0.0, 0.0
+            else:
+                prev_t, prev_busy, prev_depth = prev[0], prev[1], prev[2]
+            dt = max(now - prev_t, 1e-9)
+            result = {
+                "windowS": round(dt, 6),
+                "busyFraction": round(
+                    min(max((busy - prev_busy) / dt, 0.0), 1.0), 6
+                ),
+                "avgQueueDepth": round(max((depth_s - prev_depth) / dt, 0.0), 6),
+                "depth": len(self._queue),
+                "inflight": 1 if self._busy_since is not None else 0,
+            }
+            if len(self._occ_reads) > 32 and key not in self._occ_reads:
+                # bounded reader registry: evict the least-recently-read
+                # checkpoint only — clearing everything would reset every
+                # established reader's window to lane construction
+                oldest = min(self._occ_reads.items(), key=lambda kv: kv[1][0])[0]
+                del self._occ_reads[oldest]
+            self._occ_reads[key] = (now, busy, depth_s, result)
+        return dict(result)
 
     def close(self) -> None:
         """Idempotent: stop accepting submits, fail queued waiters, and
@@ -391,6 +518,7 @@ class DeviceLane:
                 return
             self._closed = True
             drained = list(self._queue)
+            self._depth_tick_locked()
             self._queue.clear()
             self._open.clear()
             self._by_key.clear()
@@ -403,6 +531,17 @@ class DeviceLane:
                 w._deliver(error=err)
 
     # -- internals -----------------------------------------------------
+    def _track_thread(self, t: threading.Thread) -> None:
+        """Register a spawned thread for the leak check.  Builds a new
+        list (atomic reference swap) so concurrent leak-check readers
+        never see a half-pruned list; the dedicated lock keeps two
+        registrations (lane spawn under _cv, sampler start under its
+        own lock) from losing one another's entry."""
+        with self._threads_lock:
+            alive = [x for x in self._threads if x.is_alive()]
+            alive.append(t)
+            self._threads = alive
+
     def _spawn_lane_locked(self) -> None:
         t = threading.Thread(
             target=self._run,
@@ -411,8 +550,50 @@ class DeviceLane:
             daemon=True,
         )
         self._thread = t
-        self._threads.append(t)
+        self._track_thread(t)
         t.start()
+
+    def _spawn_cost_analysis_locked(self, digest: str, provider) -> None:
+        """One short-lived helper thread per cold plan digest: runs the
+        static XLA cost analysis off the serving path and stores the
+        result (or the explicit None = "unavailable") into the compile
+        registry.  Registered in the leak-check list like every lane
+        thread, and in the module drain list so interpreter shutdown
+        joins any still-tracing analysis before XLA statics destruct."""
+        if _shutting_down:
+            return
+        t = threading.Thread(
+            target=self._run_cost_analysis,
+            args=(digest, provider),
+            name=f"lane-cost-analysis-{digest[:8]}",
+            daemon=True,
+        )
+        self._track_thread(t)
+        with _cost_threads_lock:
+            _cost_threads[:] = [x for x in _cost_threads if x.is_alive()]
+            _cost_threads.append(t)
+        t.start()
+
+    def _run_cost_analysis(self, digest: str, provider) -> None:
+        if _shutting_down:
+            return
+        try:
+            analysis = provider()
+        except Exception:
+            analysis = None
+        if analysis is not None and not isinstance(analysis, dict):
+            analysis = None
+        with self._cv:
+            entry = self._compile.get(digest)
+            if entry is not None:
+                entry["costAnalysis"] = analysis
+        if self.metrics is not None:
+            name = (
+                "compile.costAnalyses"
+                if analysis is not None
+                else "compile.costAnalysisUnavailable"
+            )
+            self.metrics.meter(name).mark()
 
     def _spawn_watchdog_locked(self) -> None:
         if self._watchdog is not None:
@@ -421,7 +602,7 @@ class DeviceLane:
             target=self._watch, name="device-lane-watchdog", daemon=True
         )
         self._watchdog = w
-        self._threads.append(w)
+        self._track_thread(w)
         w.start()
 
     def _watch(self) -> None:
@@ -452,6 +633,12 @@ class DeviceLane:
                 else:
                     d = infl[0]
                     self._inflight = None
+                    if self._busy_since is not None:
+                        # bank the wedged launch's window as busy time;
+                        # the abandoned thread sees itself stale later
+                        # and leaves the accounting alone
+                        self._busy_s += max(0.0, now - self._busy_since)
+                        self._busy_since = None
                     self._generation += 1
                     self.restart_count += 1
                     self.device_failure_count += 1
@@ -533,6 +720,7 @@ class DeviceLane:
                     return
                 if self._closed and not self._queue:
                     return
+                self._depth_tick_locked()
                 d = self._queue.popleft()
                 self._set_depth()
                 # deadline shed at lane-dequeue time, mirroring the
@@ -551,6 +739,7 @@ class DeviceLane:
                     # wedge inside the fault injector or the launch
                     # itself both count as in-flight stalls
                     self._inflight = (d, now)
+                    self._busy_since = now  # occupancy: device busy
             if dead:
                 self.shed_count += len(dead)
                 if self.metrics is not None:
@@ -586,6 +775,13 @@ class DeviceLane:
             cold = False
             with self._cv:
                 stale = gen != self._generation
+                if not stale and self._busy_since is not None:
+                    # occupancy: launch window closed.  Stale threads
+                    # must not touch this — after a watchdog restart
+                    # _busy_since belongs to the fresh lane thread (the
+                    # watchdog already banked the wedged window).
+                    self._busy_s += max(0.0, time.monotonic() - self._busy_since)
+                    self._busy_since = None
                 if not stale and self._inflight is not None and self._inflight[0] is d:
                     self._inflight = None
                 if stale:
@@ -615,9 +811,19 @@ class DeviceLane:
                             "firstCallMs": round(launch_ms, 3),
                             "firstAt": round(time.time(), 3),
                             "launches": 1,
+                            "launchMsTotal": round(launch_ms, 3),
                         }
+                        if d.cost_provider is not None:
+                            # static cost analysis, once per digest, on
+                            # a helper thread — never the lane thread
+                            self._spawn_cost_analysis_locked(
+                                d.plan_digest, d.cost_provider
+                            )
                     else:
                         entry["launches"] += 1
+                        entry["launchMsTotal"] = round(
+                            entry.get("launchMsTotal", 0.0) + launch_ms, 3
+                        )
                 if error is not None:
                     self.device_failure_count += 1
                 d.completed = True
@@ -643,3 +849,104 @@ class DeviceLane:
                 self.metrics.timer("phase.laneDispatch").update(launch_ms)
             for w in waiters:
                 w._deliver(value=value, error=error)
+
+
+class OccupancySampler:
+    """Periodic lane-occupancy sampler: a small thread recording
+    (wall ts, busy-fraction, avg queue depth, instantaneous depth)
+    samples into a bounded ring — the queue-depth-over-time series
+    behind ``status()["device"]`` and the profiling workflow.
+
+    STRICTLY opt-in: nothing starts it by default, and while it is not
+    running the lane's launch path performs no occupancy-related
+    allocations at all (the ``OCCUPANCY_ALLOCATIONS`` contract — the
+    lane's own accounting is plain float accumulation).  ``start()`` /
+    ``stop()`` are idempotent; the thread registers with its lane's
+    leak-check list so the conftest thread-leak guard holds the
+    lifecycle honest, and it exits on its own when the lane closes."""
+
+    def __init__(self, lane: DeviceLane, interval_s: float = 0.25,
+                 capacity: int = 240) -> None:
+        self.lane = lane
+        self.interval_s = max(0.02, float(interval_s))
+        self._ring: Deque[tuple] = deque(maxlen=max(8, capacity))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._key = f"sampler-{id(self):x}"
+        self.samples_taken = 0
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive() and not self._stop.is_set()
+
+    def start(self) -> None:
+        with self._lock:
+            if self.running or self.lane._closed:
+                return
+            prev = self._thread
+            if prev is not None and prev.is_alive():
+                # a stop() set the event but hasn't finished joining:
+                # finish the join HERE before re-arming, else the old
+                # thread could miss the cleared event and sample forever
+                # alongside the new one
+                self._stop.set()
+                prev.join(timeout=2)
+                if prev.is_alive():
+                    return  # refuse to double-start; retry after it exits
+            self._stop = threading.Event()  # fresh event per thread
+            t = threading.Thread(
+                target=self._run,
+                args=(self._stop,),
+                name="lane-occupancy-sampler",
+                daemon=True,
+            )
+            self._thread = t
+            self.lane._track_thread(t)
+            t.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop.set()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=2)
+        # drop this sampler's reader checkpoint so repeated sampler
+        # lifecycles on a long-lived lane don't walk the registry cap
+        with self.lane._cv:
+            self.lane._occ_reads.pop(self._key, None)
+
+    def _run(self, stop: threading.Event) -> None:
+        global OCCUPANCY_ALLOCATIONS
+        while not stop.wait(self.interval_s):
+            if self.lane._closed:
+                return
+            occ = self.lane.occupancy_read(self._key)
+            OCCUPANCY_ALLOCATIONS += 1
+            self.samples_taken += 1
+            self._ring.append(
+                (
+                    round(time.time(), 3),
+                    occ["busyFraction"],
+                    occ["avgQueueDepth"],
+                    occ["depth"],
+                )
+            )
+
+    def snapshot(self, last: int = 60) -> Dict[str, Any]:
+        samples = list(self._ring)[-max(1, last):]
+        return {
+            "running": self.running,
+            "intervalS": self.interval_s,
+            "samplesTaken": self.samples_taken,
+            "samples": [
+                {
+                    "ts": s[0],
+                    "busyFraction": s[1],
+                    "avgQueueDepth": s[2],
+                    "depth": s[3],
+                }
+                for s in samples
+            ],
+        }

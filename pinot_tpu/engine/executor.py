@@ -571,11 +571,15 @@ class QueryExecutor:
             dev_bytes = int(
                 dev_bytes * min(1.0, scanned_rows / staged.total_docs)
             )
-        result.add_cost(bytesScanned=dev_bytes, **cost)
+        result.add_cost(bytesScanned=dev_bytes, deviceBytes=dev_bytes, **cost)
         if block_ids is not None:
             result.add_cost(segmentsZonemap=len(live))
         else:
             result.add_cost(segmentsFullScan=len(live))
+        # device-plan identity for the utilization plane: lets the
+        # plan-stats recorder join this shape's measured wall time with
+        # the lane's static cost analysis (roofline numerator)
+        result._device_digest = pdigest
         self._phase("finalize", t0)
         return result
 
@@ -826,6 +830,7 @@ class QueryExecutor:
             return None, kernel(*args)  # raw jit: device arrays out
 
         t0 = time.perf_counter()
+        coalesced = False
         if self.lane is None:
             fetch, handle = launch()
         else:
@@ -838,25 +843,49 @@ class QueryExecutor:
                 if block_ids is None
                 else (block_ids.shape, block_ids.tobytes())
             )
+            from pinot_tpu.engine.packing import kernel_cost_analysis
+
             ticket = self.lane.submit(
                 (plan, staged.token, digest, bkey),
                 launch,
                 deadline,
                 plan_digest=pdigest,
+                # static roofline numerator: flops/bytes per launch of
+                # this compiled plan, resolved ONCE per digest on the
+                # lane's async analysis thread (graceful None fallback)
+                cost_provider=lambda: kernel_cost_analysis(kernel, args),
             )
             fetch, handle = ticket.result(deadline)
             # queue + coalesce wait only; the coalesced tag marks a
             # query that rode an identical in-flight dispatch
-            t0 = self._phase("laneWait", t0, coalesced=ticket.coalesced)
-            if cost is not None and ticket.coalesced:
+            coalesced = ticket.coalesced
+            t0 = self._phase("laneWait", t0, coalesced=coalesced)
+            if cost is not None and coalesced:
                 cost["coalesceHits"] = cost.get("coalesceHits", 0) + 1
-        outs = fetch(handle) if fetch is not None else handle
+        # exactly ONE waiter per dispatch is non-coalesced, so the
+        # physical D2H copy is counted once no matter how many queries
+        # rode the dispatch (coalesced waiters read the cached host copy)
+        outs = fetch(handle, count_transfer=not coalesced) if fetch is not None else handle
         outs = {
             k: np.asarray(v)
             if not isinstance(v, tuple)
             else tuple(np.asarray(x) for x in v)
             for k, v in outs.items()
         }
+        if fetch is None:
+            # raw-jit path (mesh/chunked kernels): the np.asarray calls
+            # above were the D2H transfers — the packed path counts its
+            # own single buffer inside packing.fetch
+            from pinot_tpu.engine.device import TRANSFERS
+
+            if not coalesced:
+                TRANSFERS.record_d2h(
+                    sum(
+                        x.nbytes
+                        for v in outs.values()
+                        for x in (v if isinstance(v, tuple) else (v,))
+                    )
+                )
         # planExec excludes lane queueing (timed above as laneWait): it
         # covers launch (serial mode) + the blocking packed D2H fetch,
         # so the per-stage timers on status() sum to wall time instead

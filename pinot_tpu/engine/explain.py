@@ -289,11 +289,19 @@ def build_explain_node(
                 compile_entry = (
                     lane.compile_info(pdigest) if lane is not None else None
                 )
-                compile_info = (
-                    {"state": "warm", **compile_entry}
-                    if compile_entry is not None
-                    else {"state": "cold"}
-                )
+                if compile_entry is not None:
+                    compile_info = {"state": "warm", **compile_entry}
+                    # static cost-analysis tri-state (utilization
+                    # plane): a dict once the async analysis landed,
+                    # explicit "unavailable" when the backend reported
+                    # nothing, "pending" while it is still running
+                    if "costAnalysis" not in compile_entry:
+                        compile_info["costAnalysis"] = "pending"
+                    elif compile_entry["costAnalysis"] is None:
+                        compile_info["costAnalysis"] = "unavailable"
+                else:
+                    # never launched here: no analysis exists yet
+                    compile_info = {"state": "cold", "costAnalysis": "unavailable"}
                 device_info = {
                     "planDigest": pdigest,
                     "compile": compile_info,

@@ -97,11 +97,21 @@ def make_packed_kernel(fn: Callable) -> Callable:
             layout_cache[key] = lay
         return lay, packed(*args)
 
-    def fetch(handle):
+    def fetch(handle, count_transfer: bool = True):
         """ONE device->host transfer + unpack; blocks until the
         dispatched program completes."""
         (treedef, layout), buf_dev = handle
         buf = np.asarray(buf_dev)
+        # D2H accounting for the utilization plane: this is THE packed
+        # result transfer, so counting here captures every pipelined
+        # and serial device query's fetch bytes.  Coalesced waiters
+        # pass count_transfer=False — they unpack the SAME cached host
+        # copy, and N records for one physical copy would inflate
+        # d2hBytes with the coalescing rate.
+        from pinot_tpu.engine.device import TRANSFERS
+
+        if count_transfer:
+            TRANSFERS.record_d2h(buf.nbytes)
         outs = []
         for shape, dt, off, nbytes in layout:
             if nbytes == 0:
@@ -119,4 +129,89 @@ def make_packed_kernel(fn: Callable) -> Callable:
 
     call.dispatch = dispatch
     call.fetch = fetch
+    # AOT lowering handle for the static cost analysis (the jitted
+    # packed program is what actually runs, so its analysis is the
+    # honest one — packing copies included)
+    call.lower = packed.lower
     return call
+
+
+# ---------------------------------------------------------------------------
+# Static XLA cost analysis (the utilization plane's "paper roofline"
+# numerator): flops + bytes-accessed estimates per compiled plan.
+# ---------------------------------------------------------------------------
+
+
+def _normalize_cost_analysis(ca) -> "dict | None":
+    """XLA cost-analysis output (dict, or list-of-dicts on older
+    backends) -> {"flops", "bytesAccessed"} floats, or None when the
+    backend reported nothing usable."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    flops = ca.get("flops")
+    if isinstance(flops, (int, float)) and flops >= 0:
+        out["flops"] = float(flops)
+    nbytes = ca.get("bytes accessed")
+    if isinstance(nbytes, (int, float)) and nbytes >= 0:
+        out["bytesAccessed"] = float(nbytes)
+    return out or None
+
+
+def kernel_cost_analysis(kernel, args) -> "dict | None":
+    """Static per-plan cost analysis for a kernel callable — the packed
+    wrapper above (``.lower`` re-exported) or a plain ``jax.jit``
+    object.  Tries the cheap path first (``lowered.cost_analysis()`` —
+    a trace plus HLO-level analysis, no XLA optimization pass), and
+    falls back to ``lowered.compile().cost_analysis()`` plus
+    ``memory_analysis`` only when ``PINOT_TPU_COST_ANALYSIS=compile``
+    (a SECOND full compile: ~free on CPU, ~25s cold on a tunneled
+    chip, so never implicit).  Returns ``{"flops", "bytesAccessed"[,
+    "peakMemoryBytes"], "source"}`` or None — every backend gap
+    degrades to None, never an exception (the graceful-fallback
+    contract the tests hold)."""
+    import os
+
+    mode = os.environ.get("PINOT_TPU_COST_ANALYSIS", "lowered")
+    if mode == "0" or mode == "off":
+        return None
+    lower = getattr(kernel, "lower", None)
+    if lower is None:
+        return None
+    try:
+        lowered = lower(*args)
+    except Exception:
+        return None
+    out = None
+    try:
+        out = _normalize_cost_analysis(lowered.cost_analysis())
+    except Exception:
+        out = None
+    if out is not None:
+        out["source"] = "lowered"
+    if mode == "compile":
+        try:
+            compiled = lowered.compile()
+            full = _normalize_cost_analysis(compiled.cost_analysis())
+            if full is not None:
+                out = dict(full)
+                out["source"] = "compiled"
+            try:
+                mem = compiled.memory_analysis()
+                peak = sum(
+                    int(getattr(mem, attr, 0) or 0)
+                    for attr in (
+                        "argument_size_in_bytes",
+                        "output_size_in_bytes",
+                        "temp_size_in_bytes",
+                    )
+                )
+                if out is not None and peak > 0:
+                    out["peakMemoryBytes"] = peak
+            except Exception:
+                pass
+        except Exception:
+            pass
+    return out

@@ -226,6 +226,10 @@ GroupKey = Tuple[str, ...]
 #                      bytes of referenced columns; postings: O(matches))
 #   deviceMs / hostMs  kernel-execution wall ms split by where the
 #                      filter/aggregate work actually ran
+#   deviceBytes        the DEVICE-TIER share of bytesScanned (staged
+#                      array bytes the kernel read) — the utilization
+#                      plane's achieved-bandwidth numerator; host/
+#                      postings bytes never pollute the roofline
 #   coalesceHits       queries served by riding an identical in-flight
 #                      device dispatch (engine/dispatch.py)
 #   qinputCacheHits    device-resident query-input cache hits
@@ -240,6 +244,7 @@ COST_KEYS = (
     "bytesScanned",
     "deviceMs",
     "hostMs",
+    "deviceBytes",
     "coalesceHits",
     "qinputCacheHits",
     "segmentsPruned",

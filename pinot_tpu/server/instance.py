@@ -43,6 +43,70 @@ from pinot_tpu.utils.trace import (
 logger = logging.getLogger(__name__)
 
 
+class _RooflineWindow:
+    """Rolling window of device-served query records backing the
+    server-wide ``device.util.achieved*`` gauges: recent achieved
+    HBM bytes/s and FLOP/s over the trailing ``window_s`` seconds,
+    plus the roofline fraction against the declared platform peaks.
+    Records happen on the request path (host side — the lane's
+    zero-alloc contract is about the launch path, not here)."""
+
+    def __init__(self, window_s: float = 300.0, capacity: int = 2048) -> None:
+        import collections
+        import threading
+
+        self.window_s = window_s
+        self._dq = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._cached: Optional[tuple] = None  # (monotonic t, snapshot)
+
+    def record(self, device_ms: float, device_bytes: float, flops: float) -> None:
+        with self._lock:
+            self._dq.append(
+                (time.monotonic(), float(device_ms), float(device_bytes), float(flops))
+            )
+            self._cached = None
+
+    def snapshot(self, since: Optional[float] = None) -> dict:
+        """``since`` (a ``time.monotonic()`` stamp) narrows the window to
+        records at/after that instant — bench uses it to exclude warmup
+        (cold-compile) queries from the measured-ladder figures.  The
+        0.5s read cache only serves the default full-window view."""
+        now = time.monotonic()
+        with self._lock:
+            if since is None and self._cached is not None and now - self._cached[0] < 0.5:
+                return dict(self._cached[1])
+            horizon = now - self.window_s
+            while self._dq and self._dq[0][0] < horizon:
+                self._dq.popleft()
+            records = (
+                list(self._dq)
+                if since is None
+                else [r for r in self._dq if r[0] >= since]
+            )
+            ms = sum(r[1] for r in records)
+            nbytes = sum(r[2] for r in records)
+            flops = sum(r[3] for r in records)
+            n = len(records)
+        out = {
+            "windowS": self.window_s,
+            "queries": n,
+            "deviceMs": round(ms, 3),
+            "deviceBytes": int(nbytes),
+            "achievedBytesPerSec": round(nbytes * 1000.0 / ms, 3) if ms > 0 else 0.0,
+            "achievedFlopsPerSec": round(flops * 1000.0 / ms, 3) if ms > 0 else 0.0,
+        }
+        from pinot_tpu.utils.platform import roofline_fractions
+
+        out["rooflineFraction"] = roofline_fractions(
+            out["achievedBytesPerSec"], out["achievedFlopsPerSec"]
+        )["rooflineFraction"]
+        if since is None:
+            with self._lock:
+                self._cached = (now, dict(out))
+        return out
+
+
 class ServerInstance:
     def __init__(
         self,
@@ -110,6 +174,55 @@ class ServerInstance:
         self.metrics.gauge("plan.digests").set_fn(self.plan_stats.digest_count)
         for k in self._TIER_KEYS:
             self.metrics.meter(f"cost.tier.{k}")
+        # device utilization & profiling plane (PR 10): occupancy +
+        # achieved-rate gauges, H2D/D2H transfer counters, and the
+        # on-demand jax.profiler bracket.  All pre-registered; the
+        # occupancy gauges are windowed lane reads (0 while idle), the
+        # sampler is opt-in (zero per-launch overhead until started).
+        from pinot_tpu.engine.device import TRANSFERS
+        from pinot_tpu.engine.dispatch import OccupancySampler
+        from pinot_tpu.server.profiler import DeviceProfiler
+
+        self._roofline_window = _RooflineWindow()
+        self.profiler = DeviceProfiler(name=name, metrics=self.metrics)
+        self.occupancy_sampler = (
+            OccupancySampler(self.lane) if self.lane is not None else None
+        )
+        if self.occupancy_sampler is not None:
+            # a deep-profile bracket records the occupancy time series
+            # alongside the XLA trace; the sampler parks again when the
+            # capture ends (stop OR auto-stop)
+            self.profiler.on_capture_end = self.occupancy_sampler.stop
+        if self.lane is not None:
+            lane = self.lane
+            self.metrics.gauge("device.util.busyFraction").set_fn(
+                lambda: lane.occupancy_read("gauge", min_interval_s=0.05)[
+                    "busyFraction"
+                ]
+            )
+            self.metrics.gauge("device.util.avgQueueDepth").set_fn(
+                lambda: lane.occupancy_read("gauge", min_interval_s=0.05)[
+                    "avgQueueDepth"
+                ]
+            )
+        else:
+            self.metrics.gauge("device.util.busyFraction").set(0)
+            self.metrics.gauge("device.util.avgQueueDepth").set(0)
+        self.metrics.gauge("device.util.h2dBytes").set_fn(
+            lambda: TRANSFERS.h2d_bytes
+        )
+        self.metrics.gauge("device.util.d2hBytes").set_fn(
+            lambda: TRANSFERS.d2h_bytes
+        )
+        self.metrics.gauge("device.util.achievedBytesPerSec").set_fn(
+            lambda: self._roofline_window.snapshot()["achievedBytesPerSec"]
+        )
+        self.metrics.gauge("device.util.achievedFlopsPerSec").set_fn(
+            lambda: self._roofline_window.snapshot()["achievedFlopsPerSec"]
+        )
+        self.metrics.gauge("device.util.rooflineFraction").set_fn(
+            lambda: self._roofline_window.snapshot()["rooflineFraction"]
+        )
         from pinot_tpu.engine.device import LEDGER
 
         # NOTE: the ledger (like the staging cache) is process-global —
@@ -378,6 +491,32 @@ class ServerInstance:
                 return  # unparseable request: nothing to key on
         if explain_mode == "plan":
             return
+        # utilization join: the device-plan digest (when this query ran
+        # on device) links the shape's measured wall time to the lane's
+        # static cost analysis — the per-digest roofline numerator
+        device_ms = float(result.cost.get("deviceMs", 0) or 0)
+        host_ms = float(result.cost.get("hostMs", 0) or 0)
+        device_info = None
+        ddigest = getattr(result, "_device_digest", None)
+        if ddigest is not None and self.lane is not None:
+            ci = self.lane.compile_info(ddigest)
+            if ci is not None:
+                device_info = {"digest": ddigest}
+                analysis = ci.get("costAnalysis")
+                if isinstance(analysis, dict):
+                    device_info.update(
+                        {
+                            k: analysis[k]
+                            for k in ("flops", "bytesAccessed", "peakMemoryBytes")
+                            if k in analysis
+                        }
+                    )
+        if device_ms > 0:
+            self._roofline_window.record(
+                device_ms,
+                float(result.cost.get("deviceBytes", 0) or 0),
+                float((device_info or {}).get("flops", 0) or 0),
+            )
         self.plan_stats.record(
             digest,
             summary=summary,
@@ -387,6 +526,9 @@ class ServerInstance:
             num_docs=result.num_docs_scanned,
             shed=(outcome == "shed"),
             failed=(outcome == "failed"),
+            device_ms=device_ms or None,
+            host_ms=host_ms or None,
+            device_info=device_info,
         )
         self.metrics.meter("plan.recorded").mark()
 
@@ -413,10 +555,57 @@ class ServerInstance:
             "lane": None if self.lane is None else self.lane.stats(),
             "selfHealing": heal,
             "hbm": hbm,
+            "device": self.device_utilization(),
             "ingest": self.ingest_backpressure.snapshot(),
             "plans": self.plan_stats.snapshot(top=20),
             "metrics": self.metrics.snapshot(),
         }
+
+    def profile_start(self, timeout_s: Optional[float] = None) -> dict:
+        """Begin (or join) an on-demand profile capture: the jax
+        profiler trace starts/extends AND the lane occupancy sampler
+        runs for the capture's duration.  Raises
+        ``ProfilerUnavailableError`` (typed 404 on the admin surface)
+        when the backend has no working profiler."""
+        snap = self.profiler.start(timeout_s)
+        if self.occupancy_sampler is not None:
+            self.occupancy_sampler.start()
+        return snap
+
+    def profile_stop(self) -> dict:
+        """Release one profile start; sampler parks when the capture
+        actually ends (refcount zero — the on_capture_end hook)."""
+        return self.profiler.stop()
+
+    def device_utilization(self, roofline_since: Optional[float] = None) -> dict:
+        """Device utilization snapshot (the ``status()["device"]``
+        section and the controller ``/debug/utilization`` rollup's
+        per-server unit): declared platform peaks, windowed lane
+        occupancy, cumulative H2D/D2H transfer totals, the recent
+        achieved-rate window (optionally narrowed to records at/after
+        the ``roofline_since`` monotonic stamp), profiler state, and
+        (when the opt-in sampler is running) its queue-depth-over-time
+        ring."""
+        from pinot_tpu.engine.device import TRANSFERS
+        from pinot_tpu.utils.platform import platform_peaks
+
+        occupancy = None
+        if self.lane is not None:
+            occupancy = self.lane.occupancy_read("status")
+            occupancy["open"] = self.lane.stats().get("open", 0)
+        out = {
+            "platform": platform_peaks(),
+            "occupancy": occupancy,
+            "transfers": TRANSFERS.snapshot(),
+            "recent": self._roofline_window.snapshot(since=roofline_since),
+            "profiler": self.profiler.snapshot(),
+        }
+        if self.occupancy_sampler is not None and (
+            self.occupancy_sampler.running
+            or self.occupancy_sampler.samples_taken
+        ):
+            out["sampler"] = self.occupancy_sampler.snapshot()
+        return out
 
     def metrics_text(self) -> str:
         """Prometheus exposition of this server's registry (served at
@@ -426,9 +615,13 @@ class ServerInstance:
         return prometheus_text(self.metrics)
 
     def shutdown(self) -> None:
-        """Idempotent: drain-stop the scheduler and close the device
-        lane (queued lane waiters fail fast with LaneClosedError)."""
+        """Idempotent: drain-stop the scheduler, close the device lane
+        (queued lane waiters fail fast with LaneClosedError), stop the
+        occupancy sampler, and force-stop any active profile capture."""
         self.scheduler.shutdown()
+        if self.occupancy_sampler is not None:
+            self.occupancy_sampler.stop()
+        self.profiler.shutdown()
         if self.lane is not None:
             self.lane.close()
 

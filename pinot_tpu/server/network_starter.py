@@ -45,11 +45,14 @@ logger = logging.getLogger(__name__)
 class ServerAdminHttpServer:
     """Server-side observability HTTP surface (the reference server's
     admin-application analog): ``/health``, Prometheus text at
-    ``/metrics``, and the full status/metrics JSON at
-    ``/debug/metrics``.  The query data plane stays on the framed TCP
-    socket; this port is scrape/ops-only.  The networked starter
-    advertises it to the controller as the instance URL so the
-    dashboard can aggregate a cluster-wide metrics snapshot."""
+    ``/metrics``, the full status/metrics JSON at ``/debug/metrics``,
+    per-plan stats at ``/debug/plans``, the device-utilization
+    snapshot at ``/debug/device``, and the on-demand profiler bracket
+    at ``POST /debug/profile/start|stop`` (``GET /debug/profile`` for
+    state).  The query data plane stays on the framed TCP socket; this
+    port is scrape/ops-only.  The networked starter advertises it to
+    the controller as the instance URL so the dashboard can aggregate
+    a cluster-wide metrics snapshot."""
 
     def __init__(self, server: ServerInstance, host: str = "127.0.0.1", port: int = 0):
         inst = server
@@ -65,6 +68,11 @@ class ServerAdminHttpServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_json(self, payload, status: int = 200) -> None:
+                self._send(
+                    json.dumps(payload).encode("utf-8"), "application/json", status
+                )
+
             def do_GET(self):
                 if self.path == "/health":
                     return self._send(b'{"status": "ok"}', "application/json")
@@ -78,6 +86,13 @@ class ServerAdminHttpServer:
                         json.dumps(inst.status()).encode("utf-8"),
                         "application/json",
                     )
+                if self.path == "/debug/device":
+                    # utilization snapshot alone (status() minus the
+                    # heavyweight sections): the controller rollup and
+                    # dashboards poll this cheaply
+                    return self._send_json(inst.device_utilization())
+                if self.path == "/debug/profile":
+                    return self._send_json(inst.profiler.snapshot())
                 from urllib.parse import parse_qs, urlparse
 
                 url = urlparse(self.path)
@@ -87,13 +102,47 @@ class ServerAdminHttpServer:
                     # of frequency
                     qs = parse_qs(url.query)
                     by = (qs.get("by") or ["count"])[0]
+                    try:
+                        top = int((qs.get("top") or ["50"])[0])
+                    except ValueError:
+                        top = 50
                     return self._send(
                         json.dumps(
-                            inst.plan_stats.snapshot(top=50, by=by)
+                            inst.plan_stats.snapshot(top=top, by=by)
                         ).encode("utf-8"),
                         "application/json",
                     )
                 self._send(b'{"error": "not found"}', "application/json", 404)
+
+            def do_POST(self):
+                from pinot_tpu.server.profiler import ProfilerUnavailableError
+
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                raw = self.rfile.read(n) if n else b""
+                try:
+                    body = json.loads(raw) if raw else {}
+                except ValueError:
+                    return self._send_json({"error": "bad JSON body"}, 400)
+                if self.path == "/debug/profile/start":
+                    try:
+                        return self._send_json(
+                            inst.profile_start(body.get("timeoutS"))
+                        )
+                    except ProfilerUnavailableError as e:
+                        # typed 404: THIS backend has no usable profiler
+                        # — distinct from an unknown route or bad input
+                        return self._send_json(
+                            {
+                                "error": str(e),
+                                "errorType": "ProfilerUnavailableError",
+                            },
+                            404,
+                        )
+                    except Exception as e:
+                        return self._send_json({"error": str(e)}, 500)
+                if self.path == "/debug/profile/stop":
+                    return self._send_json(inst.profile_stop())
+                self._send_json({"error": "not found"}, 404)
 
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self.host = host

@@ -25,10 +25,68 @@ from typing import Any, Dict, List
 
 
 def _fmt_cost(cost: Dict[str, Any]) -> str:
+    # nested dicts (perQuery, roofline) render on their own lines
     return "  ".join(
         f"{k}={round(v, 3) if isinstance(v, float) else v}"
         for k, v in sorted(cost.items())
+        if not isinstance(v, dict)
     )
+
+
+def _fmt_qty(v: float) -> str:
+    """1.23e9 -> '1.23G' (flops / bytes-scale quantities)."""
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return str(v)
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f}{suffix}"
+    return f"{v:.0f}"
+
+
+def render_cost_analysis(dev: Dict[str, Any]) -> str:
+    """The compile/cost-analysis block of a device plan node: static
+    flops / bytes-accessed estimates when the analysis landed, the
+    explicit 'unavailable'/'pending' states otherwise."""
+    comp = dev.get("compile") or {}
+    ca = comp.get("costAnalysis")
+    if isinstance(ca, dict):
+        parts = []
+        if "flops" in ca:
+            parts.append(f"est flops={_fmt_qty(ca['flops'])}")
+        if "bytesAccessed" in ca:
+            parts.append(f"est bytes={_fmt_qty(ca['bytesAccessed'])}")
+        if "peakMemoryBytes" in ca:
+            parts.append(f"peak mem={_fmt_qty(ca['peakMemoryBytes'])}")
+        src = ca.get("source")
+        return (
+            "  cost-analysis: " + "  ".join(parts)
+            + (f"  ({src})" if src else "") + "\n"
+        )
+    if ca in ("unavailable", "pending"):
+        return f"  cost-analysis: {ca}\n"
+    return ""
+
+
+def render_roofline(est: Dict[str, Any], indent: str = "  ") -> str:
+    """Achieved-utilization footer for a shape that has executed on
+    device (the plan-stats roofline riding EXPLAIN's history
+    estimate): measured achieved bytes/s + FLOP/s against the declared
+    platform peaks."""
+    roof = (est or {}).get("roofline")
+    if not isinstance(roof, dict):
+        return ""
+    parts = [f"achieved={_fmt_qty(roof.get('achievedBytesPerSec', 0))}B/s"]
+    if roof.get("achievedFlopsPerSec"):
+        parts.append(f"{_fmt_qty(roof['achievedFlopsPerSec'])}FLOP/s")
+    frac = roof.get("rooflineFraction")
+    parts.append(
+        "roofline=n/a (no peak declared)"
+        if frac is None
+        else f"roofline={float(frac) * 100.0:.2f}%"
+    )
+    return indent + "utilization: " + "  ".join(parts) + "\n"
 
 
 def _delta_line(est: float, act: float, label: str) -> str:
@@ -80,6 +138,7 @@ def render_explain(obj: Dict[str, Any]) -> str:
                 f"  device plan {dev.get('planDigest')}  "
                 f"compile={comp_str}{quarantined}\n"
             )
+            out += render_cost_analysis(dev)
         staged = node.get("staged") or {}
         if staged.get("hbmBytes"):
             out += (
@@ -110,8 +169,10 @@ def render_explain(obj: Dict[str, Any]) -> str:
             out += _delta_line(
                 est_bytes, float(actual.get("bytesScanned", 0)), "bytesScanned"
             )
+            out += render_roofline(node_est)
         elif node_est:
             out += f"  estimated: {_fmt_cost(node_est)}\n"
+            out += render_roofline(node_est)
 
     if mode == "analyze":
         actual = explain.get("actualCost") or {}

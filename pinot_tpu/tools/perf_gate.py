@@ -23,6 +23,13 @@ to oranges — pass ``--allow-config-mismatch`` to force the comparison
 anyway (ratio semantics survive a platform change poorly; use only for
 exploration).
 
+Serving-mode documents (``PINOT_TPU_BENCH_MODE=serving``) gate their
+own namespace — saturation QPS, pipelined-vs-serial speedup, and the
+ISSUE 10 utilization fields (lane busy-fraction, achieved device
+bytes/s, D2H volume) against the committed ``SERVING_UTIL_r10.json``
+— with the same direction-aware bands and config-mismatch SKIP.
+Mixed kinds (default baseline vs serving current) skip outright.
+
 Usage:
   python -m pinot_tpu.tools.perf_gate current.json [--baseline BENCH_r05.json]
   python bench.py > /tmp/fresh.json && \
@@ -61,6 +68,39 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
 # config keys that must match for latency/throughput numbers to be
 # comparable at all
 CONFIG_KEYS = ("detail.total_rows", "detail.num_segments", "detail.platform")
+
+# serving-mode documents (PINOT_TPU_BENCH_MODE=serving) carry their own
+# metric namespace: saturation QPS + the utilization-plane fields
+# (ISSUE 10 — lane occupancy and achieved bandwidth are the gated
+# substrate for the throughput arc).  Occupancy/bandwidth bands are
+# wide: closed-loop QPS on shared CI boxes swings, and these gate the
+# 2x cliff (a lane suddenly idle, a bandwidth collapse), not jitter.
+SERVING_METRIC_SPECS: Dict[str, Tuple[str, float]] = {
+    "saturation_qps_repeated_q1.pipelined": ("higher", 0.40),
+    "saturation_qps_repeated_q1.serial": ("higher", 0.40),
+    "saturation_qps_mixed.pipelined": ("higher", 0.40),
+    "saturation_qps_mixed.serial": ("higher", 0.40),
+    "speedup_repeated_q1": ("higher", 0.50),
+    "utilization.pipelined.busyFraction": ("higher", 0.30),
+    "utilization.pipelined.achievedBytesPerSec": ("higher", 0.30),
+    "utilization.serial.achievedBytesPerSec": ("higher", 0.30),
+    "utilization.pipelined.d2hBytes": ("higher", 0.30),
+}
+
+SERVING_CONFIG_KEYS = ("total_rows", "num_segments", "platform")
+
+SERVING_DEFAULT_BASELINE = "SERVING_UTIL_r10.json"
+
+
+def _is_serving(doc: Dict[str, Any]) -> bool:
+    return str(doc.get("metric", "")).startswith("serving_")
+
+
+def _specs_for(doc: Dict[str, Any]):
+    """(metric specs, config keys) for a bench document's kind."""
+    if _is_serving(doc):
+        return SERVING_METRIC_SPECS, SERVING_CONFIG_KEYS
+    return METRIC_SPECS, CONFIG_KEYS
 
 
 def _get(doc: Dict[str, Any], path: str) -> Any:
@@ -109,10 +149,25 @@ def compare(
     allow_config_mismatch: bool = False,
 ) -> Dict[str, Any]:
     """Gate verdict: ``{"verdict": "pass"|"fail"|"skipped", ...}`` with
-    one row per compared metric.  Pure — unit-testable without files."""
+    one row per compared metric.  Pure — unit-testable without files.
+    The spec set follows the document kind (default bench vs serving
+    mode); mismatched kinds skip — there is nothing to compare."""
+    if _is_serving(baseline) != _is_serving(current):
+        return {
+            "verdict": "skipped",
+            "reason": "bench document kinds differ (default vs serving mode)",
+            "configMismatch": {
+                "metric": {
+                    "baseline": baseline.get("metric"),
+                    "current": current.get("metric"),
+                }
+            },
+            "metrics": [],
+        }
+    specs, config_keys = _specs_for(current)
     mismatches = {
         k: {"baseline": _get(baseline, k), "current": _get(current, k)}
-        for k in CONFIG_KEYS
+        for k in config_keys
         if _get(baseline, k) != _get(current, k)
     }
     if mismatches and not allow_config_mismatch:
@@ -125,7 +180,7 @@ def compare(
         }
     rows: List[Dict[str, Any]] = []
     failures = 0
-    for path, (direction, band) in METRIC_SPECS.items():
+    for path, (direction, band) in specs.items():
         b, c = _get(baseline, path), _get(current, path)
         if not isinstance(b, (int, float)) or not isinstance(c, (int, float)):
             continue  # metric absent in one doc: nothing to gate
@@ -168,8 +223,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("current", help="fresh bench.py JSON (file or - for stdin)")
     p.add_argument(
         "--baseline",
-        default="BENCH_r05.json",
-        help="committed capture to gate against (default BENCH_r05.json)",
+        default=None,
+        help="committed capture to gate against (default BENCH_r05.json, "
+        f"or {SERVING_DEFAULT_BASELINE} for a serving-mode document)",
     )
     p.add_argument(
         "--tolerance-scale",
@@ -184,8 +240,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = p.parse_args(argv)
     try:
-        baseline = load_bench(args.baseline)
         current = load_bench(args.current)
+        baseline_path = args.baseline
+        if baseline_path is None:
+            # default baseline follows the current document's kind
+            baseline_path = (
+                SERVING_DEFAULT_BASELINE
+                if _is_serving(current)
+                else "BENCH_r05.json"
+            )
+        baseline = load_bench(baseline_path)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(json.dumps({"verdict": "error", "error": str(e)}), file=sys.stderr)
         return 2

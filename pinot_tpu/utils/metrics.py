@@ -463,6 +463,36 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "compile.warm": "device launches that reused an already-compiled plan",
     "compile.firstCallMs": "first-call (compile-inclusive) launch wall ms "
     "per device-plan digest",
+    "compile.costAnalyses": "device-plan digests whose static XLA cost "
+    "analysis (flops / bytes accessed) landed in the compile registry",
+    "compile.costAnalysisUnavailable": "device-plan digests whose backend "
+    "reported no usable static cost analysis (explicit 'unavailable')",
+    # device utilization & profiling plane (ISSUE 10): windowed lane
+    # occupancy, cumulative transfer totals, and achieved-vs-peak
+    # roofline rates against utils/platform.py declared peaks
+    "device.util.busyFraction": "fraction of the recent window the device "
+    "lane spent inside kernel launch calls (0 when idle)",
+    "device.util.avgQueueDepth": "time-weighted average device-lane queue "
+    "depth over the recent window",
+    "device.util.h2dBytes": "cumulative host->device transfer bytes "
+    "(segment staging + batched query-input uploads)",
+    "device.util.d2hBytes": "cumulative device->host transfer bytes "
+    "(packed result fetches + raw-path output reads)",
+    "device.util.achievedBytesPerSec": "achieved device scan bytes/s over "
+    "the recent roofline window (deviceBytes / measured deviceMs)",
+    "device.util.achievedFlopsPerSec": "achieved FLOP/s over the recent "
+    "roofline window (static flops per exec x execs / measured deviceMs)",
+    "device.util.rooflineFraction": "best-utilized-resource achieved/peak "
+    "fraction (null when no platform peak is declared)",
+    # on-demand deep profiling (server/profiler.py jax.profiler bracket)
+    "profile.starts": "profile capture start requests (ref-counted joins "
+    "included)",
+    "profile.stops": "profile capture stop requests released",
+    "profile.autoStops": "captures force-stopped by the auto-stop deadline "
+    "(client died mid-capture)",
+    "profile.failedStarts": "capture starts that failed inside the "
+    "profiler trace backend",
+    "profile.active": "1 while a jax.profiler trace capture is active",
     # HBM staging ledger (engine/device.py LEDGER; per-process)
     "hbm.stagedBytes": "bytes of segment arrays currently staged in HBM",
     "hbm.highWatermarkBytes": "high-watermark of staged HBM bytes",

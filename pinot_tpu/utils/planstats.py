@@ -36,7 +36,7 @@ class _Entry:
     __slots__ = (
         "digest", "summary", "table", "count", "shed_count", "failed_count",
         "coalesce_hits", "docs_scanned", "cost", "latency", "first_seen",
-        "last_seen",
+        "last_seen", "device_lat", "host_lat", "device_execs", "device_info",
     )
 
     def __init__(self, digest: str, summary: str, table: str, now: float) -> None:
@@ -50,6 +50,19 @@ class _Entry:
         self.docs_scanned = 0
         self.cost: Dict[str, float] = {}
         self.latency: Deque[float] = deque(maxlen=_LAT_WINDOW)
+        # per-tier execution-time windows (utilization plane): device
+        # kernel ms and host-path ms recorded separately so /debug/plans
+        # tier mixes carry comparable latency on BOTH tiers
+        self.device_lat: Deque[float] = deque(maxlen=_LAT_WINDOW)
+        self.host_lat: Deque[float] = deque(maxlen=_LAT_WINDOW)
+        # unbounded device-tier exec count (device_lat is a capped
+        # sample window): the flops multiplier must track the same
+        # accumulation horizon as e.cost["deviceMs"], and must NOT
+        # count host-fallback/shed/failed queries that ran no kernel
+        self.device_execs = 0
+        # device-plan identity + static cost analysis (last writer
+        # wins): {"digest", "flops", "bytesAccessed", ...} or None
+        self.device_info: Optional[Dict[str, Any]] = None
         self.first_seen = now
         self.last_seen = now
 
@@ -60,6 +73,59 @@ class PlanStatsStore:
         self._entries: Dict[str, _Entry] = {}
         self._lock = threading.Lock()
         self.total_recorded = 0
+
+    @staticmethod
+    def _tier_latency(samples) -> Dict[str, Any]:
+        s = sorted(samples)
+        return {
+            "p50Ms": round(_percentile(s, 50), 3),
+            "p95Ms": round(_percentile(s, 95), 3),
+            "samples": len(s),
+        }
+
+    @staticmethod
+    def _roofline(e: "_Entry") -> Optional[Dict[str, Any]]:
+        """Achieved-vs-peak roofline for one plan shape: measured device
+        wall ms (the SAME deviceMs the phase timers / cost vector
+        report) under the bytes the kernel read and the static flops
+        the lane's cost analysis declared.  None when the shape never
+        ran on device.  Coalesced waiters each record their own fetch
+        window, so the sums are per-QUERY attribution, not raw device
+        seconds — consistent with every other cost-vector surface."""
+        dev_ms = float(e.cost.get("deviceMs", 0) or 0)
+        # device_execs is only set by the SERVER store (record(device_ms=...)
+        # on a locally measured launch); the broker records fleet-MERGED
+        # cost vectors, and a sum-over-servers rate divided by THIS
+        # process's platform peak is not a roofline — skip it there
+        if dev_ms <= 0 or not e.device_execs:
+            return None
+        dev_bytes = float(e.cost.get("deviceBytes", 0) or 0)
+        out: Dict[str, Any] = {
+            "deviceMs": round(dev_ms, 3),
+            "deviceBytes": int(dev_bytes),
+            "achievedBytesPerSec": round(dev_bytes * 1000.0 / dev_ms, 3),
+        }
+        info = e.device_info or {}
+        if info.get("digest"):
+            out["deviceDigest"] = info["digest"]
+        flops = info.get("flops")
+        if isinstance(flops, (int, float)) and flops > 0 and e.device_execs:
+            out["staticFlopsPerExec"] = float(flops)
+            # multiplier is DEVICE execs only: a mixed-tier shape's host
+            # queries add to e.count but execute zero kernel flops
+            out["achievedFlopsPerSec"] = round(
+                float(flops) * e.device_execs * 1000.0 / dev_ms, 3
+            )
+        if isinstance(info.get("bytesAccessed"), (int, float)):
+            out["staticBytesPerExec"] = float(info["bytesAccessed"])
+        from pinot_tpu.utils.platform import roofline_fractions
+
+        out.update(
+            roofline_fractions(
+                out["achievedBytesPerSec"], out.get("achievedFlopsPerSec")
+            )
+        )
+        return out
 
     # -- write side ----------------------------------------------------
     def record(
@@ -72,6 +138,9 @@ class PlanStatsStore:
         num_docs: int = 0,
         shed: bool = False,
         failed: bool = False,
+        device_ms: Optional[float] = None,
+        host_ms: Optional[float] = None,
+        device_info: Optional[Dict[str, Any]] = None,
     ) -> None:
         now = time.time()
         with self._lock:
@@ -95,6 +164,13 @@ class PlanStatsStore:
             if failed:
                 e.failed_count += 1
             e.latency.append(float(latency_ms))
+            if device_ms:
+                e.device_lat.append(float(device_ms))
+                e.device_execs += 1
+            if host_ms:
+                e.host_lat.append(float(host_ms))
+            if device_info is not None:
+                e.device_info = dict(device_info)
             e.docs_scanned += int(num_docs)
             for k, v in (cost or {}).items():
                 e.cost[k] = e.cost.get(k, 0) + v
@@ -137,6 +213,13 @@ class PlanStatsStore:
                 "p99": round(_percentile(lat, 99), 3),
                 "samples": len(lat),
             },
+            # per-tier execution time so a shape's device vs host cost
+            # reads side by side (the tier-mix comparability contract)
+            "tierLatencyMs": {
+                "device": self._tier_latency(e.device_lat),
+                "host": self._tier_latency(e.host_lat),
+            },
+            "roofline": self._roofline(e),
             "firstSeen": round(e.first_seen, 3),
             "lastSeen": round(e.last_seen, 3),
         }
@@ -188,7 +271,7 @@ class PlanStatsStore:
             if e is None or e.count == 0:
                 return None
             lat = sorted(e.latency)
-            return {
+            out = {
                 "execCount": e.count,
                 "latencyP50Ms": round(_percentile(lat, 50), 3),
                 "latencyP95Ms": round(_percentile(lat, 95), 3),
@@ -196,6 +279,13 @@ class PlanStatsStore:
                     k: round(v / e.count, 3) for k, v in sorted(e.cost.items())
                 },
             }
+            # achieved utilization for shapes that ran on device — rides
+            # into EXPLAIN's history estimate so explain_dump can render
+            # the roofline footer next to the static flops/bytes
+            roof = self._roofline(e)
+            if roof is not None:
+                out["roofline"] = roof
+            return out
 
     def snapshot(self, top: int = 50, by: str = "count") -> Dict[str, Any]:
         return {

@@ -48,6 +48,136 @@ def force_cpu_mesh(n_devices: int) -> bool:
     return True
 
 
+# ---------------------------------------------------------------------------
+# Declared per-platform roofline peaks.
+#
+# The utilization plane divides MEASURED achieved FLOP/s and bytes/s by
+# these DECLARED peaks to get a roofline fraction (PAPERS.md's
+# bulk-bitwise PIM line argues from exactly this achieved-vs-peak
+# framing).  Values are per-chip datasheet numbers: dense bf16/fp
+# peak FLOP/s and HBM bandwidth.  Matching is by ``device_kind``
+# substring (longest match wins) so "TPU v5 lite" and "TPU v5e" both
+# land on the v5e row.  Unknown platforms (CPU test runs, new chips)
+# report None peaks — the roofline fraction is then "unavailable", not
+# a made-up number — unless the operator declares peaks via
+# ``PINOT_TPU_PEAK_FLOPS`` / ``PINOT_TPU_PEAK_HBM_BPS``.
+# ---------------------------------------------------------------------------
+
+# lowercase device_kind substring -> (peak FLOP/s, peak HBM bytes/s)
+_PLATFORM_PEAKS = {
+    "v5 lite": (197e12, 819e9),  # v5e: 197 TFLOP/s bf16, 819 GB/s
+    "v5litepod": (197e12, 819e9),
+    "v5e": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v6e": (918e12, 1640e9),
+    "v4": (275e12, 1228e9),
+    "v3": (123e12, 900e9),
+    "v2": (45e12, 700e9),
+}
+
+_peaks_cache = None
+
+
+def platform_peaks(refresh: bool = False) -> dict:
+    """Declared roofline peaks for this process's default device.
+
+    Returns ``{"platform", "deviceKind", "peakFlopsPerSec",
+    "peakBytesPerSec", "source"}``.  Peaks are None when the platform
+    is unknown (source "unknown") or when jax backends have not
+    initialized yet (source "uninitialized" — this function must NEVER
+    trigger backend init: on a wedged device tunnel ``jax.devices()``
+    blocks forever, and metric scrapes call through here).  Env
+    overrides (``PINOT_TPU_PEAK_FLOPS`` / ``PINOT_TPU_PEAK_HBM_BPS``,
+    source "env") win over the table — the CPU escape hatch and the
+    knob for chips the table doesn't know."""
+    global _peaks_cache
+    env_flops = os.environ.get("PINOT_TPU_PEAK_FLOPS")
+    env_bps = os.environ.get("PINOT_TPU_PEAK_HBM_BPS")
+    if not refresh and _peaks_cache is not None and not (env_flops or env_bps):
+        return dict(_peaks_cache)
+    out = {
+        "platform": None,
+        "deviceKind": None,
+        "peakFlopsPerSec": None,
+        "peakBytesPerSec": None,
+        "source": "unknown",
+    }
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge.backends_are_initialized():
+            out["source"] = "uninitialized"
+        else:
+            import jax
+
+            dev = jax.devices()[0]
+            out["platform"] = dev.platform
+            kind = (getattr(dev, "device_kind", "") or "").lower()
+            out["deviceKind"] = kind
+            best = None
+            for sub, peaks in _PLATFORM_PEAKS.items():
+                if sub in kind and (best is None or len(sub) > len(best[0])):
+                    best = (sub, peaks)
+            if best is not None:
+                out["peakFlopsPerSec"], out["peakBytesPerSec"] = best[1]
+                out["source"] = "declared"
+    except Exception:
+        out["source"] = "error"
+    if env_flops or env_bps:
+        try:
+            # parse BOTH before applying EITHER: a half-applied pair
+            # would report one env peak under a non-"env" source label
+            parsed_flops = float(env_flops) if env_flops else None
+            parsed_bps = float(env_bps) if env_bps else None
+        except ValueError:
+            pass  # junk overrides must not break metric scrapes
+        else:
+            if parsed_flops is not None:
+                out["peakFlopsPerSec"] = parsed_flops
+            if parsed_bps is not None:
+                out["peakBytesPerSec"] = parsed_bps
+            out["source"] = "env"
+    # never cache transient states: "uninitialized" resolves once a
+    # backend comes up, and "error" may be a one-off probe hiccup — a
+    # pinned error would report None peaks on a known TPU until restart
+    if out["source"] not in ("uninitialized", "error") and not (
+        env_flops or env_bps
+    ):
+        _peaks_cache = dict(out)
+    return out
+
+
+def roofline_fractions(
+    achieved_bytes_per_sec,
+    achieved_flops_per_sec=None,
+    peaks: "dict | None" = None,
+) -> dict:
+    """Per-resource achieved-vs-peak fractions — the ONE place the
+    roofline verdict rule lives (PlanStatsStore per-shape entries and
+    the server-wide recent window both call through here).
+
+    Returns ``{"bandwidthFraction"?, "flopsFraction"?,
+    "rooflineFraction"}``: a per-resource key is present only when its
+    peak is declared AND the achieved rate is positive; a kernel is "at
+    the roofline" when its BEST-utilized resource is, so
+    ``rooflineFraction`` is the max of the present fractions — or the
+    explicit None (never an invented 0) when no peak is declared."""
+    if peaks is None:
+        peaks = platform_peaks()
+    out: dict = {}
+    fractions = []
+    if peaks.get("peakBytesPerSec") and achieved_bytes_per_sec:
+        f = achieved_bytes_per_sec / peaks["peakBytesPerSec"]
+        out["bandwidthFraction"] = round(f, 6)
+        fractions.append(f)
+    if peaks.get("peakFlopsPerSec") and achieved_flops_per_sec:
+        f = achieved_flops_per_sec / peaks["peakFlopsPerSec"]
+        out["flopsFraction"] = round(f, 6)
+        fractions.append(f)
+    out["rooflineFraction"] = round(max(fractions), 6) if fractions else None
+    return out
+
+
 def probe_device(timeout_s: float = 120.0) -> bool:
     """True when the default backend initializes in a SUBPROCESS within
     the timeout.  The axon tunnel can wedge so hard that the first

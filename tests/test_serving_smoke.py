@@ -47,6 +47,19 @@ def test_serving_curve_smoke():
     # no result divergence between the two execution paths
     assert doc["differential"]["identical_payloads"], doc["differential"]
 
+    # utilization plane (PR 10): the pipelined lane's occupancy window
+    # covers the measured ladder and must be busy under 8 closed-loop
+    # clients; the D2H counter saw the result fetches; the CPU mesh
+    # declares no peak so the roofline fraction is the explicit null
+    util = doc["utilization"]["pipelined"]
+    assert util["busyFraction"] > 0, util
+    assert util["achievedBytesPerSec"] > 0 and util["d2hBytes"] > 0
+    assert util["rooflineFraction"] is None
+    # the serial mode has no lane, hence no occupancy fields — but its
+    # device path still reports achieved bandwidth
+    assert "busyFraction" not in doc["utilization"]["serial"]
+    assert doc["utilization"]["serial"]["achievedBytesPerSec"] > 0
+
     # every curve step completed queries without errors
     for mode in ("serial", "pipelined"):
         for steps in doc["modes"][mode]["curves"].values():
