@@ -249,6 +249,10 @@ def _closed_loop(broker, queries, clients: int, duration_s: float) -> dict:
         "clients": clients,
         "queries": len(lat),
         "qps": round(len(lat) / wall, 1),
+        # throughput of SUCCESSFUL queries only: a broker shedding 429s
+        # answers in microseconds, so counting sheds as served traffic
+        # can inflate "qps" by 50x+ while the cluster does no work
+        "ok_qps": round((len(lat) - errors[0]) / wall, 1),
         "p50_ms": round(pct(50), 3),
         "p99_ms": round(pct(99), 3),
         "errors": errors[0],
@@ -369,14 +373,64 @@ def _serving_main() -> None:
         }
         print(json.dumps({"mode_done": mode}), file=__import__("sys").stderr, flush=True)
 
-    # saturation = best closed-loop QPS across the ladder, per workload
+    # saturation = best closed-loop ok-QPS across the ladder, per
+    # workload (shed responses excluded — see _closed_loop)
     for wname in workloads:
         sat = {
-            m: max(s["qps"] for s in doc["modes"][m]["curves"][wname])
+            m: max(s["ok_qps"] for s in doc["modes"][m]["curves"][wname])
             for m in doc["modes"]
         }
         doc[f"saturation_qps_{wname}"] = sat
         doc[f"speedup_{wname}"] = round(sat["pipelined"] / max(sat["serial"], 1e-9), 2)
+
+    # sampling-overhead spec (ISSUE 11): observability defaults
+    # (always-on tail tracing + history recorder) vs sampling off
+    # (PINOT_TPU_TAIL_TRACE=0, recorder stopped), on otherwise
+    # IDENTICAL fresh brokers.  Two traps this measurement dodges:
+    # both brokers start with the AIMD admission window pre-opened (a
+    # fresh window ramping under a closed-loop flood sheds thousands
+    # of instant 429s — admission behavior, not sampler overhead), and
+    # the ratio uses ok_qps (a shed answers in microseconds, so raw
+    # qps counts a storm of 429s as 50x+ "throughput").  An earlier
+    # draft fell into both and measured a bogus 75x overhead.
+    # tools/perf_gate.py gates the ratio: the always-on sampler must
+    # stay within band of the sampling-off run forever.
+    overhead_clients = ladder[-1]
+    overhead_runs = {}
+    for key in ("on", "off"):
+        os.environ["PINOT_TPU_ADMISSION_WINDOW_INIT"] = str(
+            max(64, 2 * overhead_clients)
+        )
+        if key == "off":
+            os.environ["PINOT_TPU_TAIL_TRACE"] = "0"
+        try:
+            b = single_server_broker("lineitem", segments, pipeline=True)
+        finally:
+            os.environ.pop("PINOT_TPU_ADMISSION_WINDOW_INIT", None)
+            os.environ.pop("PINOT_TPU_TAIL_TRACE", None)
+        if key == "off":
+            b.shutdown()  # stops the history recorder thread: fully dark
+        for _ in range(2):  # warm staging + compile before measuring
+            resp = b.handle_pql(Q1_PQL)
+            assert not resp.exceptions, resp.exceptions
+        overhead_runs[key] = _closed_loop(b, [Q1_PQL], overhead_clients, duration_s)
+        if key == "on":
+            b.shutdown()
+    on_run, off_run = overhead_runs["on"], overhead_runs["off"]
+    doc["sampling_overhead"] = {
+        "clients": overhead_clients,
+        "samplingOnQps": on_run["ok_qps"],
+        "samplingOffQps": off_run["ok_qps"],
+        "qpsRatio": round(on_run["ok_qps"] / max(off_run["ok_qps"], 1e-9), 4),
+        "samplingOnP99Ms": on_run["p99_ms"],
+        "samplingOffP99Ms": off_run["p99_ms"],
+        "errors": {"on": on_run["errors"], "off": off_run["errors"]},
+        "note": "ok-qps (shed/error responses excluded) on fresh identical "
+        "brokers with the admission window pre-opened; on = defaults "
+        "(always-on tail tracing + history recorder), off = "
+        "PINOT_TPU_TAIL_TRACE=0 with the recorder stopped; pipelined "
+        "repeated_q1 at the top ladder step",
+    }
 
     # differential: pipelined and serial must serve byte-identical
     # payloads (timing field excluded) for every workload shape

@@ -34,6 +34,7 @@ import concurrent.futures
 import json
 import logging
 import math
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -188,6 +189,40 @@ class BrokerRequestHandler:
         for m in ("workload.recorded", "explain.queries"):
             self.metrics.meter(m)
         self.metrics.gauge("workload.digests").set_fn(self.planstats.digest_count)
+        # SLO & tail-latency attribution plane (ISSUE 11): ONE history
+        # thread snapshots this registry (+ the per-table SLO counters)
+        # on a cadence; burn-rate evaluation and the flight-recorder
+        # triggers ride its tick hook.  Tail sampling arms lightweight
+        # tracing on EVERY query and keeps the merged span tree only
+        # for slow/failed/partial/1-in-N completions (utils/tailsample).
+        # All series pre-registered inside the constructors.
+        from pinot_tpu.utils.flightrec import FlightRecorder
+        from pinot_tpu.utils.slo import SloTracker
+        from pinot_tpu.utils.tailsample import TailSampler
+        from pinot_tpu.utils.timeseries import HistoryRecorder
+
+        self.history = HistoryRecorder(self.metrics, metrics=self.metrics)
+        self.slo = SloTracker(history=self.history, metrics=self.metrics)
+        self.history.register_provider(self.slo.series)
+        self.tail = TailSampler(metrics=self.metrics)
+        self.flightrec = FlightRecorder(
+            "broker",
+            name,
+            metrics=self.metrics,
+            sources={
+                "history": lambda: self.history.query(window_s=900),
+                "slowQueries": self.querylog.snapshot,
+                "tails": lambda: self.tail.snapshot(include_traces=True),
+                "slo": self.slo.snapshot,
+                "workload": lambda: self.workload_snapshot(top=20),
+                "admission": self.admission.snapshot,
+            },
+        )
+        self._last_dropped = 0
+        self._shed_burst_threshold = max(
+            1, int(os.environ.get("PINOT_TPU_FLIGHTREC_SHED_BURST", "32"))
+        )
+        self.history.add_tick_hook(self._history_tick)
 
     @classmethod
     def from_conf(cls, transport, server_addresses, conf, **overrides) -> "BrokerRequestHandler":
@@ -235,11 +270,14 @@ class BrokerRequestHandler:
         t0 = time.perf_counter()
         self.metrics.meter("queries").mark()
         request_id = self._next_request_id()
+        # with the tail sampler armed (default), EVERY query carries the
+        # lightweight span tree so the retention decision can happen at
+        # completion; with sampling off (PINOT_TPU_TAIL_TRACE=0),
         # untraced queries share the NULL context — no span allocation
-        # anywhere on the handle path (the zero-overhead contract)
+        # anywhere on the handle path (the PR 4 zero-overhead contract)
         ctx = (
             TraceContext(enabled=True, scope=self.name, trace_id=request_id)
-            if trace
+            if trace or self.tail.armed
             else NULL_TRACE
         )
         resp: Optional[BrokerResponse] = None
@@ -275,7 +313,7 @@ class BrokerRequestHandler:
             parse_ms = (time.perf_counter() - t_parse) * 1000
             self.metrics.timer("phase.parse").update(parse_ms)
             if resp is None:
-                request.enable_trace = trace
+                request.enable_trace = ctx.enabled
                 resp = self.handle_request(
                     request,
                     pql,
@@ -283,19 +321,27 @@ class BrokerRequestHandler:
                     request_id=request_id,
                     trace_ctx=ctx,
                 )
+        if not trace and resp.trace_info:
+            # tail arming traces every query internally, but the client
+            # contract is unchanged: traceInfo rides the response only
+            # when the caller asked (trace=true).  The armed span trees
+            # reach the tail sampler via the _server_traces side channel
+            # below, never an untraced client's payload (which must stay
+            # byte-identical to the sampling-off response).
+            resp.trace_info = {}
         resp.request_id = request_id
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
         self.metrics.timer("queryTotal").update(resp.time_used_ms)
+        shed_q = any(
+            e.error_code == ErrorCode.TOO_MANY_REQUESTS
+            for e in resp.exceptions
+        )
         if plan_digest:
             resp.plan_digest = plan_digest
             if request is None or request.explain != "plan":
                 # workload roll-up: every executed query lands in the
                 # per-digest registry (plain EXPLAIN excluded — it did
                 # no work and must not skew frequency/cost rankings)
-                shed = any(
-                    e.error_code == ErrorCode.TOO_MANY_REQUESTS
-                    for e in resp.exceptions
-                )
                 self.planstats.record(
                     plan_digest,
                     summary=plan_summary,
@@ -303,22 +349,69 @@ class BrokerRequestHandler:
                     latency_ms=resp.time_used_ms,
                     cost=resp.cost,
                     num_docs=resp.num_docs_scanned,
-                    shed=shed,
-                    failed=bool(resp.exceptions) and not shed,
+                    shed=shed_q,
+                    failed=bool(resp.exceptions) and not shed_q,
                 )
                 self.metrics.meter("workload.recorded").mark()
+        failed_q = bool(resp.exceptions)
+        tail_reason = None
         if ctx.enabled:
-            # merge the per-server span trees under their scatter
-            # attempts, next to this broker's own tree — ONE waterfall
-            scopes: Dict[str, Any] = {}
-            merge_scope(scopes, ctx.to_dict())
-            for attempt_id, server_trace in getattr(resp, "_server_traces", ()) or ():
-                merge_scope(scopes, server_trace, root_parent=attempt_id)
-            resp.trace_info = {"traceId": request_id, "scopes": scopes}
+
+            def _build_scopes() -> Dict[str, Any]:
+                # merge the per-server span trees under their scatter
+                # attempts, next to this broker's own tree — ONE
+                # waterfall.  Deliberately deferred: on the tail
+                # sampler's NOT-retained path this merge (and its span
+                # copies) never runs — the zero-overhead contract.
+                scopes: Dict[str, Any] = {}
+                merge_scope(scopes, ctx.to_dict())
+                for attempt_id, server_trace in (
+                    getattr(resp, "_server_traces", ()) or ()
+                ):
+                    merge_scope(scopes, server_trace, root_parent=attempt_id)
+                return scopes
+
+            built: Optional[Dict[str, Any]] = None
+            if trace:
+                built = _build_scopes()
+                resp.trace_info = {"traceId": request_id, "scopes": built}
+            if self.tail.armed:
+                scopes_fn = (lambda b=built: b) if built is not None else _build_scopes
+                # sheds are typed overload verdicts, not failures worth a
+                # span tree: retaining them would do the MOST tail work
+                # exactly during a 429 storm (and flood the bounded ring
+                # with microsecond entries), inverting the zero-overhead
+                # contract.  SLO availability still counts them below.
+                tail_reason = self.tail.observe(
+                    request_id,
+                    resp.time_used_ms,
+                    failed_q and not shed_q,
+                    resp.partial_response,
+                    scopes_fn,
+                    table=getattr(request, "table_name", "") or "",
+                    plan_digest=plan_digest,
+                    summary=plan_summary,
+                )
+        # per-table SLO counters (utils/slo.py): burn rates evaluate on
+        # the history cadence over exactly these cumulative series
+        self.slo.observe(
+            getattr(request, "table_name", "") or "",
+            resp.time_used_ms,
+            failed_q,
+        )
         phases = dict(getattr(resp, "phase_ms", ()) or ())
         phases["parse"] = round(parse_ms, 3)
         if self.querylog.observe(
             {
+                # tail cross-link: the retained span tree is fetchable by
+                # this requestId (both directions: /debug/tails entries
+                # carry the requestId back into this log)
+                "traceRetained": bool(tail_reason),
+                **(
+                    {"traceRef": f"/debug/tails?requestId={request_id}"}
+                    if tail_reason
+                    else {}
+                ),
                 "requestId": request_id,
                 "pql": pql[:500],
                 # cross-link key into /debug/plans and /debug/workload
@@ -344,7 +437,49 @@ class BrokerRequestHandler:
             }
         ):
             self.metrics.meter("slowQueries").mark()
+        if failed_q and any(
+            e.error_code
+            not in (ErrorCode.TOO_MANY_REQUESTS, ErrorCode.PQL_PARSING)
+            for e in resp.exceptions
+        ):
+            # notable event: a genuinely failed query (sheds are typed
+            # overload verdicts, parse errors are client bugs) dumps the
+            # observability state that explains it — rate-limited and
+            # disabled unless PINOT_TPU_FLIGHTREC_DIR is set
+            self.flightrec.maybe_dump(
+                "failedQuery",
+                {
+                    "requestId": request_id,
+                    "table": getattr(request, "table_name", None),
+                    "codes": [e.error_code for e in resp.exceptions],
+                },
+            )
         return resp
+
+    def _history_tick(self, now: float) -> None:
+        """Runs on every history sample (the recorder's cadence): SLO
+        burn evaluation + the broker-side flight-recorder triggers."""
+        ev = self.slo.evaluate()
+        for table in ev.get("crossed", ()):
+            t = ev["tables"].get(table, {})
+            self.flightrec.maybe_dump(
+                "sloBurn",
+                {
+                    "table": table,
+                    "burnRate5m": t.get("burnRate5m"),
+                    "burnRate1h": t.get("burnRate1h"),
+                },
+            )
+        dropped = self.metrics.meter("queriesDropped").count
+        delta = dropped - self._last_dropped
+        self._last_dropped = dropped
+        if delta >= self._shed_burst_threshold:
+            self.flightrec.maybe_dump("shedBurst", {"droppedThisTick": delta})
+
+    def shutdown(self) -> None:
+        """Stop the history recorder thread (idempotent); the scatter
+        pool's daemon workers die with the process as before."""
+        self.history.stop()
 
     def handle_request(
         self,
@@ -1175,6 +1310,37 @@ class BrokerHttpServer:
                         return self._respond(broker.querylog.snapshot())
                     if url.path == "/debug/admission":
                         return self._respond(broker.admission.snapshot())
+                    if url.path == "/debug/history":
+                        return self._respond(
+                            broker.history.query_from_qs(url.query)
+                        )
+                    if url.path == "/debug/slo":
+                        return self._respond(broker.slo.snapshot())
+                    if url.path == "/debug/tails":
+                        qs = parse_qs(url.query)
+                        rid = (qs.get("requestId") or [""])[0]
+                        if rid:
+                            entry = broker.tail.get(rid)
+                            if entry is None:
+                                return self._respond(
+                                    {"error": f"no retained tail for {rid}"},
+                                    404,
+                                )
+                            return self._respond(entry)
+                        try:
+                            top = int((qs.get("top") or ["20"])[0])
+                        except ValueError:
+                            top = 20
+                        traces = (
+                            (qs.get("traces") or ["false"])[0].lower() == "true"
+                        )
+                        return self._respond(
+                            broker.tail.snapshot(
+                                top=max(1, top), include_traces=traces
+                            )
+                        )
+                    if url.path == "/debug/flightrec":
+                        return self._respond(broker.flightrec.snapshot())
                     if url.path == "/debug/workload":
                         qs = parse_qs(url.query)
                         try:

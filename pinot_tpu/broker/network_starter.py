@@ -146,6 +146,7 @@ class NetworkedBrokerStarter:
         for t in self._threads:
             t.join(timeout=2)
         self.http.stop()
+        self.handler.shutdown()
 
     def _register(self) -> None:
         # rides the heartbeat loop on reregister: must respect the same
@@ -282,5 +283,13 @@ class NetworkedBrokerStarter:
             self.handler.quota.set_quota(
                 raw, q.get("maxQueriesPerSecond"), q.get("burstQueries")
             )
+            # per-table SLO objectives ride the same snapshot; an absent
+            # block clears the override back to the env defaults
+            self.handler.slo.set_objective(raw, q.get("slo"))
         for stale in set(self.handler.quota.tables()) - quota_raw_names:
             self.handler.quota.set_quota(stale, None)
+        # SLO overrides clear on their own inventory: a table with an
+        # slo block but no QPS quota never had a quota bucket, so the
+        # loop above would never reach it after the table is deleted
+        for stale in set(self.handler.slo.objective_tables()) - quota_raw_names:
+            self.handler.slo.set_objective(stale, None)

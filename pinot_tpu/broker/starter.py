@@ -47,6 +47,13 @@ class BrokerStarter:
         if table not in self.resources.tables():
             self.broker.routing.remove(table)
             self.broker.time_boundary.remove(table)
+            # clear the SLO override once no physical half of the raw
+            # table remains (hybrid: OFFLINE and REALTIME share one)
+            raw = table.rsplit("_", 1)[0]
+            if not any(
+                t.rsplit("_", 1)[0] == raw for t in self.resources.tables()
+            ):
+                self.broker.slo.set_objective(raw, None)
             return
         self.broker.routing.update(table, view)
         config = self.resources.table_configs.get(table)
@@ -58,6 +65,12 @@ class BrokerStarter:
                 config.raw_name,
                 config.quota.max_queries_per_second,
                 config.quota.burst_queries,
+            )
+            # per-table SLO objectives ride the same propagation path as
+            # quotas (None clears back to the env defaults)
+            self.broker.slo.set_objective(
+                config.raw_name,
+                config.slo.to_json() if config.slo is not None else None,
             )
         if table.endswith(OFFLINE_SUFFIX):
             metas = []

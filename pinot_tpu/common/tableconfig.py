@@ -70,6 +70,32 @@ class StreamConfig:
 
 
 @dataclass
+class SloConfig:
+    """Per-table service-level objectives (ISSUE 11): the broker
+    evaluates these as multi-window burn rates (utils/slo.py).  Unset
+    fields fall back to the env defaults (PINOT_TPU_SLO_*)."""
+
+    latency_ms: Optional[float] = None  # queries must answer under this
+    latency_target: Optional[float] = None  # fraction that must (0.99)
+    availability_target: Optional[float] = None  # non-failed fraction
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "latencyMs": self.latency_ms,
+            "latencyTarget": self.latency_target,
+            "availabilityTarget": self.availability_target,
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "SloConfig":
+        return cls(
+            latency_ms=d.get("latencyMs"),
+            latency_target=d.get("latencyTarget"),
+            availability_target=d.get("availabilityTarget"),
+        )
+
+
+@dataclass
 class QuotaConfig:
     storage: Optional[str] = None
     # fractional values (< 1.0) are honored: 0.5 = one query per 2s
@@ -109,6 +135,7 @@ class TableConfig:
     indexing: IndexingConfig = field(default_factory=IndexingConfig)
     stream: Optional[StreamConfig] = None
     quota: QuotaConfig = field(default_factory=QuotaConfig)
+    slo: Optional[SloConfig] = None
     broker_tenant: str = "DefaultTenant"
     server_tenant: str = "DefaultTenant"
 
@@ -138,6 +165,8 @@ class TableConfig:
             "tenants": {"broker": self.broker_tenant, "server": self.server_tenant},
             "quota": self.quota.to_json(),
         }
+        if self.slo is not None:
+            d["slo"] = self.slo.to_json()
         if self.stream is not None:
             d["streamConfigs"] = {
                 "streamType": self.stream.stream_type,
@@ -188,5 +217,6 @@ class TableConfig:
                 startree_dimensions_split_order=idx.get("starTreeDimensionsSplitOrder", []),
                 startree_max_leaf_records=idx.get("starTreeMaxLeafRecords", 10_000),
             ),
+            slo=SloConfig.from_json(d["slo"]) if d.get("slo") else None,
             stream=stream,
         )

@@ -102,6 +102,33 @@ class Controller:
             self.gateway.server_lease_valid
         )
 
+        # SLO & tail-latency attribution plane (ISSUE 11): one history
+        # thread over the controller + stabilizer registries (served at
+        # /debug/history); dead servers / stabilizer repairs spotted on
+        # its tick dump a flight-recorder bundle (disabled unless
+        # PINOT_TPU_FLIGHTREC_DIR is set)
+        from pinot_tpu.utils.flightrec import FlightRecorder
+        from pinot_tpu.utils.timeseries import HistoryRecorder
+
+        self.history = HistoryRecorder(
+            [self.metrics, self.stabilizer.metrics], metrics=self.metrics
+        )
+        # gauges like aliveServers refresh lazily; the provider keeps
+        # every history sample current without a second thread
+        self.history.register_provider(lambda: self._refresh_gauges() or {})
+        self.flightrec = FlightRecorder(
+            "controller",
+            "controller",
+            metrics=self.metrics,
+            sources={
+                "history": lambda: self.history.query(window_s=900),
+                "metrics": self.metrics_snapshot,
+                "stabilizer": lambda: self.stabilizer.debug_snapshot(),
+            },
+        )
+        self._last_notable = 0
+        self.history.add_tick_hook(self._history_tick)
+
         self._recover()
 
         if start_managers:
@@ -376,7 +403,26 @@ class Controller:
             ]
         )
 
+    def _history_tick(self, now: float) -> None:
+        """Flight-recorder trigger on the history cadence: servers
+        declared dead or stabilizer repairs since the last sample are
+        the cluster-level notable events."""
+        total = (
+            self.metrics.meter("instancesMarkedDead").count
+            + self.stabilizer.metrics.meter("stabilizer.replicasAdded").count
+            + self.stabilizer.metrics.meter(
+                "stabilizer.consumingReassigned"
+            ).count
+        )
+        delta = total - self._last_notable
+        self._last_notable = total
+        if delta > 0:
+            self.flightrec.maybe_dump(
+                "serverDeathOrHeal", {"notableEventsThisTick": delta}
+            )
+
     def stop(self) -> None:
+        self.history.stop()
         self.retention_manager.stop()
         self.validation_manager.stop()
         self.status_checker.stop()
@@ -591,6 +637,86 @@ def collect_workload(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, An
         "totalRecorded": total_recorded,
         "topByCount": sorted(plans, key=lambda d: -d["count"])[:20],
         "topByCost": sorted(plans, key=cost_key, reverse=True)[:20],
+        "unreachable": unreachable,
+    }
+
+
+def collect_slo(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, Any]:
+    """Fleet SLO rollup (``/debug/slo`` on the controller): every alive
+    broker's ``/debug/slo`` merged per table.  Each broker evaluates
+    burn rates over its OWN traffic, so the fleet view takes the WORST
+    burn per table across brokers (the one an operator should look at)
+    and keeps the per-broker breakdown verbatim underneath.  A table is
+    fleet-burning if ANY broker reports it burning.  Unreachable
+    brokers degrade to an ``unreachable`` entry (partial rollups say
+    so)."""
+    import urllib.error
+    import urllib.request
+
+    brokers = [
+        i
+        for i in ctrl.resources.instances_snapshot()
+        if i.role == "broker" and i.alive and i.url
+    ]
+
+    def fetch(inst):
+        try:
+            with urllib.request.urlopen(
+                inst.url.rstrip("/") + "/debug/slo", timeout=timeout_s
+            ) as r:
+                return json.loads(r.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            return {"_error": str(e)}
+
+    results = []
+    if brokers:
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(8, len(brokers))
+        ) as pool:
+            results = list(pool.map(fetch, brokers))
+
+    tables: Dict[str, Dict[str, Any]] = {}
+    unreachable: Dict[str, str] = {}
+    config: Dict[str, Any] = {}
+    for inst, snap in zip(brokers, results):
+        if "_error" in snap:
+            unreachable[inst.name] = snap["_error"]
+            continue
+        config = config or (snap.get("config") or {})
+        for table, entry in (snap.get("tables") or {}).items():
+            t = tables.get(table)
+            if t is None:
+                t = tables[table] = {
+                    "burnRate5m": 0.0,
+                    "burnRate1h": 0.0,
+                    "burning": False,
+                    "objective": entry.get("objective"),
+                    "byBroker": {},
+                }
+            t["burnRate5m"] = max(
+                t["burnRate5m"], float(entry.get("burnRate5m") or 0.0)
+            )
+            t["burnRate1h"] = max(
+                t["burnRate1h"], float(entry.get("burnRate1h") or 0.0)
+            )
+            t["burning"] = t["burning"] or bool(entry.get("burning"))
+            t["byBroker"][inst.name] = {
+                "burnRate5m": entry.get("burnRate5m"),
+                "burnRate1h": entry.get("burnRate1h"),
+                "burning": entry.get("burning"),
+                "windows": entry.get("windows"),
+            }
+    burning = sorted(t for t, e in tables.items() if e["burning"])
+    ranked = sorted(
+        tables.items(),
+        key=lambda kv: -max(kv[1]["burnRate5m"], kv[1]["burnRate1h"]),
+    )
+    return {
+        "brokers": len(brokers),
+        "config": config,
+        "tables": tables,
+        "burningTables": burning,
+        "worstBurning": [t for t, _ in ranked[:10]],
         "unreachable": unreachable,
     }
 
@@ -905,6 +1031,21 @@ class ControllerHttpServer:
                         return self._respond_html(
                             dashboard.render_workload(ctrl, collect_workload(ctrl))
                         )
+                    if parts == ["debug", "history"]:
+                        # bounded metric time series (utils/timeseries.py):
+                        # ?series= comma-separated name prefixes,
+                        # ?windowS= trailing window in seconds
+                        return self._respond(
+                            ctrl.history.query_from_qs(url.query)
+                        )
+                    if parts == ["debug", "slo"]:
+                        return self._respond(collect_slo(ctrl))
+                    if parts == ["dashboard", "slo"]:
+                        return self._respond_html(
+                            dashboard.render_slo(ctrl, collect_slo(ctrl))
+                        )
+                    if parts == ["debug", "flightrec"]:
+                        return self._respond(ctrl.flightrec.snapshot())
                     if parts == ["debug", "stabilizer"]:
                         return self._respond(ctrl.stabilizer.debug_snapshot())
                     if len(parts) == 3 and parts[0] == "instances" and parts[2] == "drain":

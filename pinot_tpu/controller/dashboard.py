@@ -43,6 +43,7 @@ _NAV = (
     "<a href='/dashboard/capacity'>Capacity</a>"
     "<a href='/dashboard/workload'>Workload</a>"
     "<a href='/dashboard/utilization'>Utilization</a>"
+    "<a href='/dashboard/slo'>SLOs</a>"
     "<a href='/clusterstate'>Raw state (JSON)</a></nav>"
 )
 
@@ -483,6 +484,80 @@ def render_utilization(ctrl, util: dict) -> str:
             )
         body.append("</table>")
     return _page("Device utilization", body)
+
+
+def _fmt_burn(v) -> str:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return str(v)
+    cls = "bad" if f >= 1.0 else ("warn" if f >= 0.5 else "ok")
+    return f"<span class='{cls}'>{f:.2f}</span>"
+
+
+def render_slo(ctrl, slo: dict) -> str:
+    """Fleet SLO page (``collect_slo`` rollup): per-table error-budget
+    burn rates over the fast/slow windows, worst-burning tables first —
+    the page that names the table an operator should look at when the
+    ``slo.burning`` gauge fires."""
+    tables = slo.get("tables") or {}
+    burning = slo.get("burningTables") or []
+    cfg = slo.get("config") or {}
+    body = ["<h1>SLO burn rates</h1>"]
+    head = (
+        f"<span class='bad'>{len(burning)} table(s) burning: "
+        f"{_esc(', '.join(burning))}</span>"
+        if burning
+        else "<span class='ok'>no table burning</span>"
+    )
+    body.append(
+        f"<p>{head} &middot; brokers polled: <b>{slo.get('brokers', 0)}</b>"
+        f" &middot; windows: {cfg.get('fastWindowS', '?')}s /"
+        f" {cfg.get('slowWindowS', '?')}s, threshold"
+        f" {cfg.get('burnThreshold', '?')}"
+        f" &middot; raw JSON: <a href='/debug/slo'>/debug/slo</a></p>"
+    )
+    unreachable = slo.get("unreachable") or {}
+    if unreachable:
+        names = ", ".join(_esc(n) for n in sorted(unreachable))
+        body.append(f"<p class='bad'>Partial rollup — unreachable: {names}</p>")
+    if not tables:
+        body.append("<p>No per-table SLO traffic observed yet.</p>")
+        return _page("SLOs", body)
+    body.append(
+        "<table><tr><th>table</th><th>burn (fast)</th><th>burn (slow)</th>"
+        "<th>burning</th><th>objective</th><th>brokers</th></tr>"
+    )
+    for name in slo.get("worstBurning") or sorted(tables):
+        t = tables.get(name) or {}
+        obj = t.get("objective") or {}
+        burn = (
+            "<span class='bad'>YES</span>"
+            if t.get("burning")
+            else "<span class='ok'>no</span>"
+        )
+        obj_str = (
+            f"p{100 * float(obj.get('latencyTarget', 0) or 0):g} &lt; "
+            f"{obj.get('latencyMs', '?')}ms, avail "
+            f"{100 * float(obj.get('availabilityTarget', 0) or 0):g}%"
+            if obj
+            else "?"
+        )
+        body.append(
+            f"<tr><td>{_esc(name)}</td>"
+            f"<td>{_fmt_burn(t.get('burnRate5m', 0))}</td>"
+            f"<td>{_fmt_burn(t.get('burnRate1h', 0))}</td>"
+            f"<td>{burn}</td><td>{obj_str}</td>"
+            f"<td>{_esc(', '.join(sorted(t.get('byBroker') or {})))}</td></tr>"
+        )
+    body.append("</table>")
+    body.append(
+        "<p>burn = bad-fraction / error-budget per window; a table is "
+        "burning only when BOTH windows exceed the threshold. History: "
+        "<a href='/debug/history?series=slo.'>/debug/history?series=slo.</a>"
+        " &middot; tails: on each broker at <code>/debug/tails</code></p>"
+    )
+    return _page("SLOs", body)
 
 
 def render_query_console() -> str:

@@ -493,6 +493,9 @@ class ParticipantGateway:
                     "rawName": config.raw_name,
                     "maxQueriesPerSecond": config.quota.max_queries_per_second,
                     "burstQueries": config.quota.burst_queries,
+                    # per-table SLO objectives propagate with the quota
+                    # (broker/network_starter applies them per poll)
+                    "slo": config.slo.to_json() if config.slo is not None else None,
                 }
             if table.endswith("_OFFLINE"):
                 from pinot_tpu.broker.time_boundary import compute_boundary

@@ -553,6 +553,22 @@ class ClusterResourceManager:
         # brokers see the bumped version on their next clusterstate poll
         self._notify_view(physical)
 
+    def update_table_slo(self, physical: str, slo) -> None:
+        """Live SLO-objective update/removal for a running table
+        (``SloConfig`` or None to fall back to env defaults).  Same
+        propagation contract as ``update_table_quota``: persist the
+        changed config, bump the cluster-state version (networked
+        brokers re-apply on their next poll), re-notify the view
+        (in-process brokers re-apply immediately)."""
+        with self._lock:
+            config = self.table_configs.get(physical)
+            if config is None:
+                raise KeyError(f"no such table {physical}")
+            config.slo = slo
+        if self.property_store is not None:
+            self.property_store.put("tables", physical, config.to_json())
+        self._notify_view(physical)
+
     def delete_table(self, physical: str) -> None:
         with self._lock:
             segs = list(self.ideal_states.get(physical, {}).keys())

@@ -274,6 +274,28 @@ class ServerInstance:
         # the "partitioned but riding it out" observable
         self.metrics.gauge("controller.unreachable").set(0)
         self.metrics.meter("controller.heartbeatFailures")
+        # SLO & tail-latency attribution plane (ISSUE 11): one history
+        # thread snapshots this registry on a cadence (served at
+        # /debug/history on the admin surface); heal events spotted on
+        # its tick dump a flight-recorder bundle (disabled unless
+        # PINOT_TPU_FLIGHTREC_DIR is set)
+        from pinot_tpu.utils.flightrec import FlightRecorder
+        from pinot_tpu.utils.timeseries import HistoryRecorder
+
+        self.history = HistoryRecorder(self.metrics, metrics=self.metrics)
+        self.flightrec = FlightRecorder(
+            "server",
+            name,
+            metrics=self.metrics,
+            sources={
+                "history": lambda: self.history.query(window_s=900),
+                "plans": lambda: self.plan_stats.snapshot(top=20),
+                "device": self.device_utilization,
+                "status": self.status,
+            },
+        )
+        self._last_heal_total = 0
+        self.history.add_tick_hook(self._history_tick)
 
     # serving-tier cost-vector keys mirrored into cost.tier.* meters —
     # the ONE source in engine/results.py, so a new tier cannot
@@ -532,6 +554,22 @@ class ServerInstance:
         )
         self.metrics.meter("plan.recorded").mark()
 
+    def _history_tick(self, now: float) -> None:
+        """Flight-recorder trigger on the history cadence: any heal
+        activity since the last sample (device failures healed over to
+        host, lane restarts, CRC quarantines) is a notable event whose
+        surrounding state is worth keeping."""
+        total = (
+            self.metrics.meter("heal.deviceFailures").count
+            + self.metrics.meter("heal.hostFailovers").count
+            + self.metrics.meter("crcFailures").count
+            + (0 if self.lane is None else self.lane.restart_count)
+        )
+        delta = total - self._last_heal_total
+        self._last_heal_total = total
+        if delta > 0:
+            self.flightrec.maybe_dump("healEvent", {"healEventsThisTick": delta})
+
     def status(self) -> dict:
         """Serving-surface snapshot: scheduler depth/shed, device-lane
         depth + coalesce/dispatch/shed counters, the per-stage phase
@@ -619,6 +657,7 @@ class ServerInstance:
         (queued lane waiters fail fast with LaneClosedError), stop the
         occupancy sampler, and force-stop any active profile capture."""
         self.scheduler.shutdown()
+        self.history.stop()
         if self.occupancy_sampler is not None:
             self.occupancy_sampler.stop()
         self.profiler.shutdown()

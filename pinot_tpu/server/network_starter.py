@@ -93,9 +93,18 @@ class ServerAdminHttpServer:
                     return self._send_json(inst.device_utilization())
                 if self.path == "/debug/profile":
                     return self._send_json(inst.profiler.snapshot())
+                if self.path == "/debug/flightrec":
+                    return self._send_json(inst.flightrec.snapshot())
                 from urllib.parse import parse_qs, urlparse
 
                 url = urlparse(self.path)
+                if url.path == "/debug/history":
+                    # bounded metric time series (utils/timeseries.py):
+                    # ?series= comma-separated name prefixes, ?windowS=
+                    # trailing window in seconds
+                    return self._send_json(
+                        inst.history.query_from_qs(url.query)
+                    )
                 if url.path == "/debug/plans":
                     # per-plan-digest workload stats (utils/planstats.py);
                     # ?by=cost reorders the top-K by total work instead

@@ -133,6 +133,12 @@ class InProcessCluster:
     def stop(self) -> None:
         if self.http is not None:
             self.http.stop()
+        # history recorders are per-role daemon threads; stop them with
+        # the cluster so tests don't accumulate tick loops (schedulers/
+        # lanes are left as-is — stop() must not fail in-flight queries)
+        self.broker.shutdown()
+        for s in self.servers:
+            s.history.stop()
         self.controller.stop()
 
 

@@ -1,0 +1,252 @@
+"""Cluster doctor: one postmortem bundle from every role's debug surface.
+
+When something went wrong — an SLO burn, a shed burst, a dead server —
+the evidence is scattered across the controller's rollups, each
+broker's history/SLO/tail rings, each server's device and plan
+registries, and whatever flight-recorder bundles the roles dumped on
+disk.  The doctor walks all of it from ONE entry point (the controller
+URL), concurrently fetches every role's debug endpoints, inlines any
+locally-readable flight-recorder bundles, and writes a single JSON
+document an operator (or a later tool) can take away:
+
+    {
+      "ts": ..., "controllerUrl": ...,
+      "controller": {"<endpoint>": <payload> | {"error": ...}, ...},
+      "instances": {name: {"role": ..., "url": ...,
+                           "endpoints": {...}, "flightBundles": [...]}},
+      "summary": {...}           # the at-a-glance postmortem header
+    }
+
+Instance discovery rides ``/debug/clustermetrics`` (role + url per
+registered instance), so the doctor needs no out-of-band inventory.
+Every fetch degrades independently to an ``{"error": ...}`` entry — a
+half-dead cluster yields a half-full bundle, never an exception.
+
+Usage:
+  python -m pinot_tpu.tools.doctor http://127.0.0.1:9000 \\
+      [--out bundle.json] [--timeout 5] [--history-window 900]
+
+Exit codes: 0 bundle written (possibly partial), 2 controller
+unreachable (nothing to collect).
+"""
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+# per-role debug endpoints the doctor pulls.  History fetches append
+# ?windowS= so a long-lived ring doesn't bloat the bundle.
+CONTROLLER_ENDPOINTS = [
+    "/health",
+    "/debug/metrics",
+    "/debug/slo",
+    "/debug/history",
+    "/debug/flightrec",
+    "/debug/stabilizer",
+    "/debug/capacity",
+    "/debug/workload",
+    "/debug/utilization",
+    "/clusterstate",
+]
+BROKER_ENDPOINTS = [
+    "/debug/metrics",
+    "/debug/queries",
+    "/debug/slo",
+    "/debug/tails?traces=true",
+    "/debug/history",
+    "/debug/admission",
+    "/debug/workload",
+    "/debug/flightrec",
+]
+SERVER_ENDPOINTS = [
+    "/debug/metrics",
+    "/debug/device",
+    "/debug/plans",
+    "/debug/history",
+    "/debug/profile",
+    "/debug/flightrec",
+]
+
+ENDPOINTS_BY_ROLE = {"broker": BROKER_ENDPOINTS, "server": SERVER_ENDPOINTS}
+
+
+def _fetch_json(url: str, timeout_s: float) -> Any:
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return json.loads(r.read())
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _fetch_endpoints(
+    base: str, endpoints: List[str], timeout_s: float, history_window_s: float
+) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for ep in endpoints:
+        url = base.rstrip("/") + ep
+        if ep.endswith("/debug/history"):
+            url += f"?windowS={history_window_s:g}"
+        out[ep] = _fetch_json(url, timeout_s)
+    return out
+
+
+def _inline_flight_bundles(flightrec: Any, limit: int = 16) -> List[Dict[str, Any]]:
+    """When the role's flight-recorder directory is readable from THIS
+    process (in-process harness, same-host postmortem), inline the
+    bundle documents themselves; otherwise the inventory from
+    ``/debug/flightrec`` is all the doctor can carry."""
+    if not isinstance(flightrec, dict):
+        return []
+    d = flightrec.get("dir")
+    if not d or not os.path.isdir(d):
+        return []
+    out: List[Dict[str, Any]] = []
+    for entry in (flightrec.get("bundles") or [])[-limit:]:
+        path = os.path.join(d, entry.get("file", ""))
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                out.append(json.load(f))
+        except (OSError, ValueError) as e:
+            out.append({"file": entry.get("file"), "error": str(e)})
+    return out
+
+
+def collect(
+    controller_url: str,
+    timeout_s: float = 5.0,
+    history_window_s: float = 900.0,
+) -> Dict[str, Any]:
+    """The whole postmortem bundle as one dict (pure HTTP + local
+    flight-bundle reads; unit-testable against an in-process cluster)."""
+    base = controller_url.rstrip("/")
+    bundle: Dict[str, Any] = {
+        "ts": round(time.time(), 3),
+        "controllerUrl": base,
+        "controller": _fetch_endpoints(
+            base, CONTROLLER_ENDPOINTS, timeout_s, history_window_s
+        ),
+        "instances": {},
+    }
+    bundle["controller"]["flightBundles"] = _inline_flight_bundles(
+        bundle["controller"].get("/debug/flightrec")
+    )
+
+    cm = _fetch_json(base + "/debug/clustermetrics", timeout_s)
+    instances = cm.get("instances") if isinstance(cm, dict) else None
+
+    def visit(item):
+        name, meta = item
+        role = meta.get("role")
+        url = meta.get("url")
+        entry: Dict[str, Any] = {"role": role, "url": url}
+        eps = ENDPOINTS_BY_ROLE.get(role)
+        if not url:
+            entry["error"] = "no HTTP surface registered"
+        elif eps is None:
+            entry["error"] = f"unknown role {role!r}"
+        else:
+            entry["endpoints"] = _fetch_endpoints(
+                url, eps, timeout_s, history_window_s
+            )
+            entry["flightBundles"] = _inline_flight_bundles(
+                entry["endpoints"].get("/debug/flightrec")
+            )
+        return name, entry
+
+    items = sorted((instances or {}).items())
+    if items:
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(8, len(items))
+        ) as pool:
+            bundle["instances"] = dict(pool.map(visit, items))
+    bundle["summary"] = summarize(bundle)
+    return bundle
+
+
+def summarize(bundle: Dict[str, Any]) -> Dict[str, Any]:
+    """At-a-glance postmortem header computed from the collected
+    payloads — what an operator reads before opening anything else."""
+    ctrl = bundle.get("controller") or {}
+    slo = ctrl.get("/debug/slo") or {}
+    instances = bundle.get("instances") or {}
+    roles: Dict[str, int] = {}
+    errors = 0
+    retained_tails = 0
+    flight_bundles = len(ctrl.get("flightBundles") or [])
+    for entry in instances.values():
+        roles[entry.get("role") or "?"] = roles.get(entry.get("role") or "?", 0) + 1
+        if "error" in entry:
+            errors += 1
+            continue
+        flight_bundles += len(entry.get("flightBundles") or [])
+        for ep, payload in (entry.get("endpoints") or {}).items():
+            if isinstance(payload, dict) and "error" in payload and len(payload) == 1:
+                errors += 1
+            if ep.startswith("/debug/tails") and isinstance(payload, dict):
+                retained_tails += int(payload.get("retained") or 0)
+    return {
+        "instances": roles,
+        "fetchErrors": errors,
+        "burningTables": slo.get("burningTables") or [],
+        "worstBurning": slo.get("worstBurning") or [],
+        "retainedTails": retained_tails,
+        "flightBundles": flight_bundles,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="pinot_tpu-doctor", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("controller", help="controller base URL (http://host:port)")
+    p.add_argument(
+        "--out",
+        default=None,
+        help="bundle file path (default doctor-<millis>.json in cwd; "
+        "- for stdout)",
+    )
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.add_argument(
+        "--history-window",
+        type=float,
+        default=900.0,
+        help="seconds of metric history to pull per role",
+    )
+    args = p.parse_args(argv)
+
+    probe = _fetch_json(args.controller.rstrip("/") + "/health", args.timeout)
+    if isinstance(probe, dict) and set(probe) == {"error"}:
+        print(
+            json.dumps({"error": f"controller unreachable: {probe['error']}"}),
+            file=sys.stderr,
+        )
+        return 2
+
+    bundle = collect(
+        args.controller,
+        timeout_s=args.timeout,
+        history_window_s=args.history_window,
+    )
+    text = json.dumps(bundle, indent=1)
+    if args.out == "-":
+        print(text)
+    else:
+        out = args.out or f"doctor-{int(bundle['ts'] * 1000)}.json"
+        with open(out, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(out)
+    print(json.dumps(bundle["summary"]), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

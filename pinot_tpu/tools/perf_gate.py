@@ -24,10 +24,12 @@ anyway (ratio semantics survive a platform change poorly; use only for
 exploration).
 
 Serving-mode documents (``PINOT_TPU_BENCH_MODE=serving``) gate their
-own namespace — saturation QPS, pipelined-vs-serial speedup, and the
+own namespace — saturation QPS, pipelined-vs-serial speedup, the
 ISSUE 10 utilization fields (lane busy-fraction, achieved device
-bytes/s, D2H volume) against the committed ``SERVING_UTIL_r10.json``
-— with the same direction-aware bands and config-mismatch SKIP.
+bytes/s, D2H volume), and the ISSUE 11 sampling-overhead ratio (QPS
+with the always-on tail sampler vs sampling off) against the committed
+``SERVING_UTIL_r11.json`` — with the same direction-aware bands and
+config-mismatch SKIP.
 Mixed kinds (default baseline vs serving current) skip outright.
 
 Usage:
@@ -85,11 +87,19 @@ SERVING_METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     "utilization.pipelined.achievedBytesPerSec": ("higher", 0.30),
     "utilization.serial.achievedBytesPerSec": ("higher", 0.30),
     "utilization.pipelined.d2hBytes": ("higher", 0.30),
+    # sampling-overhead spec (ISSUE 11): qpsRatio = saturation QPS with
+    # the always-on tail sampler + history recorder at defaults over
+    # the same run with sampling off.  Near 1.0 by construction; the
+    # band catches the sampler growing a real per-query cost (a ratio
+    # collapse), not closed-loop jitter.  The absolute on-QPS also
+    # rides the standard saturation band.
+    "sampling_overhead.qpsRatio": ("higher", 0.60),
+    "sampling_overhead.samplingOnQps": ("higher", 0.40),
 }
 
 SERVING_CONFIG_KEYS = ("total_rows", "num_segments", "platform")
 
-SERVING_DEFAULT_BASELINE = "SERVING_UTIL_r10.json"
+SERVING_DEFAULT_BASELINE = "SERVING_UTIL_r11.json"
 
 
 def _is_serving(doc: Dict[str, Any]) -> bool:

@@ -1,0 +1,171 @@
+"""Tail-latency attribution report: where do the slow queries spend it?
+
+Input is a ``/debug/tails`` payload (utils/tailsample.py) — fetched
+live from a broker, read from a saved JSON file, or dug out of a
+doctor / flight-recorder bundle — rendered as a per-plan-shape
+attribution table:
+
+    digest    table      tails  p50ms   p99ms  top phase (share)
+    783f0726  testTable     41  212.4   480.1  laneWait (70.2%)
+        shape: SELECT sum(..) FROM .. GROUP BY ..
+        attribution: laneWait 70.2% | staging 21.4% | planExec 5.1% ...
+
+Phase shares are SELF-time fractions over the retained-tail window
+(a span's ms minus its children's — nesting never double-counts), so
+"for this shape, tail p99 is 70% laneWait" reads straight off the
+table.  The retained-entry list at the bottom links each tail back to
+its requestId for ``/debug/queries`` cross-navigation.
+
+Usage:
+  python -m pinot_tpu.tools.tail_report --broker http://127.0.0.1:8099
+  python -m pinot_tpu.tools.tail_report tails.json
+  python -m pinot_tpu.tools.doctor http://127.0.0.1:9000 --out b.json &&
+      python -m pinot_tpu.tools.tail_report b.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _find_tails_payloads(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Accept a bare ``/debug/tails`` payload, a doctor bundle, or a
+    flight-recorder bundle — returns every tails payload found."""
+    if "byDigest" in doc or "entries" in doc:
+        return [doc]
+    out: List[Dict[str, Any]] = []
+    # doctor bundle: instances.<name>.endpoints["/debug/tails?..."]
+    for entry in (doc.get("instances") or {}).values():
+        for ep, payload in (entry.get("endpoints") or {}).items():
+            if ep.startswith("/debug/tails") and isinstance(payload, dict):
+                if "byDigest" in payload or "entries" in payload:
+                    out.append(payload)
+    # flight-recorder bundle: sources.tails
+    tails = (doc.get("sources") or {}).get("tails")
+    if isinstance(tails, dict) and ("byDigest" in tails or "entries" in tails):
+        out.append(tails)
+    return out
+
+
+def _merge(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Concatenate rings / aggregates from multiple brokers (aggregates
+    stay per-(broker, digest): windows are broker-local percentiles and
+    cannot be merged exactly, so they are listed, not summed)."""
+    merged: Dict[str, Any] = {
+        "observed": 0,
+        "retained": 0,
+        "entries": [],
+        "byDigest": [],
+    }
+    for p in payloads:
+        merged["observed"] += int(p.get("observed") or 0)
+        merged["retained"] += int(p.get("retained") or 0)
+        merged["entries"].extend(p.get("entries") or [])
+        merged["byDigest"].extend(p.get("byDigest") or [])
+    merged["entries"].sort(key=lambda e: -(e.get("ts") or 0))
+    merged["byDigest"].sort(
+        key=lambda a: -((a.get("latencyMs") or {}).get("p99") or 0)
+    )
+    return merged
+
+
+def render_report(
+    tails: Dict[str, Any], top: int = 20, entries: int = 10
+) -> str:
+    """Tails payload -> multi-line report (pure; unit-testable)."""
+    lines: List[str] = []
+    lines.append(
+        f"tail-based sampling: {tails.get('retained', 0)} retained of "
+        f"{tails.get('observed', 0)} observed"
+        + (
+            f" (slowMs={tails['slowMs']:g}, 1-in-{tails.get('sampleN')})"
+            if "slowMs" in tails
+            else ""
+        )
+    )
+    aggs = (tails.get("byDigest") or [])[: max(1, top)]
+    if not aggs:
+        lines.append("(no retained tails — nothing slow, failed, or sampled yet)")
+        return "\n".join(lines) + "\n"
+    lines.append("")
+    lines.append(
+        f"{'digest':<18} {'table':<20} {'tails':>5} {'p50ms':>9} "
+        f"{'p99ms':>9}  top phase (share)"
+    )
+    for a in aggs:
+        lat = a.get("latencyMs") or {}
+        attribution = a.get("attribution") or {}
+        topk = next(iter(attribution), None)
+        top_str = (
+            f"{topk} ({100.0 * attribution[topk]:.1f}%)" if topk else "-"
+        )
+        lines.append(
+            f"{(a.get('digest') or '?')[:16]:<18} "
+            f"{(a.get('table') or '')[:20]:<20} "
+            f"{a.get('tails', 0):>5} "
+            f"{lat.get('p50', 0):>9.1f} {lat.get('p99', 0):>9.1f}  {top_str}"
+        )
+        if a.get("summary"):
+            lines.append(f"    shape: {a['summary'][:100]}")
+        if attribution:
+            parts = " | ".join(
+                f"{k} {100.0 * v:.1f}%" for k, v in list(attribution.items())[:6]
+            )
+            lines.append(f"    attribution: {parts}")
+    ring = (tails.get("entries") or [])[: max(0, entries)]
+    if ring:
+        lines.append("")
+        lines.append("recent retained tails (newest first):")
+        for e in ring:
+            lines.append(
+                f"  {e.get('requestId', '?'):<28} {e.get('reason', '?'):<8} "
+                f"{e.get('timeUsedMs', 0):>9.1f}ms  "
+                f"{(e.get('table') or '')[:20]:<20} "
+                f"digest={str(e.get('planDigest') or '')[:16]}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="pinot_tpu-tail-report", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument(
+        "source", nargs="?",
+        help="tails JSON / doctor bundle / flight-recorder bundle "
+        "(file or - for stdin); or use --broker",
+    )
+    p.add_argument("--broker", help="fetch live from this broker base URL")
+    p.add_argument("--top", type=int, default=20, help="plan shapes shown")
+    p.add_argument("--entries", type=int, default=10, help="ring entries shown")
+    args = p.parse_args(argv)
+
+    if args.broker:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            args.broker.rstrip("/") + "/debug/tails?top=1024", timeout=10
+        ) as r:
+            doc = json.loads(r.read())
+    elif args.source:
+        text = (
+            sys.stdin.read() if args.source == "-" else open(args.source).read()
+        )
+        doc = json.loads(text)
+    else:
+        p.error("need a source file or --broker")
+        return 2
+    payloads = _find_tails_payloads(doc)
+    if not payloads:
+        print("no /debug/tails payload found in input", file=sys.stderr)
+        return 1
+    tails = payloads[0] if len(payloads) == 1 else _merge(payloads)
+    sys.stdout.write(render_report(tails, top=args.top, entries=args.entries))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -403,6 +403,22 @@ BROKER_METRIC_CATALOG: Dict[str, str] = {
     "explain.queries": "EXPLAIN / EXPLAIN ANALYZE queries handled",
     # partition-tolerance plane (ISSUE 9): a partitioned broker keeps
     # serving from its last versioned snapshot and says so
+    # SLO & tail-latency attribution plane (ISSUE 11)
+    "history.ticks": "metric-history samples recorded into the ring "
+    "(utils/timeseries.py, served at /debug/history)",
+    "history.series": "distinct series in the latest history sample",
+    "slo.burning": "tables currently burning their error budget on BOTH "
+    "the fast and slow windows",
+    "slo.worstBurnRate5m": "worst per-table burn rate over the fast "
+    "(default 5m) window",
+    "slo.worstBurnRate1h": "worst per-table burn rate over the slow "
+    "(default 1h) window",
+    "tails.observed": "completed queries offered to the tail sampler",
+    "tails.retained": "tail traces kept (slow / failed / partial / "
+    "1-in-N sampled)",
+    "tails.ring": "retained tail traces currently held in the ring",
+    "flightrec.dumps": "flight-recorder bundles written on notable events",
+    "flightrec.bundles": "flight-recorder bundles currently on disk",
     "controller.unreachable": "1 while cluster-state polls are failing "
     "(serving from the last versioned snapshot)",
     "controller.pollFailures": "failed cluster-state polls (partition / "
@@ -522,6 +538,12 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "the serving lease expired",
     "lease.blockedTransitions": "CONSUMING transitions deferred "
     "(unacked) while the serving lease was expired",
+    # SLO & tail-latency attribution plane (ISSUE 11)
+    "history.ticks": "metric-history samples recorded into the ring "
+    "(utils/timeseries.py, served at /debug/history)",
+    "history.series": "distinct series in the latest history sample",
+    "flightrec.dumps": "flight-recorder bundles written on notable events",
+    "flightrec.bundles": "flight-recorder bundles currently on disk",
     "controller.unreachable": "1 while heartbeats to the controller "
     "are failing (riding out a partition on local state)",
     "controller.heartbeatFailures": "failed controller heartbeats "
@@ -574,6 +596,12 @@ CONTROLLER_METRIC_CATALOG: Dict[str, str] = {
     "elected one lost its serving lease mid-protocol",
     "netfaults.*": "injected link faults observed by this role's "
     "transports (dropped/replyDropped/delayed/duplicated/flaky)",
+    # SLO & tail-latency attribution plane (ISSUE 11)
+    "history.ticks": "metric-history samples recorded into the ring "
+    "(utils/timeseries.py, served at /debug/history)",
+    "history.series": "distinct series in the latest history sample",
+    "flightrec.dumps": "flight-recorder bundles written on notable events",
+    "flightrec.bundles": "flight-recorder bundles currently on disk",
     "*.missingReplicas": "per-table replicas missing from the external view",
     "*.errorReplicas": "per-table replicas in ERROR state",
     "*.percentSegmentsAvailable": "per-table % of segments with a live replica",

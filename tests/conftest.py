@@ -72,6 +72,22 @@ def _no_leaked_scheduler_threads():
 
 
 @pytest.fixture(autouse=True)
+def _no_leaked_recorder_threads():
+    """History recorders (utils/timeseries.py): one daemon thread per
+    role snapshots metrics on a cadence; ``stop()`` must actually end
+    it.  Recorders still running (module fixtures, live roles) are
+    exempt — a STOPPED recorder whose thread survives is the leak."""
+    yield
+    from pinot_tpu.utils.timeseries import leaked_recorder_threads
+
+    leaked = leaked_recorder_threads(grace_s=2.0)
+    assert not leaked, (
+        f"history-recorder threads leaked past stop(): "
+        f"{[t.name for t in leaked]}"
+    )
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_manager_threads():
     """Controller periodic managers (retention/validation/status/
     stabilizer): a stopped manager's worker must actually exit —

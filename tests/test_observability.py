@@ -126,10 +126,16 @@ def test_trace_tree_in_process(cluster):
     )
 
 
-def test_trace_disabled_allocates_no_spans(cluster):
+def test_trace_disabled_allocates_no_spans(cluster, monkeypatch):
+    """With tail sampling opted out (the PINOT_TPU_TAIL_TRACE=0
+    contract), an untraced query allocates zero spans — the original
+    PR 4 bar.  The always-on default's own zero-overhead contract (no
+    retained-entry work on the not-retained path) lives in
+    test_slo_tails.py."""
     broker, _, _ = cluster
     import pinot_tpu.utils.trace as trace_mod
 
+    monkeypatch.setattr(broker.tail, "enabled", False)
     broker.handle_pql(f"SELECT count(*) FROM {TABLE}")  # warm
     before = trace_mod.SPAN_ALLOCATIONS
     resp = broker.handle_pql(f"SELECT count(*) FROM {TABLE}")
@@ -239,8 +245,9 @@ def test_prometheus_text_valid_and_covers_key_series(cluster):
     from pinot_tpu.utils.metrics import prometheus_text
 
     broker.handle_pql(f"SELECT count(*) FROM {TABLE}")
+    btext = prometheus_text(broker.metrics)
     _assert_valid_prometheus(
-        prometheus_text(broker.metrics),
+        btext,
         required_substrings=[
             "pinot_tpu_broker_queries_total",
             "pinot_tpu_broker_scatterGather_ms",
@@ -256,6 +263,19 @@ def test_prometheus_text_valid_and_covers_key_series(cluster):
             "pinot_tpu_server_phase_schedulerWait_ms",
         ],
     )
+    # every timer summary family carries _count and _sum samples, so an
+    # external scraper can do rate x latency math (ISSUE 11 satellite)
+    for exposition in (btext, text):
+        summaries = [
+            line.split()[2]
+            for line in exposition.splitlines()
+            if line.startswith("# TYPE ") and line.endswith(" summary")
+        ]
+        assert summaries, "no timer families in exposition"
+        for fam in summaries:
+            assert f"{fam}_count{{" in exposition, f"{fam} missing _count"
+            assert f"{fam}_sum{{" in exposition, f"{fam} missing _sum"
+            assert f'{fam}{{scope="' in exposition  # quantile samples
 
 
 def test_meter_windowed_rate_and_timer_interpolation():

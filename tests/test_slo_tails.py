@@ -1,0 +1,738 @@
+"""SLO & tail-latency attribution plane (ISSUE 11): the history
+recorder ring, multi-window burn rates, tail-based trace sampling with
+its zero-overhead contract, the flight recorder, and the doctor.
+
+Covers the acceptance bar: burn-rate math evaluates over exactly the
+recorded history (deterministic, injected clocks — no wall-clock
+sleeps for window math); a not-retained query does ZERO retained-entry
+work; in a chaos scenario the SLO burn gauge crosses, a flight-recorder
+bundle lands on disk, ``/debug/tails`` attributes the victim table's
+tail to a phase, and ``tools/doctor.py`` collects all of it into one
+parseable bundle.
+"""
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+from pinot_tpu.utils.metrics import MetricsRegistry
+from pinot_tpu.utils.timeseries import HistoryRecorder, leaked_recorder_threads
+
+TABLE = "testTable"
+
+
+# ------------------------------------------------------- history recorder
+def test_history_recorder_ring_and_window_delta():
+    reg = MetricsRegistry("t")
+    clk = [1000.0]
+    rec = HistoryRecorder(
+        reg, interval_s=5, capacity=4, clock=lambda: clk[0], start=False
+    )
+    reg.meter("m").mark(10)
+    reg.gauge("g").set(2)
+    reg.gauge("flag").set(True)  # bool gauges flatten to 1.0/0.0
+    reg.gauge("label").set("not-a-number")  # non-numeric: skipped
+    reg.timer("ph").update(5.0)
+    rec.tick()
+    clk[0] += 5
+    reg.meter("m").mark(5)
+    rec.tick()
+    assert rec.sample_count() == 2
+    assert rec.latest("m.count") == 15
+    assert rec.latest("flag") == 1.0
+    assert rec.latest("label") is None
+    assert rec.latest("ph.p99Ms") == 5.0
+    # exact window: base is the newest sample at least window_s old
+    assert rec.window_delta("m.count", 5) == (5, 5.0)
+    # window longer than the ring: partial figure from the oldest sample
+    assert rec.window_delta("m.count", 600) == (5, 5.0)
+    assert rec.window_delta("nope", 5) is None
+    # capacity bound: the ring never exceeds 4 samples
+    for _ in range(6):
+        clk[0] += 5
+        rec.tick()
+    assert rec.sample_count() == 4
+    q = rec.query(series=["m."], window_s=10)
+    assert set(q["series"]) == {"m.count", "m.rate1m"}
+    assert q["samples"] == 4
+    # windowS filter: only the trailing 10s of samples ride out
+    assert len(q["series"]["m.count"]) == 3  # ts in [now-10, now]
+
+
+def test_history_recorder_providers_hooks_and_thread_lifecycle():
+    reg = MetricsRegistry("t")
+    rec = HistoryRecorder(reg, interval_s=0.02, capacity=8, metrics=reg)
+    try:
+        seen = []
+        rec.register_provider(lambda: {"extra.series": 7.0})
+        rec.register_provider(lambda: 1 / 0)  # sick provider: tolerated
+        rec.add_tick_hook(seen.append)
+        rec.add_tick_hook(lambda now: 1 / 0)  # sick hook: tolerated
+        deadline = time.monotonic() + 5
+        while rec.sample_count() < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rec.sample_count() >= 2, "recorder thread never ticked"
+        assert rec.latest("extra.series") == 7.0
+        assert rec.latest("history.ticks.count") >= 1
+        assert seen and all(isinstance(t, float) for t in seen)
+    finally:
+        rec.stop()
+    assert rec.stopped
+    assert leaked_recorder_threads(grace_s=2.0) == []
+    rec.start()  # restartable after stop
+    rec.stop()
+    assert leaked_recorder_threads(grace_s=2.0) == []
+
+
+# ------------------------------------------------------------- SLO burn
+def _slo_rig(fast=10.0, slow=100.0):
+    from pinot_tpu.utils.slo import SloTracker
+
+    reg = MetricsRegistry("t")
+    clk = [0.0]
+    hist = HistoryRecorder(
+        reg, interval_s=5, capacity=64, clock=lambda: clk[0], start=False
+    )
+    slo = SloTracker(
+        history=hist,
+        metrics=reg,
+        fast_window_s=fast,
+        slow_window_s=slow,
+        burn_threshold=1.0,
+    )
+    hist.register_provider(slo.series)
+    return reg, clk, hist, slo
+
+
+def test_slo_burn_rate_math_and_crossing():
+    reg, clk, hist, slo = _slo_rig()
+    for _ in range(100):
+        slo.observe(TABLE, 10.0, failed=False)
+    hist.tick()
+    clk[0] += 10.0
+    for i in range(100):
+        slo.observe(TABLE, 10.0, failed=(i < 50))
+    hist.tick()
+    # a read-only poll between ticks (/debug/slo, fleet rollup, doctor,
+    # flight-recorder source) must NOT consume the crossing edge the
+    # sloBurn trigger depends on
+    assert slo.snapshot()["burningTables"] == [TABLE]
+    ev = slo.evaluate()
+    t = ev["tables"][TABLE]
+    # availability: 50 bad / 100 over the fast window, budget 1-0.999
+    av = t["windows"]["burnRate5m"]["availability"]
+    assert av["queries"] == 100 and av["bad"] == 50
+    assert av["badFraction"] == pytest.approx(0.5)
+    assert av["burnRate"] == pytest.approx(0.5 / 0.001, rel=1e-3)
+    # slow window is younger than 100s: partial figure from the oldest
+    # sample — same delta here, so both windows burn and the table
+    # CROSSES into burning exactly once
+    assert t["burning"] and ev["crossed"] == [TABLE]
+    assert ev["burningTables"] == [TABLE]
+    assert ev["worstBurning"][0] == TABLE
+    assert reg.gauge("slo.burning").value == 1
+    assert reg.gauge("slo.worstBurnRate5m").value > 1.0
+    ev2 = slo.evaluate()
+    assert ev2["crossed"] == []  # still burning, but no new crossing
+    # snapshot() is evaluate() without the edge-trigger field
+    assert "crossed" not in slo.snapshot()
+
+
+def test_slo_multi_window_guard_fast_spike_does_not_page():
+    """A burst that burns the FAST window while the slow window is
+    healthy must not mark the table burning (multi-window practice)."""
+    reg, clk, hist, slo = _slo_rig(fast=10.0, slow=100.0)
+    # generous latency budget (target 0.5) so slow-window burn stays <1
+    slo.set_objective(TABLE, {"latencyMs": 5.0, "latencyTarget": 0.5})
+    for ts in (0.0, 5.0, 10.0, 15.0):
+        clk[0] = ts
+        for _ in range(75):
+            slo.observe(TABLE, 1.0, failed=False)  # under the 5ms bar
+        hist.tick()
+    clk[0] = 25.0
+    for _ in range(10):
+        slo.observe(TABLE, 50.0, failed=False)  # every one breaches
+    hist.tick()
+    ev = slo.evaluate()
+    t = ev["tables"][TABLE]
+    # fast window (base = sample@15): 10/10 breaches, burn = 1/0.5 = 2
+    assert t["burnRate5m"] == pytest.approx(2.0, rel=1e-3)
+    # slow window (base = sample@0): 10/310 breaches, burn ~ 0.065
+    assert t["burnRate1h"] < 1.0
+    assert not t["burning"] and ev["burningTables"] == []
+
+
+def test_slo_objectives_override_and_clear(monkeypatch):
+    from pinot_tpu.utils.slo import SloTracker, default_objective
+
+    monkeypatch.setenv("PINOT_TPU_SLO_LATENCY_MS", "400")
+    assert default_objective()["latencyMs"] == 400.0
+    slo = SloTracker()
+    # partial override: unset fields fall back per-field to env defaults
+    slo.set_objective(TABLE, {"latencyTarget": 0.9})
+    obj = slo.objective(TABLE)
+    assert obj["latencyTarget"] == 0.9 and obj["latencyMs"] == 400.0
+    slo.set_objective(TABLE, None)
+    assert slo.objective(TABLE) == default_objective()
+    # a failed query counts against BOTH availability and latency
+    slo.observe(TABLE, 1.0, failed=True)
+    s = slo.series()
+    assert s[f"slo.{TABLE}.failures"] == 1
+    assert s[f"slo.{TABLE}.latencyBreaches"] == 1
+
+
+# ------------------------------------------------------- tail sampling
+def test_tail_sampler_decisions_and_zero_overhead():
+    import pinot_tpu.utils.tailsample as ts_mod
+    from pinot_tpu.utils.tailsample import TailSampler
+
+    t = TailSampler(enabled=True, slow_ms=100.0, sample_n=4, capacity=3)
+    assert t.decide(50.0, failed=True, partial=False) == "failed"
+    assert t.decide(50.0, failed=False, partial=True) == "partial"
+    assert t.decide(150.0, failed=False, partial=False) == "slow"
+    # 4th decide() call: the 1-in-N sample fires even for a fast query
+    assert t.decide(1.0, failed=False, partial=False) == "sampled"
+    assert t.decide(1.0, failed=False, partial=False) is None
+
+    # zero-overhead contract: a not-retained observe() never calls the
+    # scopes builder and never builds a retained entry
+    before = ts_mod.TAIL_ALLOCATIONS
+
+    def boom():
+        raise AssertionError("scopes built on the not-retained path")
+
+    assert t.observe("r0", 1.0, False, False, boom) is None
+    assert ts_mod.TAIL_ALLOCATIONS == before
+
+    # retained path: scopes_fn runs once, entry lands in the ring
+    scopes = {
+        "brk": [
+            {"id": "1", "parent": None, "span": "query", "ms": 100.0},
+            {"id": "2", "parent": "1", "span": "laneWait", "ms": 70.0},
+        ]
+    }
+    reason = t.observe(
+        "r1", 500.0, False, False, lambda: scopes,
+        table=TABLE, plan_digest="d1", summary="SELECT ...",
+    )
+    assert reason == "slow"
+    assert ts_mod.TAIL_ALLOCATIONS == before + 1
+    got = t.get("r1")
+    assert got is not None and got["reason"] == "slow"
+    # self time: the 100ms parent holding a 70ms child splits 30/70
+    assert got["phaseSelfMs"] == {"query": 30.0, "laneWait": 70.0}
+    # ring bound: capacity 3 evicts the oldest
+    for i in range(4):
+        t.retain(f"rr{i}", "slow", 300.0, {})
+    assert t.get("r1") is None
+    snap = t.snapshot()
+    assert snap["retained"] == 3 and len(snap["entries"]) == 3
+    # span trees are elided from the listing unless asked
+    assert all("scopes" not in e for e in snap["entries"])
+    assert all("scopes" in e for e in t.snapshot(include_traces=True)["entries"])
+
+
+def test_tail_phase_self_time_never_double_counts():
+    from pinot_tpu.utils.tailsample import phase_self_ms
+
+    # concurrent children overlapping the parent: self floors at 0
+    scopes = {
+        "s": [
+            {"id": "p", "parent": None, "span": "serverQuery", "ms": 100.0},
+            {"id": "a", "parent": "p", "span": "stageA", "ms": 80.0},
+            {"id": "b", "parent": "p", "span": "stageB", "ms": 60.0},
+        ]
+    }
+    out = phase_self_ms(scopes)
+    assert "serverQuery" not in out  # 100 - 140 floors at 0, dropped
+    assert out == {"stageA": 80.0, "stageB": 60.0}
+    assert phase_self_ms({}) == {}
+
+
+def test_tail_digest_attribution_fractions():
+    from pinot_tpu.utils.tailsample import TailSampler
+
+    t = TailSampler(enabled=True, slow_ms=100.0, sample_n=0, capacity=8)
+    scopes = {
+        "b": [
+            {"id": "1", "parent": None, "span": "query", "ms": 100.0},
+            {"id": "2", "parent": "1", "span": "laneWait", "ms": 75.0},
+        ]
+    }
+    for i in range(6):
+        t.retain(f"r{i}", "slow", 200.0 + i, scopes, plan_digest="dig",
+                 table=TABLE, summary="shape")
+    agg = t.snapshot()["byDigest"][0]
+    assert agg["digest"] == "dig" and agg["tails"] == 6
+    assert agg["topPhase"] == "laneWait"
+    assert agg["attribution"]["laneWait"] == pytest.approx(0.75)
+    assert sum(agg["attribution"].values()) == pytest.approx(1.0)
+    assert agg["latencyMs"]["p50"] <= agg["latencyMs"]["p99"]
+
+
+def test_tail_env_opt_out(monkeypatch):
+    from pinot_tpu.utils.tailsample import TailSampler
+
+    monkeypatch.setenv("PINOT_TPU_TAIL_TRACE", "0")
+    assert TailSampler().armed is False
+    monkeypatch.delenv("PINOT_TPU_TAIL_TRACE")
+    assert TailSampler().armed is True
+
+
+# ------------------------------------------------------ flight recorder
+def test_flight_recorder_dump_prune_rate_limit(tmp_path):
+    from pinot_tpu.utils.flightrec import FlightRecorder
+
+    # disabled without a directory: dumps are free no-ops
+    off = FlightRecorder("broker", "b0")
+    assert not off.enabled and off.maybe_dump("x") is None
+
+    clk = [100.0]
+    rec = FlightRecorder(
+        "broker", "b0",
+        sources={"ok": lambda: {"v": 1}, "sick": lambda: 1 / 0},
+        directory=str(tmp_path), max_bundles=2, min_interval_s=30.0,
+        clock=lambda: clk[0],
+    )
+    path = rec.maybe_dump("sloBurn", {"table": TABLE})
+    assert path is not None and os.path.exists(path)
+    doc = json.loads(open(path).read())
+    assert doc["reason"] == "sloBurn" and doc["detail"]["table"] == TABLE
+    assert doc["sources"]["ok"] == {"v": 1}
+    assert "ZeroDivisionError" in doc["sources"]["sick"]["error"]
+    # rate limit: a second dump inside the window is suppressed
+    assert rec.maybe_dump("sloBurn") is None
+    # bounded: oldest pruned BEFORE writing, never the fresh bundle
+    written = [path]
+    for i in range(3):
+        clk[0] += 31.0
+        p = rec.maybe_dump(f"r{i}")
+        assert p is not None
+        written.append(p)
+    files = rec.bundle_files()
+    assert len(files) == 2 and files[-1] == written[-1]
+    snap = rec.snapshot()
+    assert snap["enabled"] and len(snap["bundles"]) == 2
+    assert snap["dir"] == str(tmp_path)
+
+
+def test_tableconfig_slo_roundtrip():
+    from pinot_tpu.common.tableconfig import SloConfig, TableConfig
+
+    cfg = TableConfig(
+        table_name=TABLE, table_type="OFFLINE",
+        slo=SloConfig(latency_ms=250.0, latency_target=0.95),
+    )
+    d = cfg.to_json()
+    assert d["slo"] == {
+        "latencyMs": 250.0, "latencyTarget": 0.95, "availabilityTarget": None,
+    }
+    back = TableConfig.from_json(d)
+    assert back.slo is not None and back.slo.latency_ms == 250.0
+    # absent block stays absent
+    plain = TableConfig(table_name=TABLE, table_type="OFFLINE")
+    assert "slo" not in plain.to_json()
+    assert TableConfig.from_json(plain.to_json()).slo is None
+
+
+# --------------------------------------------------- broker integration
+@pytest.fixture(scope="module")
+def served():
+    from pinot_tpu.tools.cluster_harness import single_server_broker
+
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 300, seed=9)
+    seg = build_segment(schema, rows, TABLE, "tailSeg")
+    broker = single_server_broker(TABLE, [seg])
+    for _ in range(2):  # warm staging + compile
+        r = broker.handle_pql(f"SELECT count(*) FROM {TABLE}")
+        assert not r.exceptions
+    yield broker
+    broker.shutdown()
+
+
+def test_broker_tail_retention_links_querylog(served, monkeypatch):
+    broker = served
+    monkeypatch.setattr(broker.tail, "slow_ms", 0.001)  # retain everything
+    monkeypatch.setattr(broker.querylog, "threshold_ms", 0.0)
+    resp = broker.handle_pql(f"SELECT sum(metInt) FROM {TABLE}")
+    assert not resp.exceptions
+    # the client did not ask for a trace: even though tail arming traced
+    # the query internally (and retained it), the RESPONSE must stay
+    # byte-identical to the sampling-off payload — no traceInfo
+    assert resp.trace_info == {}
+    got = broker.tail.get(resp.request_id)
+    assert got is not None and got["reason"] == "slow"
+    assert got["table"] == TABLE and got["planDigest"]
+    assert got["phaseSelfMs"], "no phase attribution on the retained tail"
+    # querylog cross-link, both directions
+    entry = next(
+        e
+        for e in broker.querylog.snapshot()["entries"]
+        if e["requestId"] == resp.request_id
+    )
+    assert entry["traceRetained"] is True
+    assert entry["traceRef"] == f"/debug/tails?requestId={resp.request_id}"
+    assert broker.metrics.meter("tails.retained").count > 0
+
+
+def test_broker_not_retained_path_is_zero_overhead(served, monkeypatch):
+    import pinot_tpu.utils.tailsample as ts_mod
+
+    broker = served
+    monkeypatch.setattr(broker.tail, "slow_ms", 1e9)
+    monkeypatch.setattr(broker.tail, "sample_n", 0)
+    broker.handle_pql(f"SELECT count(*) FROM {TABLE}")  # warm this config
+    before_alloc = ts_mod.TAIL_ALLOCATIONS
+    before_obs = broker.metrics.meter("tails.observed").count
+    resp = broker.handle_pql(f"SELECT count(*) FROM {TABLE}")
+    assert not resp.exceptions
+    assert ts_mod.TAIL_ALLOCATIONS == before_alloc, (
+        "not-retained query built a tail entry"
+    )
+    assert resp.trace_info == {}  # armed-but-untraced: no traceInfo leak
+    assert broker.metrics.meter("tails.observed").count == before_obs + 1
+    # an explicitly traced query still gets its waterfall back even when
+    # the tail verdict is drop
+    resp = broker.handle_pql(f"SELECT count(*) FROM {TABLE}", trace=True)
+    assert resp.trace_info["scopes"]
+
+
+def test_broker_shed_not_retained_as_tail(served, monkeypatch):
+    """A 429 shed is a typed overload verdict, not a failure worth a
+    span tree: retaining sheds would do the MOST tail-sampling work
+    exactly during a shed storm and flood the bounded ring.  SLO
+    availability still counts them."""
+    import pinot_tpu.utils.tailsample as ts_mod
+    from pinot_tpu.common.response import ErrorCode
+
+    broker = served
+    monkeypatch.setattr(broker.tail, "slow_ms", 1e9)
+    monkeypatch.setattr(broker.tail, "sample_n", 0)
+    broker.quota.set_quota(TABLE, 0.001)  # one initial token, then shed
+    try:
+        before = ts_mod.TAIL_ALLOCATIONS
+        fail0 = broker.slo.series().get(f"slo.{TABLE}.failures", 0)
+        sheds = 0
+        for _ in range(3):
+            resp = broker.handle_pql(f"SELECT count(*) FROM {TABLE}")
+            if resp.exceptions:
+                assert resp.exceptions[0].error_code == ErrorCode.TOO_MANY_REQUESTS
+                sheds += 1
+                assert broker.tail.get(resp.request_id) is None
+        assert sheds >= 2, "quota never shed"
+        assert ts_mod.TAIL_ALLOCATIONS == before, "shed retained as a tail"
+        assert broker.slo.series()[f"slo.{TABLE}.failures"] == fail0 + sheds
+    finally:
+        broker.quota.set_quota(TABLE, None)
+
+
+def test_broker_http_history_slo_tails_flightrec(served, monkeypatch):
+    from pinot_tpu.broker.broker import BrokerHttpServer
+
+    broker = served
+    monkeypatch.setattr(broker.tail, "slow_ms", 0.001)
+    resp = broker.handle_pql(f"SELECT count(*) FROM {TABLE}")
+    broker.history.tick()
+    http = BrokerHttpServer(broker)
+    http.start()
+    try:
+        base = f"http://127.0.0.1:{http.port}"
+
+        def get(path, status=200):
+            try:
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    assert r.status == status
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                assert e.code == status, (path, e.code)
+                return json.loads(e.read())
+
+        hist = get("/debug/history?series=queries,slo.&windowS=600")
+        assert hist["samples"] >= 1 and hist["windowS"] == 600.0
+        assert any(k.startswith("queries") for k in hist["series"])
+        assert any(k.startswith("slo.") for k in hist["series"])
+        slo = get("/debug/slo")
+        assert TABLE in slo["tables"] and "burningTables" in slo
+        tails = get("/debug/tails?top=5")
+        assert tails["retained"] >= 1 and tails["byDigest"]
+        one = get(f"/debug/tails?requestId={resp.request_id}")
+        assert one["scopes"], "per-request fetch must include the tree"
+        assert get("/debug/tails?requestId=nope", status=404)["error"]
+        frec = get("/debug/flightrec")
+        assert frec["enabled"] is False  # env not set in this test
+    finally:
+        http.stop()
+
+
+def test_role_series_preregistered_at_construction():
+    """Metric hygiene: every history.*/slo.*/tails.*/flightrec.* series
+    exists (zero-valued) from construction, before any traffic."""
+    from pinot_tpu.broker.broker import BrokerRequestHandler
+    from pinot_tpu.server.instance import ServerInstance
+    from pinot_tpu.transport.local import LocalTransport
+
+    broker = BrokerRequestHandler(LocalTransport(), {}, name="hygBrk")
+    snap = broker.metrics.snapshot()
+    for m in ("history.ticks", "tails.observed", "tails.retained",
+              "flightrec.dumps"):
+        assert m in snap["meters"], m
+    for g in ("history.series", "slo.burning", "slo.worstBurnRate5m",
+              "slo.worstBurnRate1h", "tails.ring", "flightrec.bundles"):
+        assert g in snap["gauges"], g
+    broker.shutdown()
+
+    server = ServerInstance("hygSrv")
+    snap = server.metrics.snapshot()
+    for m in ("history.ticks", "flightrec.dumps"):
+        assert m in snap["meters"], m
+    for g in ("history.series", "flightrec.bundles"):
+        assert g in snap["gauges"], g
+    server.shutdown()
+
+
+# ----------------------------------------------- controller + dashboard
+def test_controller_history_slo_flightrec_endpoints(tmp_path):
+    from pinot_tpu.controller.controller import Controller, ControllerHttpServer
+
+    ctrl = Controller(str(tmp_path))
+    http = ControllerHttpServer(ctrl)
+    http.start()
+    try:
+        base = f"http://{http.host}:{http.port}"
+        ctrl.history.tick()
+        hist = json.loads(
+            urllib.request.urlopen(base + "/debug/history?windowS=60", timeout=10).read()
+        )
+        assert hist["samples"] >= 1
+        # controller + stabilizer registries ride the same recorder
+        assert any(k.startswith("stabilizer.") for k in hist["series"])
+        slo = json.loads(
+            urllib.request.urlopen(base + "/debug/slo", timeout=10).read()
+        )
+        assert slo["brokers"] == 0 and slo["tables"] == {}
+        frec = json.loads(
+            urllib.request.urlopen(base + "/debug/flightrec", timeout=10).read()
+        )
+        assert frec["enabled"] is False
+        page = urllib.request.urlopen(base + "/dashboard/slo", timeout=10).read()
+        assert b"SLO burn rates" in page and b"no table burning" in page
+    finally:
+        http.stop()
+        ctrl.stop()
+
+
+# ------------------------------------------------------- chaos scenarios
+def test_chaos_slo_burn_crossing_tails_and_flight_bundle(tmp_path, monkeypatch):
+    """Kill the only server under a warmed table: the SLO burn gauge
+    crosses, sloBurn + failedQuery flight bundles land on disk, and
+    /debug/tails attributes the victim table's tail latency."""
+    from pinot_tpu.common.tableconfig import SloConfig
+    from pinot_tpu.tools.cluster_harness import InProcessCluster
+
+    frec = tmp_path / "frec"
+    monkeypatch.setenv("PINOT_TPU_FLIGHTREC_DIR", str(frec))
+    monkeypatch.setenv("PINOT_TPU_FLIGHTREC_MIN_INTERVAL_S", "0")
+    cluster = InProcessCluster(num_servers=1, data_dir=str(tmp_path / "data"))
+    try:
+        schema = make_test_schema(with_mv=False)
+        physical = cluster.add_offline_table(
+            schema, slo=SloConfig(latency_ms=123.0)
+        )
+        rows = random_rows(schema, 120, seed=3)
+        cluster.upload(physical, build_segment(schema, rows, physical, "s0"))
+        broker = cluster.broker
+        # the table-config SLO block landed via the starter path
+        assert broker.slo.objective(TABLE)["latencyMs"] == 123.0
+        broker.tail.slow_ms = 0.001  # retain the healthy-path tails too
+        for _ in range(3):
+            r = cluster.query(f"SELECT sum(metInt) FROM {TABLE}")
+            assert not r.exceptions
+        broker.history.tick()  # baseline sample: healthy traffic
+
+        cluster.transport.set_down(("server0", 0))  # kill the only server
+        for _ in range(8):
+            r = cluster.query(f"SELECT count(*) FROM {TABLE}")
+            assert r.exceptions, "query must fail with the server dead"
+        time.sleep(0.02)
+        broker.history.tick()  # burn evaluation + flight trigger fire here
+
+        assert broker.metrics.gauge("slo.burning").value >= 1
+        assert broker.metrics.gauge("slo.worstBurnRate5m").value > 1.0
+        names = os.listdir(frec)
+        assert any("-sloBurn-" in f for f in names), names
+        assert any("-failedQuery-" in f for f in names), names
+        bundle = json.loads(
+            open(frec / next(f for f in names if "-sloBurn-" in f)).read()
+        )
+        assert bundle["detail"]["table"] == TABLE
+        assert bundle["detail"]["burnRate5m"] > 1.0
+        for source in ("history", "slowQueries", "tails", "slo"):
+            assert source in bundle["sources"], source
+
+        # tails attribute the victim table: healthy tails carry server-
+        # side phases, the post-kill failures are retained as "failed"
+        snap = broker.tail.snapshot()
+        aggs = [a for a in snap["byDigest"] if a["table"] == TABLE]
+        assert aggs and aggs[0]["topPhase"], aggs
+        assert any(e["reason"] == "failed" for e in snap["entries"])
+    finally:
+        cluster.stop()
+
+
+def test_chaos_kill_server_leaves_controller_flight_bundle(tmp_path, monkeypatch):
+    """The kill-server chaos shape (satellite): a server death + heal
+    round spotted on the controller's history cadence dumps a
+    controller flight-recorder bundle."""
+    from pinot_tpu.tools.cluster_harness import _build_scenario_cluster
+
+    frec = tmp_path / "frec"
+    monkeypatch.setenv("PINOT_TPU_FLIGHTREC_DIR", str(frec))
+    monkeypatch.setenv("PINOT_TPU_FLIGHTREC_MIN_INTERVAL_S", "0")
+    cluster, physical, total = _build_scenario_cluster(
+        3, 2, 4, data_dir=str(tmp_path / "data")
+    )
+    try:
+        cluster.transport.set_down(("server0", 0))
+        cluster.controller.resources.set_instance_alive("server0", False)
+        cluster.controller.stabilizer.run_once()  # re-replication = heal
+        cluster.controller.history.tick()  # deterministic trigger point
+        files = [f for f in os.listdir(frec) if "-controller-" in f]
+        assert files, os.listdir(frec)
+        bundle = json.loads(open(frec / files[-1]).read())
+        assert bundle["reason"] == "serverDeathOrHeal"
+        assert bundle["detail"]["notableEventsThisTick"] > 0
+        for source in ("history", "metrics", "stabilizer"):
+            assert source in bundle["sources"], source
+        # the serving bar of the scenario still holds
+        final = cluster.query(f"SELECT count(*) FROM {TABLE}")
+        assert final.num_docs_scanned == total and not final.exceptions
+    finally:
+        cluster.stop()
+
+
+# ------------------------------------------------------------- doctor
+def test_doctor_bundle_and_tail_report(tmp_path, monkeypatch):
+    """Tier-1 doctor smoke (satellite): against a networked in-process
+    cluster under closed-loop load, the doctor produces one parseable
+    bundle carrying every role's debug surfaces, inlined flight
+    bundles, and retained tails — and tail_report renders it."""
+    from pinot_tpu.tools import doctor, tail_report
+    from pinot_tpu.tools.cluster_harness import (
+        ClosedLoopLoad,
+        _build_partition_cluster,
+    )
+
+    monkeypatch.setenv("PINOT_TPU_FLIGHTREC_DIR", str(tmp_path / "frec"))
+    monkeypatch.setenv("PINOT_TPU_FLIGHTREC_MIN_INTERVAL_S", "0")
+    monkeypatch.setenv("PINOT_TPU_TAIL_SLOW_MS", "0.001")
+    cluster, physical, total = _build_partition_cluster(
+        2, 2, 3, data_dir=str(tmp_path / "data")
+    )
+    try:
+        load = ClosedLoopLoad(
+            cluster, f"SELECT count(*) FROM {TABLE}", total, clients=2
+        ).start()
+        time.sleep(0.4)
+        summary = load.stop()
+        assert summary["okQueries"] > 0
+        cluster.query("SELECT count(*) FROM nosuchtable")  # -> flight bundle
+        cluster.broker.history.tick()
+        for s in cluster.server_starters:
+            s.server.history.tick()
+        cluster.controller.history.tick()
+
+        bundle = doctor.collect(cluster.url, timeout_s=10)
+        json.dumps(bundle)  # parseable end to end
+        roles = bundle["summary"]["instances"]
+        assert roles.get("broker") == 1 and roles.get("server") == 2
+        assert bundle["summary"]["fetchErrors"] == 0, bundle["summary"]
+        assert bundle["summary"]["retainedTails"] > 0
+        assert bundle["summary"]["flightBundles"] >= 1
+        # the controller's fleet SLO rollup saw the loaded table
+        assert TABLE in bundle["controller"]["/debug/slo"]["tables"]
+        brk = next(
+            e for e in bundle["instances"].values() if e["role"] == "broker"
+        )
+        assert brk["endpoints"]["/debug/history"]["series"]
+        assert TABLE in brk["endpoints"]["/debug/slo"]["tables"]
+        assert brk["flightBundles"], "failedQuery bundle not inlined"
+        srv = next(
+            e for e in bundle["instances"].values() if e["role"] == "server"
+        )
+        assert srv["endpoints"]["/debug/history"]["series"]
+
+        # CLI path writes the same bundle to disk
+        out = tmp_path / "doctor.json"
+        assert doctor.main([cluster.url, "--out", str(out)]) == 0
+        assert json.loads(out.read_text())["summary"]["retainedTails"] > 0
+
+        # tail_report digs the tails payloads out of the doctor bundle
+        payloads = tail_report._find_tails_payloads(bundle)
+        assert payloads
+        text = tail_report.render_report(tail_report._merge(payloads))
+        assert "retained" in text and "top phase" in text
+
+        # live SLO objective propagation over the network poll path
+        # (update_table_slo bumps the clusterstate version — a silent
+        # config mutation would never reach a polling broker)
+        from pinot_tpu.common.tableconfig import SloConfig
+
+        cluster.controller.resources.update_table_slo(
+            physical, SloConfig(latency_ms=150.0)
+        )
+        cluster.wait(
+            lambda: cluster.broker.slo.objective(TABLE)["latencyMs"] == 150.0,
+            what="slo objective propagation",
+        )
+        cluster.controller.resources.update_table_slo(physical, None)
+        cluster.wait(
+            lambda: cluster.broker.slo.objective(TABLE)["latencyMs"] != 150.0,
+            what="slo objective clearing",
+        )
+    finally:
+        cluster.stop()
+
+
+def test_tail_report_and_doctor_pure_renderers():
+    from pinot_tpu.tools import doctor, tail_report
+
+    empty = tail_report.render_report({"observed": 0, "retained": 0})
+    assert "no retained tails" in empty
+    snap = {
+        "observed": 100, "retained": 2, "slowMs": 250.0, "sampleN": 128,
+        "entries": [
+            {"requestId": "b-1", "reason": "slow", "timeUsedMs": 400.0,
+             "table": TABLE, "planDigest": "deadbeef", "ts": 2.0},
+        ],
+        "byDigest": [
+            {"digest": "deadbeef", "summary": "SELECT ...", "table": TABLE,
+             "tails": 2, "windowTails": 2,
+             "latencyMs": {"p50": 300.0, "p99": 400.0},
+             "phaseMs": {"laneWait": 70.0, "query": 30.0},
+             "attribution": {"laneWait": 0.7, "query": 0.3},
+             "topPhase": "laneWait"},
+        ],
+    }
+    text = tail_report.render_report(snap)
+    assert "deadbeef" in text and "laneWait (70.0%)" in text
+    assert "b-1" in text
+
+    summary = doctor.summarize(
+        {
+            "controller": {"/debug/slo": {"burningTables": [TABLE]}},
+            "instances": {
+                "b0": {"role": "broker",
+                       "endpoints": {"/debug/tails?traces=true": {"retained": 3}}},
+                "s0": {"role": "server", "error": "no HTTP surface registered"},
+            },
+        }
+    )
+    assert summary["burningTables"] == [TABLE]
+    assert summary["retainedTails"] == 3
+    assert summary["instances"] == {"broker": 1, "server": 1}
+    assert summary["fetchErrors"] == 1
