@@ -85,8 +85,17 @@ class StagedTable:
     # process-unique staging identity: the device lane's coalesce key
     # needs "same staged table" without pinning the object (an id()
     # would recycle after GC and could alias a RE-staged table into an
-    # in-flight dispatch — silent stale results)
+    # in-flight dispatch — silent stale results).  Sharded placements
+    # (mesh execution) keep the same invariant: each (segment set,
+    # placement) staging mints its OWN token, so a table re-staged onto
+    # a different chip group can never alias an in-flight dispatch.
     token: int = field(default_factory=lambda: next(_stage_tokens))
+    # placement of the leading segment axis (engine/mesh.py chip
+    # groups): a jax Sharding splitting axis 0 across the group's
+    # chips, or None for default single-device placement.  Role-array
+    # augmentation and the on-demand valid mask must land on the SAME
+    # placement, so it rides the staged table.
+    sharding: Any = field(default=None, repr=False, compare=False)
 
     def column(self, name: str) -> StagedColumn:
         return self.columns[name]
@@ -103,7 +112,13 @@ class StagedTable:
             v = np.zeros((self.num_segments, self.n_pad), dtype=bool)
             for i, n in enumerate(self.num_docs):
                 v[i, :n] = True
-            self._valid = jnp.asarray(v)
+            # same placement as the staged columns: a default-device
+            # mask fed to a chip-group program would force a reshard
+            self._valid = (
+                jax.device_put(v, self.sharding)
+                if self.sharding is not None
+                else jnp.asarray(v)
+            )
         return self._valid
 
 
@@ -134,12 +149,19 @@ def stage_segments(
     hll_columns: Sequence[str] = (),
     ctx=None,
     skip_base_columns: Sequence[str] = (),
+    sharding=None,
 ) -> StagedTable:
     """Stack + pad + transfer the given columns of the segments.
 
     ``pad_segments_to`` rounds the segment axis up with all-invalid
     dummy segments so it divides the mesh's device count (multi-chip
     ``shard_map`` needs an evenly shardable leading axis).
+
+    ``sharding`` (mesh execution, engine/mesh.py): a jax Sharding
+    splitting the leading segment axis across a chip group — the
+    GlobalDeviceArray-style staging where each chip's HBM holds only
+    its shard of every column.  None keeps default placement (the
+    single-chip path).
 
     ``raw_columns`` (numeric SV) additionally stage dictionary-decoded
     value arrays; ``gfwd_columns`` (SV, requires ``ctx``) stage
@@ -158,7 +180,12 @@ def stage_segments(
     S = max(len(segments), pad_segments_to)
     n_pad = config.pad_docs(max(seg.num_docs for seg in segments))
 
-    put = (lambda x: jax.device_put(x, device)) if device is not None else jnp.asarray
+    if sharding is not None:
+        put = lambda x: jax.device_put(x, sharding)  # noqa: E731
+    elif device is not None:
+        put = lambda x: jax.device_put(x, device)  # noqa: E731
+    else:
+        put = jnp.asarray
 
     staged = StagedTable(
         segment_names=tuple(s.segment_name for s in segments),
@@ -171,6 +198,7 @@ def stage_segments(
                 dtype=np.int32,
             )
         ),
+        sharding=sharding,
     )
 
     fdt = config.np_float_dtype()
@@ -279,16 +307,57 @@ _ROLE_ATTRS = (
 )
 
 
-def _measure_staged(staged: StagedTable) -> Tuple[int, Dict[str, int], Dict[str, int]]:
-    """(total bytes, per-column bytes, per-role bytes) of a staged
-    table's device arrays — read straight off the jax arrays' nbytes,
-    so the ledger total matches the staged bytes exactly."""
+def _device_label(dev) -> str:
+    return f"{getattr(dev, 'platform', 'dev')}:{getattr(dev, 'id', '?')}"
+
+
+def _add_device_bytes(arr, by_device: Dict[str, int]) -> None:
+    """Attribute one staged array's bytes to the device(s) actually
+    holding them.  Sharded placements (mesh execution) split across the
+    chip group via ``addressable_shards`` — each shard's OWN nbytes, so
+    a replicated array honestly counts once per holding device; plain
+    single-device arrays land on their one device; host-side arrays
+    (never the real staging path) attribute to "host"."""
+    shards = None
+    try:
+        shards = getattr(arr, "addressable_shards", None)
+    except Exception:
+        shards = None
+    if shards:
+        try:
+            # accumulate into a scratch map first: a mid-iteration
+            # failure (buffer deleted concurrently) must not leave
+            # partial per-shard bytes behind AND re-attribute the whole
+            # array below — that would break "byDevice sums to total"
+            local: Dict[str, int] = {}
+            for sh in shards:
+                key = _device_label(getattr(sh, "device", None))
+                local[key] = local.get(key, 0) + int(sh.data.nbytes)
+            for key, n in local.items():
+                by_device[key] = by_device.get(key, 0) + n
+            return
+        except Exception:
+            pass  # fall through to whole-array attribution
+    by_device["host"] = by_device.get("host", 0) + int(getattr(arr, "nbytes", 0))
+
+
+def _measure_staged(
+    staged: StagedTable,
+) -> Tuple[int, Dict[str, int], Dict[str, int], Dict[str, int]]:
+    """(total bytes, per-column bytes, per-role bytes, per-device
+    bytes) of a staged table's device arrays — read straight off the
+    jax arrays' nbytes, so the ledger total matches the staged bytes
+    exactly; the per-device map sums to the total for (non-replicated)
+    sharded placements."""
     total = int(getattr(staged.num_docs_arr, "nbytes", 0))
     by_role: Dict[str, int] = {"meta": total}
+    by_device: Dict[str, int] = {}
+    _add_device_bytes(staged.num_docs_arr, by_device)
     if staged._valid is not None:
         n = int(staged._valid.nbytes)
         total += n
         by_role["meta"] = by_role.get("meta", 0) + n
+        _add_device_bytes(staged._valid, by_device)
     by_column: Dict[str, int] = {}
     for name, sc in staged.columns.items():
         col_bytes = 0
@@ -299,9 +368,10 @@ def _measure_staged(staged: StagedTable) -> Tuple[int, Dict[str, int], Dict[str,
             n = int(arr.nbytes)
             col_bytes += n
             by_role[role] = by_role.get(role, 0) + n
+            _add_device_bytes(arr, by_device)
         by_column[name] = col_bytes
         total += col_bytes
-    return total, by_column, by_role
+    return total, by_column, by_role, by_device
 
 
 class StagingLedger:
@@ -319,7 +389,7 @@ class StagingLedger:
         self.evicted_bytes = 0
 
     def update(self, staged: StagedTable, table: str) -> int:
-        total, by_column, by_role = _measure_staged(staged)
+        total, by_column, by_role, by_device = _measure_staged(staged)
         with self._lock:
             self._entries[staged.token] = {
                 "table": table,
@@ -327,6 +397,7 @@ class StagingLedger:
                 "bytes": total,
                 "columns": by_column,
                 "roles": by_role,
+                "devices": by_device,
             }
             now = sum(e["bytes"] for e in self._entries.values())
             if now > self.high_watermark:
@@ -354,17 +425,21 @@ class StagingLedger:
         with self._lock:
             by_table: Dict[str, int] = {}
             by_role: Dict[str, int] = {}
+            by_device: Dict[str, int] = {}
             entries = []
             for e in self._entries.values():
                 by_table[e["table"]] = by_table.get(e["table"], 0) + e["bytes"]
                 for role, n in e["roles"].items():
                     by_role[role] = by_role.get(role, 0) + n
+                for dev, n in e.get("devices", {}).items():
+                    by_device[dev] = by_device.get(dev, 0) + n
                 entries.append(
                     {
                         "table": e["table"],
                         "segments": list(e["segments"]),
                         "bytes": e["bytes"],
                         "columns": dict(e["columns"]),
+                        "devices": dict(e.get("devices", {})),
                     }
                 )
             return {
@@ -375,6 +450,7 @@ class StagingLedger:
                 "evictedBytes": self.evicted_bytes,
                 "byTable": by_table,
                 "byRole": by_role,
+                "byDevice": by_device,
                 "entries": entries,
             }
 
@@ -469,6 +545,22 @@ def _lock_for(key: Tuple) -> "threading.Lock":
         return lock
 
 
+def placement_key(sharding) -> Optional[Tuple]:
+    """Hashable identity of a staging placement: None for default
+    single-device placement, else the sharding's device set + spec.
+    Part of the staging-cache key, so the same segments staged onto two
+    chip groups are two entries — one group's arrays can never alias
+    another group's dispatch (the sharded extension of the PR 3
+    staging-token invariant)."""
+    if sharding is None:
+        return None
+    try:
+        ids = tuple(sorted(getattr(d, "id", -1) for d in sharding.device_set))
+    except Exception:
+        ids = (repr(sharding),)
+    return (type(sharding).__name__, ids, str(getattr(sharding, "spec", "")))
+
+
 def get_staged(
     segments: Sequence[ImmutableSegment],
     column_names: Sequence[str],
@@ -478,6 +570,7 @@ def get_staged(
     hll_columns: Sequence[str] = (),
     ctx=None,
     skip_base_columns: Sequence[str] = (),
+    sharding=None,
 ) -> StagedTable:
     """Cached staging. The cache key covers only the base arrays; role
     arrays (raw/gfwd/hll streams) are attached to the cached
@@ -485,7 +578,8 @@ def get_staged(
     HBM copy of the base columns.  A column staged stream-only
     (skip_base_columns) gets its base arrays backfilled if a later
     query needs them (e.g. a filter arrives on a former agg-only
-    column)."""
+    column).  ``sharding`` places the segment axis across a chip group
+    (mesh execution) and is part of the cache identity."""
     # identity component: (name, claimed crc, instance token).  The
     # token (segment/immutable.py) is what makes a re-loaded copy of the
     # same segment a guaranteed MISS — name+crc alone would alias a
@@ -498,6 +592,7 @@ def get_staged(
         ),
         tuple(sorted(column_names)),
         pad_segments_to,
+        placement_key(sharding),
     )
     with _lock_for(key):
         st = _stage_cache.get(key)
@@ -511,6 +606,7 @@ def get_staged(
                 hll_columns=hll_columns,
                 ctx=ctx,
                 skip_base_columns=skip_base_columns,
+                sharding=sharding,
             )
             with _cache_guard:
                 if len(_stage_cache) > 32:
@@ -567,18 +663,26 @@ def _augment_staged(
     attached = 0
     fdt = config.np_float_dtype()
     S, n_pad = st.num_segments, st.n_pad
+    # augmentation lands on the SAME placement the base staging used:
+    # a default-device role array attached to a chip-group table would
+    # force a reshard on every launch
+    put = (
+        (lambda x: jax.device_put(x, st.sharding))
+        if st.sharding is not None
+        else jnp.asarray
+    )
     for name in base_columns:
         # backfill base arrays a stream-only staging skipped
         sc = st.columns.get(name)
         if sc is None or not sc.single_value or sc.fwd is not None:
             continue
         cols = [seg.column(name) for seg in segments]
-        sc.fwd = jnp.asarray(
+        sc.fwd = put(
             _stack_fwd(cols, S, n_pad, config.index_dtype(sc.card_pad))
         )
         attached += int(sc.fwd.nbytes)
         if sc.is_numeric and sc.dict_vals is None:
-            sc.dict_vals = jnp.asarray(
+            sc.dict_vals = put(
                 _stack_dict_vals(cols, S, sc.card_pad, fdt)
             )
             attached += int(sc.dict_vals.nbytes)
@@ -591,7 +695,7 @@ def _augment_staged(
             c = seg.column(name)
             vals = np.asarray(c.dictionary.values, dtype=fdt)
             raw[i, : c.fwd.size] = vals[c.fwd]
-        sc.raw = jnp.asarray(raw)
+        sc.raw = put(raw)
         attached += int(sc.raw.nbytes)
     for name in gfwd_columns:
         sc = st.columns.get(name)
@@ -603,7 +707,7 @@ def _augment_staged(
         for i, seg in enumerate(segments):
             c = seg.column(name)
             gf[i, : c.fwd.size] = remaps[i][c.fwd]
-        sc.gfwd = jnp.asarray(gf)
+        sc.gfwd = put(gf)
         attached += int(sc.gfwd.nbytes)
     for name in raw_columns:
         sc = st.columns.get(name)
@@ -620,7 +724,7 @@ def _augment_staged(
             c = seg.column(name)
             vals = np.asarray(c.dictionary.values, dtype=fdt)
             _csr_scatter(vals[c.mv_values], c.mv_offsets, mvr[i])
-        sc.mv_raw = jnp.asarray(mvr)
+        sc.mv_raw = put(mvr)
         attached += int(sc.mv_raw.nbytes)
     for name in hll_columns:
         sc = st.columns.get(name)
@@ -629,8 +733,8 @@ def _augment_staged(
         hb, hr = _hll_streams([seg.column(name) for seg in segments], S, n_pad)
         # rho FIRST: readers holding this cached table guard on
         # hll_bucket, so both must be visible once bucket is
-        sc.hll_rho = jnp.asarray(hr)
-        sc.hll_bucket = jnp.asarray(hb)
+        sc.hll_rho = put(hr)
+        sc.hll_bucket = put(hb)
         attached += int(sc.hll_rho.nbytes) + int(sc.hll_bucket.nbytes)
     return attached
 
@@ -677,17 +781,23 @@ def evict_staged_segment(segment_name: str) -> int:
         return len(victims)
 
 
-def to_device_inputs(tree):
+def to_device_inputs(tree, sharding=None):
     """Convert a numpy pytree (query inputs) to device arrays — the one
     converter production and benchmarks share.  All ndarray leaves ride
     ONE batched ``jax.device_put``: per-leaf puts each pay a host->
     device dispatch (a full round trip on a tunneled chip); the batched
-    form coalesces the transfer."""
+    form coalesces the transfer.  ``sharding`` places every leaf across
+    a chip group (mesh execution — query inputs lead with the segment
+    axis, like the staged columns they join)."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     idx = [i for i, leaf in enumerate(leaves) if isinstance(leaf, np.ndarray)]
     if idx:
         TRANSFERS.record_h2d(sum(leaves[i].nbytes for i in idx))
-        put = jax.device_put([leaves[i] for i in idx])
+        batch = [leaves[i] for i in idx]
+        if sharding is not None:
+            put = jax.device_put(batch, [sharding] * len(batch))
+        else:
+            put = jax.device_put(batch)
         for i, v in zip(idx, put):
             leaves[i] = v
     return jax.tree_util.tree_unflatten(treedef, leaves)

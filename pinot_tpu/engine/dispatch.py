@@ -287,7 +287,16 @@ class DeviceLane:
         metrics=None,
         stall_timeout_s: Optional[float] = None,
         fault_injector=None,
+        index: Optional[int] = None,
     ) -> None:
+        # lane-group membership (engine/mesh.py): ``index`` set means
+        # this lane is one of several driving distinct chip groups —
+        # its gauges move to the per-lane ``lane.<i>.*`` namespace and
+        # its meters mark BOTH the aggregate lane.* series (marks sum
+        # naturally across lanes) and the per-lane twin.  None (the
+        # default, and every single-lane server) keeps the exact
+        # pre-mesh metric names.
+        self.index = index
         self.metrics = metrics
         if stall_timeout_s is None:
             # default well ABOVE the worst observed first-call compile
@@ -355,10 +364,29 @@ class DeviceLane:
                          "compile.costAnalysisUnavailable"):
                 metrics.meter(name)
             metrics.timer("compile.firstCallMs")
-            metrics.gauge("lane.depth").set(0)
-            metrics.gauge("lane.open").set(0)
-            metrics.gauge("lane.inflight").set(0)
+            if self.index is None:
+                metrics.gauge("lane.depth").set(0)
+                metrics.gauge("lane.open").set(0)
+                metrics.gauge("lane.inflight").set(0)
+            else:
+                # per-lane twins (lane.<i>.*): the group registers the
+                # aggregate gauges as set_fn rollups over every lane
+                for suffix in ("dispatches", "coalesced", "shed",
+                               "deviceFailures", "restarts"):
+                    metrics.meter(f"lane.{self.index}.{suffix}")
+                metrics.gauge(f"lane.{self.index}.depth").set(0)
+                metrics.gauge(f"lane.{self.index}.open").set(0)
+                metrics.gauge(f"lane.{self.index}.inflight").set(0)
         _all_lanes.add(self)
+
+    def _lane_mark(self, suffix: str, n: int = 1) -> None:
+        """Mark the aggregate lane.<suffix> meter and, on a lane-group
+        member, its per-lane twin lane.<index>.<suffix>."""
+        if self.metrics is None:
+            return
+        self.metrics.meter(f"lane.{suffix}").mark(n)
+        if self.index is not None:
+            self.metrics.meter(f"lane.{self.index}.{suffix}").mark(n)
 
     # -- producer side -------------------------------------------------
     def submit(
@@ -656,25 +684,30 @@ class DeviceLane:
                     d.error = err
                     self._spawn_lane_locked()
             if victims:
-                if self.metrics is not None:
-                    self.metrics.meter("lane.restarts").mark()
-                    self.metrics.meter("lane.deviceFailures").mark()
+                self._lane_mark("restarts")
+                self._lane_mark("deviceFailures")
                 for w in victims:
                     w._deliver(error=err)
 
     def _hit(self) -> None:
         self.coalesce_hits += 1
-        if self.metrics is not None:
-            self.metrics.meter("lane.coalesced").mark()
+        self._lane_mark("coalesced")
 
     def _set_depth(self) -> None:
         if self.metrics is not None:
-            self.metrics.gauge("lane.depth").set(len(self._queue))
-            self.metrics.gauge("lane.open").set(len(self._open))
+            if self.index is None:
+                self.metrics.gauge("lane.depth").set(len(self._queue))
+                self.metrics.gauge("lane.open").set(len(self._open))
+            else:
+                self.metrics.gauge(f"lane.{self.index}.depth").set(len(self._queue))
+                self.metrics.gauge(f"lane.{self.index}.open").set(len(self._open))
 
     def _set_inflight(self, n: int) -> None:
         if self.metrics is not None:
-            self.metrics.gauge("lane.inflight").set(n)
+            if self.index is None:
+                self.metrics.gauge("lane.inflight").set(n)
+            else:
+                self.metrics.gauge(f"lane.{self.index}.inflight").set(n)
 
     def _still_pending(self, d: _Dispatch) -> bool:
         if d.pending is None:
@@ -742,8 +775,7 @@ class DeviceLane:
                     self._busy_since = now  # occupancy: device busy
             if dead:
                 self.shed_count += len(dead)
-                if self.metrics is not None:
-                    self.metrics.meter("lane.shed").mark(len(dead))
+                self._lane_mark("shed", len(dead))
                 err = QueryAbandonedError(
                     "deadline expired while queued in device lane; "
                     "broker already gave up"
@@ -837,9 +869,9 @@ class DeviceLane:
                 elif self._by_key.get(d.key) is d:
                     self._by_key.pop(d.key)
             if self.metrics is not None:
-                self.metrics.meter("lane.dispatches").mark()
+                self._lane_mark("dispatches")
                 if error is not None:
-                    self.metrics.meter("lane.deviceFailures").mark()
+                    self._lane_mark("deviceFailures")
                 elif d.plan_digest is not None:
                     if cold:
                         self.metrics.meter("compile.cold").mark()
@@ -849,6 +881,145 @@ class DeviceLane:
                 self.metrics.timer("phase.laneDispatch").update(launch_ms)
             for w in waiters:
                 w._deliver(value=value, error=error)
+
+
+class LaneSelection:
+    """One query's lane routing verdict: which lane executes it and
+    which chip group (engine/mesh.py) that lane drives."""
+
+    __slots__ = ("index", "lane", "group")
+
+    def __init__(self, index: int, lane: DeviceLane, group) -> None:
+        self.index = index
+        self.lane = lane
+        self.group = group
+
+
+class LaneGroup:
+    """One DeviceLane per chip group (engine/mesh.py MeshTopology) —
+    the pod-scale generalization of the single serving lane.
+
+    Lane selection is SHAPE-HASHED: a query routes by its literal-
+    erased plan-shape digest (engine/plandigest.py), so every instance
+    of a shape lands on the same lane and identical-dispatch coalescing
+    keeps working exactly as on a single lane, while distinct shapes
+    spread across the groups.  Deadline shedding, watchdog supervision,
+    and poison classification are all per-lane (unchanged DeviceLane
+    semantics): one wedged or poisoned lane heals via the host path
+    while the other lanes keep serving their shapes.
+
+    A single-group topology builds ONE lane with ``index=None`` — the
+    byte-identical pre-mesh configuration (same metric names, same
+    stats shape)."""
+
+    def __init__(
+        self,
+        topology,
+        metrics=None,
+        stall_timeout_s: Optional[float] = None,
+        fault_injector=None,
+    ) -> None:
+        self.topology = topology
+        groups = list(topology.groups)
+        n = len(groups)
+        self.lanes: List[DeviceLane] = [
+            DeviceLane(
+                metrics=metrics,
+                stall_timeout_s=stall_timeout_s,
+                fault_injector=fault_injector,
+                index=None if n == 1 else g.index,
+            )
+            for g in groups
+        ]
+        if metrics is not None and n > 1:
+            # aggregate gauges become rollups over the group (per-lane
+            # twins live at lane.<i>.*); meters need nothing — every
+            # lane marks the shared aggregate series
+            lanes = self.lanes
+            metrics.gauge("lane.depth").set_fn(
+                lambda: sum(l.depth for l in lanes)
+            )
+            metrics.gauge("lane.open").set_fn(
+                lambda: sum(len(l._open) for l in lanes)
+            )
+            metrics.gauge("lane.inflight").set_fn(
+                lambda: sum(1 for l in lanes if l._busy_since is not None)
+            )
+
+    @property
+    def size(self) -> int:
+        return len(self.lanes)
+
+    @property
+    def primary(self) -> DeviceLane:
+        return self.lanes[0]
+
+    @property
+    def restart_count(self) -> int:
+        return sum(l.restart_count for l in self.lanes)
+
+    def lane_index(self, shape_key) -> int:
+        """Stable shape -> lane hash (blake2b, not the per-process-
+        randomized builtin hash: the routing must be reproducible
+        across runs for committed bench artifacts to be comparable)."""
+        if len(self.lanes) == 1:
+            return 0
+        import hashlib
+
+        h = hashlib.blake2b(str(shape_key).encode(), digest_size=8).digest()
+        return int.from_bytes(h, "little") % len(self.lanes)
+
+    def select(self, shape_key) -> LaneSelection:
+        i = self.lane_index(shape_key)
+        return LaneSelection(i, self.lanes[i], self.topology.groups[i])
+
+    def compile_info(self, digest: Optional[str]) -> Optional[Dict[str, float]]:
+        """Compile-timeline entry across the group (a digest only ever
+        launches on its shape-hashed lane, so at most one lane knows
+        it)."""
+        for lane in self.lanes:
+            ci = lane.compile_info(digest)
+            if ci is not None:
+                return ci
+        return None
+
+    def stats(self) -> Dict[str, Any]:
+        """Single lane: the lane's stats verbatim (pre-mesh shape).
+        Group: summed rollup plus the per-lane list — the fleet-rollup
+        totals are computed FROM the per-lane snapshots, so they equal
+        the sum of lane snapshots by construction."""
+        if len(self.lanes) == 1:
+            return self.lanes[0].stats()
+        per_lane = [l.stats() for l in self.lanes]
+        rollup: Dict[str, Any] = {
+            k: sum(s[k] for s in per_lane) for k in per_lane[0]
+        }
+        rollup["lanes"] = per_lane
+        return rollup
+
+    def occupancy_read(
+        self, key: str = "default", min_interval_s: float = 0.0
+    ) -> Dict[str, Any]:
+        """Windowed occupancy across the group.  Single lane: verbatim
+        lane read.  Group: per-lane reads under ``lanes`` plus a rollup
+        whose summable fields equal the sum of the lane snapshots
+        (busyFraction sums to "busy lanes" in [0, size] — the fleet
+        busy measure; depth/inflight/avgQueueDepth sum likewise)."""
+        if len(self.lanes) == 1:
+            return self.lanes[0].occupancy_read(key, min_interval_s)
+        reads = [l.occupancy_read(key, min_interval_s) for l in self.lanes]
+        return {
+            "windowS": max(r["windowS"] for r in reads),
+            "busyFraction": round(sum(r["busyFraction"] for r in reads), 6),
+            "avgQueueDepth": round(sum(r["avgQueueDepth"] for r in reads), 6),
+            "depth": sum(r["depth"] for r in reads),
+            "inflight": sum(r["inflight"] for r in reads),
+            "lanes": reads,
+        }
+
+    def close(self) -> None:
+        for lane in self.lanes:
+            lane.close()
 
 
 class OccupancySampler:

@@ -160,8 +160,17 @@ class QueryExecutor:
     single-device kernel runs.
     """
 
-    def __init__(self, mesh=None, metrics=None, lane=None) -> None:
+    def __init__(self, mesh=None, metrics=None, lane=None, lanes=None) -> None:
         self.mesh = mesh
+        # mesh execution plane (engine/mesh.py + dispatch.LaneGroup):
+        # with a lane group set, every query is routed to a chip-group
+        # lane by its literal-erased plan-shape digest — staging,
+        # kernel compilation, and the launch all happen against THAT
+        # group's mesh.  ``mesh``/``lane`` stay as the single-lane
+        # (pre-mesh) configuration for standalone executors.
+        self.lanes = lanes
+        if lanes is not None and lane is None:
+            lane = lanes.primary
         if metrics is None:
             # the registry is the single source of truth for phase
             # timers AND the self-healing counters (heal.*), so a
@@ -182,6 +191,7 @@ class QueryExecutor:
         # results — the differential suite holds the two together)
         self.lane = lane
         self._sharded_kernels: Dict[Any, Any] = {}
+        self._mesh_shardings: Dict[Any, Any] = {}  # mesh id -> NamedSharding
         from collections import OrderedDict
 
         self._qinput_cache: "OrderedDict[Any, Any]" = OrderedDict()
@@ -268,6 +278,42 @@ class QueryExecutor:
         with self._heal_lock:
             self._poisoned.clear()
 
+    # -- mesh / lane-group routing -------------------------------------
+    def lane_selection(self, request: BrokerRequest):
+        """Shape-hashed chip-group routing (dispatch.LaneGroup.select),
+        or None without a lane group.  Shared by the serving path and
+        EXPLAIN so the phantom plan stages/pads exactly like the lane
+        that would execute it."""
+        if self.lanes is None:
+            return None
+        from pinot_tpu.engine.plandigest import plan_shape_digest
+
+        return self.lanes.select(plan_shape_digest(request))
+
+    def _mesh_sharding(self, mesh):
+        """NamedSharding splitting the segment axis over ``mesh`` (one
+        cached instance per mesh — it is part of staging-cache keys)."""
+        if mesh is None:
+            return None
+        key = id(mesh)
+        sh = self._mesh_shardings.get(key)
+        if sh is None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # axis 0 shards over EVERY mesh axis — the same spec the
+            # sharded kernels' in_specs use (multichip._make_sharded),
+            # so staged arrays arrive already laid out for shard_map
+            sh = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+            self._mesh_shardings[key] = sh
+        return sh
+
+    def _mesh_key(self, mesh) -> Any:
+        """Hashable kernel-cache component for a mesh (per-lane meshes
+        must not share compiled sharded kernels)."""
+        if mesh is None:
+            return None
+        return tuple(getattr(d, "id", i) for i, d in enumerate(mesh.devices.flat))
+
     def _phase(self, name: str, t0: float, **tags) -> float:
         """Record a ServerQueryPhase-style timer (SURVEY §5: pruning /
         planBuild / planExec phases) AND, when the request is traced, a
@@ -336,9 +382,14 @@ class QueryExecutor:
             sel_columns = self._resolve_selection_columns(request, live[0])
             needed.update(sel_columns)
 
+        # chip-group routing (mesh execution): the lane group picks the
+        # lane/mesh this shape executes on; without one, the legacy
+        # single-mesh (or no-mesh) configuration applies
+        sel = self.lane_selection(request)
+        mesh = sel.group.mesh if sel is not None else self.mesh
         pad_to = 0
-        if self.mesh is not None:
-            n = int(self.mesh.devices.size)
+        if mesh is not None:
+            n = int(mesh.devices.size)
             pad_to = -(-len(live) // n) * n
 
         # columns used ONLY by doc-range predicates on sorted columns
@@ -394,7 +445,7 @@ class QueryExecutor:
             try:
                 return self._device_section(
                     live, request, deadline, ctx, needed, sel_columns,
-                    pad_to, total_docs, t0, poison_ref,
+                    pad_to, total_docs, t0, poison_ref, sel=sel, mesh=mesh,
                 )
             except (QueryAbandonedError, LaneClosedError, TimeoutError):
                 raise
@@ -434,7 +485,13 @@ class QueryExecutor:
         total_docs: int,
         t0: float,
         poison_ref: Dict[str, Any],
+        sel=None,
+        mesh=None,
     ) -> IntermediateResult:
+        if sel is None and mesh is None:
+            mesh = self.mesh  # standalone callers (no lane group)
+        lane = sel.lane if sel is not None else self.lane
+        sharding = self._mesh_sharding(mesh)
         raw_cols, gfwd_cols, hll_cols = self._role_columns(request, live, ctx)
         # Columns the kernel reads ONLY through a role stream skip their
         # base fwd/dict arrays: at 1B rows the dictId stream is the
@@ -472,6 +529,7 @@ class QueryExecutor:
             hll_columns=hll_cols,
             ctx=ctx,
             skip_base_columns=skip_base,
+            sharding=sharding,
         )
         t0 = self._phase("staging", t0)
         scratch: Dict[Any, Any] = {}  # plan->inputs table cache (regex)
@@ -508,7 +566,9 @@ class QueryExecutor:
         cost: Dict[str, float] = {}  # per-query cost vector accumulator
         q_np = build_query_inputs(request, plan, ctx, staged, scratch=scratch)
         digest = self._inputs_digest(q_np)
-        q_inputs = self._to_device_inputs(q_np, plan=plan, digest=digest, cost=cost)
+        q_inputs = self._to_device_inputs(
+            q_np, plan=plan, digest=digest, cost=cost, sharding=sharding
+        )
         seg_arrays = segment_arrays(staged, needed)
         block_ids, scanned_rows = self._block_skip_ids(plan, q_np, live, staged)
         from pinot_tpu.engine.kernel import chunk_rows_limit
@@ -527,19 +587,25 @@ class QueryExecutor:
             from pinot_tpu.engine.zonemap import zone_block_rows
 
             block = zone_block_rows()
-            if self.mesh is None:
+            if mesh is None:
                 from pinot_tpu.engine.kernel import make_packed_block_table_kernel
 
                 kernel = make_packed_block_table_kernel(plan, block)
             else:
-                kernel = self._block_kernel(plan, block)
-            args = (seg_arrays, q_inputs, jnp.asarray(block_ids))
+                kernel = self._block_kernel(plan, block, mesh)
+            # block ids shard over the segment axis with everything else
+            ids_dev = (
+                jax.device_put(np.asarray(block_ids), sharding)
+                if sharding is not None
+                else jnp.asarray(block_ids)
+            )
+            args = (seg_arrays, q_inputs, ids_dev)
         else:
-            kernel = self._kernel(plan, staged)
+            kernel = self._kernel(plan, staged, mesh)
             args = (seg_arrays, q_inputs)
         outs = self._run_kernel(
             kernel, args, plan, staged, digest, block_ids, deadline, pdigest,
-            cost=cost,
+            cost=cost, lane=lane,
         )
         t0 = time.perf_counter()  # laneWait/planExec timed inside _run_kernel
 
@@ -578,8 +644,10 @@ class QueryExecutor:
             result.add_cost(segmentsFullScan=len(live))
         # device-plan identity for the utilization plane: lets the
         # plan-stats recorder join this shape's measured wall time with
-        # the lane's static cost analysis (roofline numerator)
+        # the lane's static cost analysis (roofline numerator); the
+        # lane index attributes it to the chip group that executed
         result._device_digest = pdigest
+        result._lane_index = sel.index if sel is not None else 0
         self._phase("finalize", t0)
         return result
 
@@ -687,19 +755,23 @@ class QueryExecutor:
             self._sharded_kernels[key] = k
         return k
 
-    def _block_kernel(self, plan: StaticPlan, block: int):
+    def _block_kernel(self, plan: StaticPlan, block: int, mesh=None):
         from pinot_tpu.engine.packing import make_packed_kernel
         from pinot_tpu.parallel.multichip import make_sharded_block_table_kernel
 
+        if mesh is None:
+            mesh = self.mesh
         return self._cached_sharded(
-            (plan, "block", block),
+            (plan, "block", block, self._mesh_key(mesh)),
             lambda: make_packed_kernel(
-                make_sharded_block_table_kernel(plan, self.mesh, block)
+                make_sharded_block_table_kernel(plan, mesh, block)
             ),
         )
 
-    def _kernel(self, plan: StaticPlan, staged):
-        if self.mesh is None:
+    def _kernel(self, plan: StaticPlan, staged, mesh=None):
+        if mesh is None and self.lanes is None:
+            mesh = self.mesh
+        if mesh is None:
             from pinot_tpu.engine.kernel import (
                 chunk_rows_limit,
                 make_chunked_table_kernel,
@@ -728,9 +800,16 @@ class QueryExecutor:
         # falls back to the plain packed sharded kernel when chunking
         # is off or unnecessary
         return self._cached_sharded(
-            (plan, "mesh", staged.num_segments, staged.n_pad, chunk_rows_limit()),
+            (
+                plan,
+                "mesh",
+                staged.num_segments,
+                staged.n_pad,
+                chunk_rows_limit(),
+                self._mesh_key(mesh),
+            ),
             lambda: make_chunked_sharded_kernel(
-                plan, self.mesh, staged.num_segments, staged.n_pad
+                plan, mesh, staged.num_segments, staged.n_pad
             ),
         )
 
@@ -815,13 +894,16 @@ class QueryExecutor:
 
     def _run_kernel(
         self, kernel, args, plan, staged, digest, block_ids, deadline,
-        pdigest=None, cost: Optional[Dict[str, float]] = None,
+        pdigest=None, cost: Optional[Dict[str, float]] = None, lane=None,
     ) -> Dict[str, Any]:
         """DISPATCH + output fetch.  Serial mode (no lane): launch and
         fetch inline, the pre-pipeline behavior.  Pipelined: the launch
-        runs on the device lane — coalesced with identical in-flight
-        dispatches — and this worker blocks only when FINALIZE first
-        reads the outputs (the packed D2H transfer)."""
+        runs on the (shape-selected) device lane — coalesced with
+        identical in-flight dispatches — and this worker blocks only
+        when FINALIZE first reads the outputs (the packed D2H
+        transfer)."""
+        if lane is None:
+            lane = self.lane
 
         def launch():
             disp = getattr(kernel, "dispatch", None)
@@ -831,7 +913,7 @@ class QueryExecutor:
 
         t0 = time.perf_counter()
         coalesced = False
-        if self.lane is None:
+        if lane is None:
             fetch, handle = launch()
         else:
             # coalesce key: identical (plan, staged-table token, inputs
@@ -845,7 +927,7 @@ class QueryExecutor:
             )
             from pinot_tpu.engine.packing import kernel_cost_analysis
 
-            ticket = self.lane.submit(
+            ticket = lane.submit(
                 (plan, staged.token, digest, bkey),
                 launch,
                 deadline,
@@ -924,19 +1006,21 @@ class QueryExecutor:
         plan=None,
         digest: Optional[str] = None,
         cost: Optional[Dict[str, float]] = None,
+        sharding=None,
     ) -> Dict[str, Any]:
         """Device-resident query-inputs cache: a repeated query (same
         plan, same literal tables) reuses the arrays already in HBM
         instead of re-uploading — on a tunneled chip every upload pays
-        a host->device round trip.  Keyed by (plan, content digest), so
-        realtime watermark changes or different literals miss safely."""
-        from pinot_tpu.engine.device import to_device_inputs
+        a host->device round trip.  Keyed by (plan, content digest,
+        placement), so realtime watermark changes, different literals,
+        or a different chip group miss safely."""
+        from pinot_tpu.engine.device import placement_key, to_device_inputs
 
         if plan is None:
-            return to_device_inputs(inputs)
+            return to_device_inputs(inputs, sharding=sharding)
         if digest is None:
             digest = self._inputs_digest(inputs)
-        key = (plan, digest)
+        key = (plan, digest, placement_key(sharding))
         with self._qinput_cache_lock:
             cached = self._qinput_cache.get(key)
             if cached is not None:
@@ -944,7 +1028,7 @@ class QueryExecutor:
                 if cost is not None:
                     cost["qinputCacheHits"] = cost.get("qinputCacheHits", 0) + 1
                 return cached[0]
-        dev = to_device_inputs(inputs)
+        dev = to_device_inputs(inputs, sharding=sharding)
         # Evict by HBM bytes, not entry count: one entry can hold
         # per-segment match tables of S x card_pad, so 128 entries of a
         # high-cardinality workload would pin multiple GB (ADVICE r3).

@@ -235,9 +235,19 @@ def build_explain_node(
         if request.is_selection:
             sel_columns = executor._resolve_selection_columns(request, normal[0])
             needed.update(sel_columns)
+        # chip-group routing mirrors the executor EXACTLY: the phantom
+        # must pad the segment axis for the mesh of the lane this shape
+        # would execute on, or the StaticPlan digest would diverge from
+        # real sharded execution
+        selection = None
+        if getattr(executor, "lanes", None) is not None:
+            selection = executor.lane_selection(request)
+        exec_mesh = (
+            selection.group.mesh if selection is not None else executor.mesh
+        )
         pad_to = 0
-        if executor.mesh is not None:
-            n = int(executor.mesh.devices.size)
+        if exec_mesh is not None:
+            n = int(exec_mesh.devices.size)
             pad_to = -(-len(normal) // n) * n
         needed -= executor._docrange_only_columns(request, normal, sel_columns)
         ctx = get_table_context(normal)
@@ -285,7 +295,11 @@ def build_explain_node(
             else:
                 pdigest = plan_digest(plan)
                 poison = executor.poisoned_entry((pdigest, phantom.segment_names))
-                lane = getattr(executor, "lane", None)
+                lane = (
+                    selection.lane
+                    if selection is not None
+                    else getattr(executor, "lane", None)
+                )
                 compile_entry = (
                     lane.compile_info(pdigest) if lane is not None else None
                 )
@@ -302,10 +316,34 @@ def build_explain_node(
                 else:
                     # never launched here: no analysis exists yet
                     compile_info = {"state": "cold", "costAnalysis": "unavailable"}
+                # mesh decision record: which chip-group lane executes
+                # this shape, the mesh it shards over, and the XLA
+                # collectives the cross-chip merge lowers to (the
+                # single-chip fallback reports shardAxis/collective
+                # None — the per-segment combine is fused in-program)
+                from pinot_tpu.engine.mesh import SEGMENT_AXIS, collective_names
+
+                lanes_obj = getattr(executor, "lanes", None)
+                n_lanes = lanes_obj.size if lanes_obj is not None else 1
+                group_size = (
+                    selection.group.size
+                    if selection is not None
+                    else (int(exec_mesh.devices.size) if exec_mesh is not None else 1)
+                )
+                mesh_info = {
+                    "shape": f"{n_lanes}x{group_size}",
+                    "lanes": n_lanes,
+                    "laneIndex": selection.index if selection is not None else 0,
+                    "shardAxis": SEGMENT_AXIS if exec_mesh is not None else None,
+                    "collective": (
+                        collective_names(plan) if exec_mesh is not None else None
+                    ),
+                }
                 device_info = {
                     "planDigest": pdigest,
                     "compile": compile_info,
                     "quarantined": poison is not None,
+                    "mesh": mesh_info,
                 }
                 if poison is not None:
                     # HONESTY: the device plan is quarantined, so this

@@ -51,11 +51,17 @@ class _RooflineWindow:
     Records happen on the request path (host side — the lane's
     zero-alloc contract is about the launch path, not here)."""
 
-    def __init__(self, window_s: float = 300.0, capacity: int = 2048) -> None:
+    def __init__(
+        self, window_s: float = 300.0, capacity: int = 2048, peak_scale: int = 1
+    ) -> None:
         import collections
         import threading
 
         self.window_s = window_s
+        # how many chips this window's records aggregate over: the
+        # roofline denominator scales with it (a lane driving 8 chips
+        # measured against ONE chip's peak would overstate up to 8x)
+        self.peak_scale = max(1, int(peak_scale))
         self._dq = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._cached: Optional[tuple] = None  # (monotonic t, snapshot)
@@ -96,10 +102,15 @@ class _RooflineWindow:
             "achievedBytesPerSec": round(nbytes * 1000.0 / ms, 3) if ms > 0 else 0.0,
             "achievedFlopsPerSec": round(flops * 1000.0 / ms, 3) if ms > 0 else 0.0,
         }
-        from pinot_tpu.utils.platform import roofline_fractions
+        from pinot_tpu.utils.platform import platform_peaks, roofline_fractions
 
+        peaks = dict(platform_peaks())
+        if self.peak_scale != 1:
+            for k in ("peakFlopsPerSec", "peakBytesPerSec"):
+                if peaks.get(k):
+                    peaks[k] = peaks[k] * self.peak_scale
         out["rooflineFraction"] = roofline_fractions(
-            out["achievedBytesPerSec"], out["achievedFlopsPerSec"]
+            out["achievedBytesPerSec"], out["achievedFlopsPerSec"], peaks=peaks
         )["rooflineFraction"]
         if since is None:
             with self._lock:
@@ -117,24 +128,47 @@ class ServerInstance:
         pipeline: Optional[bool] = None,
         lane_stall_timeout_s: Optional[float] = None,
         device_fault_injector=None,
+        topology=None,
     ) -> None:
         self.name = name
         self.data_manager = InstanceDataManager()
         self.metrics = ServerMetrics(name)
+        # mesh execution plane (engine/mesh.py): the server's chips
+        # carve into chip groups — one DeviceLane per group, queries
+        # shape-hash-routed across them, each group executing as one
+        # SPMD program over its own mesh.  ``topology`` wins; a legacy
+        # ``mesh`` argument becomes a one-lane topology driving that
+        # mesh; with neither, the env (PINOT_TPU_MESH_SHAPE /
+        # PINOT_TPU_LANES) decides — unset env is the trivial single
+        # lane, the exact pre-mesh path (and touches no jax state).
+        from pinot_tpu.engine.mesh import MeshTopology
+
+        if topology is None:
+            topology = (
+                MeshTopology.from_mesh(mesh)
+                if mesh is not None
+                else MeshTopology.from_env()
+            )
+        self.topology = topology
+        self.metrics.gauge("mesh.lanes").set(topology.num_lanes)
+        self.metrics.gauge("mesh.devices").set(topology.num_devices)
+        self.metrics.gauge("mesh.devicesPerLane").set(topology.devices_per_lane)
         # three-stage serving pipeline (engine/dispatch.py): PREP on the
-        # scheduler's worker pool, kernel launches on the single device
-        # lane (coalescing identical dispatches), FINALIZE back on the
-        # submitting worker.  On by default; PINOT_TPU_PIPELINE=0 (or
-        # pipeline=False) restores the serial per-worker path.
-        # ``lane_stall_timeout_s`` arms the lane watchdog (wedged-launch
+        # scheduler's worker pool, kernel launches on the per-chip-group
+        # device lanes (coalescing identical dispatches), FINALIZE back
+        # on the submitting worker.  On by default; PINOT_TPU_PIPELINE=0
+        # (or pipeline=False) restores the serial per-worker path.
+        # ``lane_stall_timeout_s`` arms the lane watchdogs (wedged-launch
         # restart); ``device_fault_injector`` is the deterministic-chaos
-        # hook (common/faults.py DeviceFaultInjector).
+        # hook (common/faults.py DeviceFaultInjector), consulted by
+        # every lane.
         if pipeline is None:
             pipeline = os.environ.get("PINOT_TPU_PIPELINE", "1") != "0"
-        from pinot_tpu.engine.dispatch import DeviceLane
+        from pinot_tpu.engine.dispatch import LaneGroup
 
-        self.lane = (
-            DeviceLane(
+        self.lanes = (
+            LaneGroup(
+                topology,
                 metrics=self.metrics,
                 stall_timeout_s=lane_stall_timeout_s,
                 fault_injector=device_fault_injector,
@@ -142,7 +176,15 @@ class ServerInstance:
             if pipeline
             else None
         )
-        self.executor = QueryExecutor(mesh=mesh, metrics=self.metrics, lane=self.lane)
+        # back-compat handle: the primary lane (THE lane on single-lane
+        # servers — the overwhelmingly common configuration)
+        self.lane = self.lanes.primary if self.lanes is not None else None
+        self.executor = QueryExecutor(
+            mesh=topology.primary_mesh if self.lanes is None else None,
+            metrics=self.metrics,
+            lane=self.lane,
+            lanes=self.lanes,
+        )
         self.scheduler = QueryScheduler(
             num_workers=num_workers, max_pending=max_pending, metrics=self.metrics
         )
@@ -183,25 +225,47 @@ class ServerInstance:
         from pinot_tpu.engine.dispatch import OccupancySampler
         from pinot_tpu.server.profiler import DeviceProfiler
 
-        self._roofline_window = _RooflineWindow()
+        # one roofline window per lane (chip group): /debug/device and
+        # the fleet rollup attribute achieved rates per lane, with the
+        # rollup computed FROM the per-lane snapshots so totals always
+        # equal the sum of lane snapshots.  Single-lane servers see the
+        # pre-mesh single-window shape verbatim.
+        if self.lanes is not None and self.lanes.size > 1:
+            # per-lane windows measure against the lane's OWN chip
+            # count; the rollup then divides by the full device count
+            scales = [g.size for g in topology.groups]
+        else:
+            # one window covering every chip this server drives (1 on
+            # the trivial topology — the pre-mesh figures unchanged)
+            scales = [max(1, topology.num_devices)]
+        self._roofline_windows = [_RooflineWindow(peak_scale=s) for s in scales]
+        self._roofline_window = self._roofline_windows[0]
         self.profiler = DeviceProfiler(name=name, metrics=self.metrics)
-        self.occupancy_sampler = (
-            OccupancySampler(self.lane) if self.lane is not None else None
+        # one occupancy sampler per lane: a profiler bracket on a
+        # lane-group server must trace EVERY chip group's occupancy,
+        # not just lane 0's
+        self.occupancy_samplers = (
+            [OccupancySampler(lane) for lane in self.lanes.lanes]
+            if self.lanes is not None
+            else []
         )
-        if self.occupancy_sampler is not None:
+        self.occupancy_sampler = (
+            self.occupancy_samplers[0] if self.occupancy_samplers else None
+        )
+        if self.occupancy_samplers:
             # a deep-profile bracket records the occupancy time series
-            # alongside the XLA trace; the sampler parks again when the
-            # capture ends (stop OR auto-stop)
-            self.profiler.on_capture_end = self.occupancy_sampler.stop
-        if self.lane is not None:
-            lane = self.lane
+            # (every lane's) alongside the XLA trace; the samplers park
+            # again when the capture ends (stop OR auto-stop)
+            self.profiler.on_capture_end = self._stop_samplers
+        if self.lanes is not None:
+            lanes = self.lanes
             self.metrics.gauge("device.util.busyFraction").set_fn(
-                lambda: lane.occupancy_read("gauge", min_interval_s=0.05)[
+                lambda: lanes.occupancy_read("gauge", min_interval_s=0.05)[
                     "busyFraction"
                 ]
             )
             self.metrics.gauge("device.util.avgQueueDepth").set_fn(
-                lambda: lane.occupancy_read("gauge", min_interval_s=0.05)[
+                lambda: lanes.occupancy_read("gauge", min_interval_s=0.05)[
                     "avgQueueDepth"
                 ]
             )
@@ -215,13 +279,13 @@ class ServerInstance:
             lambda: TRANSFERS.d2h_bytes
         )
         self.metrics.gauge("device.util.achievedBytesPerSec").set_fn(
-            lambda: self._roofline_window.snapshot()["achievedBytesPerSec"]
+            lambda: self._roofline_rollup()["achievedBytesPerSec"]
         )
         self.metrics.gauge("device.util.achievedFlopsPerSec").set_fn(
-            lambda: self._roofline_window.snapshot()["achievedFlopsPerSec"]
+            lambda: self._roofline_rollup()["achievedFlopsPerSec"]
         )
         self.metrics.gauge("device.util.rooflineFraction").set_fn(
-            lambda: self._roofline_window.snapshot()["rooflineFraction"]
+            lambda: self._roofline_rollup()["rooflineFraction"]
         )
         from pinot_tpu.engine.device import LEDGER
 
@@ -301,6 +365,37 @@ class ServerInstance:
     # the ONE source in engine/results.py, so a new tier cannot
     # silently miss the reconciliation surfaces
     _TIER_KEYS = SEGMENT_TIER_KEYS
+
+    def _roofline_rollup(self, since: Optional[float] = None) -> dict:
+        """Recent achieved-rate window across every lane.  Single lane:
+        the window's snapshot verbatim (pre-mesh shape).  Lane group:
+        per-lane snapshots under ``lanes`` plus a rollup computed FROM
+        those snapshots — totals and achieved rates are sums over the
+        concurrent lanes, and the fleet roofline fraction divides by
+        the per-chip peak times the server's device count."""
+        if len(self._roofline_windows) == 1:
+            return self._roofline_windows[0].snapshot(since=since)
+        lanes = [w.snapshot(since=since) for w in self._roofline_windows]
+        out = {
+            "windowS": lanes[0]["windowS"],
+            "queries": sum(l["queries"] for l in lanes),
+            "deviceMs": round(sum(l["deviceMs"] for l in lanes), 3),
+            "deviceBytes": sum(l["deviceBytes"] for l in lanes),
+            "achievedBytesPerSec": sum(l["achievedBytesPerSec"] for l in lanes),
+            "achievedFlopsPerSec": sum(l["achievedFlopsPerSec"] for l in lanes),
+            "lanes": lanes,
+        }
+        from pinot_tpu.utils.platform import platform_peaks, roofline_fractions
+
+        peaks = dict(platform_peaks())
+        n_dev = max(1, self.topology.num_devices)
+        for k in ("peakFlopsPerSec", "peakBytesPerSec"):
+            if peaks.get(k):
+                peaks[k] = peaks[k] * n_dev
+        out["rooflineFraction"] = roofline_fractions(
+            out["achievedBytesPerSec"], out["achievedFlopsPerSec"], peaks=peaks
+        )["rooflineFraction"]
+        return out
 
     # -- segment lifecycle -------------------------------------------
     @staticmethod
@@ -476,8 +571,8 @@ class ServerInstance:
             "pending": self.scheduler.pending,
             "maxPending": self.scheduler.max_pending,
             "laneDepth": 0
-            if self.lane is None
-            else self.lane.stats().get("depth", 0),
+            if self.lanes is None
+            else self.lanes.stats().get("depth", 0),
         }
         return serialize_result(result)
 
@@ -520,10 +615,19 @@ class ServerInstance:
         host_ms = float(result.cost.get("hostMs", 0) or 0)
         device_info = None
         ddigest = getattr(result, "_device_digest", None)
-        if ddigest is not None and self.lane is not None:
-            ci = self.lane.compile_info(ddigest)
+        lane_idx = int(getattr(result, "_lane_index", 0) or 0)
+        lane_idx = min(lane_idx, len(self._roofline_windows) - 1)
+        if ddigest is not None and self.lanes is not None:
+            # the executor stamped which chip-group lane executed; that
+            # lane's compile registry holds the digest's cost analysis
+            lane = self.lanes.lanes[lane_idx]
+            ci = lane.compile_info(ddigest)
+            if ci is None:
+                ci = self.lanes.compile_info(ddigest)
             if ci is not None:
                 device_info = {"digest": ddigest}
+                if self.lanes.size > 1:
+                    device_info["lane"] = lane_idx
                 analysis = ci.get("costAnalysis")
                 if isinstance(analysis, dict):
                     device_info.update(
@@ -534,7 +638,7 @@ class ServerInstance:
                         }
                     )
         if device_ms > 0:
-            self._roofline_window.record(
+            self._roofline_windows[lane_idx].record(
                 device_ms,
                 float(result.cost.get("deviceBytes", 0) or 0),
                 float((device_info or {}).get("flops", 0) or 0),
@@ -563,7 +667,7 @@ class ServerInstance:
             self.metrics.meter("heal.deviceFailures").count
             + self.metrics.meter("heal.hostFailovers").count
             + self.metrics.meter("crcFailures").count
-            + (0 if self.lane is None else self.lane.restart_count)
+            + (0 if self.lanes is None else self.lanes.restart_count)
         )
         delta = total - self._last_heal_total
         self._last_heal_total = total
@@ -578,7 +682,7 @@ class ServerInstance:
         failures, host failovers, lane restarts, poisoned plans, CRC
         failures, quarantined segments)."""
         heal = self.executor.healing_stats()
-        heal["laneRestarts"] = 0 if self.lane is None else self.lane.restart_count
+        heal["laneRestarts"] = 0 if self.lanes is None else self.lanes.restart_count
         heal["crcFailures"] = self.metrics.meter("crcFailures").count
         heal["quarantinedSegments"] = self.metrics.meter("quarantinedSegments").count
         from pinot_tpu.engine.device import LEDGER
@@ -590,7 +694,10 @@ class ServerInstance:
             "draining": self.draining,
             "lease": self.lease.snapshot(),
             "scheduler": self.scheduler.stats(),
-            "lane": None if self.lane is None else self.lane.stats(),
+            # single lane: the lane's stats verbatim; lane group: the
+            # summed rollup with a per-lane list under "lanes"
+            "lane": None if self.lanes is None else self.lanes.stats(),
+            "mesh": self.topology.snapshot(),
             "selfHealing": heal,
             "hbm": hbm,
             "device": self.device_utilization(),
@@ -606,9 +713,13 @@ class ServerInstance:
         ``ProfilerUnavailableError`` (typed 404 on the admin surface)
         when the backend has no working profiler."""
         snap = self.profiler.start(timeout_s)
-        if self.occupancy_sampler is not None:
-            self.occupancy_sampler.start()
+        for sampler in self.occupancy_samplers:
+            sampler.start()
         return snap
+
+    def _stop_samplers(self) -> None:
+        for sampler in self.occupancy_samplers:
+            sampler.stop()
 
     def profile_stop(self) -> dict:
         """Release one profile start; sampler parks when the capture
@@ -628,14 +739,15 @@ class ServerInstance:
         from pinot_tpu.utils.platform import platform_peaks
 
         occupancy = None
-        if self.lane is not None:
-            occupancy = self.lane.occupancy_read("status")
-            occupancy["open"] = self.lane.stats().get("open", 0)
+        if self.lanes is not None:
+            occupancy = self.lanes.occupancy_read("status")
+            occupancy["open"] = self.lanes.stats().get("open", 0)
         out = {
             "platform": platform_peaks(),
+            "mesh": self.topology.snapshot(),
             "occupancy": occupancy,
             "transfers": TRANSFERS.snapshot(),
-            "recent": self._roofline_window.snapshot(since=roofline_since),
+            "recent": self._roofline_rollup(since=roofline_since),
             "profiler": self.profiler.snapshot(),
         }
         if self.occupancy_sampler is not None and (
@@ -643,6 +755,10 @@ class ServerInstance:
             or self.occupancy_sampler.samples_taken
         ):
             out["sampler"] = self.occupancy_sampler.snapshot()
+        if len(self.occupancy_samplers) > 1 and any(
+            s.running or s.samples_taken for s in self.occupancy_samplers
+        ):
+            out["samplers"] = [s.snapshot() for s in self.occupancy_samplers]
         return out
 
     def metrics_text(self) -> str:
@@ -658,11 +774,10 @@ class ServerInstance:
         occupancy sampler, and force-stop any active profile capture."""
         self.scheduler.shutdown()
         self.history.stop()
-        if self.occupancy_sampler is not None:
-            self.occupancy_sampler.stop()
+        self._stop_samplers()
         self.profiler.shutdown()
-        if self.lane is not None:
-            self.lane.close()
+        if self.lanes is not None:
+            self.lanes.close()
 
     def _process(
         self,

@@ -47,7 +47,8 @@ class ServerAdminHttpServer:
     admin-application analog): ``/health``, Prometheus text at
     ``/metrics``, the full status/metrics JSON at ``/debug/metrics``,
     per-plan stats at ``/debug/plans``, the device-utilization
-    snapshot at ``/debug/device``, and the on-demand profiler bracket
+    snapshot at ``/debug/device``, the mesh topology + per-lane
+    dispatch stats at ``/debug/mesh``, and the on-demand profiler bracket
     at ``POST /debug/profile/start|stop`` (``GET /debug/profile`` for
     state).  The query data plane stays on the framed TCP socket; this
     port is scrape/ops-only.  The networked starter advertises it to
@@ -91,6 +92,18 @@ class ServerAdminHttpServer:
                     # heavyweight sections): the controller rollup and
                     # dashboards poll this cheaply
                     return self._send_json(inst.device_utilization())
+                if self.path == "/debug/mesh":
+                    # mesh execution plane (engine/mesh.py): topology
+                    # snapshot + per-lane dispatch stats — which chip
+                    # group serves which lane, rolled up
+                    return self._send_json(
+                        {
+                            "topology": inst.topology.snapshot(),
+                            "lanes": None
+                            if inst.lanes is None
+                            else inst.lanes.stats(),
+                        }
+                    )
                 if self.path == "/debug/profile":
                     return self._send_json(inst.profiler.snapshot())
                 if self.path == "/debug/flightrec":

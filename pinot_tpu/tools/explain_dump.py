@@ -138,6 +138,21 @@ def render_explain(obj: Dict[str, Any]) -> str:
                 f"  device plan {dev.get('planDigest')}  "
                 f"compile={comp_str}{quarantined}\n"
             )
+            mesh = dev.get("mesh")
+            if mesh:
+                # mesh execution decision (engine/mesh.py): which lane
+                # serves the shape and what the merge lowers to
+                coll = mesh.get("collective")
+                out += (
+                    f"  mesh {mesh.get('shape')}  lane={mesh.get('laneIndex')}"
+                    f"/{mesh.get('lanes')}  "
+                    + (
+                        f"shard={mesh.get('shardAxis')}  "
+                        f"collective={','.join(coll)}\n"
+                        if mesh.get("shardAxis")
+                        else "single-chip (no sharding)\n"
+                    )
+                )
             out += render_cost_analysis(dev)
         staged = node.get("staged") or {}
         if staged.get("hbmBytes"):

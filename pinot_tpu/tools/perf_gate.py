@@ -29,8 +29,11 @@ ISSUE 10 utilization fields (lane busy-fraction, achieved device
 bytes/s, D2H volume), and the ISSUE 11 sampling-overhead ratio (QPS
 with the always-on tail sampler vs sampling off) against the committed
 ``SERVING_UTIL_r11.json`` — with the same direction-aware bands and
-config-mismatch SKIP.
-Mixed kinds (default baseline vs serving current) skip outright.
+config-mismatch SKIP.  Multichip-mode documents
+(``PINOT_TPU_BENCH_MODE=multichip``, the mesh execution plane) gate
+per-config rows/s, the sharded-vs-single speedup, and per-lane
+achieved bandwidth against the committed ``MULTICHIP_r06.json``.
+Mixed kinds (default vs serving vs multichip) skip outright.
 
 Usage:
   python -m pinot_tpu.tools.perf_gate current.json [--baseline BENCH_r05.json]
@@ -101,15 +104,47 @@ SERVING_CONFIG_KEYS = ("total_rows", "num_segments", "platform")
 
 SERVING_DEFAULT_BASELINE = "SERVING_UTIL_r11.json"
 
+# multichip-mode documents (PINOT_TPU_BENCH_MODE=multichip, the mesh
+# execution plane): per-execution-config scan-heavy rows/s, the
+# sharded-vs-single speedup (the ISSUE 12 acceptance is >= 3x on an
+# 8-device host — the band fails the gate if a merge collapses it
+# below ~2.1x of the committed capture), and per-lane utilization.
+# Direction-aware with the same config-mismatch SKIP as every kind.
+MULTICHIP_METRIC_SPECS: Dict[str, Tuple[str, float]] = {
+    "rows_per_sec.single_lane": ("higher", 0.40),
+    "rows_per_sec.sharded": ("higher", 0.40),
+    "rows_per_sec.lane_group": ("higher", 0.40),
+    "sharded_vs_single": ("higher", 0.70),
+    "lane_group_vs_single": ("higher", 0.60),
+    "utilization.sharded.achievedBytesPerSec": ("higher", 0.30),
+    "utilization.lane_group.achievedBytesPerSec": ("higher", 0.30),
+}
+
+MULTICHIP_CONFIG_KEYS = ("total_rows", "num_segments", "n_devices", "platform")
+
+MULTICHIP_DEFAULT_BASELINE = "MULTICHIP_r06.json"
+
 
 def _is_serving(doc: Dict[str, Any]) -> bool:
     return str(doc.get("metric", "")).startswith("serving_")
 
 
+def _doc_kind(doc: Dict[str, Any]) -> str:
+    metric = str(doc.get("metric", ""))
+    if metric.startswith("serving_"):
+        return "serving"
+    if metric.startswith("multichip_"):
+        return "multichip"
+    return "default"
+
+
 def _specs_for(doc: Dict[str, Any]):
     """(metric specs, config keys) for a bench document's kind."""
-    if _is_serving(doc):
+    kind = _doc_kind(doc)
+    if kind == "serving":
         return SERVING_METRIC_SPECS, SERVING_CONFIG_KEYS
+    if kind == "multichip":
+        return MULTICHIP_METRIC_SPECS, MULTICHIP_CONFIG_KEYS
     return METRIC_SPECS, CONFIG_KEYS
 
 
@@ -162,10 +197,11 @@ def compare(
     one row per compared metric.  Pure — unit-testable without files.
     The spec set follows the document kind (default bench vs serving
     mode); mismatched kinds skip — there is nothing to compare."""
-    if _is_serving(baseline) != _is_serving(current):
+    if _doc_kind(baseline) != _doc_kind(current):
         return {
             "verdict": "skipped",
-            "reason": "bench document kinds differ (default vs serving mode)",
+            "reason": "bench document kinds differ "
+            "(default vs serving vs multichip mode)",
             "configMismatch": {
                 "metric": {
                     "baseline": baseline.get("metric"),
@@ -235,7 +271,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--baseline",
         default=None,
         help="committed capture to gate against (default BENCH_r05.json, "
-        f"or {SERVING_DEFAULT_BASELINE} for a serving-mode document)",
+        f"{SERVING_DEFAULT_BASELINE} for a serving-mode document, or "
+        f"{MULTICHIP_DEFAULT_BASELINE} for a multichip-mode document)",
     )
     p.add_argument(
         "--tolerance-scale",
@@ -254,11 +291,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         baseline_path = args.baseline
         if baseline_path is None:
             # default baseline follows the current document's kind
-            baseline_path = (
-                SERVING_DEFAULT_BASELINE
-                if _is_serving(current)
-                else "BENCH_r05.json"
-            )
+            baseline_path = {
+                "serving": SERVING_DEFAULT_BASELINE,
+                "multichip": MULTICHIP_DEFAULT_BASELINE,
+            }.get(_doc_kind(current), "BENCH_r05.json")
         baseline = load_bench(baseline_path)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(json.dumps({"verdict": "error", "error": str(e)}), file=sys.stderr)

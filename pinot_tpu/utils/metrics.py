@@ -451,14 +451,26 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "heal.deviceRetries": "transient device failures retried on device",
     "heal.hostFailovers": "queries transparently served via the host path",
     "heal.poisonSkips": "queries that skipped a quarantined device plan",
-    "lane.depth": "device-lane queue depth",
+    "lane.depth": "device-lane queue depth (lane-group servers: summed "
+    "over every lane)",
     "lane.inflight": "device-lane launches currently inside the launch call",
     "lane.open": "completed dispatches still coalescible (program running)",
-    "lane.dispatches": "kernel launches issued by the device lane",
+    "lane.dispatches": "kernel launches issued by the device lane(s)",
     "lane.coalesced": "queries coalesced onto an identical in-flight dispatch",
     "lane.shed": "lane waiters shed at dequeue (deadline expired)",
     "lane.deviceFailures": "launch failures surfaced by the lane",
     "lane.restarts": "lane threads restarted by the stall watchdog",
+    # mesh execution plane (engine/mesh.py + dispatch.LaneGroup): lane
+    # groups expose per-lane twins of every lane series at lane.<i>.*,
+    # and the topology itself is gauged
+    "lane.*.depth": "per-chip-group lane queue depth (lane.<i>.depth)",
+    "lane.*.open": "per-lane completed dispatches still coalescible",
+    "lane.*.inflight": "per-lane launches inside the launch call",
+    "lane.*.*": "per-lane twins of the lane.* meters "
+    "(lane.<i>.dispatches/coalesced/shed/deviceFailures/restarts)",
+    "mesh.lanes": "chip-group lanes this server serves with",
+    "mesh.devices": "devices across every chip group",
+    "mesh.devicesPerLane": "chips per lane group (mesh shape)",
     # cost-accounting plane: per-query cost totals on this server
     "cost.docsScanned": "documents scanned by queries on this server",
     "cost.bytesScanned": "column bytes touched by queries on this server",
