@@ -272,12 +272,41 @@ def _strip_timing(resp) -> str:
     )
 
 
+def _literal_mix(segments):
+    """Same-shape distinct-literal queries — the cross-query batching
+    workload (ISSUE 13): every client cycles ONE plan shape per family
+    with literals spread across the data, so the lane's micro-batching
+    tier sees distinct dispatches that share a StaticPlan and stacks
+    them into one vmapped launch.  Two families: the Q1 group-by at
+    six shipdate cutoffs (clustered column — low cutoffs may take the
+    zone-map block path instead, which is the honest mix), and a
+    scalar-agg filter over the SHUFFLED l_quantity column (zone maps
+    cannot prune it, so it always rides the batchable full scan)."""
+    d = segments[0].column("l_shipdate").dictionary
+    qs = []
+    for f in (0.25, 0.4, 0.55, 0.7, 0.85, 0.95):
+        cutoff = d.get(int((d.cardinality - 1) * f))
+        qs.append(
+            "SELECT sum(l_quantity), sum(l_extendedprice), count(*) "
+            f"FROM lineitem WHERE l_shipdate <= {cutoff!r} "
+            "GROUP BY l_returnflag, l_linestatus TOP 10"
+        )
+    for t in (5, 15, 25, 35, 45):
+        qs.append(
+            "SELECT sum(l_extendedprice), count(*) FROM lineitem "
+            f"WHERE l_quantity > {t}"
+        )
+    return qs
+
+
 def _serving_main() -> None:
     """Concurrent serving-curve mode (PINOT_TPU_BENCH_MODE=serving):
-    closed-loop client ladders over repeated- and mixed-shape workloads
-    against the in-process broker path, pipelined (device lane +
-    identical-dispatch coalescing, engine/dispatch.py) vs serial
-    executor, plus a payload-differential check between the two.
+    closed-loop client ladders (1..256 clients, ISSUE 13) over
+    repeated-, mixed-, and literal-mix-shape workloads against the
+    in-process broker path, across THREE execution configs — serial
+    executor, pipelined (device lane + coalescing + cross-query
+    micro-batching), and cached (pipelined + the ingest-aware result
+    cache) — plus payload-differential checks across all of them.
     Prints ONE JSON document."""
     from pinot_tpu.tools.cluster_harness import single_server_broker
     from pinot_tpu.tools.serving_curve import mixed_workload
@@ -287,12 +316,19 @@ def _serving_main() -> None:
     duration_s = float(os.environ.get("PINOT_TPU_BENCH_SERVE_DURATION_S", "6"))
     ladder = [
         int(c)
-        for c in os.environ.get("PINOT_TPU_BENCH_SERVE_CLIENTS", "1,4,8,16").split(",")
+        for c in os.environ.get(
+            "PINOT_TPU_BENCH_SERVE_CLIENTS", "1,4,8,16,64,256"
+        ).split(",")
     ]
 
     segments = _build_segments(num_segments, rows_per_segment)
     queries_mixed = mixed_workload(segments)
-    workloads = {"repeated_q1": [Q1_PQL], "mixed": queries_mixed}
+    queries_literal = _literal_mix(segments)
+    workloads = {
+        "repeated_q1": [Q1_PQL],
+        "mixed": queries_mixed,
+        "literal_mix": queries_literal,
+    }
 
     import jax
 
@@ -304,22 +340,51 @@ def _serving_main() -> None:
         "duration_s_per_step": duration_s,
         "workloads": "repeated_q1 = the Q1 group-by scan issued by every "
         "client; mixed = the four BASELINE.md shapes interleaved across "
-        "clients (tools/serving_curve.py mixed_workload)",
+        "clients (tools/serving_curve.py mixed_workload); literal_mix = "
+        "same-plan distinct-literal ladders (the cross-query batching "
+        "workload, ISSUE 13)",
         "modes": {},
     }
     brokers = {}
     doc["utilization"] = {}
     from pinot_tpu.engine.device import TRANSFERS
 
-    for mode, pipelined in (("serial", False), ("pipelined", True)):
-        broker = single_server_broker("lineitem", segments, pipeline=pipelined)
+    mode_configs = (
+        ("serial", False, False),
+        ("pipelined", True, False),
+        ("cached", True, True),
+    )
+    for mode, pipelined, cached in mode_configs:
+        if cached:
+            os.environ["PINOT_TPU_RESULT_CACHE"] = "1"
+        try:
+            broker = single_server_broker("lineitem", segments, pipeline=pipelined)
+        finally:
+            os.environ.pop("PINOT_TPU_RESULT_CACHE", None)
         brokers[mode] = broker
         server = broker.local_servers[0]
         # warm every shape (staging + compile) before any measurement
-        for q in queries_mixed + [Q1_PQL]:
+        for q in queries_mixed + queries_literal + [Q1_PQL]:
             for _ in range(2):
                 resp = broker.handle_pql(q)
                 assert not resp.exceptions, resp.exceptions
+        if pipelined:
+            # warm the BATCHED kernel buckets too: concurrent distinct-
+            # literal bursts make the lane form batches, compiling the
+            # vmapped pow2-size variants — otherwise their cold
+            # compiles land inside the measured ladder (a ~30% dent on
+            # the 2-core CPU box, steady state is at parity)
+            import threading as _threading
+
+            for _ in range(3):
+                burst = [
+                    _threading.Thread(target=broker.handle_pql, args=(q,))
+                    for q in queries_literal
+                ]
+                for t in burst:
+                    t.start()
+                for t in burst:
+                    t.join()
         # utilization plane (ISSUE 10): window the occupancy + transfer
         # + achieved-rate accounting to the MEASURED ladder — warmup
         # staging/compile must not inflate busy-fraction, bandwidth, or
@@ -347,6 +412,7 @@ def _serving_main() -> None:
             "curves": curves,
             "lane": None if server.lane is None else server.lane.stats(),
             "scheduler": server.scheduler.stats(),
+            "rescache": server.result_cache.snapshot(),
             "device": {
                 "occupancy": occ,
                 "transfers": transfers,
@@ -382,6 +448,66 @@ def _serving_main() -> None:
         }
         doc[f"saturation_qps_{wname}"] = sat
         doc[f"speedup_{wname}"] = round(sat["pipelined"] / max(sat["serial"], 1e-9), 2)
+        doc[f"speedup_cached_{wname}"] = round(
+            sat["cached"] / max(sat["serial"], 1e-9), 2
+        )
+
+    # cross-query batching + result-cache rollups (ISSUE 13 gate
+    # surface).  Batching figures come from the PIPELINED mode (the
+    # cached mode answers most repeats before the lane ever sees
+    # them); cache figures from the CACHED mode.
+    pipe_lane = doc["modes"]["pipelined"]["lane"] or {}
+    # denominator: queries that actually EXECUTED (shed 429s at the
+    # 64/256-client steps never reach the lane, so counting them would
+    # understate occupancy by the shed rate)
+    pipe_ok = sum(
+        s["queries"] - s["errors"]
+        for steps in doc["modes"]["pipelined"]["curves"].values()
+        for s in steps
+    )
+    launches = pipe_lane.get("batchLaunches", 0)
+    carried = pipe_lane.get("batchedQueries", 0)
+    doc["batching"] = {
+        "batchLaunches": launches,
+        "batchedQueries": carried,
+        "avgBatchSize": round(carried / launches, 3) if launches else 0.0,
+        "batchedQueryFraction": (
+            round(carried / pipe_ok, 4) if pipe_ok else 0.0
+        ),
+        "windowCloses": {
+            "full": pipe_lane.get("batchWindowFull", 0),
+            "timeout": pipe_lane.get("batchWindowTimeout", 0),
+        },
+        "note": "2-core CPU sim executes batch members serially inside "
+        "one program, so batching is ~neutral for wall clock HERE "
+        "(steady state measured at parity; the counters prove batches "
+        "form) — the amortization win is accelerator-side, where "
+        "per-launch dispatch/transfer overhead dominates",
+    }
+    rc = doc["modes"]["cached"]["rescache"]
+    doc["rescache"] = {
+        "hitRate": rc.get("hitRate", 0.0),
+        "hits": rc.get("hits", 0),
+        "misses": rc.get("misses", 0),
+        "puts": rc.get("puts", 0),
+        "staleEvictions": rc.get("staleEvictions", 0),
+    }
+
+    # equal-client-count acceptance view (ISSUE 13: ok-QPS vs the r11
+    # baseline is compared AT THE SAME client count, not across ladder
+    # maxima — the r11 ladder stopped at 16 clients)
+    doc["ok_qps_at_16_clients"] = {}
+    for wname in workloads:
+        at16 = {}
+        for m in doc["modes"]:
+            step = next(
+                (s for s in doc["modes"][m]["curves"][wname] if s["clients"] == 16),
+                None,
+            )
+            if step is not None:
+                at16[m] = step["ok_qps"]
+        if at16:
+            doc["ok_qps_at_16_clients"][wname] = at16
 
     # sampling-overhead spec (ISSUE 11): observability defaults
     # (always-on tail tracing + history recorder) vs sampling off
@@ -432,19 +558,31 @@ def _serving_main() -> None:
         "repeated_q1 at the top ladder step",
     }
 
-    # differential: pipelined and serial must serve byte-identical
-    # payloads (timing field excluded) for every workload shape
+    # differential: serial, pipelined (batched), and cached must serve
+    # byte-identical payloads (timing field excluded) for every
+    # workload shape — and a REPEATED query against the cached broker
+    # (a guaranteed cache hit) must still match the serial payload
     diffs = 0
-    for q in queries_mixed + [Q1_PQL]:
+    cache_hit_diffs = 0
+    diff_queries = queries_mixed + queries_literal + [Q1_PQL]
+    for q in diff_queries:
         a = _strip_timing(brokers["serial"].handle_pql(q))
         b = _strip_timing(brokers["pipelined"].handle_pql(q))
-        if a != b:
+        c1 = brokers["cached"].handle_pql(q)
+        c2 = brokers["cached"].handle_pql(q)  # second call: cache hit
+        if len({a, b, _strip_timing(c1)}) != 1:
             diffs += 1
+        if _strip_timing(c2) != a or not c2.cost.get("rescacheHits"):
+            cache_hit_diffs += 1
     doc["differential"] = {
-        "queries": len(queries_mixed) + 1,
+        "queries": len(diff_queries),
         "mismatches": diffs,
-        "identical_payloads": diffs == 0,
-        "note": "payload = BrokerResponse.to_json() minus timeUsedMs/requestId, sorted keys",
+        "cache_hit_mismatches": cache_hit_diffs,
+        "identical_payloads": diffs == 0 and cache_hit_diffs == 0,
+        "note": "payload = BrokerResponse.to_json() minus "
+        "timeUsedMs/requestId/cost, sorted keys, across "
+        "serial/pipelined/cached; cache_hit rows re-query the cached "
+        "broker and require a rescacheHits-marked identical payload",
     }
     print(json.dumps(doc, indent=1))
 

@@ -31,11 +31,28 @@ handing out the buffers would be result caching, which this
 deliberately is not — a query arriving after the outputs exist always
 re-dispatches.
 
+BATCHING: coalescing only merges *identical* dispatches; the
+micro-batching tier merges *similar* ones.  Dispatches that share a
+batch key (same StaticPlan — the literal-bucketed device program, so
+``a>5`` and ``a>999`` share it — same staged-table token, same
+query-input signature) and carry a ``BatchSpec`` are collected at
+dequeue time into ONE vmapped launch: the staged columns are read once
+while every member's literals ride a stacked batch axis
+(``kernel.make_packed_batched_table_kernel``), and each member's
+FINALIZE slices its own row out of the one packed fetch — payloads stay
+byte-identical to unbatched execution.  The batch window is adaptive:
+an idle lane launches immediately (batching must never add latency when
+the device is free), while demonstrated same-shape demand (>= 2 members
+already queued — the lane-depth signal PR 7's admission plane feeds)
+holds the window open up to ``PINOT_TPU_BATCH_WINDOW_MS`` for more
+arrivals, filling to ``PINOT_TPU_BATCH_MAX``.
+
 DEADLINES: each waiter carries the broker-propagated monotonic deadline
 (server/scheduler.py semantics).  A waiter whose deadline expired while
-its dispatch sat in the lane queue is shed with the existing
-``QueryAbandonedError`` before any device work happens on its behalf;
-a dispatch all of whose waiters expired is dropped without launching.
+its dispatch sat in the lane queue — or while its batch was forming —
+is shed with the existing ``QueryAbandonedError`` before any device
+work happens on its behalf, without poisoning batchmates; a dispatch
+all of whose waiters expired is dropped without launching.
 
 SUPERVISION: the lane is the server's single point of device contact,
 so it is also where device faults are contained.  Every launch
@@ -70,6 +87,25 @@ from pinot_tpu.server.scheduler import QueryAbandonedError
 # this the oldest close early — a bound on pinned output buffers, not
 # a correctness knob
 _MAX_OPEN = 32
+
+
+def batch_max() -> int:
+    """Upper bound on batch members per launch (PINOT_TPU_BATCH_MAX,
+    default 16; <= 1 disables the micro-batching tier)."""
+    try:
+        return int(os.environ.get("PINOT_TPU_BATCH_MAX", "16"))
+    except ValueError:
+        return 16
+
+
+def batch_window_s() -> float:
+    """Bounded batch-formation window in seconds
+    (PINOT_TPU_BATCH_WINDOW_MS, default 2.0 ms; 0 disables the wait —
+    only already-queued peers batch)."""
+    try:
+        return float(os.environ.get("PINOT_TPU_BATCH_WINDOW_MS", "2.0")) / 1000.0
+    except ValueError:
+        return 0.002
 # poll period for closing open dispatches while the queue is idle; the
 # check is a non-blocking is_ready() per open dispatch
 _SWEEP_S = 0.005
@@ -216,13 +252,16 @@ class LaneTicket:
     """One waiter's slot: the submitting worker blocks on ``result`` and
     resumes FINALIZE when the lane delivers outputs (or an error).
     ``coalesced`` marks a ticket that attached to an identical in-flight
-    dispatch instead of enqueueing its own (trace/metrics attribution)."""
+    dispatch instead of enqueueing its own (trace/metrics attribution);
+    ``batch_size`` is the member count of the batched launch this
+    ticket's dispatch rode (1 = unbatched)."""
 
-    __slots__ = ("deadline", "coalesced", "_event", "_value", "_error")
+    __slots__ = ("deadline", "coalesced", "batch_size", "_event", "_value", "_error")
 
     def __init__(self, deadline: Optional[float]) -> None:
         self.deadline = deadline
         self.coalesced = False
+        self.batch_size = 1
         self._event = threading.Event()
         self._value: Any = None
         self._error: Optional[BaseException] = None
@@ -246,10 +285,79 @@ class LaneTicket:
         return self._value
 
 
+class BatchSpec:
+    """One dispatch's micro-batching contract (executor-built).
+
+    ``key``: hashable batch-equivalence key — dispatches with equal keys
+    stack into one launch.  The executor keys on (StaticPlan,
+    staged-table token, query-input signature): one device program, one
+    resident table, structurally identical input pytrees.
+    ``inputs``: this query's HOST numpy query-input pytree (the
+    pre-upload form — batched members upload ONCE, stacked).
+    ``launch_batched``: callable(list of member input pytrees) ->
+    ``(fetch, handle)`` launching the vmapped batched kernel; ``fetch``
+    returns the whole batch's host outputs in one packed D2H.
+    ``max_members``: per-plan cap below the lane-wide PINOT_TPU_BATCH_MAX
+    (the executor bounds it so batch x rows stays under the per-dispatch
+    row budget — batching must not blow HBM at compile time)."""
+
+    __slots__ = ("key", "inputs", "launch_batched", "max_members")
+
+    def __init__(
+        self,
+        key: Hashable,
+        inputs: Any,
+        launch_batched: Callable[[List[Any]], Any],
+        max_members: int = 0,
+    ) -> None:
+        self.key = key
+        self.inputs = inputs
+        self.launch_batched = launch_batched
+        self.max_members = max_members
+
+
+class _BatchFetch:
+    """Shared FINALIZE handle for one batched launch: the FIRST member
+    to need outputs performs the ONE packed D2H fetch (counted once —
+    the PR 10 transfer-accounting contract); every member then slices
+    its leading-axis row from the cached host pytree.  Thread-safe:
+    members finalize concurrently on their own scheduler workers."""
+
+    def __init__(self, fetch: Callable, size: int) -> None:
+        self._fetch = fetch
+        self._lock = threading.Lock()
+        self._outs: Any = None
+        self._error: Optional[BaseException] = None
+        self.size = size
+
+    def _resolve(self, handle) -> Any:
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+            if self._outs is None:
+                try:
+                    self._outs = self._fetch(handle, count_transfer=True)
+                except BaseException as e:
+                    self._error = e
+                    raise
+            return self._outs
+
+    def member(self, index: int) -> Callable:
+        def fetch_member(handle, count_transfer: bool = True) -> Any:
+            # count_transfer is ignored by design: the one physical D2H
+            # is counted inside _resolve exactly once per batch
+            outs = self._resolve(handle)
+            from pinot_tpu.engine.packing import slice_batched_outputs
+
+            return slice_batched_outputs(outs, index)
+
+        return fetch_member
+
+
 class _Dispatch:
     __slots__ = (
         "key", "launch", "pending", "waiters", "completed", "value",
-        "error", "plan_digest", "cost_provider",
+        "error", "plan_digest", "cost_provider", "batch", "batch_size",
     )
 
     def __init__(
@@ -259,12 +367,15 @@ class _Dispatch:
         pending: Callable[[Any], bool],
         plan_digest: Optional[str] = None,
         cost_provider: Optional[Callable[[], Optional[dict]]] = None,
+        batch: Optional[BatchSpec] = None,
     ) -> None:
         self.key = key
         self.launch = launch
         self.pending = pending
         self.plan_digest = plan_digest
         self.cost_provider = cost_provider
+        self.batch = batch
+        self.batch_size = 1  # members of the batched launch this rode
         self.waiters: List[LaneTicket] = []
         self.completed = False
         self.value: Any = None
@@ -306,6 +417,14 @@ class DeviceLane:
             stall_timeout_s = float(os.environ.get("PINOT_TPU_LANE_STALL_S", "120"))
         self.stall_timeout_s = stall_timeout_s
         self.fault_injector = fault_injector
+        # micro-batching tier config (module docstring): resolved once
+        # at construction so a long-lived lane is immune to env churn
+        self.batch_max = batch_max()
+        self.batch_window_s = batch_window_s()
+        self.batch_launches = 0
+        self.batched_queries = 0
+        self.batch_window_full = 0
+        self.batch_window_timeout = 0
         self._cv = threading.Condition()
         self._queue: Deque[_Dispatch] = deque()
         self._by_key: Dict[Hashable, _Dispatch] = {}
@@ -321,7 +440,9 @@ class DeviceLane:
         # its spawn-time generation against this and, when stale, drops
         # its result and exits without touching lane state
         self._generation = 0
-        self._inflight: Optional[tuple] = None  # (dispatch, started_at)
+        # (leader dispatch, started_at, members tuple) while a launch
+        # (possibly batched) is in flight
+        self._inflight: Optional[tuple] = None
         self._closed = False
         self.dispatch_count = 0
         self.coalesce_hits = 0
@@ -361,7 +482,11 @@ class DeviceLane:
                          "lane.deviceFailures", "lane.restarts",
                          "compile.cold", "compile.warm",
                          "compile.costAnalyses",
-                         "compile.costAnalysisUnavailable"):
+                         "compile.costAnalysisUnavailable",
+                         "batch.launches", "batch.queries",
+                         "batch.windowClosedFull",
+                         "batch.windowClosedTimeout",
+                         "batch.windowClosedIdle"):
                 metrics.meter(name)
             metrics.timer("compile.firstCallMs")
             if self.index is None:
@@ -397,6 +522,7 @@ class DeviceLane:
         pending: Callable[[Any], bool] = outputs_pending,
         plan_digest: Optional[str] = None,
         cost_provider: Optional[Callable[[], Optional[dict]]] = None,
+        batch: Optional[BatchSpec] = None,
     ) -> LaneTicket:
         """Enqueue a kernel launch, or coalesce onto an identical one
         that is queued, launching, or still executing on device.
@@ -407,7 +533,12 @@ class DeviceLane:
         callable returning the plan's static XLA cost analysis (or
         None).  Invoked ONCE per plan digest on an async helper thread
         after the digest's first successful launch — never on the lane
-        thread, so a slow analysis cannot stall serving."""
+        thread, so a slow analysis cannot stall serving.
+
+        ``batch`` (optional, micro-batching tier): a ``BatchSpec``
+        marking this dispatch stackable with same-key peers into one
+        vmapped launch.  Identical dispatches still coalesce FIRST (one
+        member, many waiters); batching merges *distinct* members."""
         ticket = LaneTicket(deadline)
         with self._cv:
             if self._closed:
@@ -420,6 +551,10 @@ class DeviceLane:
                 if still:
                     self._hit()
                     ticket.coalesced = True
+                    # a still-pending BATCHED member hands out its
+                    # member slice — the late waiter rode that batch
+                    # too, so it must report the same batch size
+                    ticket.batch_size = d.batch_size
                     ticket._deliver(value=d.value)
                     return ticket
                 self._close_open(d)
@@ -429,7 +564,7 @@ class DeviceLane:
                 ticket.coalesced = True
                 self._hit()
             else:
-                d = _Dispatch(key, launch, pending, plan_digest, cost_provider)
+                d = _Dispatch(key, launch, pending, plan_digest, cost_provider, batch)
                 d.waiters.append(ticket)
                 self._by_key[key] = d
                 self._depth_tick_locked()
@@ -462,6 +597,13 @@ class DeviceLane:
             "restarts": self.restart_count,
             "staleCompletions": self.stale_completions,
             "compiledPlans": len(self._compile),
+            # micro-batching tier: batched launches, the queries they
+            # carried (occupancy = batchedQueries / batchLaunches), and
+            # how the formation windows closed
+            "batchLaunches": self.batch_launches,
+            "batchedQueries": self.batched_queries,
+            "batchWindowFull": self.batch_window_full,
+            "batchWindowTimeout": self.batch_window_timeout,
         }
 
     def compile_info(self, digest: Optional[str]) -> Optional[Dict[str, float]]:
@@ -659,7 +801,10 @@ class DeviceLane:
                         timeout=infl[1] + self.stall_timeout_s - now + 0.005
                     )
                 else:
-                    d = infl[0]
+                    # a batched launch wedges as a unit: every member's
+                    # waiters get the stall verdict (the executor fails
+                    # each one over to the host path independently)
+                    members = infl[2]
                     self._inflight = None
                     if self._busy_since is not None:
                         # bank the wedged launch's window as busy time;
@@ -670,18 +815,20 @@ class DeviceLane:
                     self._generation += 1
                     self.restart_count += 1
                     self.device_failure_count += 1
-                    d.completed = True
-                    if self._by_key.get(d.key) is d:
-                        self._by_key.pop(d.key)
-                    victims = list(d.waiters)
-                    d.waiters = []
                     err = DeviceExecutionError(
                         f"device dispatch stalled > {self.stall_timeout_s:.3f}s; "
                         "lane restarted",
                         retryable=False,
                         stalled=True,
                     )
-                    d.error = err
+                    victims = []
+                    for d in members:
+                        d.completed = True
+                        if self._by_key.get(d.key) is d:
+                            self._by_key.pop(d.key)
+                        victims.extend(d.waiters)
+                        d.waiters = []
+                        d.error = err
                     self._spawn_lane_locked()
             if victims:
                 self._lane_mark("restarts")
@@ -734,6 +881,61 @@ class DeviceLane:
         while len(self._open) > _MAX_OPEN:
             self._close_open(self._open[0])
 
+    # -- micro-batching formation (lock held) --------------------------
+    def _gather_peers_locked(self, spec: BatchSpec, members: List[_Dispatch], cap: int) -> None:
+        """Pull queued dispatches whose batch key equals ``spec.key``
+        into ``members`` (up to ``cap``).  Coalescing already folded
+        identical dispatches together, so every peer here is a DISTINCT
+        (literals/inputs) instance of the same device program over the
+        same staged table."""
+        if len(members) >= cap:
+            return
+        taken = []
+        for peer in self._queue:
+            if len(members) + len(taken) >= cap:
+                break
+            pb = peer.batch
+            if pb is not None and pb.key == spec.key:
+                taken.append(peer)
+        if not taken:
+            return
+        self._depth_tick_locked()
+        for peer in taken:
+            self._queue.remove(peer)
+            members.append(peer)
+        self._set_depth()
+
+    def _form_batch_locked(self, d: _Dispatch, members: List[_Dispatch], gen: int) -> str:
+        """Adaptive batch window (module docstring).  Gathers queued
+        same-key peers immediately; an idle lane (no same-shape demand:
+        fewer than 2 members) closes at once so batching never adds
+        latency to a quiet server, while demonstrated demand holds the
+        window open up to ``batch_window_s`` and fills to the cap.
+        Returns the close reason ("full" | "timeout" | "idle")."""
+        spec = d.batch
+        cap = self.batch_max
+        if spec.max_members:
+            cap = max(1, min(cap, spec.max_members))
+        self._gather_peers_locked(spec, members, cap)
+        if len(members) >= cap:
+            return "full"
+        if len(members) < 2 or self.batch_window_s <= 0:
+            return "idle"
+        deadline_w = time.monotonic() + self.batch_window_s
+        while (
+            len(members) < cap
+            and not self._closed
+            and gen == self._generation
+        ):
+            remaining = deadline_w - time.monotonic()
+            if remaining <= 0:
+                return "timeout"
+            # cv.wait releases the lock: submits keep landing and the
+            # next gather sweep picks up fresh same-key arrivals
+            self._cv.wait(remaining)
+            self._gather_peers_locked(spec, members, cap)
+        return "full" if len(members) >= cap else "timeout"
+
     def _run(self, gen: int) -> None:
         while True:
             with self._cv:
@@ -756,22 +958,60 @@ class DeviceLane:
                 self._depth_tick_locked()
                 d = self._queue.popleft()
                 self._set_depth()
+                # micro-batching: gather same-key peers (and, under
+                # demonstrated demand, hold the bounded window open for
+                # more) BEFORE the deadline sweep, so members expiring
+                # during formation shed too
+                members = [d]
+                window_close = None
+                if d.batch is not None and self.batch_max > 1:
+                    window_close = self._form_batch_locked(d, members, gen)
+                if self._closed or gen != self._generation:
+                    # closed/restarted mid-formation: our members left
+                    # the queue, so close()'s drain missed them — fail
+                    # their waiters here
+                    victims: List[LaneTicket] = []
+                    closing_err: BaseException = LaneClosedError(
+                        "device lane closed while batch was forming"
+                    )
+                    for m in members:
+                        m.completed = True
+                        m.error = closing_err
+                        if self._by_key.get(m.key) is m:
+                            self._by_key.pop(m.key)
+                        victims.extend(m.waiters)
+                        m.waiters = []
+                    for w in victims:
+                        w._deliver(error=closing_err)
+                    return
                 # deadline shed at lane-dequeue time, mirroring the
                 # scheduler's dequeue check: the broker already failed
                 # over or timed out, so device work for this waiter
-                # would only delay queries that can still make it
+                # would only delay queries that can still make it.  A
+                # member expiring out of a forming batch sheds alone —
+                # its batchmates launch unaffected.
                 now = time.monotonic()
-                live = [w for w in d.waiters if w.deadline is None or now < w.deadline]
-                dead = [w for w in d.waiters if w.deadline is not None and now >= w.deadline]
-                d.waiters = live
-                if not live:
-                    d.completed = True
-                    self._by_key.pop(d.key, None)
-                else:
+                dead = []
+                live_members = []
+                for m in members:
+                    lv = [w for w in m.waiters if w.deadline is None or now < w.deadline]
+                    dd = [w for w in m.waiters if w.deadline is not None and now >= w.deadline]
+                    dead.extend(dd)
+                    m.waiters = lv
+                    if lv:
+                        live_members.append(m)
+                    else:
+                        m.completed = True
+                        if self._by_key.get(m.key) is m:
+                            self._by_key.pop(m.key)
+                members = live_members
+                if members:
                     # watchdog window opens BEFORE the launch call: a
                     # wedge inside the fault injector or the launch
-                    # itself both count as in-flight stalls
-                    self._inflight = (d, now)
+                    # itself both count as in-flight stalls; a batched
+                    # launch is ONE in-flight unit (all members stall
+                    # or complete together)
+                    self._inflight = (members[0], now, tuple(members))
                     self._busy_since = now  # occupancy: device busy
             if dead:
                 self.shed_count += len(dead)
@@ -782,19 +1022,34 @@ class DeviceLane:
                 )
                 for w in dead:
                     w._deliver(error=err)
-            if not live:
+            if not members:
                 continue
+            d = members[0]
+            batched = len(members) > 1
             # launch OUTSIDE the lock: first-call compiles can take
             # seconds and coalescing submits must not block behind them
             t0 = time.perf_counter()
             self._set_inflight(1)
             error: Optional[BaseException] = None
             value: Any = None
+            member_values: List[Any] = []
             try:
                 inj = self.fault_injector
                 if inj is not None:
+                    # one physical launch: the injector sees it once
+                    # (members share the plan digest by construction)
                     inj.on_launch(d.plan_digest, d.key)
-                value = d.launch()
+                if batched:
+                    fetch_b, handle_b = d.batch.launch_batched(
+                        [m.batch.inputs for m in members]
+                    )
+                    shared = _BatchFetch(fetch_b, len(members))
+                    member_values = [
+                        (shared.member(i), handle_b) for i in range(len(members))
+                    ]
+                    value = member_values[0]
+                else:
+                    value = d.launch()
             except Exception as e:  # typed delivery, lane stays alive
                 error = classify_device_error(e)
             except BaseException as e:  # deliver raw, keep the lane alive:
@@ -823,6 +1078,13 @@ class DeviceLane:
                     self.stale_completions += 1
                     return
                 self.dispatch_count += 1
+                if batched:
+                    self.batch_launches += 1
+                    self.batched_queries += len(members)
+                    if window_close == "full":
+                        self.batch_window_full += 1
+                    elif window_close == "timeout":
+                        self.batch_window_timeout += 1
                 if error is None and d.plan_digest is not None:
                     # compile timeline: first successful launch of this
                     # digest measured cold (trace + XLA compile included)
@@ -858,18 +1120,36 @@ class DeviceLane:
                         )
                 if error is not None:
                     self.device_failure_count += 1
-                d.completed = True
-                d.value, d.error = value, error
-                waiters = list(d.waiters)
-                d.waiters = []
-                if error is None and not self._closed and self._still_pending(d):
-                    # program still executing: keep coalescible
-                    self._open.append(d)
-                    self._sweep_open_locked()
-                elif self._by_key.get(d.key) is d:
-                    self._by_key.pop(d.key)
+                deliveries = []
+                for i, m in enumerate(members):
+                    m.completed = True
+                    m.error = error
+                    m.batch_size = len(members)
+                    m.value = (
+                        None
+                        if error is not None
+                        else (member_values[i] if batched else value)
+                    )
+                    waiters = list(m.waiters)
+                    m.waiters = []
+                    deliveries.append((m.value, waiters))
+                    if error is None and not self._closed and self._still_pending(m):
+                        # program still executing: keep coalescible
+                        self._open.append(m)
+                    elif self._by_key.get(m.key) is m:
+                        self._by_key.pop(m.key)
+                self._sweep_open_locked()
             if self.metrics is not None:
                 self._lane_mark("dispatches")
+                if batched:
+                    self.metrics.meter("batch.launches").mark()
+                    self.metrics.meter("batch.queries").mark(len(members))
+                    self.metrics.meter(
+                        {
+                            "full": "batch.windowClosedFull",
+                            "timeout": "batch.windowClosedTimeout",
+                        }.get(window_close, "batch.windowClosedIdle")
+                    ).mark()
                 if error is not None:
                     self._lane_mark("deviceFailures")
                 elif d.plan_digest is not None:
@@ -879,8 +1159,11 @@ class DeviceLane:
                     else:
                         self.metrics.meter("compile.warm").mark()
                 self.metrics.timer("phase.laneDispatch").update(launch_ms)
-            for w in waiters:
-                w._deliver(value=value, error=error)
+            n_members = len(members)
+            for mvalue, waiters in deliveries:
+                for w in waiters:
+                    w.batch_size = n_members
+                    w._deliver(value=mvalue, error=error)
 
 
 class LaneSelection:

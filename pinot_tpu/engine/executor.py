@@ -566,9 +566,6 @@ class QueryExecutor:
         cost: Dict[str, float] = {}  # per-query cost vector accumulator
         q_np = build_query_inputs(request, plan, ctx, staged, scratch=scratch)
         digest = self._inputs_digest(q_np)
-        q_inputs = self._to_device_inputs(
-            q_np, plan=plan, digest=digest, cost=cost, sharding=sharding
-        )
         seg_arrays = segment_arrays(staged, needed)
         block_ids, scanned_rows = self._block_skip_ids(plan, q_np, live, staged)
         from pinot_tpu.engine.kernel import chunk_rows_limit
@@ -583,6 +580,14 @@ class QueryExecutor:
         t0 = self._phase("planBuild", t0)
         # kernel outputs fetch via ONE packed D2H transfer
         # (engine/packing.py): per-leaf fetches pay a tunnel RTT each
+        batch_spec = None
+        analysis_args = None
+
+        def upload_inputs():
+            return self._to_device_inputs(
+                q_np, plan=plan, digest=digest, cost=cost, sharding=sharding
+            )
+
         if block_ids is not None:
             from pinot_tpu.engine.zonemap import zone_block_rows
 
@@ -599,13 +604,33 @@ class QueryExecutor:
                 if sharding is not None
                 else jnp.asarray(block_ids)
             )
-            args = (seg_arrays, q_inputs, ids_dev)
+            args = (seg_arrays, upload_inputs(), ids_dev)
         else:
             kernel = self._kernel(plan, staged, mesh)
-            args = (seg_arrays, q_inputs)
+            if lane is not None and mesh is None and sharding is None:
+                # cross-query micro-batching eligibility: the plain
+                # packed single-device kernel only (no mesh collectives,
+                # no per-query block-id gathers, no chunked dispatch
+                # sequence) — exactly the path _kernel chose above when
+                # the table fits the per-dispatch row budget
+                batch_spec = self._batch_spec(plan, staged, q_np, seg_arrays)
+            if batch_spec is not None:
+                # defer the solo upload into the launch closure: a
+                # dispatch that rides a batched launch never uses its
+                # own device copy (the batch uploads ONE stacked
+                # pytree), so an eager per-member put would be dead H2D
+                # weight exactly on the shapes that batch most
+                args = lambda: (seg_arrays, upload_inputs())
+                # cost analysis traces shapes only: the host numpy
+                # pytree stands in so the helper thread never uploads
+                analysis_args = (seg_arrays, q_np)
+            else:
+                args = (seg_arrays, upload_inputs())
+        exec_info: Dict[str, Any] = {}
         outs = self._run_kernel(
             kernel, args, plan, staged, digest, block_ids, deadline, pdigest,
-            cost=cost, lane=lane,
+            cost=cost, lane=lane, batch_spec=batch_spec, exec_info=exec_info,
+            analysis_args=analysis_args,
         )
         t0 = time.perf_counter()  # laneWait/planExec timed inside _run_kernel
 
@@ -648,6 +673,9 @@ class QueryExecutor:
         # lane index attributes it to the chip group that executed
         result._device_digest = pdigest
         result._lane_index = sel.index if sel is not None else 0
+        # batching actuals for EXPLAIN ANALYZE's device node: how many
+        # same-shape queries this member's launch actually carried
+        result._batch_size = int(exec_info.get("batchSize", 1) or 1)
         self._phase("finalize", t0)
         return result
 
@@ -892,24 +920,93 @@ class QueryExecutor:
                     hll_cols.add(a.column)
         return tuple(sorted(raw_cols)), tuple(sorted(gfwd_cols)), tuple(sorted(hll_cols))
 
+    def _batch_spec(self, plan: StaticPlan, staged, q_np, seg_arrays):
+        """BatchSpec for the lane micro-batching tier (PIMDAL-style
+        cross-query amortization — engine/dispatch.py module
+        docstring): same-StaticPlan dispatches over the same staged
+        table stack their query inputs along a leading batch axis and
+        execute as ONE vmapped launch reading the resident columns
+        once.
+
+        The key is (StaticPlan, staging token, input signature):
+        literal-bucketed program identity (``a>5`` and ``a>999`` build
+        the SAME StaticPlan — only their match tables/bounds differ) x
+        resident-table identity x structural input identity.
+        ``max_members`` keeps batch x rows under the per-dispatch row
+        budget so batching can never blow the compile-time working set
+        the chunked path exists to bound."""
+        from pinot_tpu.engine.dispatch import BatchSpec
+        from pinot_tpu.engine.kernel import chunk_rows_limit
+        from pinot_tpu.engine.packing import batch_input_signature
+
+        limit = chunk_rows_limit()
+        rows = max(1, staged.num_segments * staged.n_pad)
+        if limit:
+            # the launch pads member count UP to a power of two, so the
+            # cap must be the largest power of two whose padded batch
+            # still fits the row budget — a plain floor-divide cap of 5
+            # would pad to 8 and overshoot the budget by ~1.5x
+            cap = limit // rows
+            max_members = 1
+            while max_members * 2 <= cap:
+                max_members *= 2
+        else:
+            max_members = 0
+        if max_members == 1:
+            return None  # one batch member already fills the budget
+        key = (plan, staged.token, batch_input_signature(q_np))
+
+        def launch_batched(inputs_list):
+            from pinot_tpu.engine.device import to_device_inputs
+            from pinot_tpu.engine.kernel import make_packed_batched_table_kernel
+            from pinot_tpu.engine.packing import stack_query_inputs
+
+            bkernel = make_packed_batched_table_kernel(plan)
+            # pad the member count to a power of two (repeat member 0 —
+            # harmless extra lanes whose outputs are never sliced) so
+            # compile count per plan is bounded at log2(BATCH_MAX)
+            # distinct batch shapes instead of one per observed size
+            b = len(inputs_list)
+            b_pad = 1
+            while b_pad < b:
+                b_pad *= 2
+            if b_pad > b:
+                inputs_list = list(inputs_list) + [inputs_list[0]] * (b_pad - b)
+            stacked = stack_query_inputs(inputs_list)
+            # ONE stacked H2D upload for the whole batch (recorded by
+            # to_device_inputs); the per-member device-resident input
+            # cache is bypassed — literals differ per member by design
+            qb = to_device_inputs(stacked)
+            return bkernel.fetch, bkernel.dispatch(seg_arrays, qb)
+
+        return BatchSpec(key, q_np, launch_batched, max_members=max_members)
+
     def _run_kernel(
         self, kernel, args, plan, staged, digest, block_ids, deadline,
         pdigest=None, cost: Optional[Dict[str, float]] = None, lane=None,
+        batch_spec=None, exec_info: Optional[Dict[str, Any]] = None,
+        analysis_args=None,
     ) -> Dict[str, Any]:
         """DISPATCH + output fetch.  Serial mode (no lane): launch and
         fetch inline, the pre-pipeline behavior.  Pipelined: the launch
         runs on the (shape-selected) device lane — coalesced with
-        identical in-flight dispatches — and this worker blocks only
+        identical in-flight dispatches, or micro-batched with same-plan
+        peers when ``batch_spec`` is set — and this worker blocks only
         when FINALIZE first reads the outputs (the packed D2H
-        transfer)."""
+        transfer).  ``args`` may be a zero-arg callable (batch-eligible
+        dispatches defer their solo H2D upload into the launch itself);
+        ``analysis_args`` is the host-shaped stand-in the cost-analysis
+        helper lowers with in that case."""
         if lane is None:
             lane = self.lane
+        cost_args = args if not callable(args) else analysis_args
 
         def launch():
+            a = args() if callable(args) else args
             disp = getattr(kernel, "dispatch", None)
             if disp is not None:
-                return kernel.fetch, disp(*args)
-            return None, kernel(*args)  # raw jit: device arrays out
+                return kernel.fetch, disp(*a)
+            return None, kernel(*a)  # raw jit: device arrays out
 
         t0 = time.perf_counter()
         coalesced = False
@@ -935,7 +1032,8 @@ class QueryExecutor:
                 # static roofline numerator: flops/bytes per launch of
                 # this compiled plan, resolved ONCE per digest on the
                 # lane's async analysis thread (graceful None fallback)
-                cost_provider=lambda: kernel_cost_analysis(kernel, args),
+                cost_provider=lambda: kernel_cost_analysis(kernel, cost_args),
+                batch=batch_spec,
             )
             fetch, handle = ticket.result(deadline)
             # queue + coalesce wait only; the coalesced tag marks a
@@ -944,6 +1042,13 @@ class QueryExecutor:
             t0 = self._phase("laneWait", t0, coalesced=coalesced)
             if cost is not None and coalesced:
                 cost["coalesceHits"] = cost.get("coalesceHits", 0) + 1
+            bsize = int(getattr(ticket, "batch_size", 1) or 1)
+            if exec_info is not None:
+                exec_info["batchSize"] = bsize
+            if cost is not None and bsize > 1:
+                # this query rode a cross-query batched launch (its
+                # literals stacked with bsize-1 same-plan peers)
+                cost["batchHits"] = cost.get("batchHits", 0) + 1
         # exactly ONE waiter per dispatch is non-coalesced, so the
         # physical D2H copy is counted once no matter how many queries
         # rode the dispatch (coalesced waiters read the cached host copy)

@@ -193,10 +193,13 @@ def build_explain_node(
     table: str,
     server_name: str,
     plan_stats=None,
+    result_cache=None,
 ) -> Dict[str, Any]:
     """One server's EXPLAIN plan node (module docstring).  ``executor``
     supplies the decision helpers AND the live poison-quarantine state;
-    ``plan_stats`` (utils/planstats.py) supplies historical estimates."""
+    ``plan_stats`` (utils/planstats.py) supplies historical estimates;
+    ``result_cache`` (engine/rescache.py) answers the device node's
+    cacheHit probe without marking hit/miss meters."""
     total_docs = sum(s.num_docs for s in segments)
     records: List[Dict[str, Any]] = []
     tier_counts: Dict[str, int] = {}
@@ -400,6 +403,39 @@ def build_explain_node(
                                 "no selective tier applies: full vmapped "
                                 "device scan",
                             )
+                    # batching decision record (lane micro-batching
+                    # tier): whether this shape's dispatches would
+                    # stack with same-plan peers, the window/cap that
+                    # governs formation, and whether the result cache
+                    # holds this exact query's answer RIGHT NOW.
+                    # Mirrors the executor's eligibility exactly: the
+                    # plain packed single-device kernel only.
+                    rows_total = phantom.num_segments * phantom.n_pad
+                    cap = 0
+                    if lane is not None and getattr(lane, "batch_max", 0) > 1:
+                        cap = lane.batch_max
+                        if _limit:
+                            cap = min(cap, max(1, _limit // max(rows_total, 1)))
+                    batchable = (
+                        exec_mesh is None
+                        and block_ids is None
+                        and cap > 1
+                        and (not _limit or rows_total <= _limit)
+                    )
+                    device_info["batching"] = {
+                        "batched": batchable,
+                        "batchMax": cap,
+                        "windowMs": (
+                            round(lane.batch_window_s * 1000, 3)
+                            if lane is not None
+                            else 0.0
+                        ),
+                        "cacheHit": (
+                            result_cache.contains(request, segments, table)
+                            if result_cache is not None
+                            else False
+                        ),
+                    }
 
     digest = plan_shape_digest(request)
     estimated: Dict[str, Any] = {

@@ -1230,3 +1230,38 @@ def make_packed_block_table_kernel(plan: StaticPlan, block: int) -> Callable:
     from pinot_tpu.engine.packing import make_packed_kernel
 
     return make_packed_kernel(make_block_table_kernel(plan, block))
+
+
+@functools.lru_cache(maxsize=128)
+def make_packed_batched_table_kernel(plan: StaticPlan) -> Callable:
+    """Cross-query batched variant of the packed table kernel (the
+    lane micro-batching tier, engine/dispatch.py): ONE launch evaluates
+    B same-plan queries over the SAME staged segment arrays, with each
+    query's literals/inputs stacked along a new leading batch axis.
+
+    This is the PIMDAL amortization move for serving: the memory-bound
+    column scan is read ONCE per launch while B operator instances
+    consume it, so same-shape queries that differ only in literals
+    (``a>5`` vs ``a>999`` — one StaticPlan, different query inputs)
+    stop paying B full passes over the resident columns.
+
+    vmap is applied OUTSIDE the per-table function with
+    ``in_axes=(None, 0)``: segment arrays broadcast (never copied per
+    batch member), query-input leaves carry the batch axis, and every
+    output leaf gains a leading ``[B]`` axis the executor slices per
+    member at FINALIZE.  Per-member reductions happen along the same
+    axes as the unbatched kernel, so member b's outputs are the same
+    computation the unbatched launch would have produced — the
+    byte-identity differential in tests/test_batching.py holds the two
+    together.  Outputs fetch via the standard single packed D2H
+    transfer, counted once per batched launch."""
+    single = make_single_segment_kernel(plan)
+    reducers = output_reducers(plan)
+
+    def table_fn(segs: Dict[str, Any], q: Dict[str, Any]) -> Dict[str, Any]:
+        outs = jax.vmap(single)(segs, q)
+        return {k: apply_reduce(reducers[k], v) for k, v in outs.items()}
+
+    from pinot_tpu.engine.packing import make_packed_kernel
+
+    return make_packed_kernel(jax.vmap(table_fn, in_axes=(None, 0)))

@@ -137,6 +137,49 @@ def make_packed_kernel(fn: Callable) -> Callable:
 
 
 # ---------------------------------------------------------------------------
+# Cross-query batching helpers (engine/dispatch.py micro-batching tier):
+# stack B queries' host input pytrees along a new leading axis before the
+# one vmapped launch, and slice one member's outputs back out of the
+# fetched batch.
+# ---------------------------------------------------------------------------
+
+
+def stack_query_inputs(inputs_list):
+    """Stack B structurally-identical numpy query-input pytrees into one
+    pytree whose ndarray leaves lead with the batch axis.  Callers
+    guarantee structural identity (same StaticPlan => same treedef and
+    leaf shapes — the batch key enforces it); non-array leaves must be
+    equal across members and pass through unstacked."""
+    leaves0, treedef = jax.tree_util.tree_flatten(inputs_list[0])
+    stacked = []
+    columns = [jax.tree_util.tree_flatten(t)[0] for t in inputs_list]
+    for i, leaf in enumerate(leaves0):
+        if isinstance(leaf, np.ndarray):
+            stacked.append(np.stack([col[i] for col in columns]))
+        else:
+            stacked.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, stacked)
+
+
+def batch_input_signature(inputs) -> tuple:
+    """Hashable (shape, dtype) signature of a query-input pytree — the
+    belt-and-braces component of the lane batch key: two dispatches
+    stack only when their leaves agree exactly."""
+    return tuple(
+        (tuple(leaf.shape), str(leaf.dtype))
+        if isinstance(leaf, np.ndarray)
+        else ("scalar", repr(leaf))
+        for leaf in jax.tree_util.tree_leaves(inputs)
+    )
+
+
+def slice_batched_outputs(outs, index: int):
+    """Member ``index``'s output pytree from a batched launch's fetched
+    host outputs (every array leaf leads with the batch axis)."""
+    return jax.tree_util.tree_map(lambda x: x[index], outs)
+
+
+# ---------------------------------------------------------------------------
 # Static XLA cost analysis (the utilization plane's "paper roofline"
 # numerator): flops + bytes-accessed estimates per compiled plan.
 # ---------------------------------------------------------------------------

@@ -84,6 +84,43 @@ def plan_shape_digest(request: BrokerRequest) -> str:
     ).hexdigest()
 
 
+def plan_literals(request: BrokerRequest) -> tuple:
+    """The literal complement of ``plan_shape``: every value the shape
+    erased, in deterministic walk order — filter leaf value lists,
+    having bounds, and the debug options that can steer execution.
+    ``plan_shape(request) + plan_literals(request)`` together identify
+    the full query text semantically, which is exactly what the
+    ingest-aware result cache (engine/rescache.py) keys on:
+    (segment set + staging tokens, plan digest, literal values)."""
+    lits = []
+    if request.filter is not None:
+        for node in request.filter.walk():
+            if node.is_leaf:
+                # RANGE bounds live in range_spec, not values — a
+                # literal digest blind to them would collide `a>5`
+                # with `a>999` (tests/test_batching.py regression)
+                rng = None
+                if node.range_spec is not None:
+                    r = node.range_spec
+                    rng = (r.lower, r.upper, r.include_lower, r.include_upper)
+                lits.append(
+                    (node.column, node.operator.value, tuple(node.values), rng)
+                )
+    having = None
+    if request.having is not None:
+        having = request.having.value
+    opts = tuple(sorted((request.query_options or {}).items()))
+    dbg = tuple(sorted((request.debug_options or {}).items()))
+    return (tuple(lits), having, opts, dbg)
+
+
+def plan_literal_digest(request: BrokerRequest) -> str:
+    """Stable 16-hex-char digest of the literal tuple."""
+    return hashlib.blake2b(
+        repr(plan_literals(request)).encode(), digest_size=8
+    ).hexdigest()
+
+
 def plan_shape_summary(request: BrokerRequest) -> str:
     """Short human label for a digest ("what shape is this?"), rendered
     on /debug/plans, /debug/workload, and the controller dashboard."""

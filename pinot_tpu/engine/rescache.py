@@ -1,0 +1,269 @@
+"""Ingest-aware per-server result cache.
+
+A result cache on a realtime datastore is only safe if it can PROVE a
+cached answer is as fresh as a re-execution.  Two fences provide that
+proof, and both must hold:
+
+1. **Key fence (correctness):** entries key on
+   ``(plan-shape digest, literal digest, ((segment, staging token), …))``
+   — the full semantic query identity (engine/plandigest.py: shape +
+   literals) times the exact *resident data generation* it ran over.
+   Staging tokens are process-unique per segment instance
+   (segment/immutable.py): a consuming MutableSegment mints a NEW
+   snapshot (new token) the moment its watermark advances, and a
+   reloaded/replaced immutable copy gets a new token too.  A lookup for
+   fresher data therefore computes a DIFFERENT key and can never match
+   a stale entry — serving a stale realtime answer is structurally
+   impossible, not merely unlikely.
+
+2. **Offset fence (eagerness):** the key fence alone would leave dead
+   entries pinned until LRU pressure.  The LLC consumers
+   (realtime/llc.py + the networked RemoteConsumer) call
+   ``on_offset_advance`` whenever a partition's consume/commit offset
+   moves, and segment add/remove calls ``invalidate_table`` — stale
+   entries are dropped the moment the data that produced them is
+   superseded (``rescache.staleEvictions``), so memory tracks the live
+   working set and the hit-rate meters stay honest.
+
+Entries are stored PICKLED: a hit deserializes a fresh
+``IntermediateResult`` (no shared mutable state with past or future
+readers, and the payload a hit produces is byte-identical to what the
+stored execution produced) and replaces its cost vector with
+``{"rescacheHits": 1}`` — a cache hit marks ZERO device/host work by
+construction, which the acceptance test asserts.
+
+Opt-in: ``PINOT_TPU_RESULT_CACHE=1`` (default off, matching the
+reference ecosystem's posture — e.g. ClickHouse's query cache —
+because a result cache changes observable execution counts even when
+payloads are identical).  ``PINOT_TPU_RESCACHE_N`` /
+``PINOT_TPU_RESCACHE_BYTES`` bound the LRU.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Hashable, Optional, Sequence, Tuple
+
+from pinot_tpu.engine.plandigest import (
+    _raw_table,
+    plan_literal_digest,
+    plan_shape_digest,
+)
+
+
+def cache_enabled() -> bool:
+    return os.environ.get("PINOT_TPU_RESULT_CACHE", "0") not in ("0", "", "false")
+
+
+def _max_entries() -> int:
+    try:
+        return int(os.environ.get("PINOT_TPU_RESCACHE_N", "512"))
+    except ValueError:
+        return 512
+
+
+def _max_bytes() -> int:
+    try:
+        return int(os.environ.get("PINOT_TPU_RESCACHE_BYTES", str(64 << 20)))
+    except ValueError:
+        return 64 << 20
+
+
+class ResultCache:
+    """Bounded LRU of pickled ``IntermediateResult`` payloads (module
+    docstring).  Thread-safe: the scheduler worker pool reads and
+    writes concurrently; LLC consumer threads invalidate."""
+
+    def __init__(
+        self,
+        metrics=None,
+        enabled: Optional[bool] = None,
+        max_entries: Optional[int] = None,
+        max_bytes: Optional[int] = None,
+    ) -> None:
+        self.enabled = cache_enabled() if enabled is None else bool(enabled)
+        self.max_entries = _max_entries() if max_entries is None else int(max_entries)
+        self.max_bytes = _max_bytes() if max_bytes is None else int(max_bytes)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        # key -> (payload bytes, raw table, nbytes)
+        self._entries: "OrderedDict[Hashable, Tuple[bytes, str, int]]" = OrderedDict()
+        self._bytes = 0
+        if metrics is not None:
+            # pre-registered at construction (scrape gap != "no cache")
+            for m in (
+                "rescache.hits",
+                "rescache.misses",
+                "rescache.puts",
+                "rescache.invalidations",
+                "rescache.staleEvictions",
+            ):
+                metrics.meter(m)
+            metrics.gauge("rescache.entries").set_fn(self.entry_count)
+            metrics.gauge("rescache.bytes").set_fn(lambda: self._bytes)
+            metrics.gauge("rescache.enabled").set(1 if self.enabled else 0)
+
+    # -- keying --------------------------------------------------------
+    @staticmethod
+    def key_for(request, views: Sequence[Any], table: str) -> Optional[Hashable]:
+        """Cache key for a parsed request over the exact segment views
+        being served, or None when the query is uncacheable (EXPLAIN
+        modes).  Traced requests ARE cacheable — the tail sampler
+        (PR 11) arms tracing on every query, so excluding them would
+        disable the cache outright; stored entries carry no trace (put
+        strips it) and a hit records a ``rescacheHit`` event on the
+        live span tree instead.  The view tuple is sorted by name so
+        routing order can't fork one logical cover into several keys."""
+        if request.explain is not None:
+            return None
+        try:
+            fence = tuple(
+                sorted(
+                    (v.segment_name, int(v.staging_token)) for v in views
+                )
+            )
+        except (AttributeError, TypeError):
+            return None  # a view without token identity is uncacheable
+        return (
+            _raw_table(table),
+            plan_shape_digest(request),
+            plan_literal_digest(request),
+            fence,
+        )
+
+    # -- read/write ----------------------------------------------------
+    def _mark(self, name: str, n: int = 1) -> None:
+        if self.metrics is not None and n:
+            self.metrics.meter(name).mark(n)
+
+    def get(self, key: Hashable):
+        """A fresh ``IntermediateResult`` clone for the key, or None.
+        The clone's cost vector is exactly ``{"rescacheHits": 1}`` —
+        zero device work, one attributed cache hit."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+        if entry is None:
+            self._mark("rescache.misses")
+            return None
+        result = pickle.loads(entry[0])
+        result.cost = {"rescacheHits": 1}
+        self._mark("rescache.hits")
+        return result
+
+    def put(self, key: Hashable, result) -> None:
+        """Store a successful execution's result.  Callers must only
+        pass complete, exception-free results (no partial covers) —
+        cached partial answers would replay an outage after it healed.
+        The stored copy carries NO trace: replaying one query's span
+        tree under another's requestId would be a lie."""
+        saved_trace = result.trace
+        try:
+            result.trace = {}
+            payload = pickle.dumps(result, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:
+            return  # an unpicklable result is simply not cacheable
+        finally:
+            result.trace = saved_trace
+        nbytes = len(payload)
+        if nbytes > max(1, self.max_bytes) // 4:
+            return  # one oversized answer must not churn the whole LRU
+        raw = key[0] if isinstance(key, tuple) and key else ""
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[2]
+            self._entries[key] = (payload, str(raw), nbytes)
+            self._bytes += nbytes
+            while self._entries and (
+                self._bytes > self.max_bytes or len(self._entries) > self.max_entries
+            ):
+                _, (_, _, old_bytes) = self._entries.popitem(last=False)
+                self._bytes -= old_bytes
+        self._mark("rescache.puts")
+
+    # -- invalidation (the offset fence) -------------------------------
+    def invalidate_table(self, table: str, reason: str = "segments") -> int:
+        """Drop every entry for ``table`` (raw name; physical
+        ``_OFFLINE``/``_REALTIME`` suffixes stripped).  Returns the
+        number of entries evicted.  ``reason`` is attribution only —
+        "offset" marks LLC watermark advancement, "segments" a segment
+        set change."""
+        raw = _raw_table(table)
+        dropped = 0
+        with self._lock:
+            victims = [k for k, e in self._entries.items() if e[1] == raw]
+            for k in victims:
+                _, _, nbytes = self._entries.pop(k)
+                self._bytes -= nbytes
+                dropped += 1
+        if dropped or reason == "offset":
+            self._mark("rescache.invalidations")
+        self._mark("rescache.staleEvictions", dropped)
+        return dropped
+
+    def on_offset_advance(self, table: str, partition: int, offset: int) -> int:
+        """LLC watermark hook: a partition's consume/commit offset
+        advanced, so every cached answer over this table's previous
+        watermark is superseded.  (The key fence already makes those
+        entries unreachable — this drops them eagerly.)"""
+        return self.invalidate_table(table, reason="offset")
+
+    def contains(self, request, views: Sequence[Any], table: str) -> bool:
+        """EXPLAIN probe: would this exact query over these exact views
+        hit right now?  Ignores the request's explain mode (the probe
+        asks about the EXECUTABLE twin) and marks no hit/miss meters —
+        EXPLAIN must never skew the hit-rate series."""
+        if not self.enabled:
+            return False
+        try:
+            fence = tuple(
+                sorted((v.segment_name, int(v.staging_token)) for v in views)
+            )
+        except (AttributeError, TypeError):
+            return False
+        key = (
+            _raw_table(table),
+            plan_shape_digest(request),
+            plan_literal_digest(request),
+            fence,
+        )
+        with self._lock:
+            return key in self._entries
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    # -- observability -------------------------------------------------
+    def entry_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            entries = len(self._entries)
+            nbytes = self._bytes
+        out = {
+            "enabled": self.enabled,
+            "entries": entries,
+            "bytes": nbytes,
+            "maxEntries": self.max_entries,
+            "maxBytes": self.max_bytes,
+        }
+        if self.metrics is not None:
+            for short, name in (
+                ("hits", "rescache.hits"),
+                ("misses", "rescache.misses"),
+                ("puts", "rescache.puts"),
+                ("invalidations", "rescache.invalidations"),
+                ("staleEvictions", "rescache.staleEvictions"),
+            ):
+                out[short] = self.metrics.meter(name).count
+            denom = out["hits"] + out["misses"]
+            out["hitRate"] = round(out["hits"] / denom, 4) if denom else 0.0
+        return out

@@ -233,6 +233,12 @@ GroupKey = Tuple[str, ...]
 #   coalesceHits       queries served by riding an identical in-flight
 #                      device dispatch (engine/dispatch.py)
 #   qinputCacheHits    device-resident query-input cache hits
+#   batchHits          queries that rode a cross-query batched launch
+#                      (literals stacked with same-plan peers into one
+#                      vmapped kernel — the lane micro-batching tier)
+#   rescacheHits       queries answered from the ingest-aware result
+#                      cache (engine/rescache.py) — a hit marks ZERO
+#                      device/host work by construction
 #   segmentsPruned     segments dropped by metadata pruning (pruner.py)
 #   segmentsPostings   segments answered from host postings (invindex)
 #   segmentsZonemap    segments scanned via the zone-map block kernel
@@ -247,6 +253,8 @@ COST_KEYS = (
     "deviceBytes",
     "coalesceHits",
     "qinputCacheHits",
+    "batchHits",
+    "rescacheHits",
     "segmentsPruned",
     "segmentsPostings",
     "segmentsZonemap",

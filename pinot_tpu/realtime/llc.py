@@ -833,6 +833,16 @@ class RealtimeSegmentDataManager:
         if n and self._metrics is not None:
             self._metrics.meter("ingest.rowsConsumed").mark(int(n))
 
+    def _notify_offset_advance(self) -> None:
+        """Result-cache watermark hook (engine/rescache.py): the
+        consume offset moved, so every cached answer over this table's
+        previous watermark is superseded — drop it eagerly.  The
+        cache's staging-token key fence already made those entries
+        unreachable; this keeps memory and hit-rate honest."""
+        cache = getattr(self.server, "result_cache", None)
+        if cache is not None and cache.enabled:
+            cache.on_offset_advance(self.table, self.partition, self.offset)
+
     # -- consumption ---------------------------------------------------
     def _fetch_and_index(self, limit: int) -> int:
         """One fetch + index against the stream, preferring the
@@ -886,12 +896,16 @@ class RealtimeSegmentDataManager:
                 self.offset = next_offset
                 self.mutable.end_offset = next_offset
                 self._mark_rows(n)
+                self._notify_offset_advance()
                 return n
         rows, next_offset = self.stream.fetch(self.partition, self.offset, limit)
         self.mutable.index_batch(rows)
+        advanced = next_offset != self.offset
         self.offset = next_offset
         self.mutable.end_offset = next_offset
         self._mark_rows(len(rows))
+        if advanced:
+            self._notify_offset_advance()
         return len(rows)
 
     def consume_step(self, max_rows: int = 1000) -> int:

@@ -214,6 +214,14 @@ class ServerInstance:
         for m in ("plan.recorded", "plan.explains"):
             self.metrics.meter(m)
         self.metrics.gauge("plan.digests").set_fn(self.plan_stats.digest_count)
+        # ingest-aware result cache (engine/rescache.py, opt-in via
+        # PINOT_TPU_RESULT_CACHE=1): keyed on (plan shape digest,
+        # literal digest, segment set + staging tokens) so a stale
+        # realtime answer is structurally unreachable, and invalidated
+        # eagerly by LLC offset advancement + segment set changes
+        from pinot_tpu.engine.rescache import ResultCache
+
+        self.result_cache = ResultCache(metrics=self.metrics)
         for k in self._TIER_KEYS:
             self.metrics.meter(f"cost.tier.{k}")
         # device utilization & profiling plane (PR 10): occupancy +
@@ -458,11 +466,15 @@ class ServerInstance:
 
             inject_default_columns(segment, schema)
         self.data_manager.add_segment(table, segment)
+        # segment set changed: cached answers over the old cover are
+        # superseded (the staleness fence's segment-lifecycle edge)
+        self.result_cache.invalidate_table(self._raw_table(table))
 
     def remove_segment(self, table: str, name: str) -> None:
         tdm = self.data_manager.table(table)
         if tdm is not None:
             tdm.remove_segment(name)
+        self.result_cache.invalidate_table(self._raw_table(table))
 
     def record_crc_failure(self, table: str, name: str) -> None:
         """A disk copy failed its integrity check (load or fetch)."""
@@ -702,6 +714,7 @@ class ServerInstance:
             "hbm": hbm,
             "device": self.device_utilization(),
             "ingest": self.ingest_backpressure.snapshot(),
+            "rescache": self.result_cache.snapshot(),
             "plans": self.plan_stats.snapshot(top=20),
             "metrics": self.metrics.snapshot(),
         }
@@ -866,6 +879,7 @@ class ServerInstance:
                         node = build_explain_node(
                             self.executor, views, request, req["table"],
                             self.name, plan_stats=self.plan_stats,
+                            result_cache=self.result_cache,
                         )
                     node["mode"] = "plan"
                     self.metrics.meter("plan.explains").mark()
@@ -874,10 +888,29 @@ class ServerInstance:
                         plan_info=[node],
                     )
                 else:
-                    with trace.span("planAndExecute", segments=len(acquired)):
-                        result = self.executor.execute(
-                            views, request, deadline=deadline
-                        )
+                    # ingest-aware result cache: the key covers the
+                    # exact staged data generation (segment names +
+                    # process-unique staging tokens), so a hit is
+                    # provably as fresh as re-executing — and costs
+                    # zero device work.  Traced/EXPLAIN requests and
+                    # partial covers bypass (key_for + the missing
+                    # guard); results with exceptions are never stored.
+                    ckey = None
+                    cache = self.result_cache
+                    if cache.enabled and not missing:
+                        ckey = cache.key_for(request, views, req["table"])
+                    result = cache.get(ckey) if ckey is not None else None
+                    if result is not None:
+                        # the hit executed nothing: the live span tree
+                        # records the verdict instead of phase spans
+                        trace.event("rescacheHit")
+                    else:
+                        with trace.span("planAndExecute", segments=len(acquired)):
+                            result = self.executor.execute(
+                                views, request, deadline=deadline
+                            )
+                        if ckey is not None and not result.exceptions:
+                            cache.put(ckey, result)
                     if request.explain == "analyze":
                         # EXPLAIN ANALYZE: the prediction is built AFTER
                         # execution (so quarantine/compile state reflects
@@ -894,10 +927,23 @@ class ServerInstance:
                         node = build_explain_node(
                             self.executor, views, request, req["table"],
                             self.name, plan_stats=self.plan_stats,
+                            result_cache=self.result_cache,
                         )
                         node["mode"] = "analyze"
                         node["actualCost"] = _json_safe(dict(result.cost))
                         node["actualDocsScanned"] = int(result.num_docs_scanned)
+                        dev_node = node.get("device")
+                        if isinstance(dev_node, dict) and "batching" in dev_node:
+                            # batching ACTUAL off this very execution:
+                            # how many same-shape peers the launch
+                            # carried.  (No actualCacheHit field:
+                            # ANALYZE always executes — the cache is
+                            # keyed off for explain modes — so the
+                            # standing-entry probe `cacheHit` is the
+                            # honest cache signal here.)
+                            dev_node["batching"]["actualBatchSize"] = int(
+                                getattr(result, "_batch_size", 1) or 1
+                            )
                         result.plan_info = [node]
                 result.unserved_segments = missing
             finally:

@@ -280,10 +280,17 @@ class RemoteConsumer:
             budget = self._governor.clamp_batch(budget)
         rows, next_offset = self.stream.fetch(self.partition, self.offset, budget)
         self.mutable.index_batch(rows)
+        advanced = next_offset != self.offset
         self.offset = next_offset
         self.mutable.end_offset = next_offset
         if rows and self._metrics is not None:
             self._metrics.meter("ingest.rowsConsumed").mark(len(rows))
+        if advanced:
+            # result-cache watermark hook (engine/rescache.py): cached
+            # answers over the previous consume offset are superseded
+            cache = getattr(self.starter.server, "result_cache", None)
+            if cache is not None and cache.enabled:
+                cache.on_offset_advance(self.table, self.partition, self.offset)
         return len(rows)
 
     def _run(self) -> None:

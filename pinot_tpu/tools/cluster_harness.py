@@ -563,6 +563,8 @@ def run_noisy_neighbor_scenario(
     flood_s: float = 2.5,
     max_pending: int = 16,
     data_dir: Optional[str] = None,
+    p99_floor_ms: float = 25.0,
+    p99_multiple: float = 3.0,
 ) -> Dict[str, Any]:
     """Tenant A floods its table while tenant B runs a steady closed
     loop.  The overload plane must contain A end to end:
@@ -626,8 +628,11 @@ def run_noisy_neighbor_scenario(
         baseline_p99 = baseline["p99Ms"]
         loaded_p99 = b_summary["p99Ms"]
         # absolute floor absorbs scheduler jitter on a near-zero
-        # baseline: 3x of 2ms is not a meaningful isolation bar
-        p99_limit = 3.0 * max(baseline_p99, 25.0)
+        # baseline: 3x of 2ms is not a meaningful isolation bar.
+        # Callers on CPU-starved boxes (the 2-core CI container under
+        # full-suite load) widen floor/multiple rather than compare
+        # wall clock against a baseline measured in a quieter window.
+        p99_limit = p99_multiple * max(baseline_p99, p99_floor_ms)
         offered_qps = a_summary["queries"] / max(flood_s, 1e-9)
         return {
             "scenario": "noisy-neighbor",

@@ -24,11 +24,12 @@ anyway (ratio semantics survive a platform change poorly; use only for
 exploration).
 
 Serving-mode documents (``PINOT_TPU_BENCH_MODE=serving``) gate their
-own namespace — saturation QPS, pipelined-vs-serial speedup, the
-ISSUE 10 utilization fields (lane busy-fraction, achieved device
-bytes/s, D2H volume), and the ISSUE 11 sampling-overhead ratio (QPS
-with the always-on tail sampler vs sampling off) against the committed
-``SERVING_UTIL_r11.json`` — with the same direction-aware bands and
+own namespace — saturation QPS across serial/pipelined/cached configs,
+the ISSUE 10 utilization fields (lane busy-fraction, achieved device
+bytes/s, D2H volume), the ISSUE 11 sampling-overhead ratio (QPS with
+the always-on tail sampler vs sampling off), and the ISSUE 13 batching
+occupancy + result-cache hit rate against the committed
+``SERVING_BATCH_r13.json`` — with the same direction-aware bands and
 config-mismatch SKIP.  Multichip-mode documents
 (``PINOT_TPU_BENCH_MODE=multichip``, the mesh execution plane) gate
 per-config rows/s, the sharded-vs-single speedup, and per-lane
@@ -98,11 +99,26 @@ SERVING_METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     # rides the standard saturation band.
     "sampling_overhead.qpsRatio": ("higher", 0.60),
     "sampling_overhead.samplingOnQps": ("higher", 0.40),
+    # cross-query batching + result cache (ISSUE 13): the batched
+    # fraction and average batch size prove batches actually form on
+    # the literal-mix ladder (a collapse means the tier silently
+    # disengaged), the cache hit rate proves the ingest-aware cache
+    # still serves repeats, and the cached-config ok-QPS rides the
+    # same saturation bands as the other configs.  All absent in
+    # pre-r13 baselines — the gate skips absent metrics.
+    "saturation_qps_repeated_q1.cached": ("higher", 0.40),
+    "saturation_qps_mixed.cached": ("higher", 0.40),
+    "saturation_qps_literal_mix.cached": ("higher", 0.40),
+    "saturation_qps_literal_mix.pipelined": ("higher", 0.40),
+    "saturation_qps_literal_mix.serial": ("higher", 0.40),
+    "batching.avgBatchSize": ("higher", 0.50),
+    "batching.batchedQueryFraction": ("higher", 0.50),
+    "rescache.hitRate": ("higher", 0.50),
 }
 
 SERVING_CONFIG_KEYS = ("total_rows", "num_segments", "platform")
 
-SERVING_DEFAULT_BASELINE = "SERVING_UTIL_r11.json"
+SERVING_DEFAULT_BASELINE = "SERVING_BATCH_r13.json"
 
 # multichip-mode documents (PINOT_TPU_BENCH_MODE=multichip, the mesh
 # execution plane): per-execution-config scan-heavy rows/s, the

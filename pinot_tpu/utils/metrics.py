@@ -471,6 +471,31 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "mesh.lanes": "chip-group lanes this server serves with",
     "mesh.devices": "devices across every chip group",
     "mesh.devicesPerLane": "chips per lane group (mesh shape)",
+    # cross-query micro-batching tier (engine/dispatch.py BatchSpec):
+    # same-plan distinct-literal dispatches stacked into one vmapped
+    # launch; occupancy = batch.queries / batch.launches
+    "batch.launches": "batched kernel launches (>= 2 members stacked)",
+    "batch.queries": "queries carried by batched launches (members)",
+    "batch.windowClosedFull": "batch windows closed by reaching the "
+    "member cap (PINOT_TPU_BATCH_MAX / the per-plan row-budget cap)",
+    "batch.windowClosedTimeout": "batch windows closed by the bounded "
+    "formation window expiring (PINOT_TPU_BATCH_WINDOW_MS)",
+    "batch.windowClosedIdle": "batches launched without a window wait "
+    "(peers already queued; the lane never idles waiting for demand)",
+    # ingest-aware result cache (engine/rescache.py; opt-in via
+    # PINOT_TPU_RESULT_CACHE=1)
+    "rescache.hits": "queries answered from the result cache (zero "
+    "device/host work, freshness fenced by staging tokens)",
+    "rescache.misses": "cacheable queries that executed (and stored)",
+    "rescache.puts": "results stored into the cache",
+    "rescache.invalidations": "invalidation events (LLC offset "
+    "advancement or segment set change)",
+    "rescache.staleEvictions": "cached entries dropped because the "
+    "data that produced them was superseded (staleness fence)",
+    "rescache.entries": "result-cache entries currently resident",
+    "rescache.bytes": "bytes pinned by resident result-cache entries",
+    "rescache.enabled": "1 while the result cache is enabled "
+    "(PINOT_TPU_RESULT_CACHE)",
     # cost-accounting plane: per-query cost totals on this server
     "cost.docsScanned": "documents scanned by queries on this server",
     "cost.bytesScanned": "column bytes touched by queries on this server",

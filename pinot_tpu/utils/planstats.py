@@ -219,9 +219,27 @@ class PlanStatsStore:
                 "device": self._tier_latency(e.device_lat),
                 "host": self._tier_latency(e.host_lat),
             },
+            # cross-query batching + result-cache per-shape view (from
+            # the additive cost sums, so it reconciles with the merged
+            # cost vectors by construction): batchRate answers "does
+            # this shape actually batch?" on /debug/plans and the
+            # broker's /debug/workload top-K
+            "batching": self._batching(e),
             "roofline": self._roofline(e),
             "firstSeen": round(e.first_seen, 3),
             "lastSeen": round(e.last_seen, 3),
+        }
+
+    @staticmethod
+    def _batching(e: _Entry) -> Dict[str, Any]:
+        batched = int(e.cost.get("batchHits", 0) or 0)
+        cached = int(e.cost.get("rescacheHits", 0) or 0)
+        n = max(e.count, 1)
+        return {
+            "batchedQueries": batched,
+            "batchRate": round(batched / n, 4),
+            "cacheHits": cached,
+            "cacheHitRate": round(cached / n, 4),
         }
 
     @staticmethod

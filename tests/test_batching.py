@@ -1,0 +1,477 @@
+"""Cross-query vectorized batching + ingest-aware result cache (ISSUE 13).
+
+Covers the acceptance contracts:
+
+- batched execution is byte-identical to unbatched across the bench
+  shape mix (same-plan distinct-literal queries stacked into one
+  vmapped launch);
+- batch window close/fill semantics (idle close, cap fill, member cap);
+- a result-cache hit returns the identical payload with ZERO device
+  work in the cost vector;
+- a cached realtime entry is dropped the moment the covering LLC
+  consume offset advances (stale answer impossible);
+- a deadline-expired query sheds out of a forming batch without
+  poisoning its batchmates;
+- a poisoned batched plan host-heals EVERY member byte-identically.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.dispatch import BatchSpec, DeviceLane
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.server.scheduler import QueryAbandonedError
+from pinot_tpu.tools.cluster_harness import single_server_broker
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+from pinot_tpu.utils.metrics import ServerMetrics
+
+
+def _payload(resp) -> str:
+    """Canonical payload for differentials: everything except wall
+    clock, the broker-assigned requestId, and the (path-dependent)
+    cost vector — the same exclusions every differential suite uses."""
+    return json.dumps(
+        {
+            k: v
+            for k, v in resp.to_json().items()
+            if k not in ("timeUsedMs", "requestId", "cost")
+        },
+        sort_keys=True,
+    )
+
+
+def _build_stack(pipeline: bool = True, **kwargs):
+    schema = make_test_schema(with_mv=False)
+    rows = random_rows(schema, 4000, seed=9)
+    segs = [
+        build_segment(schema, rows[:2000], "testTable", "bt0"),
+        build_segment(schema, rows[2000:], "testTable", "bt1"),
+    ]
+    return single_server_broker("testTable", segs, pipeline=pipeline, **kwargs)
+
+
+# dimInt values span ~240..9300 at cardinality 20 (datagen), so these
+# literals genuinely partition the data — distinct inputs, one plan
+def _literal_ladder(shape: str):
+    return [shape.format(t=t) for t in (1000, 2300, 4800, 6500)]
+
+
+# the bench shape mix, parameterized by a literal each: filtered
+# scalar aggs, filtered group-by, distinct-count group-by, selection
+BATCH_SHAPES = [
+    "SELECT sum(metInt), count(*) FROM testTable WHERE dimInt > {t}",
+    "SELECT sum(metFloat), max(metInt) FROM testTable WHERE dimInt > {t} GROUP BY dimStr TOP 5",
+    "SELECT distinctcount(dimLong) FROM testTable WHERE dimInt > {t} GROUP BY dimStr TOP 5",
+    "SELECT dimStr, metInt FROM testTable WHERE dimInt > {t} ORDER BY metInt DESC LIMIT 7",
+]
+
+
+def _run_concurrently_batched(broker, queries, settle_s: float = 0.8):
+    """Fire ``queries`` concurrently while the lane is blocked so they
+    queue as distinct same-plan dispatches, then release — the lane's
+    dequeue gathers them into batched launches."""
+    server = broker.local_servers[0]
+    gate = threading.Event()
+    server.lane.submit(("blocker", time.monotonic()), lambda: gate.wait(15))
+    time.sleep(0.05)
+    results = {}
+    errs = []
+
+    def run(q):
+        try:
+            results[q] = broker.handle_pql(q)
+        except Exception as e:  # pragma: no cover - fail loudly below
+            errs.append((q, e))
+
+    threads = [threading.Thread(target=run, args=(q,)) for q in queries]
+    for t in threads:
+        t.start()
+    time.sleep(settle_s)  # let every PREP finish and queue on the lane
+    gate.set()
+    for t in threads:
+        t.join()
+    assert not errs, errs[:1]
+    return results
+
+
+@pytest.mark.parametrize("shape", BATCH_SHAPES, ids=["agg", "groupby", "distinct", "select"])
+def test_batched_matches_unbatched_payloads(shape):
+    """Byte-identity differential: same-plan distinct-literal queries
+    forced through one batched launch serve payloads identical to the
+    serial (unbatched, no-lane) executor — and batches actually
+    formed (the counters prove it, not just absence of errors)."""
+    serial = _build_stack(pipeline=False)
+    pipelined = _build_stack(pipeline=True)
+    queries = _literal_ladder(shape)
+    # warm staging + compile on one literal so formation isn't skewed
+    # by a cold compile holding the lane
+    for b in (serial, pipelined):
+        r = b.handle_pql(queries[0])
+        assert not r.exceptions, r.exceptions
+
+    results = _run_concurrently_batched(pipelined, queries)
+    server = pipelined.local_servers[0]
+    stats = server.lane.stats()
+    assert stats["batchLaunches"] >= 1, stats
+    assert stats["batchedQueries"] >= 2, stats
+    batched_hits = 0
+    for q in queries:
+        resp = results[q]
+        assert not resp.exceptions, (q, resp.exceptions)
+        assert _payload(serial.handle_pql(q)) == _payload(resp), q
+        batched_hits += int(resp.cost.get("batchHits", 0))
+    assert batched_hits >= 2  # the differential exercised real batches
+
+
+def test_distinct_literals_produce_distinct_results():
+    """Guard against the batching tier ever collapsing distinct
+    literals into one answer: the ladder's results must differ."""
+    pipelined = _build_stack(pipeline=True)
+    queries = _literal_ladder(BATCH_SHAPES[0])
+    r = pipelined.handle_pql(queries[0])
+    assert not r.exceptions
+    results = _run_concurrently_batched(pipelined, queries)
+    answers = {
+        json.dumps(results[q].to_json().get("aggregationResults"), sort_keys=True)
+        for q in queries
+    }
+    assert len(answers) == len(queries)
+
+
+# ------------------------------------------------------ lane-unit tier
+def _fake_spec(key, val, calls=None):
+    """BatchSpec whose batched launch doubles each member's value —
+    members must each get THEIR value back, doubled."""
+
+    def launch_batched(inputs_list):
+        if calls is not None:
+            calls.append([x["v"] for x in inputs_list])
+        arr = np.array([x["v"] for x in inputs_list], dtype=np.int64)
+
+        def fetch(handle, count_transfer=True):
+            return {"v": arr * 2}
+
+        return fetch, object()
+
+    return BatchSpec(key, {"v": val}, launch_batched)
+
+
+def _member_result(ticket, deadline=None):
+    fetch, handle = ticket.result(deadline)
+    return fetch(handle)["v"]
+
+
+def test_batch_fills_queued_peers_and_respects_cap():
+    """All queued same-key dispatches stack into one launch up to the
+    member cap; overflow launches as the NEXT batch — and each member
+    receives its own sliced output."""
+    lane = DeviceLane(metrics=ServerMetrics("t"))
+    lane.batch_max = 3
+    lane.batch_window_s = 0.0
+    calls = []
+    gate = threading.Event()
+    lane.submit(("blocker",), lambda: gate.wait(10))
+    time.sleep(0.05)
+    tickets = [
+        lane.submit(
+            ("q", i),
+            lambda i=i: ("unbatched", i),
+            batch=_fake_spec("K", i, calls),
+        )
+        for i in range(5)
+    ]
+    gate.set()
+    vals = [_member_result(t, time.monotonic() + 10) for t in tickets]
+    assert vals == [0, 2, 4, 6, 8]
+    assert [len(c) for c in calls] == [3, 2]  # cap fill, then remainder
+    assert lane.batch_launches == 2
+    assert lane.batched_queries == 5
+    assert lane.batch_window_full >= 1
+    assert all(t.batch_size in (2, 3) for t in tickets)
+    lane.close()
+
+
+def test_single_batchable_dispatch_closes_idle_without_batching():
+    """An idle lane launches a lone batchable dispatch immediately via
+    its own (unbatched) launch — batching never adds latency or a
+    vmapped recompile to a quiet server."""
+    lane = DeviceLane()
+    t = lane.submit(("q", 0), lambda: "direct", batch=_fake_spec("K", 0))
+    assert t.result(time.monotonic() + 10) == "direct"
+    assert lane.batch_launches == 0
+    assert t.batch_size == 1
+    lane.close()
+
+
+def test_batch_keys_partition_batches():
+    """Different batch keys never stack: two shapes queued together
+    launch as two batches (or singles), each member correct."""
+    lane = DeviceLane()
+    lane.batch_window_s = 0.0
+    gate = threading.Event()
+    lane.submit(("blocker",), lambda: gate.wait(10))
+    time.sleep(0.05)
+    ta = [
+        lane.submit(("a", i), lambda i=i: ("un", i), batch=_fake_spec("KA", i))
+        for i in range(2)
+    ]
+    tb = [
+        lane.submit(("b", i), lambda i=i: ("un", i), batch=_fake_spec("KB", 10 + i))
+        for i in range(2)
+    ]
+    gate.set()
+    assert [_member_result(t, time.monotonic() + 10) for t in ta] == [0, 2]
+    assert [_member_result(t, time.monotonic() + 10) for t in tb] == [20, 22]
+    assert lane.batch_launches == 2
+    lane.close()
+
+
+def test_deadline_expired_member_sheds_without_poisoning_batchmates():
+    """ISSUE 13 satellite: a member whose deadline drained while its
+    batch formed sheds with QueryAbandonedError; its batchmates launch
+    and complete normally."""
+    lane = DeviceLane()
+    lane.batch_window_s = 0.0
+    gate = threading.Event()
+    lane.submit(("blocker",), lambda: gate.wait(10))
+    time.sleep(0.05)
+    doomed = lane.submit(
+        ("q", 0),
+        lambda: ("un", 0),
+        deadline=time.monotonic() + 0.05,
+        batch=_fake_spec("K", 0),
+    )
+    survivors = [
+        lane.submit(
+            ("q", i),
+            lambda i=i: ("un", i),
+            deadline=time.monotonic() + 30,
+            batch=_fake_spec("K", i),
+        )
+        for i in (1, 2)
+    ]
+    time.sleep(0.2)  # doomed expires while the blocker holds the lane
+    gate.set()
+    with pytest.raises(QueryAbandonedError):
+        doomed.result(time.monotonic() + 5)
+    assert [_member_result(t, time.monotonic() + 10) for t in survivors] == [2, 4]
+    assert lane.shed_count == 1
+    assert lane.batch_launches == 1  # the two survivors still batched
+    assert lane.batched_queries == 2
+    lane.close()
+
+
+def test_batched_launch_error_fans_out_to_every_member():
+    """A failing batched launch delivers the SAME typed error to every
+    member's waiters (each then heals independently upstream)."""
+    from pinot_tpu.engine.dispatch import DeviceExecutionError
+
+    lane = DeviceLane()
+    lane.batch_window_s = 0.0
+
+    def bad_launch(inputs_list):
+        raise ValueError("trace-time type error")  # deterministic: poison
+
+    gate = threading.Event()
+    lane.submit(("blocker",), lambda: gate.wait(10))
+    time.sleep(0.05)
+    tickets = [
+        lane.submit(
+            ("q", i), lambda i=i: ("un", i), batch=BatchSpec("K", {"v": i}, bad_launch)
+        )
+        for i in range(3)
+    ]
+    gate.set()
+    errs = []
+    for t in tickets:
+        with pytest.raises(DeviceExecutionError) as ei:
+            t.result(time.monotonic() + 10)
+        errs.append(ei.value)
+    assert all(not e.retryable for e in errs)
+    assert lane.device_failure_count == 1  # one launch, fanned out
+    lane.close()
+
+
+def test_poisoned_batched_plan_host_heals_every_member():
+    """ISSUE 13 satellite: a plan the injector poisons fails its
+    batched launch once, and EVERY member transparently host-heals to
+    the payload the serial path serves."""
+    from pinot_tpu.common.faults import DeviceFaultInjector
+
+    inj = DeviceFaultInjector(seed=3)
+    serial = _build_stack(pipeline=False)
+    pipelined = _build_stack(pipeline=True, device_fault_injector=inj)
+    server = pipelined.local_servers[0]
+    queries = _literal_ladder(BATCH_SHAPES[0])
+    warm = pipelined.handle_pql(queries[0])
+    assert not warm.exceptions, warm.exceptions
+    # poison the device plan the whole ladder shares (one StaticPlan)
+    digest = inj.launches[-1].digest
+    assert digest is not None
+    server.executor.clear_poisoned()
+    inj.poison_plan(digest)
+
+    def heal_payload(resp) -> str:
+        # PR 3 convention: result fields are exact across heal paths,
+        # but entries-scanned WORK accounting is path-dependent (the
+        # host path and the device path count filter work differently)
+        return json.dumps(
+            {
+                k: v
+                for k, v in resp.to_json().items()
+                if k
+                not in (
+                    "timeUsedMs",
+                    "requestId",
+                    "cost",
+                    "numEntriesScannedInFilter",
+                    "numEntriesScannedPostFilter",
+                )
+            },
+            sort_keys=True,
+        )
+
+    results = _run_concurrently_batched(pipelined, queries)
+    for q in queries:
+        resp = results[q]
+        assert not resp.exceptions, (q, resp.exceptions)
+        assert heal_payload(serial.handle_pql(q)) == heal_payload(resp), q
+    heal = server.executor.healing_stats()
+    assert heal["hostFailovers"] >= len(queries), heal
+    assert heal["poisonedPlans"] >= 1, heal
+
+
+# --------------------------------------------------- result-cache tier
+def test_cache_hit_identical_payload_and_zero_device_work(monkeypatch):
+    """A hit serves the byte-identical payload, marks rescacheHits=1 as
+    its ENTIRE cost vector (zero device work — the acceptance bar), and
+    performs no lane dispatch."""
+    monkeypatch.setenv("PINOT_TPU_RESULT_CACHE", "1")
+    broker = _build_stack(pipeline=True)
+    server = broker.local_servers[0]
+    q = "SELECT sum(metInt), count(*) FROM testTable WHERE dimInt > 4800"
+    r1 = broker.handle_pql(q)
+    assert not r1.exceptions, r1.exceptions
+    d1 = server.lane.dispatch_count
+    r2 = broker.handle_pql(q)
+    assert not r2.exceptions
+    assert _payload(r1) == _payload(r2)
+    assert r2.cost == {"rescacheHits": 1}, r2.cost
+    assert server.lane.dispatch_count == d1  # zero device work
+    snap = server.result_cache.snapshot()
+    assert snap["hits"] == 1 and snap["puts"] >= 1
+    # distinct literals are distinct entries — never cross-served
+    r3 = broker.handle_pql("SELECT sum(metInt), count(*) FROM testTable WHERE dimInt > 1000")
+    assert "rescacheHits" not in r3.cost
+
+
+def test_cache_disabled_by_default():
+    broker = _build_stack(pipeline=True)
+    server = broker.local_servers[0]
+    q = "SELECT count(*) FROM testTable"
+    for _ in range(2):
+        assert not broker.handle_pql(q).exceptions
+    assert server.result_cache.snapshot()["puts"] == 0
+
+
+def test_segment_set_change_invalidates_cache(monkeypatch):
+    monkeypatch.setenv("PINOT_TPU_RESULT_CACHE", "1")
+    broker = _build_stack(pipeline=True)
+    server = broker.local_servers[0]
+    q = "SELECT count(*) FROM testTable"
+    r1 = broker.handle_pql(q)
+    assert not r1.exceptions
+    assert server.result_cache.entry_count() == 1
+    schema = make_test_schema(with_mv=False)
+    extra = build_segment(schema, random_rows(schema, 50, seed=4), "testTable", "btX")
+    server.add_segment("testTable_OFFLINE", extra)
+    assert server.result_cache.entry_count() == 0  # staleness fence
+    # the next query re-executes (no hit) even though the broker still
+    # routes the original cover — the fence dropped the entry eagerly
+    r2 = broker.handle_pql(q)
+    assert "rescacheHits" not in r2.cost
+    assert r2.num_docs_scanned == r1.num_docs_scanned
+
+
+def test_cache_invalidated_by_llc_offset_advance(monkeypatch, tmp_path):
+    """ISSUE 13 acceptance: a cached realtime answer is dropped the
+    moment the covering LLC consume offset advances — a stale answer is
+    impossible, and the follow-up query sees the new rows."""
+    from pinot_tpu.realtime.stream import MemoryStreamProvider
+    from pinot_tpu.tools.cluster_harness import InProcessCluster
+
+    from tests.test_realtime import make_row, rsvp_schema
+
+    monkeypatch.setenv("PINOT_TPU_RESULT_CACHE", "1")
+    cluster = InProcessCluster(num_servers=1, data_dir=str(tmp_path))
+    try:
+        schema = rsvp_schema()
+        stream = MemoryStreamProvider(num_partitions=1)
+        physical = cluster.add_realtime_table(schema, stream, rows_per_segment=500)
+        for i in range(120):
+            stream.produce(make_row(i))
+        from pinot_tpu.realtime.llc import make_segment_name
+
+        seg0 = make_segment_name(physical, 0, 0)
+        (dm,) = cluster.controller.realtime_manager.consumers_of(seg0)
+        dm.consume_step(max_rows=30)
+
+        q = "SELECT count(*) FROM meetupRsvp"
+        server = cluster.servers[0]
+        r1 = cluster.query(q)
+        assert r1.num_docs_scanned == 30
+        r2 = cluster.query(q)
+        assert r2.num_docs_scanned == 30
+        assert r2.cost.get("rescacheHits") == 1, r2.cost
+        assert server.result_cache.entry_count() >= 1
+
+        # the LLC offset advances -> the cached entry is DROPPED (not
+        # merely unreachable), and the next query answers fresh
+        evicted_before = server.result_cache.snapshot()["staleEvictions"]
+        dm.consume_step(max_rows=20)
+        snap = server.result_cache.snapshot()
+        assert snap["entries"] == 0
+        assert snap["staleEvictions"] > evicted_before
+        r3 = cluster.query(q)
+        assert "rescacheHits" not in r3.cost
+        assert r3.num_docs_scanned == 50  # the fresh watermark, never stale
+    finally:
+        cluster.stop()
+
+
+def test_explain_reports_batching_decision(monkeypatch):
+    """EXPLAIN's device node carries the batching decision (batched /
+    batchMax / windowMs / cacheHit), and EXPLAIN ANALYZE annotates the
+    actuals off its own execution."""
+    monkeypatch.setenv("PINOT_TPU_RESULT_CACHE", "1")
+    broker = _build_stack(pipeline=True)
+    q = "SELECT sum(metInt), count(*) FROM testTable WHERE dimInt > 4800"
+    plain = broker.handle_pql("EXPLAIN " + q)
+    assert not plain.exceptions, plain.exceptions
+    dev = plain.explain["servers"][0].get("device")
+    assert dev is not None and "batching" in dev, plain.explain
+    b = dev["batching"]
+    assert b["batched"] is True
+    assert b["batchMax"] > 1
+    assert b["windowMs"] >= 0
+    assert b["cacheHit"] is False  # nothing executed yet
+    # execute (fills the cache) + hit it once, then EXPLAIN sees the
+    # entry standing by
+    assert not broker.handle_pql(q).exceptions
+    hit = broker.handle_pql(q)
+    assert hit.cost.get("rescacheHits") == 1, hit.cost
+    again = broker.handle_pql("EXPLAIN " + q)
+    assert again.explain["servers"][0]["device"]["batching"]["cacheHit"] is True
+    analyze = broker.handle_pql("EXPLAIN ANALYZE " + q)
+    ab = analyze.explain["servers"][0]["device"]["batching"]
+    assert ab["actualBatchSize"] >= 1
+    assert "actualCacheHit" not in ab  # ANALYZE always executes; the
+    # standing-entry `cacheHit` probe is the cache signal
+    # /debug/plans carries the per-shape batch/cache view
+    server = broker.local_servers[0]
+    plans = server.plan_stats.snapshot(top=10)["plans"]
+    assert all("batching" in p for p in plans)
+    assert any(p["batching"]["cacheHits"] >= 1 for p in plans), plans
