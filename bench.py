@@ -299,6 +299,210 @@ def _literal_mix(segments):
     return qs
 
 
+def _join_main() -> None:
+    """Distributed-join mode (PINOT_TPU_BENCH_MODE=join, ISSUE 14):
+    closed-loop QPS ladder over the three join strategies x uniform vs
+    zipf-skewed join keys, a byte-identity differential holding every
+    strategy (device AND host-reference execution) to one payload, and
+    the shuffle skew-balance measurement (max owner exchange bytes /
+    mean, split on vs off) that the perf gate bounds at <= 2x."""
+    import json as _json
+
+    import numpy as np
+
+    import jax
+
+    # x64 so the differential compares exact aggregation payloads
+    # across device/host and all three strategies (the tier-1 suite
+    # holds the same contract)
+    jax.config.update("jax_enable_x64", True)
+
+    from pinot_tpu.common.schema import DataType, FieldSpec, FieldType, Schema
+    from pinot_tpu.common.tableconfig import PartitionConfig
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.tools.cluster_harness import InProcessCluster
+
+    platform = jax.devices()[0].platform
+    fact_rows = int(os.environ.get("PINOT_TPU_BENCH_JOIN_FACT_ROWS", "40000"))
+    dim_rows = int(os.environ.get("PINOT_TPU_BENCH_JOIN_DIM_ROWS", "2000"))
+    num_segments = int(os.environ.get("PINOT_TPU_BENCH_JOIN_SEGMENTS", "4"))
+    duration_s = float(os.environ.get("PINOT_TPU_BENCH_JOIN_S", "2.0"))
+    clients = int(os.environ.get("PINOT_TPU_BENCH_JOIN_CLIENTS", "4"))
+    zipf_s = 1.2
+
+    rng = np.random.default_rng(14)
+    fact_schema = lambda name: Schema(  # noqa: E731
+        name,
+        dimensions=[FieldSpec("k", DataType.INT, FieldType.DIMENSION)],
+        metrics=[FieldSpec("v", DataType.INT, FieldType.METRIC)],
+    )
+    dim_schema = Schema(
+        "dimB",
+        dimensions=[
+            FieldSpec("k", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("cat", DataType.STRING, FieldType.DIMENSION),
+        ],
+        metrics=[FieldSpec("w", DataType.INT, FieldType.METRIC)],
+    )
+
+    uni_keys = rng.integers(0, dim_rows, fact_rows)
+    zipf_keys = np.minimum(rng.zipf(zipf_s, fact_rows), dim_rows) - 1
+    vals = rng.integers(0, 1000, fact_rows)
+
+    # 4 servers: the shuffle skew measurement needs enough owners for a
+    # hash hot-spot to exist at all (2 owners bound max/mean at 2.0 by
+    # construction); the dim table replicates everywhere so colocated
+    # eligibility survives arbitrary fact placement
+    n_servers = int(os.environ.get("PINOT_TPU_BENCH_JOIN_SERVERS", "4"))
+    cluster = InProcessCluster(num_servers=n_servers)
+    try:
+        part = PartitionConfig(column="k", num_partitions=num_segments)
+        for name, keys in (("factUni", uni_keys), ("factZipf", zipf_keys)):
+            schema = fact_schema(name)
+            cluster.add_offline_table(
+                schema, table_name=name, replication=2, partitioning=part
+            )
+            for p in range(num_segments):
+                sel = keys % num_segments == p
+                rows = [
+                    {"k": int(k), "v": int(v)}
+                    for k, v in zip(keys[sel], vals[sel])
+                ]
+                cluster.upload(
+                    f"{name}_OFFLINE",
+                    build_segment(
+                        schema, rows, f"{name}_OFFLINE", segment_name=f"{name}_{p}_p{p}"
+                    ),
+                )
+        cluster.add_offline_table(
+            dim_schema, table_name="dimB", replication=n_servers, partitioning=part
+        )
+        for p in range(num_segments):
+            rows = [
+                {"k": k, "cat": f"c{k % 23}", "w": (k * 7) % 501}
+                for k in range(dim_rows)
+                if k % num_segments == p
+            ]
+            cluster.upload(
+                "dimB_OFFLINE",
+                build_segment(
+                    dim_schema, rows, "dimB_OFFLINE", segment_name=f"dimB_{p}_p{p}"
+                ),
+            )
+
+        def q(table):
+            return (
+                "SELECT count(*), sum(f.v), sum(d.w) "
+                f"FROM {table} f JOIN dimB d ON f.k = d.k"
+            )
+
+        diff_queries = [
+            q("factUni"),
+            "SELECT sum(f.v), count(*) FROM factUni f JOIN dimB d "
+            "ON f.k = d.k WHERE f.v > 500 GROUP BY d.cat TOP 8",
+            "SELECT min(d.w), max(f.v), avg(f.v) FROM factZipf f "
+            "JOIN dimB d ON f.k = d.k WHERE d.cat IN ('c1','c2','c3')",
+        ]
+
+        # ---- byte-identity differential: every strategy, device and
+        # host-reference execution, must produce ONE result payload.
+        # Work-accounting fields are strategy-dependent by construction
+        # (a shuffle scans extraction rows a colocated join never
+        # ships; covers differ per routing draw) — the PR 3 self-heal
+        # contract: result fields exact, accounting path-dependent.
+        _ACCOUNTING = (
+            "timeUsedMs", "requestId", "cost", "numDocsScanned",
+            "numEntriesScannedInFilter", "numEntriesScannedPostFilter",
+            "totalDocs", "numSegmentsQueried", "numServersQueried",
+            "numServersResponded", "numRetries", "numHedges",
+        )
+
+        def _strip_join(resp) -> str:
+            return json.dumps(
+                {
+                    k: v
+                    for k, v in resp.to_json().items()
+                    if k not in _ACCOUNTING
+                },
+                sort_keys=True,
+            )
+
+        payloads = {}
+        for strategy in ("colocated", "broadcast", "shuffle"):
+            for device in ("1", "0"):
+                os.environ["PINOT_TPU_JOIN_STRATEGY"] = strategy
+                os.environ["PINOT_TPU_JOIN_DEVICE"] = device
+                for i, pql in enumerate(diff_queries):
+                    resp = cluster.broker.handle_pql(pql)
+                    assert not resp.exceptions, (strategy, device, resp.exceptions)
+                    payloads.setdefault(i, set()).add(_strip_join(resp))
+        identical = all(len(v) == 1 for v in payloads.values())
+        os.environ.pop("PINOT_TPU_JOIN_DEVICE", None)
+
+        # ---- QPS ladder ---------------------------------------------
+        qps: dict = {}
+        for strategy in ("colocated", "broadcast", "shuffle"):
+            os.environ["PINOT_TPU_JOIN_STRATEGY"] = strategy
+            qps[strategy] = {}
+            for dist, table in (("uniform", "factUni"), ("zipf", "factZipf")):
+                cluster.broker.handle_pql(q(table))  # warm kernels
+                summary = _closed_loop(
+                    cluster.broker, [q(table)], clients, duration_s
+                )
+                qps[strategy][dist] = summary["ok_qps"]
+                qps[f"{strategy}_p50_ms_{dist}"] = summary["p50_ms"]
+
+        # ---- shuffle skew balance (zipf keys) -----------------------
+        os.environ["PINOT_TPU_JOIN_STRATEGY"] = "shuffle"
+        skew: dict = {}
+        for split, label in (("1", "Split"), ("0", "NoSplit")):
+            os.environ["PINOT_TPU_JOIN_SPLIT"] = split
+            resp = cluster.broker.handle_pql("EXPLAIN ANALYZE " + q("factZipf"))
+            actual = (resp.explain or {}).get("join", {}).get("actual", {})
+            per = actual.get("shuffleBytesPerServer") or {}
+            mean = sum(per.values()) / max(1, len(per))
+            skew[f"balanceRatio{label}"] = (
+                round(max(per.values()) / mean, 3) if mean else 0.0
+            )
+            if label == "Split":
+                skew["heavyHitterSplits"] = int(
+                    actual.get("heavyHitterSplits") or 0
+                )
+        os.environ.pop("PINOT_TPU_JOIN_SPLIT", None)
+        os.environ.pop("PINOT_TPU_JOIN_STRATEGY", None)
+
+        doc = {
+            "metric": "join_qps",
+            "value": qps["shuffle"]["uniform"],
+            "unit": "queries/s",
+            "config": {
+                "fact_rows": fact_rows,
+                "dim_rows": dim_rows,
+                "num_segments": num_segments,
+                "n_servers": n_servers,
+                "clients": clients,
+                "zipf_s": zipf_s,
+                "platform": platform,
+            },
+            "qps": {
+                s: {d: qps[s][d] for d in ("uniform", "zipf")}
+                for s in ("colocated", "broadcast", "shuffle")
+            },
+            "latency_p50_ms": {
+                k: v for k, v in qps.items() if isinstance(v, float)
+            },
+            "differential": {
+                "identical": 1.0 if identical else 0.0,
+                "queries": len(diff_queries),
+                "variants": 6,
+            },
+            "skew": skew,
+        }
+        print(_json.dumps(doc, indent=1))
+    finally:
+        cluster.stop()
+
+
 def _serving_main() -> None:
     """Concurrent serving-curve mode (PINOT_TPU_BENCH_MODE=serving):
     closed-loop client ladders (1..256 clients, ISSUE 13) over
@@ -815,6 +1019,14 @@ def main() -> None:
     if mode == "serving":
         try:
             _serving_main()
+        finally:
+            if deadline is not None:
+                deadline.cancel()
+        return
+
+    if mode == "join":
+        try:
+            _join_main()
         finally:
             if deadline is not None:
                 deadline.cancel()

@@ -67,7 +67,14 @@ REALTIME_SUFFIX = "_REALTIME"
 # query (fatal codes like QUERY_EXECUTION would fail identically on
 # every replica and do not retry)
 RETRYABLE_SERVER_CODES = frozenset(
-    {ErrorCode.SERVER_SCHEDULER_DOWN, ErrorCode.SERVER_SHUTTING_DOWN}
+    {
+        ErrorCode.SERVER_SCHEDULER_DOWN,
+        ErrorCode.SERVER_SHUTTING_DOWN,
+        # "I don't hold the segments this request names" (e.g. a
+        # colocated-join build side that moved): a replica may hold
+        # them locally, so the broker re-covers there before degrading
+        ErrorCode.SERVER_SEGMENT_MISSING,
+    }
 )
 
 
@@ -81,6 +88,11 @@ class _Batch:
         "reissues", "errors", "done", "inflight",
         "hedged", "first_sent", "order",
     )
+
+    # NOTE: join-phase context rides per-submit via _scatter_gather's
+    # ``extra_fn(server)`` — derived from the target server at send
+    # time so failover children automatically get the right build
+    # segment list for THEIR server (broker/joinplan.py)
 
     def __init__(
         self,
@@ -189,6 +201,12 @@ class BrokerRequestHandler:
         for m in ("workload.recorded", "explain.queries"):
             self.metrics.meter(m)
         self.metrics.gauge("workload.digests").set_fn(self.planstats.digest_count)
+        # distributed join plane (broker/joinplan.py): strategy planner
+        # + multi-phase exchange coordinator; registers its join.*
+        # meters at construction
+        from pinot_tpu.broker.joinplan import JoinCoordinator
+
+        self.joinplan = JoinCoordinator(self)
         # SLO & tail-latency attribution plane (ISSUE 11): ONE history
         # thread snapshots this registry (+ the per-table SLO counters)
         # on a cadence; burn-rate evaluation and the flight-recorder
@@ -538,6 +556,18 @@ class BrokerRequestHandler:
         ctx: TraceContext,
         table: str,
     ) -> BrokerResponse:
+        if request.join is not None:
+            # broker-planned distributed join (broker/joinplan.py):
+            # strategy choice + multi-phase scatter, riding the same
+            # resilient scatter-gather machinery per phase.  Admission
+            # already happened (the left table's quota/in-flight slot).
+            with ctx.span("joinPlan", table=table):
+                resp = self.joinplan.handle(
+                    request, pql, timeout_ms, request_id, ctx, table
+                )
+            resp.request_id = request_id
+            resp._server_traces = getattr(resp, "_server_traces", [])
+            return resp
         t_route = time.perf_counter()
         try:
             with ctx.span("route", table=table):
@@ -646,6 +676,11 @@ class BrokerRequestHandler:
             ms = resp.cost.get(key)
             if ms:
                 self.metrics.timer(timer).update(float(ms))
+        # the join planner's size estimator learns table totals from
+        # every plain scan's merged reply (EXPLAIN of a join can then
+        # name the strategy real execution will pick)
+        if resp.total_docs:
+            self.joinplan.stats.observe(table, resp.total_docs)
         resp.num_servers_queried = len(sg["servers_queried"])
         resp.num_servers_responded = len(sg["servers_responded"])
         resp.num_segments_unserved = len(sg["unserved"])
@@ -745,6 +780,7 @@ class BrokerRequestHandler:
         logical_table: str,
         request_id: str,
         ctx: TraceContext,
+        extra_fn=None,
     ) -> Tuple[List[IntermediateResult], Dict[str, Any]]:
         # request_id is REQUIRED: minting a fallback here would hand the
         # servers a different id than the one echoed to the client,
@@ -827,6 +863,7 @@ class BrokerRequestHandler:
                 remaining_ms,
                 attempt_ms,
                 request_id,
+                extra_fn(server) if extra_fn is not None else None,
             )
             # AIMD window accounting: the done-callback observes EVERY
             # attempt outcome exactly once — including attempts that
@@ -1195,6 +1232,7 @@ class BrokerRequestHandler:
         timeout_ms: float,
         attempt_timeout_ms: Optional[float],
         request_id: str,
+        join: Optional[Dict[str, Any]] = None,
     ) -> IntermediateResult:
         # timeout_ms is the REMAINING deadline budget at (re-)issue time,
         # already clamped by handle_request — the server's scheduler pins
@@ -1213,6 +1251,7 @@ class BrokerRequestHandler:
             timeout_ms,
             trace=trace,
             debug_options=debug_options,
+            join=join,
         )
         wait_ms = timeout_ms if attempt_timeout_ms is None else attempt_timeout_ms
         reply = self.transport.request(address, payload, timeout=wait_ms / 1000.0)

@@ -286,6 +286,12 @@ class NetworkedBrokerStarter:
             # per-table SLO objectives ride the same snapshot; an absent
             # block clears the override back to the env defaults
             self.handler.slo.set_objective(raw, q.get("slo"))
+            # declared partitioning feeds the join planner's colocation
+            # check over the same poll (absent block clears it)
+            p = q.get("partitioning") or {}
+            self.handler.joinplan.partitions.set_partitioning(
+                raw, p.get("column"), p.get("numPartitions")
+            )
         for stale in set(self.handler.quota.tables()) - quota_raw_names:
             self.handler.quota.set_quota(stale, None)
         # SLO overrides clear on their own inventory: a table with an

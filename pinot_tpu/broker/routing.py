@@ -158,3 +158,13 @@ class RoutingTableProvider:
     def tables(self) -> List[str]:
         with self._lock:
             return list(self._routing.keys())
+
+    def view_of(self, table_name: str) -> Optional[ExternalView]:
+        """Copy of the raw external view for a table (the join planner
+        reads it to place colocated build sides and to find shuffle
+        owners' alternates)."""
+        with self._lock:
+            view = self._views.get(table_name)
+            if view is None:
+                return None
+            return {seg: dict(replicas) for seg, replicas in view.items()}

@@ -72,6 +72,14 @@ class BrokerStarter:
                 config.raw_name,
                 config.slo.to_json() if config.slo is not None else None,
             )
+            # declared partitioning feeds the join planner's colocation
+            # check (broker/joinplan.py PartitionRegistry)
+            p = config.partitioning
+            self.broker.joinplan.partitions.set_partitioning(
+                config.raw_name,
+                p.column if p is not None else None,
+                p.num_partitions if p is not None else None,
+            )
         if table.endswith(OFFLINE_SUFFIX):
             metas = []
             for seg in self.resources.segments_of(table):

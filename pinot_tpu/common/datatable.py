@@ -91,6 +91,12 @@ class _Writer:
             for k, x in v.items():
                 self.string(str(k))
                 self.value(x)
+        elif isinstance(v, np.ndarray):
+            # 'a': typed binary array — the join-exchange payloads ship
+            # columnar key/value arrays through the same tagged codec
+            # (orders of magnitude tighter than per-element 'i' tags)
+            self.parts.append(b"a")
+            self.array(v)
         else:
             raise TypeError(f"unsupported wire value {type(v)}")
 
@@ -154,6 +160,8 @@ class _Reader:
         if tag == b"d":
             n = self.i64()
             return {self.string(): self.value() for _ in range(n)}
+        if tag == b"a":
+            return self.array()
         raise ValueError(f"bad value tag {tag!r} at {self.pos}")
 
     def array(self) -> np.ndarray:
@@ -297,6 +305,11 @@ def serialize_result(res: IntermediateResult) -> bytes:
     # predating the introspection plane
     w.value(list(res.plan_info))
 
+    # trailing optional join-exchange payload (engine/join.py SideRows
+    # wire dict — columnar arrays via the 'a' tag): None for every
+    # non-join reply, absent for peers predating the join plane
+    w.value(getattr(res, "join_payload", None))
+
     payload = w.getvalue()
     return MAGIC + struct.pack("<Q", len(payload)) + payload
 
@@ -341,6 +354,9 @@ def deserialize_result(data: bytes) -> IntermediateResult:
     if r.pos < len(r.data):
         # trailing EXPLAIN plan-tree list (absent from older peers)
         res.plan_info = [dict(n) for n in (r.value() or [])]
+    if r.pos < len(r.data):
+        # trailing join-exchange payload (absent from older peers)
+        res.join_payload = r.value()
     return res
 
 
@@ -357,6 +373,7 @@ def serialize_instance_request(
     timeout_ms: float,
     trace: bool = False,
     debug_options: Optional[Dict[str, str]] = None,
+    join: Optional[Dict[str, Any]] = None,
 ) -> bytes:
     # request_id is the broker-assigned globally-unique id (a
     # broker-name-prefixed string, e.g. "broker0-3fa9c1-17"); it rides
@@ -372,6 +389,11 @@ def serialize_instance_request(
     # per-query debug options ride to the server so its re-parse applies
     # the same optimizer flags (BrokerRequest.debugOptions thrift field)
     w.value(dict(debug_options or {}))
+    # trailing optional join context (broker/joinplan.py): phase + spec
+    # + shipped build/exchange payloads (columnar arrays via the 'a'
+    # tag).  None for every single-table request; absent for peers
+    # predating the join plane.
+    w.value(join)
     return w.getvalue()
 
 
@@ -392,4 +414,6 @@ def deserialize_instance_request(data: bytes) -> Dict[str, Any]:
         out["debugOptions"] = dict(r.value() or {})
     else:
         out["debugOptions"] = {}
+    # trailing optional join context (absent from older peers)
+    out["join"] = r.value() if r.pos < len(data) else None
     return out

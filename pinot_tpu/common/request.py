@@ -197,6 +197,37 @@ class HavingSpec:
 
 
 @dataclass
+class JoinSpec:
+    """Two-table INNER equi-join (``FROM a JOIN b ON a.k = b.k``).
+
+    The LEFT table (``BrokerRequest.table_name``) is the probe/fact
+    side; the RIGHT table is the build/dimension side.  Column
+    references are resolved at parse time: left-side columns are stored
+    UNQUALIFIED everywhere in the request (filter tree, aggregations,
+    group-by, selection), right-side columns as
+    ``"<right_table>.<col>"`` — the raw right TABLE name, not the query
+    alias, so two aliases of the same semantic query share a plan
+    shape.  ``left_key``/``right_key`` are plain column names on their
+    own sides.  The reference (Pinot v0.016) had no join support at
+    all — see PARITY.md."""
+
+    right_table: str
+    left_key: str
+    right_key: str
+
+    def right_prefix(self) -> str:
+        return self.right_table + "."
+
+    def is_right_column(self, column: Optional[str]) -> bool:
+        return bool(column) and column.startswith(self.right_prefix())
+
+    def strip_right(self, column: str) -> str:
+        """``"<right_table>.<col>"`` -> ``"<col>"``."""
+        p = self.right_prefix()
+        return column[len(p):] if column.startswith(p) else column
+
+
+@dataclass
 class BrokerRequest:
     table_name: str
     filter: Optional[FilterQueryTree] = None
@@ -204,6 +235,9 @@ class BrokerRequest:
     group_by: Optional[GroupBy] = None
     selection: Optional[Selection] = None
     having: Optional[HavingSpec] = None
+    # two-table equi-join (broker-planned; engine/join.py executes) —
+    # None for the single-table queries the reference supported
+    join: Optional[JoinSpec] = None
     enable_trace: bool = False
     query_options: Dict[str, str] = field(default_factory=dict)
     debug_options: Dict[str, str] = field(default_factory=dict)

@@ -96,6 +96,25 @@ class SloConfig:
 
 
 @dataclass
+class PartitionConfig:
+    """Declared key partitioning (the reference's segmentPartitionConfig
+    analog): segments of the table carry their partition id in the
+    segment name (``..._pN``), and the broker's join planner picks the
+    COLOCATED strategy when both join sides declare partitioning on
+    their join keys with equal partition counts and the covers align."""
+
+    column: Optional[str] = None
+    num_partitions: Optional[int] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"column": self.column, "numPartitions": self.num_partitions}
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "PartitionConfig":
+        return cls(column=d.get("column"), num_partitions=d.get("numPartitions"))
+
+
+@dataclass
 class QuotaConfig:
     storage: Optional[str] = None
     # fractional values (< 1.0) are honored: 0.5 = one query per 2s
@@ -136,6 +155,7 @@ class TableConfig:
     stream: Optional[StreamConfig] = None
     quota: QuotaConfig = field(default_factory=QuotaConfig)
     slo: Optional[SloConfig] = None
+    partitioning: Optional[PartitionConfig] = None
     broker_tenant: str = "DefaultTenant"
     server_tenant: str = "DefaultTenant"
 
@@ -167,6 +187,8 @@ class TableConfig:
         }
         if self.slo is not None:
             d["slo"] = self.slo.to_json()
+        if self.partitioning is not None:
+            d["partitioning"] = self.partitioning.to_json()
         if self.stream is not None:
             d["streamConfigs"] = {
                 "streamType": self.stream.stream_type,
@@ -218,5 +240,10 @@ class TableConfig:
                 startree_max_leaf_records=idx.get("starTreeMaxLeafRecords", 10_000),
             ),
             slo=SloConfig.from_json(d["slo"]) if d.get("slo") else None,
+            partitioning=(
+                PartitionConfig.from_json(d["partitioning"])
+                if d.get("partitioning")
+                else None
+            ),
             stream=stream,
         )

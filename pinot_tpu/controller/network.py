@@ -496,6 +496,13 @@ class ParticipantGateway:
                     # per-table SLO objectives propagate with the quota
                     # (broker/network_starter applies them per poll)
                     "slo": config.slo.to_json() if config.slo is not None else None,
+                    # declared key partitioning feeds the remote broker's
+                    # join planner (colocated strategy eligibility)
+                    "partitioning": (
+                        config.partitioning.to_json()
+                        if config.partitioning is not None
+                        else None
+                    ),
                 }
             if table.endswith("_OFFLINE"):
                 from pinot_tpu.broker.time_boundary import compute_boundary

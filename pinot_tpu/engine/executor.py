@@ -1408,6 +1408,135 @@ class QueryExecutor:
         raise AssertionError(agg)
 
     # ------------------------------------------------------------------
+    # distributed joins (engine/join.py): device hash-join under the
+    # SAME self-healing contract as scans — classify, retry once on
+    # transients, quarantine the join-plan digest, heal to the exact
+    # host join.  A poisoned join plan heals exactly like a poisoned
+    # scan plan (shared poison map, shared heal.* counters).
+    # ------------------------------------------------------------------
+    def execute_join(
+        self,
+        request: BrokerRequest,
+        build,
+        probe,
+        deadline: Optional[float] = None,
+    ) -> IntermediateResult:
+        from pinot_tpu.engine import join as join_mod
+
+        t0 = time.perf_counter()
+        side_bytes = build.nbytes() + probe.nbytes()
+        try:
+            planned = join_mod.build_join_plan(request, build, probe)
+        except join_mod.JoinValidationError:
+            raise  # typed client error, not a healable fault
+        except Exception as e:
+            # host-side packing is part of the device section's promise:
+            # a packing bug degrades to the exact host join, it never
+            # takes the query down
+            self._heal_mark("hostFailovers", reason=f"joinPack: {e}"[:200])
+            planned = None
+        if planned is None:
+            res = join_mod.host_join(request, build, probe)
+            res.add_cost(buildRows=build.n, probeRows=probe.n)
+            self._phase("hostPath", t0)
+            return res
+        plan, inputs, meta = planned
+        jdigest = join_mod.join_plan_digest(plan)
+
+        from pinot_tpu.engine.dispatch import (
+            DeviceExecutionError,
+            LaneClosedError,
+            classify_device_error,
+        )
+        from pinot_tpu.server.scheduler import QueryAbandonedError
+
+        poison_key = (jdigest, "join")
+        sel = self.lane_selection(request)
+        lane = sel.lane if sel is not None else self.lane
+        if self._is_poisoned(poison_key):
+            self._heal_mark("poisonSkips")
+            res = join_mod.host_join(request, build, probe)
+            res.add_cost(buildRows=build.n, probeRows=probe.n)
+            self._phase("hostFailover", t0)
+            return res
+
+        last: Optional[DeviceExecutionError] = None
+        for attempt in (0, 1):
+            if attempt:
+                if last is None or not last.retryable:
+                    break
+                self._heal_mark("deviceRetries")
+            try:
+                return self._join_device_section(
+                    request, plan, inputs, meta, build, probe, deadline,
+                    jdigest, lane, sel, side_bytes, t0,
+                )
+            except (QueryAbandonedError, LaneClosedError, TimeoutError):
+                raise
+            except Exception as e:
+                last = classify_device_error(e)
+                self._heal_mark(
+                    "deviceFailures", retryable=last.retryable, error=str(last)[:200]
+                )
+        self._poison(poison_key, str(last))
+        self._heal_mark("hostFailovers", reason=str(last)[:200])
+        t0 = time.perf_counter()
+        res = join_mod.host_join(request, build, probe)
+        res.add_cost(buildRows=build.n, probeRows=probe.n)
+        self._phase("hostFailover", t0)
+        return res
+
+    def _join_device_section(
+        self, request, plan, inputs, meta, build, probe, deadline,
+        jdigest, lane, sel, side_bytes, t0,
+    ) -> IntermediateResult:
+        from pinot_tpu.engine import join as join_mod
+        from pinot_tpu.engine.kernel import make_join_kernel
+
+        kernel = make_join_kernel(plan)
+        digest = self._inputs_digest(inputs)
+        cost: Dict[str, float] = {}
+
+        class _JoinToken:
+            # stands in for the staged-table token in _run_kernel's
+            # coalesce key: join inputs are content-digested, so the
+            # constant token can never alias distinct data generations
+            token = ("join",)
+            num_segments = 0
+            n_pad = 0
+
+        dev_bytes = sum(a.nbytes for a in inputs.values())
+        # joins are deliberately EXCLUDED from the micro-batching tier
+        # (batch_spec=None): stacking distinct join payloads has no
+        # shared-column amortization to win, and the byte-identity
+        # proof for batched joins hasn't been done (ISSUE 14 guard)
+        outs = self._run_kernel(
+            kernel, (inputs,), plan, _JoinToken(), digest, None, deadline,
+            pdigest=jdigest, cost=cost, lane=lane, batch_spec=None,
+        )
+        if not bool(outs.get("join_ok", True)):
+            # the parallel-claim build ran out of rounds (cannot happen
+            # with unique keys and a half-full table, but a wrong
+            # answer must never ship): heal to the exact host join
+            raise RuntimeError("join hash-table build did not converge")
+        t_fin = time.perf_counter()
+        result = join_mod.finalize_device_join(
+            request, plan, meta, build, probe, outs
+        )
+        result.add_cost(
+            buildRows=build.n,
+            probeRows=probe.n,
+            bytesScanned=side_bytes,
+            deviceBytes=dev_bytes,
+            **cost,
+        )
+        result._device_digest = jdigest
+        result._lane_index = sel.index if sel is not None else 0
+        result._batch_size = 1
+        self._phase("finalize", t_fin)
+        return result
+
+    # ------------------------------------------------------------------
     def _finalize_selection(
         self,
         request: BrokerRequest,

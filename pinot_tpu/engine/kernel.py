@@ -1265,3 +1265,203 @@ def make_packed_batched_table_kernel(plan: StaticPlan) -> Callable:
     from pinot_tpu.engine.packing import make_packed_kernel
 
     return make_packed_kernel(jax.vmap(table_fn, in_axes=(None, 0)))
+
+
+# ---------------------------------------------------------------------------
+# Device hash join (engine/join.py JoinPlan -> one jitted program)
+# ---------------------------------------------------------------------------
+
+
+def _join_hash(k, cap: int):
+    """Knuth multiplicative hash of int32 key ids, masked to the pow2
+    open-addressing capacity."""
+    h = (k.astype(jnp.uint32) * jnp.uint32(2654435761)) >> jnp.uint32(8)
+    return (h & jnp.uint32(cap - 1)).astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=128)
+def make_join_kernel(jplan) -> Callable:
+    """Build+probe hash-join program for one ``engine/join.py``
+    JoinPlan: int32 open-addressing over padded lanes.
+
+    BUILD: unique build keys insert in parallel-claim rounds — each
+    unplaced lane proposes slot ``(hash + r) & (cap-1)``; lanes whose
+    proposed slot is empty scatter-min their lane index to claim it,
+    winners write (key, lane) into the table, everyone else advances
+    ``r``.  Keys are unique (the host packing pre-aggregated per key)
+    and the table is <= half full, so every lane lands within ``cap``
+    rounds; ``join_ok`` reports the invariant so the executor can heal
+    to the host join instead of serving a wrong answer if it ever
+    breaks.
+
+    PROBE: every probe lane walks its probe sequence until key match
+    (join hit: the build lane index) or empty slot (no match), all
+    lanes in lockstep under one while_loop.
+
+    AGGREGATE: matched lanes gather the build side's per-key
+    pre-reductions (cnt/sum/min/max) and combine with their own value
+    columns — a probe row matching a duplicated build key contributes
+    ``cnt`` joined rows, so SUM weights by cnt and COUNT sums cnt,
+    which is exactly the inner-join multiplicity.  Group mode scatters
+    into dense ``[n_groups]`` holders keyed by the mixed-radix
+    (probe-group, build-group) id."""
+    cap = jplan.cap
+
+    def kern(inputs: Dict[str, Any]) -> Dict[str, Any]:
+        bk = inputs["bk"]
+        bc = inputs["bc"]
+        U = bk.shape[0]
+
+        # -- build phase: parallel-claim insertion --------------------
+        bh = _join_hash(bk, cap)
+        lane_ids = jnp.arange(U, dtype=jnp.int32)
+        table_key = jnp.full((cap,), -1, dtype=jnp.int32)
+        table_row = jnp.zeros((cap,), dtype=jnp.int32)
+        placed = bk < 0  # padded lanes never insert
+
+        def build_cond(state):
+            _tk, _tr, placed_, r = state
+            return jnp.logical_and(jnp.any(~placed_), r < 2 * cap)
+
+        def build_body(state):
+            tk, tr, placed_, r = state
+            slot = (bh + r) & (cap - 1)
+            attempt = jnp.logical_and(~placed_, tk[slot] == -1)
+            # claim: lowest lane index wins each contested empty slot
+            claim_slot = jnp.where(attempt, slot, cap)
+            claims = jnp.full((cap,), U, dtype=jnp.int32)
+            claims = claims.at[claim_slot].min(lane_ids, mode="drop")
+            won = jnp.logical_and(attempt, claims[slot] == lane_ids)
+            win_slot = jnp.where(won, slot, cap)
+            tk = tk.at[win_slot].set(bk, mode="drop")
+            tr = tr.at[win_slot].set(lane_ids, mode="drop")
+            return tk, tr, jnp.logical_or(placed_, won), r + 1
+
+        table_key, table_row, placed, _r = jax.lax.while_loop(
+            build_cond, build_body, (table_key, table_row, placed, jnp.int32(0))
+        )
+        join_ok = jnp.all(placed)
+
+        # -- probe phase: lockstep linear probing ---------------------
+        pk = inputs["pk"]
+        N = pk.shape[0]
+        ph = _join_hash(pk, cap)
+        midx0 = jnp.full((N,), -1, dtype=jnp.int32)
+        done0 = pk < 0  # padded lanes: no match
+
+        def probe_cond(state):
+            done, _m, off = state
+            return jnp.logical_and(jnp.any(~done), off <= cap)
+
+        def probe_body(state):
+            done, midx, off = state
+            slot = (ph + off) & (cap - 1)
+            at = table_key[slot]
+            found = jnp.logical_and(~done, at == pk)
+            empty = jnp.logical_and(~done, at == -1)
+            midx = jnp.where(found, table_row[slot], midx)
+            return jnp.logical_or(done, jnp.logical_or(found, empty)), midx, off + 1
+
+        _done, midx, _off = jax.lax.while_loop(
+            probe_cond, probe_body, (done0, midx0, jnp.int32(0))
+        )
+
+        matched = midx >= 0
+        safe = jnp.maximum(midx, 0)
+        fdt = config.float_dtype()
+        cnt = jnp.where(matched, bc[safe], 0).astype(jnp.int32)
+        cntf = cnt.astype(fdt)
+        outs: Dict[str, Any] = {
+            "num_docs": jnp.sum(cnt.astype(jnp.int64))
+            if jax.config.jax_enable_x64
+            else jnp.sum(cnt),
+            "join_ok": join_ok,
+        }
+
+        pv = inputs["pv"]
+        bs = inputs["bs"]
+        bmn = inputs["bmn"]
+        bmx = inputs["bmx"]
+        inf = jnp.asarray(jnp.inf, dtype=fdt)
+
+        def probe_vals(idx):
+            return pv[idx]
+
+        if jplan.n_groups:
+            G = jplan.n_groups
+            gid = inputs["pg"] * jnp.int32(jplan.bg_space) + inputs["bg"][safe]
+            gslot = jnp.where(matched, gid, G)  # drop unmatched lanes
+            gcnt = jnp.zeros((G,), jnp.int32).at[gslot].add(cnt, mode="drop")
+            outs["gb_cnt"] = gcnt
+            for i, (kind, side, idx) in enumerate(jplan.aggs):
+                if kind == "count":
+                    outs[f"gb_{i}"] = gcnt
+                    continue
+                if side == "p":
+                    v = probe_vals(idx)
+                    vsum = v * cntf
+                    vmin = v
+                    vmax = v
+                else:
+                    vsum = bs[idx][safe]
+                    vmin = bmn[idx][safe]
+                    vmax = bmx[idx][safe]
+
+                def _sum():
+                    return jnp.zeros((G,), fdt).at[gslot].add(
+                        jnp.where(matched, vsum, 0.0), mode="drop"
+                    )
+
+                def _min():
+                    return jnp.full((G,), inf).at[gslot].min(
+                        jnp.where(matched, vmin, inf), mode="drop"
+                    )
+
+                def _max():
+                    return jnp.full((G,), -inf).at[gslot].max(
+                        jnp.where(matched, vmax, -inf), mode="drop"
+                    )
+
+                if kind == "sum":
+                    outs[f"gb_{i}"] = _sum()
+                elif kind == "avg":
+                    outs[f"gb_{i}"] = (_sum(), gcnt)
+                elif kind == "min":
+                    outs[f"gb_{i}"] = _min()
+                elif kind == "max":
+                    outs[f"gb_{i}"] = _max()
+                else:  # minmaxrange
+                    outs[f"gb_{i}"] = (_min(), _max())
+            return outs
+
+        total_cnt = jnp.sum(cnt)
+        for i, (kind, side, idx) in enumerate(jplan.aggs):
+            if kind == "count":
+                outs[f"agg_{i}"] = total_cnt
+                continue
+            if side == "p":
+                v = probe_vals(idx)
+                ssum = jnp.sum(jnp.where(matched, v * cntf, 0.0))
+                smin = jnp.min(jnp.where(jnp.logical_and(matched, cnt > 0), v, inf))
+                smax = jnp.max(
+                    jnp.where(jnp.logical_and(matched, cnt > 0), v, -inf)
+                )
+            else:
+                ssum = jnp.sum(jnp.where(matched, bs[idx][safe], 0.0))
+                smin = jnp.min(jnp.where(matched, bmn[idx][safe], inf))
+                smax = jnp.max(jnp.where(matched, bmx[idx][safe], -inf))
+            if kind == "sum":
+                outs[f"agg_{i}"] = ssum
+            elif kind == "avg":
+                outs[f"agg_{i}"] = (ssum, total_cnt)
+            elif kind == "min":
+                outs[f"agg_{i}"] = smin
+            elif kind == "max":
+                outs[f"agg_{i}"] = smax
+            else:
+                outs[f"agg_{i}"] = (smin, smax)
+        return outs
+
+    from pinot_tpu.engine.packing import make_packed_kernel
+
+    return make_packed_kernel(kern)

@@ -65,6 +65,13 @@ def plan_shape(request: BrokerRequest) -> tuple:
     if request.having is not None:
         h = request.having
         having = (h.function, h.column, h.operator)
+    join = None
+    if request.join is not None:
+        j = request.join
+        # the join is part of the plan shape: a joined scan and a plain
+        # scan of the same left table are different workloads (and the
+        # broker's strategy planner keys per-shape stats off this)
+        join = (_raw_table(j.right_table), j.left_key, j.right_key)
     return (
         _raw_table(request.table_name),
         _filter_shape(request.filter),
@@ -72,6 +79,7 @@ def plan_shape(request: BrokerRequest) -> tuple:
         gb,
         sel,
         having,
+        join,
     )
 
 
@@ -142,4 +150,9 @@ def plan_shape_summary(request: BrokerRequest) -> str:
             "order " + ",".join(s.column for s in request.selection.sorts)
         )
     parts.append(f"from {_raw_table(request.table_name)}")
+    if request.join is not None:
+        j = request.join
+        parts.append(
+            f"join {_raw_table(j.right_table)} on {j.left_key}={j.right_key}"
+        )
     return " ".join(parts)

@@ -88,7 +88,7 @@ class ResultCache:
         self.max_bytes = _max_bytes() if max_bytes is None else int(max_bytes)
         self.metrics = metrics
         self._lock = threading.Lock()
-        # key -> (payload bytes, raw table, nbytes)
+        # key -> (payload bytes, raw table attribution tuple, nbytes)
         self._entries: "OrderedDict[Hashable, Tuple[bytes, str, int]]" = OrderedDict()
         self._bytes = 0
         if metrics is not None:
@@ -133,6 +133,41 @@ class ResultCache:
             fence,
         )
 
+    @staticmethod
+    def key_for_join(
+        request,
+        probe_views: Sequence[Any],
+        build_views: Sequence[Any],
+        probe_table: str,
+        build_table: str,
+    ) -> Optional[Hashable]:
+        """Cache key for a COLOCATED join execution: the semantic query
+        identity times BOTH sides' exact resident data generations.  An
+        ingest advance / segment change on EITHER table mints new
+        staging tokens, so a stale joined answer is structurally
+        unreachable; the entry is attributed to both raw tables so
+        either side's eager invalidation drops it (ISSUE 14 guard).
+        Broadcast/shuffle executions are never cached server-side —
+        their build payloads are broker-shipped per query."""
+        if request.explain is not None:
+            return None
+        try:
+            fence_p = tuple(
+                sorted((v.segment_name, int(v.staging_token)) for v in probe_views)
+            )
+            fence_b = tuple(
+                sorted((v.segment_name, int(v.staging_token)) for v in build_views)
+            )
+        except (AttributeError, TypeError):
+            return None
+        return (
+            (_raw_table(probe_table), _raw_table(build_table)),
+            plan_shape_digest(request),
+            plan_literal_digest(request),
+            fence_p,
+            fence_b,
+        )
+
     # -- read/write ----------------------------------------------------
     def _mark(self, name: str, n: int = 1) -> None:
         if self.metrics is not None and n:
@@ -172,11 +207,14 @@ class ResultCache:
         if nbytes > max(1, self.max_bytes) // 4:
             return  # one oversized answer must not churn the whole LRU
         raw = key[0] if isinstance(key, tuple) and key else ""
+        # entries attribute to one raw table (scans) or several (joins:
+        # the key's first element is a tuple of both sides)
+        raw = tuple(raw) if isinstance(raw, tuple) else (str(raw),)
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[2]
-            self._entries[key] = (payload, str(raw), nbytes)
+            self._entries[key] = (payload, raw, nbytes)
             self._bytes += nbytes
             while self._entries and (
                 self._bytes > self.max_bytes or len(self._entries) > self.max_entries
@@ -195,7 +233,7 @@ class ResultCache:
         raw = _raw_table(table)
         dropped = 0
         with self._lock:
-            victims = [k for k, e in self._entries.items() if e[1] == raw]
+            victims = [k for k, e in self._entries.items() if raw in e[1]]
             for k in victims:
                 _, _, nbytes = self._entries.pop(k)
                 self._bytes -= nbytes

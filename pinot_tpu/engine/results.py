@@ -246,6 +246,15 @@ GroupKey = Tuple[str, ...]
 #   segmentsHost       segments served by the host path (forced,
 #                      failover, or pair overflow)
 #   segmentsStarTree   segments answered from their star-tree cube
+#   buildRows          join build-side rows extracted / hash-table
+#                      inserted (engine/join.py — dim-side work)
+#   probeRows          join probe-side rows extracted / probed against
+#                      the build hash table (fact-side work)
+#   shuffleBytes       serialized join-exchange bytes a server RECEIVED
+#                      in a shuffle join (the skew-balance observable:
+#                      no server should receive >2x the mean)
+#   broadcastBytes     serialized build-side bytes a server received in
+#                      a broadcast join (one copy per probe server)
 COST_KEYS = (
     "bytesScanned",
     "deviceMs",
@@ -255,6 +264,10 @@ COST_KEYS = (
     "qinputCacheHits",
     "batchHits",
     "rescacheHits",
+    "buildRows",
+    "probeRows",
+    "shuffleBytes",
+    "broadcastBytes",
     "segmentsPruned",
     "segmentsPostings",
     "segmentsZonemap",
@@ -330,6 +343,12 @@ class IntermediateResult:
         # like traces (never summed) — the broker collects them into
         # BrokerResponse.explain["servers"]
         self.plan_info: List[Dict[str, Any]] = list(plan_info or [])
+        # join-extract payload (engine/join.py SideRows wire dict):
+        # columnar key/value arrays a join-extract phase returns to the
+        # broker exchange.  NOT additive — the broker drains it before
+        # the result joins the reduce merge; always None on the normal
+        # single-table serving path.
+        self.join_payload: Optional[Dict[str, Any]] = None
 
     def add_cost(self, **kv: float) -> None:
         """Accumulate cost-vector components (key-wise add)."""

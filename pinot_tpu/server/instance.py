@@ -204,6 +204,13 @@ class ServerInstance:
         for m in ("ingest.rowsConsumed",):
             self.metrics.meter(m)
         self.metrics.timer("ingest.commitMs")
+        # distributed-join plane (engine/join.py): extraction + hash
+        # join execution counters, pre-registered
+        for m in (
+            "join.extracts", "join.execs", "join.buildRows",
+            "join.probeRows", "join.shuffleBytes", "join.broadcastBytes",
+        ):
+            self.metrics.meter(m)
         # workload-introspection plane: per-plan-digest rolling stats
         # (utils/planstats.py) behind /debug/plans + status()["plans"],
         # with the plan.* series and the per-tier cost counters the
@@ -869,6 +876,19 @@ class ServerInstance:
                     if missing:
                         self.metrics.meter("segmentsMissedServing").mark(len(missing))
                 views = [a.query_view() for a in acquired]
+                if req.get("join"):
+                    # distributed-join phase request (broker/joinplan.py):
+                    # extraction or join execution over the local views,
+                    # through the SAME fair-share scheduler slot this
+                    # request already queued in — one tenant's join
+                    # traffic is bounded exactly like its scans
+                    result = self._process_join(
+                        req, request, req["join"], views, deadline, trace
+                    )
+                    result.unserved_segments = missing
+                    if trace.enabled:
+                        result.trace.update(trace.to_dict())
+                    return result
                 if request.explain == "plan":
                     # EXPLAIN: the physical plan INSTEAD of execution —
                     # zero lane submissions, zero cost (safe to call in
@@ -950,4 +970,217 @@ class ServerInstance:
                 tdm.release_segments(acquired)
         if trace.enabled:
             result.trace.update(trace.to_dict())
+        return result
+
+    # -- distributed joins (engine/join.py + broker/joinplan.py) ------
+    def _extract_bytes(self, views, columns) -> int:
+        total = 0
+        for seg in views:
+            for c in columns:
+                col = seg.columns.get(c)
+                if col is not None and getattr(col, "fwd", None) is not None:
+                    total += col.fwd.nbytes
+        return total
+
+    def _process_join(
+        self, req: dict, request, jctx: dict, views, deadline, trace
+    ) -> IntermediateResult:
+        """One join-phase request: ``extract`` returns the side's
+        matched rows as a dict-encoded exchange payload; ``exec`` runs
+        the hash join (device kernel with host heal) over local and/or
+        shipped sides and returns normal mergeable partials."""
+        from pinot_tpu.engine import join as join_mod
+
+        spec = request.join
+        if spec is None:
+            return IntermediateResult(
+                exceptions=[
+                    (ErrorCode.QUERY_EXECUTION, "join context on a non-join query")
+                ]
+            )
+        phase = jctx.get("phase")
+        t0 = time.perf_counter()
+        try:
+            left_f, right_f = join_mod.split_join_filter(request)
+            left_cols, right_cols = join_mod.side_columns(request)
+            if phase == "extract":
+                side_name = jctx.get("side")
+                if side_name == "build":
+                    stripped = [spec.strip_right(c) for c in right_cols]
+                    name_of = {spec.strip_right(c): c for c in right_cols}
+                    rows, matched = join_mod.extract_side(
+                        views, right_f, spec.right_key, stripped, name_of
+                    )
+                    read_cols = [spec.right_key, *stripped]
+                else:
+                    rows, matched = join_mod.extract_side(
+                        views, left_f, spec.left_key, left_cols
+                    )
+                    read_cols = [spec.left_key, *left_cols]
+                res = IntermediateResult(
+                    num_docs_scanned=matched,
+                    total_docs=sum(v.num_docs for v in views),
+                    num_segments_queried=len(views),
+                )
+                res.add_cost(
+                    hostMs=round((time.perf_counter() - t0) * 1000, 3),
+                    bytesScanned=self._extract_bytes(views, read_cols),
+                )
+                res.join_payload = join_mod.encode_side(rows)
+                self.metrics.meter("join.extracts").mark()
+                self.executor._phase(
+                    "joinExtract", t0, side=side_name, segments=len(views)
+                )
+                return res
+
+            if phase != "exec":
+                raise join_mod.JoinValidationError(
+                    f"unknown join phase {phase!r}"
+                )
+            strategy = jctx.get("strategy")
+            ckey = None
+            cache = self.result_cache
+            if strategy == "colocated":
+                build_table = jctx.get("buildTable") or ""
+                build_names = list(jctx.get("buildSegments") or ())
+                tdm_b = self.data_manager.table(build_table)
+                if tdm_b is None:
+                    return IntermediateResult(
+                        exceptions=[
+                            (
+                                ErrorCode.SERVER_SEGMENT_MISSING,
+                                f"build table {build_table} not on server {self.name}",
+                            )
+                        ]
+                    )
+                b_acquired = tdm_b.acquire_segments(build_names or None)
+                try:
+                    held = {a.name for a in b_acquired}
+                    miss_b = [n for n in build_names if n not in held]
+                    if miss_b:
+                        return IntermediateResult(
+                            exceptions=[
+                                (
+                                    ErrorCode.SERVER_SEGMENT_MISSING,
+                                    f"server {self.name}: build segments "
+                                    f"unavailable: {sorted(miss_b)}",
+                                )
+                            ]
+                        )
+                    b_views = [a.query_view() for a in b_acquired]
+                    # failover re-check: a child batch may land on a
+                    # replica whose LOCAL build segments cover different
+                    # partitions — serve only if every probe partition
+                    # is locally buildable, else 230 so the broker
+                    # re-covers elsewhere
+                    probe_parts = {
+                        join_mod.partition_of_segment(v.segment_name) for v in views
+                    }
+                    build_parts = {
+                        join_mod.partition_of_segment(v.segment_name)
+                        for v in b_views
+                    }
+                    if None in probe_parts or not probe_parts <= build_parts:
+                        return IntermediateResult(
+                            exceptions=[
+                                (
+                                    ErrorCode.SERVER_SEGMENT_MISSING,
+                                    f"server {self.name}: local build side does "
+                                    f"not cover probe partitions",
+                                )
+                            ]
+                        )
+                    # ingest-aware result cache, keyed on BOTH sides'
+                    # segment sets + staging tokens: an ingest advance
+                    # or segment change on EITHER table mints new
+                    # tokens, so a stale joined answer is structurally
+                    # unreachable (ISSUE 14 interop guard)
+                    if cache.enabled:
+                        ckey = cache.key_for_join(
+                            request, views, b_views, req["table"], build_table
+                        )
+                    cached = cache.get(ckey) if ckey is not None else None
+                    if cached is not None:
+                        trace.event("rescacheHit")
+                        return cached
+                    result = self._join_exec(
+                        request, spec, right_f, right_cols, b_views,
+                        left_f, left_cols, views, deadline, trace,
+                    )
+                    result.num_segments_queried = len(views) + len(b_views)
+                    if ckey is not None and not result.exceptions:
+                        cache.put(ckey, result)
+                finally:
+                    tdm_b.release_segments(b_acquired)
+            elif strategy == "broadcast":
+                build = join_mod.decode_side(jctx["build"])
+                result = self._join_exec(
+                    request, spec, None, right_cols, None,
+                    left_f, left_cols, views, deadline, trace, build=build,
+                )
+                result.num_segments_queried = len(views)
+                bbytes = build.nbytes()
+                result.add_cost(broadcastBytes=bbytes)
+                self.metrics.meter("join.broadcastBytes").mark(bbytes)
+            elif strategy == "shuffle":
+                build = join_mod.decode_side(jctx["build"])
+                probe = join_mod.decode_side(jctx["probe"])
+                sbytes = build.nbytes() + probe.nbytes()
+                with trace.span(
+                    "joinExec", strategy="shuffle", buildRows=build.n,
+                    probeRows=probe.n,
+                ):
+                    result = self.executor.execute_join(
+                        request, build, probe, deadline=deadline
+                    )
+                result.add_cost(shuffleBytes=sbytes)
+                self.metrics.meter("join.shuffleBytes").mark(sbytes)
+            else:
+                raise join_mod.JoinValidationError(
+                    f"unknown join strategy {strategy!r}"
+                )
+            self.metrics.meter("join.execs").mark()
+            self.metrics.meter("join.buildRows").mark(
+                int(result.cost.get("buildRows", 0))
+            )
+            self.metrics.meter("join.probeRows").mark(
+                int(result.cost.get("probeRows", 0))
+            )
+            return result
+        except join_mod.JoinValidationError as e:
+            # a typed client error, never a crash: the broker surfaces
+            # it as QUERY_VALIDATION (4xx), and it is NOT retryable
+            return IntermediateResult(
+                exceptions=[(ErrorCode.QUERY_VALIDATION, str(e))]
+            )
+
+    def _join_exec(
+        self, request, spec, right_f, right_cols, b_views,
+        left_f, left_cols, views, deadline, trace, build=None,
+    ) -> IntermediateResult:
+        """Local probe-side extraction (+ build-side for colocated),
+        then the healed hash join."""
+        from pinot_tpu.engine import join as join_mod
+
+        t0 = time.perf_counter()
+        if build is None:
+            stripped = [spec.strip_right(c) for c in right_cols]
+            name_of = {spec.strip_right(c): c for c in right_cols}
+            with trace.span("joinBuildLocal", segments=len(b_views)):
+                build, _m = join_mod.extract_side(
+                    b_views, right_f, spec.right_key, stripped, name_of
+                )
+        with trace.span("joinProbeLocal", segments=len(views)):
+            probe, matched = join_mod.extract_side(
+                views, left_f, spec.left_key, left_cols
+            )
+        self.metrics.timer("phase.joinExtract").update(
+            (time.perf_counter() - t0) * 1000
+        )
+        with trace.span(
+            "joinExec", buildRows=build.n, probeRows=probe.n
+        ):
+            result = self.executor.execute_join(
+                request, build, probe, deadline=deadline
+            )
         return result

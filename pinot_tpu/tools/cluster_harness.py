@@ -661,6 +661,135 @@ def run_noisy_neighbor_scenario(
         cluster.stop()
 
 
+def run_join_under_flood_scenario(
+    num_servers: int = 2,
+    replication: int = 1,
+    clients: int = 3,
+    flood_clients: int = 4,
+    quota_qps: float = 8.0,
+    baseline_s: float = 1.0,
+    flood_s: float = 2.5,
+    max_pending: int = 16,
+    data_dir: Optional[str] = None,
+    p99_floor_ms: float = 25.0,
+    p99_multiple: float = 3.0,
+) -> Dict[str, Any]:
+    """ISSUE 14 chaos: tenant A floods two-table JOINs at >>10x its
+    quota while tenant B runs steady scans.  Joins fan out into
+    multi-phase scatter traffic (extracts + exchange), so this proves
+    the join plane rides the overload machinery end to end:
+
+    - the broker admission front door sheds A's overflow BEFORE any
+      join phase scatters (429s, typed);
+    - the phase requests that do run queue under tenant A's tables in
+      the server fair-share scheduler, so B's p99 holds within a fixed
+      multiple of its unloaded baseline;
+    - tenant B suffers ZERO failed queries.
+    """
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.tools.datagen import random_rows
+
+    cluster = InProcessCluster(
+        num_servers=num_servers, data_dir=data_dir, max_pending=max_pending
+    )
+    try:
+        from pinot_tpu.common.schema import DataType, FieldSpec, FieldType, Schema
+
+        fact_schema = Schema(
+            "aFact",
+            dimensions=[FieldSpec("k", DataType.INT, FieldType.DIMENSION)],
+            metrics=[FieldSpec("v", DataType.INT, FieldType.METRIC)],
+        )
+        dim_schema = Schema(
+            "aDim",
+            dimensions=[FieldSpec("k", DataType.INT, FieldType.DIMENSION)],
+            metrics=[FieldSpec("w", DataType.INT, FieldType.METRIC)],
+        )
+        fact_phys = cluster.add_offline_table(fact_schema, replication=replication)
+        dim_phys = cluster.add_offline_table(dim_schema, replication=replication)
+        import numpy as _np
+
+        rng = _np.random.default_rng(11)
+        for i in range(2):
+            frows = [
+                {"k": int(k), "v": int(v)}
+                for k, v in zip(rng.integers(0, 60, 150), rng.integers(0, 99, 150))
+            ]
+            cluster.upload(
+                fact_phys, build_segment(fact_schema, frows, fact_phys, f"aFact_s{i}")
+            )
+        cluster.upload(
+            dim_phys,
+            build_segment(
+                dim_schema,
+                [{"k": k, "w": k * 2} for k in range(60)],
+                dim_phys,
+                "aDim_s0",
+            ),
+        )
+        schema_b = _tenant_schema("tenantB")
+        phys_b = cluster.add_offline_table(schema_b, replication=replication)
+        rows_b = random_rows(schema_b, 240, seed=7)
+        total_b = 0
+        for i in range(3):
+            n = 40 + 30 * (i % 3)
+            cluster.upload(
+                phys_b, build_segment(schema_b, rows_b[:n], phys_b, f"tenantBs{i}")
+            )
+            total_b += n
+
+        pql_join = "SELECT count(*), sum(f.v) FROM aFact f JOIN aDim d ON f.k = d.k"
+        pql_b = "SELECT count(*) FROM tenantB"
+        for pql in (pql_join, pql_b):
+            r = cluster.broker.handle_pql(pql)
+            assert not r.exceptions, r.exceptions
+
+        base_load = ClosedLoopLoad(cluster, pql_b, total_b, clients).start()
+        time.sleep(baseline_s)
+        baseline = base_load.stop()
+
+        # quota lands on the join's LEFT table through the live path —
+        # the broker admission front door keys joins on it
+        cluster.controller.resources.update_table_quota(fact_phys, quota_qps)
+
+        b_load = ClosedLoopLoad(cluster, pql_b, total_b, clients).start()
+        a_flood = FloodLoad(cluster, pql_join, clients=flood_clients).start()
+        time.sleep(flood_s)
+        a_summary = a_flood.stop()
+        b_summary = b_load.stop()
+
+        baseline_p99 = baseline["p99Ms"]
+        loaded_p99 = b_summary["p99Ms"]
+        p99_limit = p99_multiple * max(baseline_p99, p99_floor_ms)
+        offered_qps = a_summary["queries"] / max(flood_s, 1e-9)
+        return {
+            "scenario": "join-under-flood",
+            "quotaQps": quota_qps,
+            "offeredQpsA": round(offered_qps, 1),
+            "offeredMultiple": round(offered_qps / quota_qps, 1),
+            "tenantA": a_summary,
+            "tenantB": b_summary,
+            "tenantBBaseline": baseline,
+            "tenantBLoadedP99Ms": loaded_p99,
+            "tenantBP99LimitMs": round(p99_limit, 3),
+            "tenantBP99Within": loaded_p99 <= p99_limit,
+            "sheddingTyped": a_summary["timeouts"] == 0
+            and a_summary["otherFailures"] == 0,
+            "joinMeters": {
+                k: v["count"]
+                for k, v in cluster.broker.metrics.snapshot()
+                .get("meters", {})
+                .items()
+                if k.startswith("join.")
+            },
+            "failedQueries": b_summary["failedQueries"]
+            + a_summary["timeouts"]
+            + a_summary["otherFailures"],
+        }
+    finally:
+        cluster.stop()
+
+
 def run_ingest_backpressure_scenario(
     rows: int = 400,
     rows_per_segment: int = 1000,
@@ -1473,6 +1602,7 @@ SCENARIOS = {
     "drain": run_drain_scenario,
     "rolling-restart": run_rolling_restart_scenario,
     "noisy-neighbor": run_noisy_neighbor_scenario,
+    "join-under-flood": run_join_under_flood_scenario,
     "ingest-backpressure": run_ingest_backpressure_scenario,
     "partition-server": run_partition_server_scenario,
     "partition-controller": run_partition_controller_scenario,

@@ -99,6 +99,60 @@ def _delta_line(est: float, act: float, label: str) -> str:
     return f"    {label}: est={int(est)}  actual={int(act)}  ({shown}){flag}\n"
 
 
+def render_join_node(join: Dict[str, Any]) -> str:
+    """Join-plan block (broker/joinplan.py node): the chosen strategy,
+    the colocation verdict, per-side estimates vs actuals, and the
+    heavy-hitter split decision.  Pure; unit-testable."""
+    lines: List[str] = []
+    forced = "  (forced)" if join.get("forced") else ""
+    lines.append(f"join: {join.get('strategy')}{forced}  on {join.get('on')}")
+    colo = join.get("colocated") or {}
+    lines.append(
+        f"  colocated: {'eligible' if colo.get('eligible') else 'ineligible'}"
+        f" — {colo.get('reason', '')}"
+    )
+    build = join.get("build") or {}
+    if build:
+        est_rows = build.get("estRows")
+        est_b = build.get("estBytes")
+        lines.append(
+            f"  build side {build.get('table')}: est "
+            f"{est_rows if est_rows is not None else '?'} rows / "
+            f"{_fmt_qty(est_b) if est_b is not None else '?'}B "
+            f"(source={build.get('estSource') or 'none'})"
+        )
+    budget = join.get("budget") or {}
+    if budget:
+        lines.append(
+            f"  broadcast budget: {budget.get('broadcastRows')} rows / "
+            f"{_fmt_qty(budget.get('broadcastBytes', 0))}B"
+        )
+    skew = join.get("skew") or {}
+    if skew:
+        lines.append(
+            f"  skew: split={'on' if skew.get('splitEnabled') else 'OFF'}  "
+            f"heavyFactor={skew.get('heavyFactor')}"
+        )
+    actual = join.get("actual") or {}
+    if actual:
+        parts = [f"strategy={actual.get('strategy')}"]
+        for k in ("buildRows", "probeRows", "broadcastBytes", "shuffleBytes",
+                  "heavyHitterSplits", "owners"):
+            if actual.get(k) is not None:
+                parts.append(f"{k}={actual[k]}")
+        lines.append("  actual: " + "  ".join(parts))
+        per = actual.get("shuffleBytesPerServer") or {}
+        if per:
+            mean = sum(per.values()) / max(1, len(per))
+            worst = max(per.values()) / mean if mean else 0.0
+            lines.append(
+                "  shuffle bytes/server: "
+                + "  ".join(f"{s}={_fmt_qty(v)}B" for s, v in sorted(per.items()))
+                + f"  (max/mean={worst:.2f}x)"
+            )
+    return "\n".join(lines) + "\n"
+
+
 def render_explain(obj: Dict[str, Any]) -> str:
     """Full response JSON or bare explain object -> ASCII tree.  Pure;
     unit-testable."""
@@ -120,6 +174,9 @@ def render_explain(obj: Dict[str, Any]) -> str:
     est = explain.get("estimatedCost") or {}
     if est:
         lines.append(f"estimated: {_fmt_cost(est)}")
+    join = explain.get("join")
+    if join:
+        lines.extend(render_join_node(join).rstrip("\n").split("\n"))
     out = "\n".join(lines) + "\n"
 
     for node in explain.get("servers") or []:

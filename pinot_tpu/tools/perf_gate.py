@@ -140,6 +140,26 @@ MULTICHIP_CONFIG_KEYS = ("total_rows", "num_segments", "n_devices", "platform")
 
 MULTICHIP_DEFAULT_BASELINE = "MULTICHIP_r06.json"
 
+# join-mode documents (PINOT_TPU_BENCH_MODE=join, ISSUE 14): per-
+# strategy closed-loop QPS over uniform and zipf-skewed keys, plus the
+# two structural invariants the gate must never let collapse — the
+# byte-identity differential against the host-reference join
+# (identical == 1.0, exact) and the shuffle skew balance (max owner
+# bytes / mean <= 2.0 under zipf with splitting on).
+JOIN_METRIC_SPECS: Dict[str, Tuple[str, float]] = {
+    "qps.colocated.uniform": ("higher", 0.40),
+    "qps.broadcast.uniform": ("higher", 0.40),
+    "qps.shuffle.uniform": ("higher", 0.40),
+    "qps.shuffle.zipf": ("higher", 0.40),
+    "differential.identical": ("higher", 1.0),
+    "skew.balanceRatioSplit": ("lower", 1.30),
+    "skew.heavyHitterSplits": ("higher", 1.0),
+}
+
+JOIN_CONFIG_KEYS = ("fact_rows", "dim_rows", "num_segments", "platform")
+
+JOIN_DEFAULT_BASELINE = "JOIN_r14.json"
+
 
 def _is_serving(doc: Dict[str, Any]) -> bool:
     return str(doc.get("metric", "")).startswith("serving_")
@@ -151,6 +171,8 @@ def _doc_kind(doc: Dict[str, Any]) -> str:
         return "serving"
     if metric.startswith("multichip_"):
         return "multichip"
+    if metric.startswith("join_"):
+        return "join"
     return "default"
 
 
@@ -161,6 +183,8 @@ def _specs_for(doc: Dict[str, Any]):
         return SERVING_METRIC_SPECS, SERVING_CONFIG_KEYS
     if kind == "multichip":
         return MULTICHIP_METRIC_SPECS, MULTICHIP_CONFIG_KEYS
+    if kind == "join":
+        return JOIN_METRIC_SPECS, JOIN_CONFIG_KEYS
     return METRIC_SPECS, CONFIG_KEYS
 
 
@@ -310,6 +334,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             baseline_path = {
                 "serving": SERVING_DEFAULT_BASELINE,
                 "multichip": MULTICHIP_DEFAULT_BASELINE,
+                "join": JOIN_DEFAULT_BASELINE,
             }.get(_doc_kind(current), "BENCH_r05.json")
         baseline = load_bench(baseline_path)
     except (OSError, ValueError, json.JSONDecodeError) as e:

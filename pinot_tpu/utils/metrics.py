@@ -401,6 +401,21 @@ BROKER_METRIC_CATALOG: Dict[str, str] = {
     "workload registry",
     "workload.digests": "distinct plan-shape digests currently tracked",
     "explain.queries": "EXPLAIN / EXPLAIN ANALYZE queries handled",
+    # distributed-join plane (broker/joinplan.py planner + coordinator)
+    "join.queries": "join queries planned by this broker",
+    "join.failed": "join queries that completed with exceptions",
+    "join.strategy.colocated": "joins executed with the colocated "
+    "partitioned strategy (zero exchange bytes)",
+    "join.strategy.broadcast": "joins executed by broadcasting the "
+    "build side to every probe server",
+    "join.strategy.shuffle": "joins executed through the key-hash "
+    "shuffle exchange",
+    "join.heavyHitterSplits": "heavy-hitter keys split-and-replicated "
+    "across shuffle owners instead of hot-spotting one server",
+    "join.shuffleBytes": "exchange bytes shipped to shuffle owners",
+    "join.broadcastBytes": "build-side bytes shipped across all "
+    "broadcast probe servers",
+    "join.planMs": "join planning + coordination wall ms per query",
     # partition-tolerance plane (ISSUE 9): a partitioned broker keeps
     # serving from its last versioned snapshot and says so
     # SLO & tail-latency attribution plane (ISSUE 11)
@@ -552,6 +567,14 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "hbm.stagedTables": "staged-table cache entries currently resident",
     "hbm.evictedBytes": "staged bytes released by cache evictions",
     "hbm.qinputCacheBytes": "bytes pinned by the device query-input cache",
+    # distributed-join plane (engine/join.py): per-phase server counters
+    "join.extracts": "join side-extraction phase requests served",
+    "join.execs": "join executions (hash build + probe) served",
+    "join.buildRows": "build-side rows inserted into join hash tables",
+    "join.probeRows": "probe-side rows probed against join hash tables",
+    "join.shuffleBytes": "shuffle-exchange bytes RECEIVED by this server "
+    "(the skew-balance observable: compare across servers)",
+    "join.broadcastBytes": "broadcast build-side bytes received",
     # ingest observability (realtime consumers hosted on this server)
     "ingest.rowsConsumed": "stream rows consumed into mutable segments",
     "ingest.commitMs": "segment commit latency (convert + persist round)",
