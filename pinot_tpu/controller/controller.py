@@ -56,7 +56,8 @@ class Controller:
         # pre-register the control-plane series so /metrics exposes
         # them at zero from process start
         for m in ("instanceRegistrations", "heartbeats", "instancesMarkedDead",
-                  "transitionAcks", "clusterStatePolls", "segmentUploads",
+                  "transitionAcks", "clusterStatePolls",
+                  "clusterStateCacheHits", "segmentUploads",
                   "lease.granted", "fence.staleEpochRejections",
                   "fence.leaseRejections", "fence.committerReElections"):
             self.metrics.meter(m)
@@ -78,10 +79,19 @@ class Controller:
         from pinot_tpu.controller.stabilizer import SelfStabilizer
 
         # the convergence loop: re-replicates off dead/draining servers,
-        # retires orphaned consuming segments, cleans the ideal state
+        # retires orphaned consuming segments, cleans the ideal state —
+        # and (r15) proactively rebalances skewed placement
         self.stabilizer = SelfStabilizer(
             self.resources, realtime_manager=self.realtime_manager
         )
+        # skew inputs for the rebalance planner: TTL-cached rollups of
+        # the fleet's /debug/capacity (per-table cost rates) and
+        # /debug/utilization (per-server busy fraction).  In-process
+        # instances advertise no admin URLs, so the rollups degrade to
+        # empty and placement weighs by docs alone.
+        probe = _SkewProbe(self)
+        self.stabilizer.cost_rate_fn = probe.cost_rates
+        self.stabilizer.busy_fn = probe.busy
 
         from pinot_tpu.controller.network import ParticipantGateway
 
@@ -427,6 +437,78 @@ class Controller:
         self.validation_manager.stop()
         self.status_checker.stop()
         self.stabilizer.stop()
+
+
+def cost_rates_from_capacity(capacity: Dict[str, Any]) -> Dict[str, float]:
+    """Per-table docsScanned 1-minute rates out of a ``/debug/capacity``
+    rollup — the cost axis of the rebalance planner's doc-x-cost
+    placement weight."""
+    out: Dict[str, float] = {}
+    for table, entry in (capacity.get("tables") or {}).items():
+        try:
+            out[table] = float(entry.get("docsScannedRate1m") or 0.0)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def busy_from_utilization(util: Dict[str, Any]) -> Dict[str, float]:
+    """Per-server device busy fractions out of a ``/debug/utilization``
+    rollup — the rebalance planner's destination tiebreak (prefer the
+    idlest cold server)."""
+    out: Dict[str, float] = {}
+    for name, entry in (util.get("servers") or {}).items():
+        occ = (entry.get("device") or {}).get("occupancy") or {}
+        try:
+            out[name] = float(occ.get("busyFraction") or 0.0)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+class _SkewProbe:
+    """TTL-cached skew inputs for the stabilizer's rebalance planner.
+
+    The planner evaluates every round (the 2s stabilizer cadence), but
+    the fleet rollups behind it cost one HTTP fan-out each — so the
+    probe refreshes at most every ``ttl_s`` seconds and serves cached
+    maps in between.  Any failure degrades to empty maps (docs-only
+    weighting); a dead server's rollup entry must never stall the
+    convergence loop."""
+
+    def __init__(self, ctrl: "Controller", ttl_s: float = 30.0) -> None:
+        self.ctrl = ctrl
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._at = 0.0
+        self._rates: Dict[str, float] = {}
+        self._busy: Dict[str, float] = {}
+
+    def _refresh(self) -> None:
+        import time as _time
+
+        with self._lock:
+            now = _time.monotonic()
+            if now - self._at < self.ttl_s:
+                return
+            self._at = now
+        try:
+            self._rates = cost_rates_from_capacity(
+                collect_capacity(self.ctrl, timeout_s=1.5)
+            )
+            self._busy = busy_from_utilization(
+                collect_utilization(self.ctrl, timeout_s=1.5)
+            )
+        except Exception:
+            logger.warning("skew-probe rollup failed", exc_info=True)
+
+    def cost_rates(self) -> Dict[str, float]:
+        self._refresh()
+        return self._rates
+
+    def busy(self) -> Dict[str, float]:
+        self._refresh()
+        return self._busy
 
 
 def collect_cluster_metrics(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, Any]:

@@ -212,6 +212,15 @@ class ParticipantGateway:
             self.epoch = str(int(epoch))
         else:
             self.epoch = f"{os.getpid()}-{time.monotonic_ns()}"
+        # versioned snapshot cache (fleet breadth): building the full
+        # cluster state walks every table's external view + segment
+        # metadata (time boundaries), so at 100+ tables x N brokers
+        # polling, an unchanged cluster must serve ONE build per
+        # version, not one per poll.  Keyed on the resource version the
+        # build captured; any bump (view change, registration, drain)
+        # naturally invalidates it.
+        self._state_cache: Optional[Dict[str, Any]] = None
+        self._state_cache_lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -458,9 +467,25 @@ class ParticipantGateway:
     # -- broker API ----------------------------------------------------
     def cluster_state(self) -> Dict[str, Any]:
         """Versioned snapshot remote brokers poll to rebuild routing,
-        server addresses, quotas, and hybrid time boundaries."""
+        server addresses, quotas, and hybrid time boundaries.  Built at
+        most once per resource version: concurrent brokers polling an
+        unchanged cluster share the cached document (the O(tables)
+        walk happens on change, not per poll)."""
         if self.metrics is not None:
             self.metrics.meter("clusterStatePolls").mark()
+        res = self.resources
+        with self._state_cache_lock:
+            cached = self._state_cache
+        if cached is not None and cached["version"] == res.version:
+            if self.metrics is not None:
+                self.metrics.meter("clusterStateCacheHits").mark()
+            return cached
+        built = self._build_cluster_state()
+        with self._state_cache_lock:
+            self._state_cache = built
+        return built
+
+    def _build_cluster_state(self) -> Dict[str, Any]:
         res = self.resources
         with res._lock:
             # version captured BEFORE the snapshot: a concurrent bump then

@@ -340,6 +340,11 @@ class RealtimeSegmentManager:
         # optional ControllerMetrics: realtime commit-plane series
         # (segmentCommits meter + segmentCommitMs persistence timer)
         self.metrics = metrics
+        # optional IngestConsumerPool (realtime/pool.py): when set,
+        # every in-process consumer this manager creates is driven by
+        # the pool's bounded workers instead of waiting for manual
+        # consume_step calls — the partition-parallel ingest plane
+        self.ingest_pool = None
         # controller fencing incarnation (set by the Controller): arms
         # the commit-plane epoch fence in SegmentCompletionManager
         self.epoch: Optional[int] = None
@@ -647,6 +652,9 @@ class RealtimeSegmentManager:
         with self._lock:
             self._consumers[(segment, server_instance.name)] = dm
         server_instance.add_segment(table, dm.mutable)
+        pool = self.ingest_pool
+        if pool is not None:
+            pool.add(dm, key=(segment, server_instance.name))
         return True
 
     def consumers_of(self, segment: str) -> List["RealtimeSegmentDataManager"]:
@@ -669,6 +677,8 @@ class RealtimeSegmentManager:
             ]:
                 self._consumers[key].stop()
                 del self._consumers[key]
+                if self.ingest_pool is not None:
+                    self.ingest_pool.remove(key)
 
     # -- commit --------------------------------------------------------
     def on_segment_committed(self, segment: str, committed) -> None:
@@ -696,6 +706,8 @@ class RealtimeSegmentManager:
             for key in [k for k in self._consumers if k[0] == segment]:
                 self._consumers[key].stop()
                 del self._consumers[key]
+                if self.ingest_pool is not None:
+                    self.ingest_pool.remove(key)
         if self.metrics is not None:
             self.metrics.meter("segmentCommits").mark()
             self.metrics.timer("segmentCommitMs").update(
@@ -781,6 +793,13 @@ class RealtimeSegmentDataManager:
         self.partition = partition
         self.offset = start_offset
         self.rows_per_segment = rows_per_segment
+        # cooperative-pool idle cadence (realtime/pool.py): how long a
+        # paused/empty/HOLDing consumer stays off its pool worker
+        self.poll_interval_s = 0.05
+        # rows one pool step may consume (columnar topics serve whole
+        # 64k blocks — the ingest ladder raises this to block size so
+        # throughput runs aren't bounded by trim-and-refetch)
+        self.step_rows = 1000
         self.mutable = MutableSegment(schema, segment_name, table)
         self.mutable.start_offset = start_offset
         self._stopped = False
@@ -929,6 +948,30 @@ class RealtimeSegmentDataManager:
     @property
     def threshold_reached(self) -> bool:
         return self.mutable.num_docs >= self.rows_per_segment
+
+    def step(self) -> Optional[float]:
+        """One cooperative pool unit (realtime/pool.py): a bounded
+        consume batch, plus one completion-protocol round at the row
+        threshold.  Returns seconds until this consumer is eligible
+        again, or None when finished (committed/discarded/stopped —
+        the successor sequence gets its own consumer).  Never blocks:
+        a backpressure pause, an empty stream, or a completion HOLD
+        all surface as an idle delay so the shared workers stay free
+        for the other partitions."""
+        if self._stopped:
+            return None
+        got = self.consume_step(self.step_rows)
+        if self.threshold_reached:
+            resp = self.try_commit()
+            if self._stopped or resp in (RESP_KEEP, RESP_DISCARD):
+                # on_segment_committed retires this consumer (stop());
+                # KEEP/DISCARD mean the sequence is settled elsewhere
+                return None
+            # HOLD / CATCH_UP / NOT_LEADER / lease-frozen: retry later
+            return self.poll_interval_s
+        if self._paused or got == 0:
+            return self.poll_interval_s
+        return 0.0
 
     def try_commit(self) -> str:
         """Run the completion protocol once

@@ -464,17 +464,25 @@ class StreamBrokerServer:
                     off = int(req.get("offset", 0))
                     if len(topic.raw[p]):
                         return json.dumps({"error": "row-mode partition"}).encode()
-                    if topic.columnar is None:
+                    columnar = topic.columnar
+                    if columnar is None:
                         return pack_columnar(
-                            {"n": 0, "start": off, "nextOffset": off, "cols": []}, []
+                            {"n": 0, "start": off, "nextOffset": off, "cols": []},
+                            [],
                         )
-                    return topic.columnar.fetch_frame(p, off)
-                if op == "latest":
+                elif op == "latest":
                     p = int(req.get("partition", 0))
                     return json.dumps({"offset": topic.count(p)}).encode()
-                if op == "meta":
+                elif op == "meta":
                     return json.dumps({"partitions": len(topic.raw)}).encode()
-            return json.dumps({"error": f"unknown op {op!r}"}).encode()
+                else:
+                    return json.dumps({"error": f"unknown op {op!r}"}).encode()
+            # fetchc reaches here: splice the reply OUTSIDE the broker
+            # lock — packing a multi-megabyte block frame under it
+            # would serialize every partition-parallel consumer on one
+            # fetch (the block list is append-only, so a concurrent
+            # produce is at worst not-yet-visible, never torn)
+            return columnar.fetch_frame(p, off)
         except (KeyError, IndexError, ValueError) as e:
             return json.dumps({"error": str(e)}).encode()
         except Exception as e:  # never kill the connection on a bad frame

@@ -1,0 +1,284 @@
+"""Ingest consumer pool: bounded worker threads driving N per-partition
+realtime consumers.
+
+Before r15 every realtime consumer owned a dedicated thread
+(``server/network_starter.py RemoteConsumer._run``) or was stepped
+manually by the harness (``realtime/llc.py
+RealtimeSegmentDataManager.consume_step``).  At fleet breadth — 100+
+tables, each with one consumer per stream partition — a
+thread-per-consumer server melts into scheduler thrash, and the
+in-process harness had no background ingest at all.
+
+The pool is the LLC analog of the reference's shared realtime consumer
+executor (``RealtimeSegmentDataManager`` instances multiplexed over a
+bounded segment-build/consume thread budget): consumers register a
+cooperative ``step()`` — one bounded unit of fetch+index (+ completion
+protocol) work that NEVER blocks on a wait — and ``PINOT_TPU_INGEST_CONSUMERS``
+worker threads (default 4) drive the ready consumer with the earliest
+eligible time.  ``step()`` returns:
+
+- ``0.0`` — made progress, immediately eligible again;
+- ``t > 0`` — idle/held (backpressure pause, stream empty, completion
+  HOLD, controller freeze): eligible again in ``t`` seconds.  The pool
+  sleeps on a condition variable, so a held consumer costs nothing;
+- ``None`` — finished (committed/discarded/stopped): deregistered.
+
+Independence properties the elastic-fleet plane leans on:
+
+- each partition's consumer checks the server's backpressure governor
+  inside its own step, so one held partition never blocks the others
+  sharing its worker;
+- N partitions crossing their row thresholds run N completion
+  protocols concurrently — safe by construction because every
+  ``segmentConsumed``/``segmentCommit`` carries the caller's lease
+  epoch through the PR 9 fences (the FSM is per-segment and the
+  property-store writes are epoch-checked);
+- a consumer raising out of ``step()`` is parked with a backoff rather
+  than killing the worker (one poisoned consumer must not stall the
+  other partitions' ingest).
+
+Per-(table, partition) lag/pause gauges stay continuous across segment
+rollover and pool resize: the series is named by (table, partition),
+not by consumer, and a successor re-registers the same name (the
+``clear_fn`` equality guard in ``utils/metrics.py`` makes the
+predecessor's detach a no-op once the successor owns the series —
+regression-tested in ``tests/test_elastic_fleet.py``).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# error backoff for a consumer whose step() raised: long enough not to
+# spin a broken consumer, short enough that a transient (stream hiccup
+# racing a commit) self-heals quickly
+_ERROR_PARK_S = 1.0
+
+
+def default_pool_workers() -> int:
+    """``PINOT_TPU_INGEST_CONSUMERS``: worker threads per pool (per
+    server process).  More workers = more partitions consuming truly
+    concurrently, up to the host's cores."""
+    try:
+        return max(1, int(os.environ.get("PINOT_TPU_INGEST_CONSUMERS", "4")))
+    except ValueError:
+        return 4
+
+
+# every pool registers here so the conftest thread-leak guard can
+# assert a stopped pool's workers actually exited (mirrors
+# engine.dispatch._all_lanes / controller.managers._all_managers)
+_all_pools: "weakref.WeakSet[IngestConsumerPool]" = weakref.WeakSet()
+
+
+def leaked_pool_threads(grace_s: float = 2.0) -> List[threading.Thread]:
+    """Worker threads still alive on STOPPED pools (running pools are
+    exempt — they are still ingesting).  Covers workers retired by a
+    shrink too, not only the current generation."""
+    suspects: List[threading.Thread] = []
+    for pool in list(_all_pools):
+        if pool._stop.is_set():
+            suspects.extend(
+                t for t in pool._threads + pool._retired if t.is_alive()
+            )
+    deadline = time.monotonic() + grace_s
+    leaked = []
+    for t in suspects:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if t.is_alive():
+            leaked.append(t)
+    return leaked
+
+
+class _Entry:
+    __slots__ = ("consumer", "eligible_at", "running")
+
+    def __init__(self, consumer: Any, eligible_at: float) -> None:
+        self.consumer = consumer
+        self.eligible_at = eligible_at
+        self.running = False
+
+
+class IngestConsumerPool:
+    """Bounded worker threads multiplexing cooperative consumers."""
+
+    def __init__(
+        self,
+        workers: Optional[int] = None,
+        metrics=None,
+        name: str = "ingest",
+    ) -> None:
+        self.workers = workers if workers is not None else default_pool_workers()
+        self.metrics = metrics
+        self.name = name
+        self._cv = threading.Condition()
+        self._entries: Dict[Any, _Entry] = {}  # key -> entry
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # workers superseded by a shrink: they exit at their next
+        # wakeup, but stay tracked until then so stop() joins them and
+        # the leak guard can see one wedged mid-step
+        self._retired: List[threading.Thread] = []
+        self._generation = 0  # bumped on resize; old workers drain out
+        self.steps = 0
+        self.errors = 0
+        if metrics is not None:
+            metrics.meter("ingest.pool.steps")
+            metrics.meter("ingest.pool.errors")
+            metrics.gauge("ingest.pool.workers").set_fn(lambda: self.workers)
+            metrics.gauge("ingest.pool.consumers").set_fn(
+                lambda: len(self._entries)
+            )
+        _all_pools.add(self)
+
+    # -- registration --------------------------------------------------
+    def add(self, consumer: Any, key: Optional[Any] = None) -> None:
+        """Register a consumer (``key`` defaults to the consumer object
+        itself).  Idempotent per key — a redelivered CONSUMING
+        transition must not double-drive one consumer."""
+        key = consumer if key is None else key
+        with self._cv:
+            if self._stop.is_set():
+                raise RuntimeError("pool is stopped")
+            if key in self._entries:
+                return
+            self._entries[key] = _Entry(consumer, time.monotonic())
+            self._ensure_workers_locked()
+            self._cv.notify_all()
+
+    def remove(self, key: Any) -> None:
+        with self._cv:
+            self._entries.pop(key, None)
+
+    def kick(self) -> None:
+        """Make every consumer immediately eligible (e.g. backpressure
+        cleared, controller reachable again) instead of sleeping out
+        its current delay."""
+        now = time.monotonic()
+        with self._cv:
+            for e in self._entries.values():
+                e.eligible_at = min(e.eligible_at, now)
+            self._cv.notify_all()
+
+    def resize(self, workers: int) -> None:
+        """Live worker-count change.  Growing starts threads; shrinking
+        retires surplus workers at their next wakeup (consumers and
+        their gauges are untouched — the series stay continuous)."""
+        workers = max(1, int(workers))
+        with self._cv:
+            if workers == self.workers:
+                return
+            if workers < self.workers:
+                # workers check their generation on wakeup and exit;
+                # until then they stay tracked in _retired
+                self._generation += 1
+                self.workers = workers
+                self._retired.extend(
+                    t for t in self._threads if t.is_alive()
+                )
+                self._threads = []
+                self._cv.notify_all()
+            else:
+                self.workers = workers
+            self._ensure_workers_locked()
+
+    def _ensure_workers_locked(self) -> None:
+        if self._stop.is_set() or not self._entries:
+            return
+        alive = [t for t in self._threads if t.is_alive()]
+        self._threads = alive
+        self._retired = [t for t in self._retired if t.is_alive()]
+        gen = self._generation
+        while len(self._threads) < self.workers:
+            idx = len(self._threads)
+            t = threading.Thread(
+                target=self._worker,
+                args=(gen, idx),
+                name=f"{self.name}-pool-{idx}",
+                daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop.set()
+            self._entries.clear()
+            self._cv.notify_all()
+        for t in self._threads + self._retired:
+            if t is not threading.current_thread():
+                t.join(timeout=2)
+
+    # -- the worker loop ----------------------------------------------
+    def _claim_locked(self, now: float):
+        """The not-running entry with the earliest eligible time, or
+        (None, soonest-wakeup) when nothing is ready."""
+        best_key = None
+        best = None
+        soonest: Optional[float] = None
+        for key, e in self._entries.items():
+            if e.running:
+                continue
+            if e.eligible_at <= now:
+                if best is None or e.eligible_at < best.eligible_at:
+                    best_key, best = key, e
+            elif soonest is None or e.eligible_at < soonest:
+                soonest = e.eligible_at
+        return best_key, best, soonest
+
+    def _worker(self, gen: int, idx: int) -> None:
+        while True:
+            with self._cv:
+                while True:
+                    if self._stop.is_set():
+                        return
+                    if gen != self._generation or idx >= self.workers:
+                        return  # retired by resize
+                    now = time.monotonic()
+                    key, entry, soonest = self._claim_locked(now)
+                    if entry is not None:
+                        entry.running = True
+                        break
+                    timeout = None if soonest is None else max(0.0, soonest - now)
+                    self._cv.wait(timeout=timeout if timeout != 0 else 0.01)
+            delay: Optional[float]
+            try:
+                delay = entry.consumer.step()
+            except Exception:
+                logger.exception(
+                    "consumer step failed in pool %s; parking %.1fs",
+                    self.name, _ERROR_PARK_S,
+                )
+                self.errors += 1
+                if self.metrics is not None:
+                    self.metrics.meter("ingest.pool.errors").mark()
+                delay = _ERROR_PARK_S
+            self.steps += 1
+            if self.metrics is not None:
+                self.metrics.meter("ingest.pool.steps").mark()
+            with self._cv:
+                if delay is None:
+                    self._entries.pop(key, None)
+                else:
+                    cur = self._entries.get(key)
+                    if cur is entry:
+                        entry.eligible_at = time.monotonic() + delay
+                        entry.running = False
+                self._cv.notify_all()
+
+    # -- observability -------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        with self._cv:
+            return {
+                "workers": self.workers,
+                "consumers": len(self._entries),
+                "steps": self.steps,
+                "errors": self.errors,
+                "running": sum(1 for e in self._entries.values() if e.running),
+            }

@@ -189,7 +189,18 @@ class RemoteConsumer:
     broker by offset, indexes into a mutable segment served to queries
     immediately, and runs the completion protocol against the
     controller over HTTP (the ``LLRealtimeSegmentDataManager.java:68``
-    consume loop + ``SegmentCompletionProtocol`` client)."""
+    consume loop + ``SegmentCompletionProtocol`` client).
+
+    Since r15 consumers are COOPERATIVE: instead of one dedicated
+    thread per consuming segment (which melts down at 100+ tables),
+    each consumer exposes ``step()`` — one bounded, never-blocking unit
+    of consume/commit work — and the starter's shared
+    ``IngestConsumerPool`` (``PINOT_TPU_INGEST_CONSUMERS`` workers)
+    drives all of them.  Every wait the old loop slept through
+    (backpressure pause, empty stream, completion HOLD, controller
+    freeze) now surfaces as the step's return delay, so a frozen
+    partition costs zero worker time and N hot partitions genuinely
+    consume in parallel."""
 
     def __init__(
         self,
@@ -215,16 +226,20 @@ class RemoteConsumer:
         self.mutable = MutableSegment(schema, segment, table)
         self.mutable.start_offset = self.offset
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
         # controller unreachability is a FREEZE, not a failure: offsets
-        # hold, the thread survives, and retries back off with full
+        # hold, the consumer survives, and retries back off with full
         # jitter (utils/retry.py) so a healing controller is not
-        # stampeded by every frozen consumer at once
+        # stampeded by every frozen consumer at once.  The backoff's
+        # delay parks this consumer in the pool (``_park_s``) instead
+        # of blocking a shared worker.
         from pinot_tpu.utils.retry import FullJitterBackoff
 
         self._ctrl_backoff = FullJitterBackoff(
             initial_s=max(0.1, poll_interval_s), cap_s=5.0
         )
+        # seconds the NEXT pool step should wait before re-driving this
+        # consumer; set by the protocol paths (HOLD/freeze) per round
+        self._park_s = poll_interval_s
         # ingest observability (same series as the in-process consumer,
         # realtime/llc.py): per-partition lag gauge + rows/s meter.
         # The TTL-cached probe (realtime/stream.py LagProbe) keeps the
@@ -261,8 +276,7 @@ class RemoteConsumer:
 
     def start(self) -> None:
         self.starter.server.add_segment(self.table, self.mutable)
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        self.starter.ingest_pool.add(self, key=self.segment)
 
     def stop(self) -> None:
         self._stop.set()
@@ -293,50 +307,56 @@ class RemoteConsumer:
                 cache.on_offset_advance(self.table, self.partition, self.offset)
         return len(rows)
 
-    def _run(self) -> None:
-        try:
-            while not self._stop.is_set():
-                if self._governor is not None:
-                    allowed = self._governor.consume_allowed()
-                    self._paused = not allowed
-                    if not allowed:
-                        # held above a memory watermark: offset freezes,
-                        # lag grows on the gauge, nothing is lost —
-                        # consumption resumes below the low watermark
-                        self._stop.wait(self.poll_interval_s)
-                        continue
-                try:
-                    got = self._consume_to(self.rows_per_segment)
-                except Exception as e:
-                    logger.warning("stream fetch failed for %s: %s", self.segment, e)
-                    self._stop.wait(self.poll_interval_s)
-                    continue
-                if self.mutable.num_docs >= self.rows_per_segment:
-                    if self._completion_round():
-                        return  # segment finished (committed or discarded)
-                elif got == 0:
-                    self._stop.wait(self.poll_interval_s)
-        except Exception:
-            logger.exception("remote consumer for %s died", self.segment)
-        finally:
-            # finished (committed/discarded) or died: this consumer's
-            # offset is frozen, so its lag series must not keep
-            # reporting; a rolled successor re-registers the same name
+    def step(self) -> Optional[float]:
+        """One cooperative pool unit: a bounded consume batch plus (at
+        the row threshold) one completion-protocol round.  Returns the
+        seconds until this consumer is eligible again, or None when the
+        segment is finished (committed/discarded/stopped) — the
+        CONSUMING transition for the next sequence registers a fresh
+        consumer under the same per-(table, partition) gauge names."""
+        if self._stop.is_set():
             self._detach_lag_gauge()
+            return None
+        if self._governor is not None:
+            allowed = self._governor.consume_allowed()
+            self._paused = not allowed
+            if not allowed:
+                # held above a memory watermark: offset freezes, lag
+                # grows on the gauge, nothing is lost — consumption
+                # resumes below the low watermark
+                return self.poll_interval_s
+        try:
+            got = self._consume_to(self.rows_per_segment)
+        except Exception as e:
+            logger.warning("stream fetch failed for %s: %s", self.segment, e)
+            return self.poll_interval_s
+        if self.mutable.num_docs >= self.rows_per_segment:
+            self._park_s = self.poll_interval_s
+            if self._completion_round():
+                # finished: this consumer's offset is frozen, so its
+                # lag series must not keep reporting; a rolled
+                # successor re-registers the same name
+                self._detach_lag_gauge()
+                return None
+            return self._park_s
+        return 0.0 if got else self.poll_interval_s
 
     def _freeze(self, why: str, err) -> bool:
         """Controller unreachable (or authority lost) mid-protocol:
-        freeze the round — offset untouched, consumer thread alive —
-        and retry after a full-jitter backoff."""
-        delay = self._ctrl_backoff.next_delay()
+        freeze the round — offset untouched, consumer alive — and park
+        for a full-jitter backoff before the pool retries it."""
+        self._park_s = self._ctrl_backoff.next_delay()
         logger.warning(
-            "%s for %s frozen (retry in %.2fs): %s", why, self.segment, delay, err
+            "%s for %s frozen (retry in %.2fs): %s",
+            why, self.segment, self._park_s, err,
         )
-        self._stop.wait(delay)
         return False
 
     def _completion_round(self) -> bool:
-        """One segmentConsumed exchange; True when this consumer is done."""
+        """One segmentConsumed exchange; True when this consumer is
+        done.  Never blocks — idle verdicts (HOLD, freeze, failed
+        commit) set ``_park_s`` and return False so the pool re-drives
+        this consumer after the delay."""
         lease = self.starter.server.lease
         if not lease.held():
             # write authority expired (partitioned past the lease
@@ -368,7 +388,7 @@ class RemoteConsumer:
                 # conversion/serialization failure: stay alive and retry
                 # via the next segmentConsumed round
                 logger.warning("commit of %s failed: %s", self.segment, e)
-                self._stop.wait(self.poll_interval_s)
+                self._park_s = self.poll_interval_s
                 return False
         if resp == "CATCH_UP" and target is not None:
             while self.offset < int(target) and not self._stop.is_set():
@@ -380,10 +400,13 @@ class RemoteConsumer:
                     # transient stream failure mid-catch-up: keep the
                     # consumer alive, retry on the next round
                     logger.warning("catch-up fetch failed for %s: %s", self.segment, e)
-                    self._stop.wait(self.poll_interval_s)
+                    self._park_s = self.poll_interval_s
                     return False
                 if got == 0:
-                    self._stop.wait(self.poll_interval_s)
+                    # stream has no more rows toward the target yet:
+                    # yield the worker, resume catching up next step
+                    self._park_s = self.poll_interval_s
+                    return False
             return False
         if resp == "DISCARD":
             # another replica committed a different offset range: drop
@@ -395,8 +418,8 @@ class RemoteConsumer:
             # committed elsewhere at exactly our offset; keep serving
             # the local rows until the ONLINE transition replaces them
             return True
-        # HOLD (or unknown): wait and retry
-        self._stop.wait(self.poll_interval_s)
+        # HOLD (or unknown): retry after the poll cadence
+        self._park_s = self.poll_interval_s
         return False
 
     def _commit(self, epoch=None) -> bool:
@@ -643,6 +666,16 @@ class NetworkedServerStarter:
         )
         self._local_crcs: Dict[str, int] = {}
         self._consumers: Dict[str, RemoteConsumer] = {}  # segment -> consumer
+        # partition-parallel ingest plane (realtime/pool.py): ONE
+        # bounded worker pool drives every LLC consumer on this server
+        # (PINOT_TPU_INGEST_CONSUMERS workers), so 100+ consuming
+        # tables cost a fixed thread budget and N hot partitions
+        # consume concurrently
+        from pinot_tpu.realtime.pool import IngestConsumerPool
+
+        self.ingest_pool = IngestConsumerPool(
+            metrics=self.server.metrics, name=name
+        )
         self._stop = threading.Event()
         # cross-signal wake: a heartbeat SUCCEEDING while the message
         # poll is deep in backoff means the controller is reachable
@@ -750,6 +783,7 @@ class NetworkedServerStarter:
         self._msg_wake.set()  # unblock a message loop deep in backoff
         for consumer in list(self._consumers.values()):
             consumer.stop()
+        self.ingest_pool.stop()
         for t in self._threads:
             t.join(timeout=2)
         self.tcp.stop()
@@ -802,9 +836,12 @@ class NetworkedServerStarter:
                 if self._hb_backoff.failures:
                     # controller back after an outage: wake the message
                     # loop out of its backoff so queued transitions
-                    # (e.g. pending ONLINE re-acks) land immediately
+                    # (e.g. pending ONLINE re-acks) land immediately,
+                    # and kick frozen consumers out of their backoff
+                    # parks (their next protocol round will now land)
                     self._msg_backoff.reset()
                     self._msg_wake.set()
+                    self.ingest_pool.kick()
                 self._hb_backoff.reset()
                 unreachable.set(0)
                 wait_s = self.heartbeat_interval_s
@@ -868,6 +905,7 @@ class NetworkedServerStarter:
                 if consumer is not None and not getattr(consumer, "rolls_locally", False):
                     self._consumers.pop(segment, None)
                     consumer.stop()
+                    self.ingest_pool.remove(segment)
                 ok = self._load(
                     table,
                     segment,
@@ -882,6 +920,7 @@ class NetworkedServerStarter:
                 consumer = self._consumers.pop(segment, None)
                 if consumer is not None:
                     consumer.stop()
+                    self.ingest_pool.remove(segment)
                 self.server.remove_segment(table, segment)
                 self._local_crcs.pop(segment, None)
                 ok = True

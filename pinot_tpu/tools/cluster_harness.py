@@ -19,6 +19,13 @@ HTTP/TCP endpoints in one process, every link routed through a shared
 ``NetworkFaultInjector`` — proving lease-fenced serving and the
 epoch-fenced commit plane under severed links (tier-1 twins in
 ``tests/test_partition.py``).
+
+``--scenario elastic-fleet`` runs the fleet-breadth chaos acceptance
+(ISSUE 15): 100+ tables under mixed ingest+query closed-loop load,
+a forced hot-tenant skew, a live make-before-break rebalance, and a
+mid-rebalance controller restart — zero failed queries, zero
+lost/duplicate rows, exactly one committed copy per sequence (tier-1
+twin in ``tests/test_elastic_fleet.py``).
 """
 from __future__ import annotations
 
@@ -195,11 +202,13 @@ class ClosedLoopLoad:
     loaded percentiles against its unloaded baseline."""
 
     def __init__(
-        self, cluster: "InProcessCluster", pql: str, expected_docs: int,
+        self, cluster: "InProcessCluster", pql: str, expected_docs: Optional[int],
         clients: int = 3,
     ) -> None:
         self.cluster = cluster
         self.pql = pql
+        # None = "any complete answer is ok" (live realtime tables,
+        # where the expected count grows while ingest runs)
         self.expected_docs = expected_docs
         self.clients = clients
         self._stop = threading.Event()
@@ -230,7 +239,10 @@ class ClosedLoopLoad:
                 self.latencies_ms.append(ms)
                 if resp.partial_response:
                     self.partials += 1
-                elif resp.exceptions or resp.num_docs_scanned != self.expected_docs:
+                elif resp.exceptions or (
+                    self.expected_docs is not None
+                    and resp.num_docs_scanned != self.expected_docs
+                ):
                     self.failed += 1
                     if len(self.failures) < 8:
                         self.failures.append(
@@ -891,6 +903,349 @@ def run_ingest_backpressure_scenario(
             else 1,
         }
     finally:
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# Elastic-fleet scenario (ISSUE 15): 100+ tables under mixed
+# ingest+query closed-loop load, a forced hot-tenant skew, a live
+# make-before-break rebalance, and a mid-rebalance controller restart.
+# Shared by the CLI and tests/test_elastic_fleet.py.
+# ---------------------------------------------------------------------------
+
+
+def _fleet_loads_by_server(res, tables) -> Dict[str, float]:
+    """Doc-weighted ideal-state load per server (the scenario's own
+    balance check — deliberately independent of the planner's)."""
+    load: Dict[str, float] = {}
+    for table in tables:
+        for seg, replicas in res.get_ideal_state(table).items():
+            info = res.get_segment_metadata(table, seg)
+            meta = info.get("metadata") if info else None
+            docs = max(1, int(getattr(meta, "num_docs", 0) or 0))
+            for s in replicas:
+                load[s] = load.get(s, 0.0) + docs
+    return load
+
+
+def run_elastic_fleet_scenario(
+    num_tables: int = 104,
+    num_servers: int = 3,
+    clients: int = 3,
+    hot_segments: int = 6,
+    hot_docs: int = 400,
+    fleet_docs: int = 20,
+    rt_tables: int = 2,
+    rt_partitions: int = 2,
+    rows_per_segment: int = 40,
+    rt_segments_per_partition: int = 2,
+    pool_workers: int = 4,
+    max_rounds: int = 40,
+    data_dir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """The elastic-fleet chaos acceptance (ISSUE 15), end to end:
+
+    1. **breadth** — ``num_tables`` tables on ``num_servers`` servers:
+       mostly tiny offline tables (the 100-tenant fleet), plus
+       ``rt_tables`` REALTIME tables whose partitions are consumed by
+       the shared ``IngestConsumerPool`` (partition-parallel ingest);
+    2. **mixed load** — closed-loop query clients over a fleet table,
+       the hot table, and a live realtime table WHILE ingest runs;
+    3. **forced skew** — ``hot_segments`` doc-heavy segments pinned
+       onto server0 plus a cost-rate hint naming the hot table, so the
+       stabilizer's skew evaluation must trip;
+    4. **live rebalance** — the planner's make-before-break moves run
+       under load; every round asserts no segment ever loses its last
+       serving replica (coverage is checked against the external view,
+       not hoped for);
+    5. **mid-rebalance controller restart** — with moves still pending,
+       the controller is torn down and a NEW incarnation recovers from
+       the property store; servers and the broker re-wire to it and its
+       stabilizer completes the remaining moves from DERIVED state.
+
+    Acceptance: zero failed queries end to end, zero lost/duplicate
+    rows (realtime counts exact), exactly one committed copy per
+    (partition, sequence), and a final placement whose doc-weighted
+    imbalance is back under the skew threshold.
+    """
+    from pinot_tpu.controller.controller import Controller
+    from pinot_tpu.realtime.llc import make_segment_name
+    from pinot_tpu.realtime.pool import IngestConsumerPool
+    from pinot_tpu.realtime.stream import MemoryStreamProvider
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.server.starter import ServerStarter
+    from pinot_tpu.tools.datagen import random_rows
+
+    cluster = InProcessCluster(num_servers=num_servers, data_dir=data_dir)
+    ctrl_a = cluster.controller
+    res = ctrl_a.resources
+    st = ctrl_a.stabilizer
+    st.grace_s = 0.0
+    # tight knobs so the scenario converges in bounded rounds (defaults
+    # are production-paced: ratio 2.0, 3 rounds, 2 moves)
+    st.rebalance_skew_ratio = 1.4
+    st.rebalance_hysteresis = 2
+    st.rebalance_max_moves = 4
+
+    pool_a = IngestConsumerPool(workers=pool_workers, name="elasticA")
+    ctrl_a.realtime_manager.ingest_pool = pool_a
+
+    ctrl_b: Optional[Controller] = None
+    pool_b: Optional[IngestConsumerPool] = None
+    loads: List[ClosedLoopLoad] = []
+    try:
+        # -- 1. breadth: the 100-table fleet --------------------------
+        template = _tenant_schema("fleet0")
+        fleet_rows = random_rows(template, fleet_docs, seed=13)
+        hot_rows = random_rows(template, hot_docs, seed=14)
+        num_offline = num_tables - rt_tables - 1  # -1: the hot table
+        fleet_physicals: List[str] = []
+        for i in range(num_offline):
+            schema = _tenant_schema(f"fleet{i}")
+            physical = cluster.add_offline_table(schema, replication=1)
+            fleet_physicals.append(physical)
+            cluster.upload(
+                physical,
+                build_segment(schema, fleet_rows, physical, f"fleet{i}s0"),
+            )
+
+        # -- realtime tables on the shared consumer pool --------------
+        rt_rows_per_partition = rows_per_segment * rt_segments_per_partition
+        rt_physicals: List[str] = []
+        rt_streams: List[MemoryStreamProvider] = []
+        for i in range(rt_tables):
+            schema = _tenant_schema(f"rtFleet{i}")
+            stream = MemoryStreamProvider(num_partitions=rt_partitions)
+            physical = cluster.add_realtime_table(
+                schema, stream, rows_per_segment=rows_per_segment
+            )
+            rt_physicals.append(physical)
+            rt_streams.append(stream)
+            rows = random_rows(schema, rt_rows_per_partition, seed=20 + i)
+            for p in range(rt_partitions):
+                for row in rows:
+                    stream.produce(row, partition=p)
+
+        # -- forced hot-tenant skew -----------------------------------
+        hot_schema = _tenant_schema("hotTable")
+        hot_physical = cluster.add_offline_table(hot_schema, replication=1)
+        for i in range(hot_segments):
+            seg = build_segment(hot_schema, hot_rows, hot_physical, f"hot{i}")
+            path = ctrl_a.store.save(hot_physical, seg)
+            res.add_segment(
+                hot_physical, seg.metadata,
+                {"dir": path, "downloadUri": "file://" + os.path.abspath(path)},
+                servers=["server0"],
+            )
+        # the cost axis: the hot table is also the hot QUERY tenant
+        # (what /debug/capacity would report once brokers attribute it)
+        st.cost_rate_fn = lambda: {"hotTable": 50.0}
+
+        expected_hot = hot_segments * hot_docs
+        expected_fleet = fleet_docs
+        total_rt = rt_partitions * rt_rows_per_partition
+
+        # -- 2. mixed ingest+query closed-loop load -------------------
+        loads = [
+            ClosedLoopLoad(
+                cluster, "SELECT count(*) FROM hotTable", expected_hot, clients
+            ).start(),
+            ClosedLoopLoad(
+                cluster, "SELECT count(*) FROM fleet0", expected_fleet, 1
+            ).start(),
+            # live realtime table: any complete answer is correct while
+            # ingest advances the count
+            ClosedLoopLoad(
+                cluster, "SELECT count(*) FROM rtFleet0", None, 1
+            ).start(),
+        ]
+        time.sleep(0.2)
+
+        def coverage_ok(r=None) -> bool:
+            """No segment may ever lose its last serving replica (checked
+            against whichever controller incarnation owns the round)."""
+            r = r or res
+            for table in [hot_physical] + fleet_physicals[:3]:
+                view = r.get_external_view(table)
+                for seg, replicas in r.get_ideal_state(table).items():
+                    if not any(
+                        view.get(seg, {}).get(s) == "ONLINE" for s in replicas
+                    ):
+                        return False
+            return True
+
+        # -- 4. live rebalance, stopped MID-flight --------------------
+        coverage_never_lost = True
+        moves_started_at_restart = 0
+        rounds_a = 0
+        for _ in range(max_rounds):
+            st.run_once()
+            rounds_a += 1
+            coverage_never_lost = coverage_never_lost and coverage_ok()
+            moves_started_at_restart = st.metrics.meter(
+                "rebalance.movesStarted"
+            ).count
+            if moves_started_at_restart and st._pending_moves:
+                break  # mid-rebalance: phase-1 done, phase-2 pending
+            time.sleep(0.02)
+        pending_at_restart = len(st._pending_moves)
+        surplus_at_restart = sum(
+            1
+            for table in [hot_physical] + fleet_physicals
+            for replicas in res.get_ideal_state(table).values()
+            if len(replicas) > 1
+        )
+
+        # realtime must be quiescent before the in-process restart (a
+        # MEMORY stream's buffered rows die with the manager, so the
+        # tip consumer must be empty = everything produced is durable)
+        def rt_quiescent() -> bool:
+            for physical in rt_physicals:
+                ideal = res.get_ideal_state(physical)
+                for p in range(rt_partitions):
+                    for seq in range(rt_segments_per_partition):
+                        seg = ideal.get(make_segment_name(physical, p, seq))
+                        if not seg or "ONLINE" not in seg.values():
+                            return False
+            return True
+
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not rt_quiescent():
+            time.sleep(0.05)
+        rt_committed = rt_quiescent()
+
+        # -- 5. mid-rebalance controller restart ----------------------
+        pool_a.stop()
+        ctrl_a.stop()
+        ctrl_b = Controller(cluster.data_dir)
+        ctrl_b.stabilizer.grace_s = 0.0
+        ctrl_b.stabilizer.rebalance_skew_ratio = st.rebalance_skew_ratio
+        ctrl_b.stabilizer.rebalance_hysteresis = st.rebalance_hysteresis
+        ctrl_b.stabilizer.rebalance_max_moves = st.rebalance_max_moves
+        ctrl_b.stabilizer.cost_rate_fn = st.cost_rate_fn
+        pool_b = IngestConsumerPool(workers=pool_workers, name="elasticB")
+        ctrl_b.realtime_manager.ingest_pool = pool_b
+        # servers first (their replays refill B's external views), THEN
+        # the broker (which re-seeds routing from those views) — the
+        # broker serves from its last routing meanwhile, and since
+        # make-before-break never dropped a serving replica, no query
+        # has anywhere to fail
+        for server in cluster.servers:
+            ServerStarter(server, ctrl_b.resources).start()
+        BrokerStarter(cluster.broker, ctrl_b.resources).start()
+
+        st_b = ctrl_b.stabilizer
+        rounds_b = 0
+        for _ in range(max_rounds):
+            st_b.run_once()
+            rounds_b += 1
+            coverage_never_lost = coverage_never_lost and coverage_ok(
+                ctrl_b.resources
+            )
+            surplus = sum(
+                1
+                for table in [hot_physical] + fleet_physicals
+                for replicas in ctrl_b.resources.get_ideal_state(table).values()
+                if len(replicas) > 1
+            )
+            if (
+                surplus == 0
+                and not st_b._pending_moves
+                and st_b.metrics.gauge("rebalance.imbalanceRatio").value
+                < st_b.rebalance_skew_ratio
+            ):
+                break
+            time.sleep(0.02)
+        time.sleep(0.2)
+        summaries = [load.stop() for load in loads]
+        loads = []
+
+        # -- acceptance accounting ------------------------------------
+        res_b = ctrl_b.resources
+        final_hot = cluster.query("SELECT count(*) FROM hotTable")
+        final_rt = [
+            cluster.query(f"SELECT count(*) FROM rtFleet{i}")
+            for i in range(rt_tables)
+        ]
+        rt_counts = [r.num_docs_scanned for r in final_rt]
+        # exactly one committed copy per (partition, sequence): the
+        # ideal state holds exactly the expected segment names, each
+        # committed one with exactly one ONLINE replica
+        one_copy_per_seq = True
+        for physical in rt_physicals:
+            ideal = res_b.get_ideal_state(physical)
+            expected_names = set()
+            for p in range(rt_partitions):
+                for seq in range(rt_segments_per_partition):
+                    name = make_segment_name(physical, p, seq)
+                    expected_names.add(name)
+                    replicas = ideal.get(name, {})
+                    if list(replicas.values()).count("ONLINE") != 1:
+                        one_copy_per_seq = False
+                # the tip consuming segment (one per partition)
+                expected_names.add(
+                    make_segment_name(physical, p, rt_segments_per_partition)
+                )
+            if set(ideal) != expected_names:
+                one_copy_per_seq = False
+
+        balance = _fleet_loads_by_server(
+            res_b, [hot_physical] + fleet_physicals
+        )
+        mean_load = sum(balance.values()) / max(1, len(balance))
+        final_ratio = (
+            max(balance.values()) / mean_load if mean_load > 0 else 0.0
+        )
+
+        failed = sum(s["failedQueries"] for s in summaries)
+        rt_exact = rt_counts == [total_rt] * rt_tables
+        ok = (
+            failed == 0
+            and coverage_never_lost
+            and rt_committed
+            and rt_exact
+            and one_copy_per_seq
+            and moves_started_at_restart > 0
+            and (pending_at_restart > 0 or surplus_at_restart > 0)
+            and final_ratio < st.rebalance_skew_ratio
+            and final_hot.num_docs_scanned == expected_hot
+            and not final_hot.exceptions
+        )
+        return {
+            "scenario": "elastic-fleet",
+            "tables": num_tables,
+            "servers": num_servers,
+            "load": summaries,
+            "queries": sum(s["queries"] for s in summaries),
+            "okQueries": sum(s["okQueries"] for s in summaries),
+            "partialQueries": sum(s["partialQueries"] for s in summaries),
+            "failures": [f for s in summaries for f in s["failures"]],
+            "roundsBeforeRestart": rounds_a,
+            "roundsAfterRestart": rounds_b,
+            "movesStartedBeforeRestart": moves_started_at_restart,
+            "pendingMovesAtRestart": pending_at_restart,
+            "surplusReplicasAtRestart": surplus_at_restart,
+            "movesCompletedAfterRestart": st_b.metrics.meter(
+                "rebalance.movesCompleted"
+            ).count,
+            "coverageNeverLost": coverage_never_lost,
+            "rtRowsExpected": total_rt,
+            "rtRowsServed": rt_counts,
+            "oneCommittedCopyPerSequence": one_copy_per_seq,
+            "finalLoadByServer": {k: round(v, 1) for k, v in sorted(balance.items())},
+            "finalImbalanceRatio": round(final_ratio, 3),
+            "skewRatioThreshold": st.rebalance_skew_ratio,
+            "ingestPool": {"a": pool_a.snapshot(), "b": pool_b.snapshot()},
+            "failedQueries": 0 if ok else max(1, failed),
+        }
+    finally:
+        for load in loads:
+            load.stop()
+        pool_a.stop()
+        if pool_b is not None:
+            pool_b.stop()
+        if ctrl_b is not None:
+            ctrl_b.stop()
         cluster.stop()
 
 
@@ -1601,6 +1956,7 @@ SCENARIOS = {
     "kill-server": run_kill_server_scenario,
     "drain": run_drain_scenario,
     "rolling-restart": run_rolling_restart_scenario,
+    "elastic-fleet": run_elastic_fleet_scenario,
     "noisy-neighbor": run_noisy_neighbor_scenario,
     "join-under-flood": run_join_under_flood_scenario,
     "ingest-backpressure": run_ingest_backpressure_scenario,
@@ -1623,7 +1979,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--clients", type=int, default=3)
     p.add_argument("--quota-qps", type=float, default=8.0)
     p.add_argument("--flood-clients", type=int, default=4)
+    p.add_argument("--tables", type=int, default=104)
     args = p.parse_args(argv)
+    if args.scenario == "elastic-fleet":
+        out = run_elastic_fleet_scenario(
+            num_tables=args.tables,
+            num_servers=args.servers,
+            clients=args.clients,
+        )
+        import json as _json
+
+        print(_json.dumps(out, indent=2))
+        return 0 if out["failedQueries"] == 0 else 1
     if args.scenario in ("ingest-backpressure", "asymmetric-partition", "split-brain"):
         out = SCENARIOS[args.scenario]()
     elif args.scenario == "partition-server":

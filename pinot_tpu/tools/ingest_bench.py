@@ -23,6 +23,18 @@ partition catches up).
 
 Usage:
   python -m pinot_tpu.tools.ingest_bench -partitions 4 -rows 1000000
+
+``--ladder`` (r15) runs the partition-parallel consumer ladder instead:
+1/2/4 consumers — each a REAL ``RealtimeSegmentDataManager`` driven by
+an ``IngestConsumerPool`` (realtime/pool.py) in its own OS process,
+the production shape of consumers spread across server processes —
+draining pre-produced partitions, reporting per-rung aggregate rows/s
+and lag drain.  The 1-consumer baseline pins broker AND consumer to a
+single core: that is the single-consumer LLC ceiling as INGEST_r5
+committed it (``cpu_cores: 1``), and the number partition-parallel
+aggregate ingest must beat.  Emits a perf-gateable document
+(``metric: ingest_parallel_rows_per_sec``; see
+``tools/perf_gate.py INGEST_METRIC_SPECS`` / ``INGEST_r15.json``).
 """
 from __future__ import annotations
 
@@ -48,6 +60,13 @@ from pinot_tpu.common.schema import (
 TOPIC = "adclicks"
 FETCH_ROWS = 4096
 BLOCK_ROWS = 65536  # columnar block size: amortizes RTT, keeps encode batches fat
+
+# the committed single-consumer LLC ceiling this arc set out to beat:
+# INGEST_r5.json llc_consumer_columnar_rows_per_sec (the production
+# RealtimeSegmentDataManager measured through its own consume_step
+# loop, cpu_cores=1).  The ladder reports its aggregate against this
+# reference alongside the same-host parallel_vs_single ratio.
+R5_SINGLE_CONSUMER_CEILING = 1_288_021.0
 
 
 def adclick_schema() -> Schema:
@@ -115,9 +134,15 @@ def worker_main() -> None:
 
 def broker_main() -> None:
     """The stream broker as its OWN process: serving byte-splice fetches
-    must not share a GIL with the query engine or a consumer."""
+    must not share a GIL with the query engine or a consumer.
+    ``PINOT_TPU_LADDER_BROKER_CORE`` pins the WHOLE process (set before
+    any serving thread spawns, so every thread inherits it) — the
+    ladder's single-core baseline rung uses this."""
     from pinot_tpu.realtime.netstream import StreamBrokerServer
 
+    core = os.environ.get("PINOT_TPU_LADDER_BROKER_CORE")
+    if core:
+        os.sched_setaffinity(0, {int(core)})
     partitions = int(sys.argv[2])
     srv = StreamBrokerServer()
     srv.start()
@@ -129,12 +154,218 @@ def broker_main() -> None:
         pass
 
 
+def ladder_worker_main() -> None:
+    """One ladder consumer process: the real r15 consumer machinery —
+    ``RealtimeSegmentDataManager`` (columnar fetch path) registered
+    with an ``IngestConsumerPool`` — draining one partition.  argv:
+    --ladder-worker host port partition rows core(-1=unpinned)."""
+    host, port, partition, rows, core = (
+        sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+        int(sys.argv[5]), int(sys.argv[6]),
+    )
+    if core >= 0:
+        os.sched_setaffinity(0, {core})
+    from pinot_tpu.realtime.llc import RealtimeSegmentDataManager
+    from pinot_tpu.realtime.netstream import NetworkStreamProvider
+    from pinot_tpu.realtime.pool import IngestConsumerPool
+
+    class _BenchServer:  # the attrs the DM reads; no metrics/governor
+        name = f"ladder{partition}"
+        metrics = None
+        ingest_backpressure = None
+        result_cache = None
+
+    stream = NetworkStreamProvider(host, port, TOPIC)
+    dm = RealtimeSegmentDataManager(
+        server=_BenchServer(),
+        manager=None,  # no commits: rows_per_segment is never reached
+        table="adclicks",
+        segment_name=f"adclicks__{partition}__0",
+        schema=adclick_schema(),
+        stream=stream,
+        partition=partition,
+        start_offset=0,
+        rows_per_segment=rows + 1,
+    )
+    dm.step_rows = BLOCK_ROWS  # consume whole columnar blocks per step
+    pool = IngestConsumerPool(workers=1, name=f"ladder{partition}")
+    # start barrier: every rung sibling finishes its (CPU-heavy)
+    # interpreter startup BEFORE any of them drains, or the measured
+    # window of one consumer overlaps another's imports
+    print("READY", flush=True)
+    sys.stdin.readline()
+    t0 = time.perf_counter()
+    pool.add(dm, key=partition)
+    while dm.offset < rows:
+        time.sleep(0.002)
+    secs = time.perf_counter() - t0
+    lag = dm.lag()
+    pool.stop()
+    print(
+        json.dumps(
+            {
+                "partition": partition,
+                "rows": dm.mutable.num_docs,
+                "seconds": round(secs, 3),
+                "lagFinal": lag,
+            }
+        ),
+        flush=True,
+    )
+
+
+def ladder_main(args) -> None:
+    """The 1/2/4-consumer partition-parallel ladder (r15)."""
+    from pinot_tpu.realtime.netstream import NetworkStreamProvider
+
+    env = dict(os.environ)
+    env.setdefault("PALLAS_AXON_POOL_IPS", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cores = sorted(os.sched_getaffinity(0))
+    partitions = max(args.partitions, max(args.ladder_rungs))
+    host = "127.0.0.1"
+
+    def start_broker(n_partitions: int, pin_core=None):
+        broker_env = dict(env)
+        if pin_core is not None:
+            broker_env["PINOT_TPU_LADDER_BROKER_CORE"] = str(pin_core)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pinot_tpu.tools.ingest_bench",
+             "--broker", str(n_partitions)],
+            stdout=subprocess.PIPE, text=True, env=broker_env,
+        )
+        return proc, int(json.loads(proc.stdout.readline())["port"])
+
+    def produce_all(port: int, n_partitions: int) -> None:
+        def produce(p: int) -> None:
+            provider = NetworkStreamProvider(host, port, TOPIC)
+            cols = gen_columns(args.rows, seed=17 + p)
+            for i in range(0, args.rows, BLOCK_ROWS):
+                provider.produce_columns(
+                    {c: a[i : i + BLOCK_ROWS] for c, a in cols.items()},
+                    partition=p,
+                )
+
+        producers = [
+            threading.Thread(target=produce, args=(p,))
+            for p in range(n_partitions)
+        ]
+        for t in producers:
+            t.start()
+        for t in producers:
+            t.join()
+
+    def rung(port: int, consumers: int, pin_core=None):
+        """Drain ``consumers`` partitions concurrently, one consumer
+        process per partition (fetches are non-destructive, so rungs
+        against the shared broker re-drain from offset 0)."""
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "pinot_tpu.tools.ingest_bench",
+                 "--ladder-worker", host, str(port), str(p), str(args.rows),
+                 str(pin_core if pin_core is not None else -1)],
+                stdout=subprocess.PIPE, stdin=subprocess.PIPE,
+                text=True, env=env,
+            )
+            for p in range(consumers)
+        ]
+        for pr in procs:  # wait for every sibling's READY, then GO
+            assert pr.stdout.readline().strip() == "READY"
+        for pr in procs:
+            pr.stdin.write("GO\n")
+            pr.stdin.flush()
+        outs = [
+            json.loads(pr.communicate(timeout=900)[0].splitlines()[-1])
+            for pr in procs
+        ]
+        wall = max(o["seconds"] for o in outs)
+        total = sum(o["rows"] for o in outs)
+        return {
+            "consumers": consumers,
+            "rows": total,
+            "rows_per_sec": round(total / wall, 1),
+            # the pre-produced backlog IS the lag: draining it to 0 is
+            # the lag-drain measurement
+            "lag_drain_rows": total,
+            "lag_drain_s": round(wall, 3),
+            "lag_final": max(int(o.get("lagFinal") or 0) for o in outs),
+        }
+
+    ladder = {}
+    # single-consumer baseline: broker AND consumer confined to ONE
+    # core — the single-consumer LLC ceiling as INGEST_r5 committed it
+    # (a cpu_cores=1 capture).  A dedicated broker process is used so
+    # the affinity is set before any serving thread spawns.
+    if 1 in args.ladder_rungs:
+        pin_broker, pin_port = start_broker(1, pin_core=cores[0])
+        produce_all(pin_port, 1)
+        ladder["c1"] = rung(pin_port, 1, pin_core=cores[0])
+        pin_broker.terminate()
+        print(json.dumps({"rung": ladder["c1"]}), file=sys.stderr, flush=True)
+    broker_proc, port = start_broker(partitions)
+    produce_all(port, partitions)
+    for c in args.ladder_rungs:
+        if c == 1:
+            continue
+        ladder[f"c{c}"] = rung(port, c)
+        print(json.dumps({"rung": ladder[f"c{c}"]}), file=sys.stderr, flush=True)
+    broker_proc.terminate()
+
+    # c1 only exists when rung 1 was requested; ratios degrade to None
+    single = (ladder.get("c1") or {}).get("rows_per_sec")
+    best = max(r["rows_per_sec"] for r in ladder.values())
+    doc = {
+        "metric": "ingest_parallel_rows_per_sec",
+        "value": best,
+        "bench": "partition_parallel_ingest_ladder",
+        "path": "RealtimeSegmentDataManager (columnar TCP fetch -> "
+        "np.frombuffer decode -> vectorized dictionary encode) driven "
+        "by IngestConsumerPool, one consumer process per partition",
+        "platform": "cpu",
+        "cpu_cores": len(cores),
+        "partitions": partitions,
+        "rows_per_partition": args.rows,
+        "ladder": ladder,
+        "single_consumer_rows_per_sec": single,
+        "parallel_vs_single": round(best / single, 3) if single else None,
+        "r5_single_consumer_ceiling_rows_per_sec": R5_SINGLE_CONSUMER_CEILING,
+        "vs_r5_single_consumer_ceiling": round(
+            best / R5_SINGLE_CONSUMER_CEILING, 3
+        ),
+        "note": "c1 pins broker+consumer to ONE core (the single-"
+        "consumer LLC ceiling as INGEST_r5 committed it, cpu_cores=1); "
+        "parallel rungs use every core.  2-core CI caveat: the "
+        "vectorized dictionary encode is MEMORY-BANDWIDTH-bound on "
+        "this container (two pure-encode processes with no broker "
+        "measure the same ~1.3-1.4x wall), so parallel_vs_single "
+        "saturates near 1.3x here — re-capture on a many-core host "
+        "for the full partition-parallel curve.  vs_r5_single_"
+        "consumer_ceiling is the arc's headline: aggregate ingest vs "
+        "the committed INGEST_r5 single-consumer LLC ceiling.",
+    }
+    out = json.dumps(doc, indent=1)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("-partitions", type=int, default=4)
     ap.add_argument("-rows", type=int, default=1_000_000, help="rows per partition")
     ap.add_argument("-out", type=str, default="")
+    ap.add_argument(
+        "--ladder", action="store_true",
+        help="run the r15 partition-parallel consumer ladder instead",
+    )
+    ap.add_argument(
+        "--ladder-rungs", type=int, nargs="+", default=[1, 2, 4],
+        help="consumer counts per ladder rung",
+    )
     args = ap.parse_args()
+    if args.ladder:
+        return ladder_main(args)
 
     from pinot_tpu.realtime.netstream import NetworkStreamProvider
 
@@ -283,5 +514,7 @@ if __name__ == "__main__":
         worker_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--broker":
         broker_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--ladder-worker":
+        ladder_worker_main()
     else:
         main()

@@ -160,6 +160,34 @@ JOIN_CONFIG_KEYS = ("fact_rows", "dim_rows", "num_segments", "platform")
 
 JOIN_DEFAULT_BASELINE = "JOIN_r14.json"
 
+# ingest-mode documents (tools/ingest_bench.py --ladder, ISSUE 15): the
+# partition-parallel consumer ladder.  Per-rung aggregate rows/s plus
+# the two structural ratios — parallel_vs_single (same-host scaling; a
+# collapse means partition-parallel ingest silently serialized) and
+# vs_r5_single_consumer_ceiling (the arc's acceptance: aggregate must
+# stay >= 1.5x the committed INGEST_r5 single-consumer LLC ceiling —
+# the band is 1.5 / the committed INGEST_r15 capture's 2.531, so the
+# gate floor sits exactly ON the acceptance bar).  Lag drains gate
+# lower-is-better.  cpu_cores is a config key: ladder numbers are
+# only comparable on an identically-sized host (config-mismatch SKIP).
+INGEST_METRIC_SPECS: Dict[str, Tuple[str, float]] = {
+    "value": ("higher", 0.40),
+    "single_consumer_rows_per_sec": ("higher", 0.40),
+    "ladder.c1.rows_per_sec": ("higher", 0.40),
+    "ladder.c2.rows_per_sec": ("higher", 0.40),
+    "ladder.c4.rows_per_sec": ("higher", 0.40),
+    "ladder.c2.lag_drain_s": ("lower", 2.5),
+    "ladder.c4.lag_drain_s": ("lower", 2.5),
+    "parallel_vs_single": ("higher", 0.60),
+    "vs_r5_single_consumer_ceiling": ("higher", 0.593),
+}
+
+INGEST_CONFIG_KEYS = (
+    "partitions", "rows_per_partition", "cpu_cores", "platform",
+)
+
+INGEST_DEFAULT_BASELINE = "INGEST_r15.json"
+
 
 def _is_serving(doc: Dict[str, Any]) -> bool:
     return str(doc.get("metric", "")).startswith("serving_")
@@ -173,6 +201,8 @@ def _doc_kind(doc: Dict[str, Any]) -> str:
         return "multichip"
     if metric.startswith("join_"):
         return "join"
+    if metric.startswith("ingest_"):
+        return "ingest"
     return "default"
 
 
@@ -185,6 +215,8 @@ def _specs_for(doc: Dict[str, Any]):
         return MULTICHIP_METRIC_SPECS, MULTICHIP_CONFIG_KEYS
     if kind == "join":
         return JOIN_METRIC_SPECS, JOIN_CONFIG_KEYS
+    if kind == "ingest":
+        return INGEST_METRIC_SPECS, INGEST_CONFIG_KEYS
     return METRIC_SPECS, CONFIG_KEYS
 
 
@@ -335,6 +367,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "serving": SERVING_DEFAULT_BASELINE,
                 "multichip": MULTICHIP_DEFAULT_BASELINE,
                 "join": JOIN_DEFAULT_BASELINE,
+                "ingest": INGEST_DEFAULT_BASELINE,
             }.get(_doc_kind(current), "BENCH_r05.json")
         baseline = load_bench(baseline_path)
     except (OSError, ValueError, json.JSONDecodeError) as e:

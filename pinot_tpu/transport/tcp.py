@@ -28,8 +28,18 @@ class TransportError(Exception):
     pass
 
 
+_BIG_FRAME = 1 << 16
+
+
 def send_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(struct.pack(">I", len(payload)) + payload)
+    if len(payload) < _BIG_FRAME:
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+    else:
+        # large frames (columnar ingest blocks, shuffle exchanges):
+        # never concat-copy megabytes just to prepend 4 bytes — two
+        # sendalls cost one extra syscall, not an extra full copy
+        sock.sendall(struct.pack(">I", len(payload)))
+        sock.sendall(payload)
 
 
 def recv_frame(sock: socket.socket) -> bytes:
@@ -41,12 +51,16 @@ def recv_frame(sock: socket.socket) -> bytes:
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    # recv_into a preallocated buffer: no per-chunk append/copy churn
+    # on multi-megabyte frames (columnar ingest blocks)
+    buf = bytearray(n)
+    view = memoryview(buf)
+    pos = 0
+    while pos < n:
+        got = sock.recv_into(view[pos:], n - pos)
+        if not got:
             raise TransportError("connection closed")
-        buf.extend(chunk)
+        pos += got
     return bytes(buf)
 
 

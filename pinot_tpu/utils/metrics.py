@@ -587,6 +587,15 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "(1 = held by the backpressure governor)",
     "ingest.pauses": "ingest pause events (high watermark crossed)",
     "ingest.resumes": "ingest resume events (back under low watermarks)",
+    # partition-parallel ingest plane (realtime/pool.py, r15)
+    "ingest.pool.steps": "cooperative consumer steps driven by the "
+    "ingest pool's bounded workers",
+    "ingest.pool.errors": "consumer steps that raised (consumer parked "
+    "with a backoff, workers unaffected)",
+    "ingest.pool.workers": "worker threads in the ingest consumer pool "
+    "(PINOT_TPU_INGEST_CONSUMERS)",
+    "ingest.pool.consumers": "realtime consumers currently registered "
+    "with the ingest pool",
     # partition-tolerance plane (ISSUE 9): serving-lease fence on write
     # authority + controller reachability while riding out a partition
     "lease.held": "1 while this server holds (or never needed) a "
@@ -618,6 +627,8 @@ CONTROLLER_METRIC_CATALOG: Dict[str, str] = {
     "instancesMarkedDead": "instances declared dead on missed heartbeats",
     "transitionAcks": "segment-transition acks processed",
     "clusterStatePolls": "full cluster-state snapshots served to brokers",
+    "clusterStateCacheHits": "cluster-state polls answered from the "
+    "version-keyed snapshot cache (no per-poll table walk)",
     "segmentUploads": "segments stored via the upload paths",
     "segmentCommits": "realtime segments committed through the LLC FSM",
     "segmentCommitMs": "controller-side commit persistence latency",
@@ -638,6 +649,21 @@ CONTROLLER_METRIC_CATALOG: Dict[str, str] = {
     "replication on live servers",
     "stabilizer.drainingInstances": "instances currently draining",
     "stabilizer.deadServers": "servers currently tracked as dead",
+    # proactive skew-aware rebalance plane (r15, controller/stabilizer.py)
+    "rebalance.evaluations": "skew evaluations run (healthy rounds only — "
+    "healing always yields first)",
+    "rebalance.skewDeferrals": "skewed evaluations deferred inside the "
+    "hysteresis window (one hot minute moves nothing)",
+    "rebalance.movesStarted": "make-before-break phase-1 replica adds "
+    "started by the rebalance planner",
+    "rebalance.movesCompleted": "surplus source replicas dropped after "
+    "the external view proved coverage (phase 2)",
+    "rebalance.movesAborted": "moves cancelled by dropping an ERROR "
+    "destination replica instead of the source",
+    "rebalance.pendingMoves": "make-before-break moves currently between "
+    "phase 1 (added) and phase 2 (source dropped)",
+    "rebalance.imbalanceRatio": "worst per-tenant max/mean doc-x-cost "
+    "load ratio seen by the last skew evaluation",
     "aliveServers": "registered server instances currently alive",
     "aliveBrokers": "registered broker instances currently alive",
     "deadInstances": "registered instances currently marked dead",

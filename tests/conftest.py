@@ -88,6 +88,22 @@ def _no_leaked_recorder_threads():
 
 
 @pytest.fixture(autouse=True)
+def _no_leaked_ingest_pool_threads():
+    """Ingest consumer pools (realtime/pool.py): bounded workers
+    multiplexing realtime consumers; ``stop()`` must end every worker.
+    Pools still running (live servers) are exempt — a STOPPED pool
+    whose workers survive is the leak."""
+    yield
+    from pinot_tpu.realtime.pool import leaked_pool_threads
+
+    leaked = leaked_pool_threads(grace_s=2.0)
+    assert not leaked, (
+        f"ingest-pool worker threads leaked past stop(): "
+        f"{[t.name for t in leaked]}"
+    )
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_manager_threads():
     """Controller periodic managers (retention/validation/status/
     stabilizer): a stopped manager's worker must actually exit —
