@@ -369,6 +369,7 @@ class BrokerRequestHandler:
                     num_docs=resp.num_docs_scanned,
                     shed=shed_q,
                     failed=bool(resp.exceptions) and not shed_q,
+                    pql=pql,
                 )
                 self.metrics.meter("workload.recorded").mark()
         failed_q = bool(resp.exceptions)
@@ -739,17 +740,19 @@ class BrokerRequestHandler:
             out["actualDocsScanned"] = resp.num_docs_scanned
         return out
 
-    def workload_snapshot(self, top: int = 20) -> Dict[str, Any]:
+    def workload_snapshot(self, top: int = 20, tables=None) -> Dict[str, Any]:
         """``/debug/workload``: the per-plan-digest roll-up, top-K by
         frequency AND by total cost (the batching-candidate ranking).
         ``top`` at the registry capacity returns the FULL registry —
         the controller's fleet roll-up fetches that so cross-broker
-        merging never ranks on truncated slices."""
+        merging never ranks on truncated slices.  ``tables`` narrows
+        the ranking to shapes touching those tables so a prewarming
+        server only pulls plans it can actually stage."""
         return {
             "digests": self.planstats.digest_count(),
             "totalRecorded": self.planstats.total_recorded,
-            "topByCount": self.planstats.top(top, by="count"),
-            "topByCost": self.planstats.top(top, by="cost"),
+            "topByCount": self.planstats.top(top, by="count", tables=tables),
+            "topByCost": self.planstats.top(top, by="cost", tables=tables),
         }
 
     # ------------------------------------------------------------------
@@ -1382,18 +1385,31 @@ class BrokerHttpServer:
                         return self._respond(broker.flightrec.snapshot())
                     if url.path == "/debug/workload":
                         qs = parse_qs(url.query)
+                        # ?n= is the prewarm-facing alias for ?top=
+                        raw_top = (qs.get("n") or qs.get("top") or ["20"])[0]
                         try:
-                            top = int((qs.get("top") or ["20"])[0])
+                            top = int(raw_top)
                         except ValueError:
                             top = 20
+                        raw_tables = (qs.get("tables") or [""])[0]
+                        tables = [
+                            t.strip()
+                            for t in raw_tables.split(",")
+                            if t.strip()
+                        ] or None
                         return self._respond(
-                            broker.workload_snapshot(top=max(1, top))
+                            broker.workload_snapshot(
+                                top=max(1, top), tables=tables
+                            )
                         )
                     if url.path == "/serverhealth":
                         return self._respond(
                             {
                                 "circuits": broker.health.snapshot(),
                                 "drainingServers": sorted(broker.draining_servers),
+                                "warmingServers": sorted(
+                                    broker.health.warming_servers()
+                                ),
                             }
                         )
                     return self._respond({"error": "not found"}, 404)

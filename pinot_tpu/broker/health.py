@@ -59,6 +59,9 @@ class ServerHealthTracker:
         self.penalty_ms = penalty_ms
         self._clock = clock or time.monotonic
         self._circuits: Dict[str, _Circuit] = {}
+        # servers that are alive but still prewarming their compile
+        # working set — routing deprioritizes (never excludes) them
+        self._warming: set = set()
         self._lock = threading.Lock()
 
     def _circuit(self, server: str) -> _Circuit:
@@ -144,6 +147,27 @@ class ServerHealthTracker:
                 c.probe_claimed_at = self._clock()
                 return True
             return False
+
+    # -- warm-start readiness -----------------------------------------
+    def set_warming(self, server: str, warming: bool) -> None:
+        with self._lock:
+            if warming:
+                self._warming.add(server)
+            else:
+                self._warming.discard(server)
+
+    def set_warming_servers(self, servers) -> None:
+        """Replace the warming set wholesale (clusterstate refresh)."""
+        with self._lock:
+            self._warming = set(servers)
+
+    def is_warming(self, server: str) -> bool:
+        with self._lock:
+            return server in self._warming
+
+    def warming_servers(self) -> set:
+        with self._lock:
+            return set(self._warming)
 
     def state_of(self, server: str) -> str:
         with self._lock:

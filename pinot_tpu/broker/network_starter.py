@@ -262,6 +262,10 @@ class NetworkedBrokerStarter:
         # addressable for in-flight work — surfaced at /serverhealth so
         # ops can tell a deliberate drain from a sick circuit
         self.handler.draining_servers = set(state.get("drainingServers", []))
+        # warming servers stay fully routable; routing just prefers a
+        # ready replica while the restarted server rebuilds its compile
+        # working set (heartbeat-reported readiness, see server starter)
+        self.handler.health.set_warming_servers(state.get("warmingServers", []))
         known = set(self.handler.routing.tables())
         for table, view in state["tables"].items():
             self.handler.routing.update(table, view)

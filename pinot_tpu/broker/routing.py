@@ -76,7 +76,10 @@ class RoutingTableProvider:
         """Pick a precomputed cover; with a health tracker, re-route any
         segment whose chosen replica is unhealthy onto a healthy replica
         (falling back to the original pick when no replica is healthy —
-        sending to a penalty-boxed server beats not sending at all)."""
+        sending to a penalty-boxed server beats not sending at all).
+        A still-warming replica (restart in prewarm) is deprioritized
+        the same way but never excluded: healthy-and-ready replicas win,
+        a warming replica still serves when it is all that is left."""
         with self._lock:
             tables = self._routing.get(table_name)
             if not tables:
@@ -84,20 +87,26 @@ class RoutingTableProvider:
             choice = self._rng.choice(tables)
             if health is None:
                 return choice
-            if all(health.is_healthy(s) for s in choice):
+            is_warming = getattr(health, "is_warming", None) or (lambda s: False)
+            if all(health.is_healthy(s) and not is_warming(s) for s in choice):
                 return choice
             view = self._views.get(table_name, {})
             rerouted: RoutingTable = {}
             for server, segments in choice.items():
-                if health.is_healthy(server):
+                if health.is_healthy(server) and not is_warming(server):
                     rerouted.setdefault(server, []).extend(segments)
                     continue
                 for segment in segments:
-                    candidates = [
+                    online = [
                         s
                         for s, st in view.get(segment, {}).items()
-                        if st in ONLINE_STATES and health.is_healthy(s)
+                        if st in ONLINE_STATES
                     ]
+                    healthy = [s for s in online if health.is_healthy(s)]
+                    ready = [s for s in healthy if not is_warming(s)]
+                    candidates = ready or (
+                        [server] if health.is_healthy(server) else healthy
+                    )
                     picked = self._rng.choice(candidates) if candidates else server
                     rerouted.setdefault(picked, []).append(segment)
             return rerouted
@@ -152,6 +161,11 @@ class RoutingTableProvider:
                     healthy = [s for s in candidates if health.is_healthy(s)]
                     if healthy:
                         candidates = healthy
+                    is_warming = getattr(health, "is_warming", None)
+                    if is_warming is not None:
+                        ready = [s for s in candidates if not is_warming(s)]
+                        if ready:
+                            candidates = ready
                 assignment.setdefault(self._rng.choice(candidates), []).append(segment)
             return assignment, unserved
 

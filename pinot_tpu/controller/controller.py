@@ -92,6 +92,13 @@ class Controller:
         probe = _SkewProbe(self)
         self.stabilizer.cost_rate_fn = probe.cost_rates
         self.stabilizer.busy_fn = probe.busy
+        # readiness gate for movement: a rebalance destination that is
+        # still prewarming its compile working set (heartbeat-reported
+        # warming flag) defers the old replica's trim until it is ready
+        # or the prewarm window times out
+        self.stabilizer.readiness_fn = (
+            lambda name: not self.resources.is_instance_warming(name)
+        )
 
         from pinot_tpu.controller.network import ParticipantGateway
 
@@ -637,16 +644,28 @@ def collect_capacity(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, An
     }
 
 
-def collect_workload(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, Any]:
+def collect_workload(
+    ctrl: "Controller",
+    timeout_s: float = 3.0,
+    n: int = 20,
+    tables=None,
+) -> Dict[str, Any]:
     """Cluster-wide workload roll-up (``/debug/workload``): every alive
     broker's per-plan-digest registry merged by digest — counts and
-    cost sums add, summaries/tables are first-writer — then re-ranked
-    by frequency and by cost.  The fleet-level answer to "which plan
-    shapes dominate, and which should batched serving target first?"
+    cost sums add, summaries/tables/exemplars are first-writer — then
+    re-ranked by frequency and by cost.  The fleet-level answer to
+    "which plan shapes dominate, and which should batched serving
+    target first?" — and, with ``tables``, the prewarm feed a restarted
+    server pulls for the tables it hosts (``?n=&tables=``).
     Unreachable brokers degrade to an ``unreachable`` entry."""
     import urllib.error
     import urllib.request
 
+    from pinot_tpu.engine.plandigest import _raw_table
+
+    wanted = (
+        None if tables is None else {_raw_table(t) for t in tables}
+    )
     merged: Dict[str, Dict[str, Any]] = {}
     unreachable: Dict[str, str] = {}
     brokers = [
@@ -686,12 +705,17 @@ def collect_workload(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, An
             if not digest or digest in seen:
                 continue  # a digest appears in both rankings: merge once
             seen.add(digest)
+            if wanted is not None and _raw_table(plan.get("table", "")) not in wanted:
+                continue
             m = merged.get(digest)
             if m is None:
                 m = merged[digest] = {
                     "digest": digest,
                     "summary": plan.get("summary", ""),
                     "table": plan.get("table", ""),
+                    # literals-erased exemplar (first broker wins): what
+                    # a prewarming server re-parses to rebuild the shape
+                    "exemplarPql": plan.get("exemplarPql", ""),
                     "count": 0,
                     "shedCount": 0,
                     "failedCount": 0,
@@ -699,6 +723,8 @@ def collect_workload(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, An
                     "cost": {},
                     "brokers": [],
                 }
+            elif not m.get("exemplarPql") and plan.get("exemplarPql"):
+                m["exemplarPql"] = plan["exemplarPql"]
             m["count"] += int(plan.get("count") or 0)
             m["shedCount"] += int(plan.get("shedCount") or 0)
             m["failedCount"] += int(plan.get("failedCount") or 0)
@@ -713,12 +739,13 @@ def collect_workload(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, An
     cost_key = PlanStatsStore._cost_key
 
     plans = list(merged.values())
+    k = max(1, int(n))
     return {
         "brokers": len(brokers),
         "digests": len(plans),
         "totalRecorded": total_recorded,
-        "topByCount": sorted(plans, key=lambda d: -d["count"])[:20],
-        "topByCost": sorted(plans, key=cost_key, reverse=True)[:20],
+        "topByCount": sorted(plans, key=lambda d: -d["count"])[:k],
+        "topByCost": sorted(plans, key=cost_key, reverse=True)[:k],
         "unreachable": unreachable,
     }
 
@@ -1100,7 +1127,23 @@ class ControllerHttpServer:
                             dashboard.render_capacity(ctrl, collect_capacity(ctrl))
                         )
                     if parts == ["debug", "workload"]:
-                        return self._respond(collect_workload(ctrl))
+                        # ?n= caps the top-K rankings; ?tables=a,b
+                        # narrows to those tables (the prewarm feed a
+                        # restarted server pulls at segment-load time)
+                        qs = parse_qs(url.query)
+                        try:
+                            n = int((qs.get("n") or qs.get("top") or ["20"])[0])
+                        except ValueError:
+                            n = 20
+                        raw_tables = (qs.get("tables") or [""])[0]
+                        tables = [
+                            t.strip()
+                            for t in raw_tables.split(",")
+                            if t.strip()
+                        ] or None
+                        return self._respond(
+                            collect_workload(ctrl, n=n, tables=tables)
+                        )
                     if parts == ["debug", "utilization"]:
                         return self._respond(collect_utilization(ctrl))
                     if parts == ["dashboard", "utilization"]:
@@ -1237,7 +1280,10 @@ class ControllerHttpServer:
                     if parts == ["instances"]:
                         return self._respond(ctrl.gateway.register(self._read_json()))
                     if len(parts) == 3 and parts[0] == "instances" and parts[2] == "heartbeat":
-                        return self._respond(ctrl.gateway.heartbeat(parts[1]))
+                        # readiness (warming flag) rides the beat body
+                        return self._respond(
+                            ctrl.gateway.heartbeat(parts[1], self._read_json())
+                        )
                     if len(parts) == 3 and parts[0] == "instances" and parts[2] == "ack":
                         return self._respond(ctrl.gateway.ack(parts[1], self._read_json()))
                     if len(parts) == 3 and parts[0] == "instances" and parts[2] in (

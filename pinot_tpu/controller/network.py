@@ -402,10 +402,14 @@ class ParticipantGateway:
             "lease": self._grant_lease(name),
         }
 
-    def heartbeat(self, name: str) -> Dict[str, Any]:
-        return self._linked(name, lambda: self._heartbeat(name))
+    def heartbeat(
+        self, name: str, payload: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        return self._linked(name, lambda: self._heartbeat(name, payload))
 
-    def _heartbeat(self, name: str) -> Dict[str, Any]:
+    def _heartbeat(
+        self, name: str, payload: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
         if self.metrics is not None:
             self.metrics.meter("heartbeats").mark()
         inst = self.resources.instances.get(name)
@@ -413,6 +417,11 @@ class ParticipantGateway:
             return {"error": "unknown instance", "reregister": True}
         with self._lock:
             self._heartbeats[name] = time.monotonic()
+        # warm-start readiness rides the liveness beat (absent key =
+        # legacy heartbeat, leave the flag alone so a plain {} body
+        # cannot clear a warming state it knows nothing about)
+        if payload is not None and "warming" in payload:
+            self.resources.set_instance_warming(name, bool(payload["warming"]))
         if not inst.alive:
             hold = self._flap_gate(name)
             if hold is not None:
@@ -561,6 +570,13 @@ class ParticipantGateway:
             for name, inst in instances.items()
             if inst.role == "server" and inst.alive and inst.draining
         ]
+        # warming servers stay fully routable; remote brokers just
+        # prefer a ready replica until the prewarm pass completes
+        warming_servers = [
+            name
+            for name, inst in instances.items()
+            if inst.role == "server" and inst.alive and inst.warming
+        ]
         return {
             "version": version,
             "epoch": out_epoch,
@@ -568,6 +584,7 @@ class ParticipantGateway:
             "servers": servers,
             "deadServers": dead_servers,
             "drainingServers": draining_servers,
+            "warmingServers": warming_servers,
             "quotas": quotas,
             "timeBoundaries": boundaries,
         }

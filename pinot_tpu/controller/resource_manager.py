@@ -54,6 +54,11 @@ class InstanceState:
     # segment placement; the SelfStabilizer migrates its replicas off
     # so a rolling restart is drain -> restart -> rejoin (undrain)
     draining: bool = False
+    # warm-start readiness (heartbeat-reported): True while the server
+    # is still prewarming its compile working set.  A warming server
+    # serves normally — brokers merely deprioritize it and the
+    # stabilizer defers trimming the replica it is replacing.
+    warming: bool = False
     # serving-lease expiry (monotonic deadline, ParticipantGateway
     # clock): None = never leased (in-process participant — implicit
     # authority, and the stabilizer applies only its grace window).
@@ -171,6 +176,23 @@ class ClusterResourceManager:
         for table in tables:
             self._notify_view(table)
         self.bump_version()
+
+    def set_instance_warming(self, name: str, warming: bool) -> None:
+        """Warm-start readiness flip (heartbeat-reported).  Routing
+        covers are untouched — a warming server serves — but the
+        version bump makes remote brokers refetch the cluster state
+        (its ``warmingServers`` list feeds their deprioritization)."""
+        with self._lock:
+            inst = self.instances.get(name)
+            if inst is None or inst.warming == warming:
+                return
+            inst.warming = warming
+        self.bump_version()
+
+    def is_instance_warming(self, name: str) -> bool:
+        with self._lock:
+            inst = self.instances.get(name)
+            return inst is not None and inst.warming
 
     def segments_on(self, name: str) -> Dict[str, List[str]]:
         """Ideal-state replicas still placed on ``name`` per table (the

@@ -137,6 +137,15 @@ class SelfStabilizer(_PeriodicManager):
         #   busy_fn()      -> {server name: busyFraction in [0, 1]}
         self.cost_rate_fn = None
         self.busy_fn = None
+        # pluggable warm-start readiness (wired by the Controller to the
+        # heartbeat-reported warming flags; None = everyone ready, the
+        # pre-r16 behavior):  readiness_fn(server name) -> bool
+        self.readiness_fn = None
+        # (table, segment) -> monotonic stamp of the FIRST readiness
+        # deferral: a destination that never finishes prewarming can
+        # only hold a trim for the prewarm window, never forever
+        self.prewarm_timeout_s = _env_float("PINOT_TPU_PREWARM_TIMEOUT_S", 30.0)
+        self._warm_waits: Dict[Tuple[str, str], float] = {}
         self._skew_rounds: Dict[str, int] = {}  # tenant -> consecutive
         # (table, segment) -> {"src", "dst"}: observability for
         # in-flight make-before-break moves.  NOT load-bearing — the
@@ -155,6 +164,7 @@ class SelfStabilizer(_PeriodicManager):
             "rebalance.movesStarted",
             "rebalance.movesCompleted",
             "rebalance.movesAborted",
+            "rebalance.prewarmDeferrals",
         ):
             self.metrics.meter(m)
         for g in (
@@ -193,6 +203,11 @@ class SelfStabilizer(_PeriodicManager):
                     {"table": t, "segment": s, **info}
                     for (t, s), info in sorted(self._pending_moves.items())
                 ],
+                "prewarmTimeoutS": self.prewarm_timeout_s,
+                "warmWaits": {
+                    f"{t}/{s}": round(now - since, 3)
+                    for (t, s), since in sorted(self._warm_waits.items())
+                },
             },
             "events": self.events(),
             "metrics": self.metrics.snapshot(),
@@ -398,6 +413,16 @@ class SelfStabilizer(_PeriodicManager):
                 ]
                 if len(covered) >= n_target:
                     for s in unavailable:
+                        # readiness gate on DRAINING drops only: a drain
+                        # is planned movement, so the replacement cover
+                        # should be warm before the old replica leaves.
+                        # Dead victims drop immediately — holding a
+                        # corpse in the ideal state buys nothing.
+                        if s in draining and not self._destinations_ready(
+                            table, seg, covered, n_target, victim=s,
+                            cls="heal",
+                        ):
+                            continue
                         if res.remove_segment_replica(table, seg, s):
                             self.metrics.meter("stabilizer.replicasDropped").mark()
                             self._event(
@@ -465,6 +490,55 @@ class SelfStabilizer(_PeriodicManager):
             # replicas are being re-homed is transient by construction,
             # so the hysteresis clock restarts once the cluster is whole
             self._skew_rounds.clear()
+
+    # -- warm-start readiness gate (r16) --------------------------------
+    def _ready(self, server: str) -> bool:
+        if self.readiness_fn is None:
+            return True
+        try:
+            return bool(self.readiness_fn(server))
+        except Exception:
+            # a broken readiness probe must never freeze movement
+            logger.warning("readiness provider failed", exc_info=True)
+            return True
+
+    def _destinations_ready(
+        self,
+        table: str,
+        seg: str,
+        serving,
+        n_target: int,
+        victim: Optional[str] = None,
+        dst: Optional[str] = None,
+        cls: str = "rebalance",
+    ) -> bool:
+        """True when removing a replica may proceed: at least
+        ``n_target`` of the replicas that would carry coverage
+        afterwards have finished prewarming (or this (table, segment)'s
+        prewarm wait timed out).  A still-warming destination serves
+        correctly — it is just slow until its compiles land — so the
+        deferral is bounded: the first deferral starts the clock, and
+        past ``PINOT_TPU_PREWARM_TIMEOUT_S`` the movement proceeds
+        anyway (a wedged prewarm must not pin surplus replicas)."""
+        n_ready = sum(1 for s in serving if s != victim and self._ready(s))
+        if n_ready >= n_target:
+            self._warm_waits.pop((table, seg), None)
+            return True
+        first = self._warm_waits.setdefault((table, seg), self._now())
+        if self._now() - first < self.prewarm_timeout_s:
+            self.metrics.meter("rebalance.prewarmDeferrals").mark()
+            self._event(
+                "rebalanceTrimDeferred", cls=cls, table=table,
+                segment=seg, server=victim, dst=dst,
+                reason="destination warming",
+            )
+            return False
+        self._warm_waits.pop((table, seg), None)
+        self._event(
+            "rebalancePrewarmTimeout", cls=cls, table=table,
+            segment=seg, server=victim, dst=dst,
+        )
+        return True
 
     # -- proactive skew-aware rebalancing (r15) -------------------------
     def _trim_surplus(
@@ -540,6 +614,20 @@ class SelfStabilizer(_PeriodicManager):
                 victim = src
             else:
                 victim = max(candidates, key=lambda s: (load.get(s, 0), s))
+            # readiness gate: the old replica leaves only once enough of
+            # the remaining cover has finished prewarming (or the wait
+            # timed out) — a make-before-break move must hand traffic to
+            # a WARM destination, not a correct-but-cold one
+            serving = [
+                s
+                for s in replicas
+                if s in healthy and seg_view.get(s) == target_state
+            ]
+            if not self._destinations_ready(
+                table, seg, serving, n_target,
+                victim=victim, dst=pending.get("dst"),
+            ):
+                return
             if not res.remove_segment_replica(table, seg, victim):
                 return
             replicas.pop(victim, None)

@@ -1,0 +1,232 @@
+"""Persistent compile cache: warm restarts for the device lanes.
+
+PR 8 measured the failure mode this module kills: every plan shape pays
+a cold XLA compile (~25s on a real TPU) on its first launch, so a server
+restart, rollout, or rebalance destination is a p99 cliff until the
+whole working set has recompiled.  jax already ships a persistent
+compilation cache (keyed on the serialized HLO + compile options); this
+module wires it under the lanes and adds the two properties jax's cache
+cannot give us by itself:
+
+- **Topology isolation.**  The on-disk XLA cache lives under
+  ``<root>/xla/<fingerprint>`` where the fingerprint digests the jax
+  version, backend platform, device count/kind, and the x64 flag.  A
+  cache written on a different mesh shape or jax version lands in a
+  different directory — it can *miss*, never poison.  (jax's own key
+  covers most of this too; the directory split makes the isolation
+  auditable and survives jax key-scheme changes.)
+
+- **A plan ledger.**  jax's cache is opaque: a lane cannot ask "is this
+  plan-shape digest warm on disk?" before paying the compile.  The
+  ledger records one tiny JSON file per (plan digest, fingerprint) after
+  each successful compile, so the first launch of a shape can be
+  *classified* — ``persistent`` (ledger hit: the XLA cache will serve
+  the binary) vs genuinely ``cold`` — and the ``compile.cold`` meter
+  stays honest across restarts.  Corrupt or alien ledger entries are a
+  miss, never a crash: the ledger is advisory accounting, the XLA cache
+  is the actual store.
+
+Everything is gated on ``PINOT_TPU_COMPILE_CACHE_DIR``; unset means
+fully disabled (no config writes, no ledger I/O) so default test runs
+and in-process harnesses see the pre-existing cold/warm behavior.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+# directory most recently handed to jax_compilation_cache_dir (idempotence
+# guard: lanes call configure() per construction, jax.config once)
+_configured_dir: Optional[str] = None
+
+
+def cache_root() -> Optional[str]:
+    """The persistent-cache root, or None when the feature is off."""
+    root = os.environ.get("PINOT_TPU_COMPILE_CACHE_DIR", "").strip()
+    return root or None
+
+
+def enabled() -> bool:
+    return cache_root() is not None
+
+
+def topology_fingerprint(
+    jax_version: Optional[str] = None,
+    platform: Optional[str] = None,
+    device_count: Optional[int] = None,
+    device_kind: Optional[str] = None,
+    x64: Optional[bool] = None,
+) -> str:
+    """Short stable digest of everything that must invalidate the cache.
+
+    A compiled executable is only reusable on the same jax version,
+    backend platform, device count (mesh shape), device kind, and
+    float-width mode — any of these changing must produce a different
+    fingerprint so the old entries become unreachable, not wrong.  All
+    parameters are overridable so tests can prove each axis separates
+    keys without owning a second topology.
+    """
+    import jax
+
+    if jax_version is None:
+        jax_version = jax.__version__
+    if platform is None or device_count is None or device_kind is None:
+        devices = jax.devices()
+        if platform is None:
+            platform = devices[0].platform if devices else "none"
+        if device_count is None:
+            device_count = len(devices)
+        if device_kind is None:
+            device_kind = getattr(devices[0], "device_kind", "") if devices else ""
+    if x64 is None:
+        x64 = bool(jax.config.jax_enable_x64)
+    payload = json.dumps(
+        {
+            "jax": jax_version,
+            "platform": platform,
+            "devices": int(device_count),
+            "kind": device_kind,
+            "x64": bool(x64),
+        },
+        sort_keys=True,
+    )
+    return hashlib.blake2b(payload.encode(), digest_size=8).hexdigest()
+
+
+def configure_jax_cache(root: Optional[str] = None) -> Optional[str]:
+    """Point jax's persistent compilation cache under the root.
+
+    Returns the per-topology XLA cache directory in use, or None when
+    the feature is disabled or jax refused the config (old jax builds
+    without the knobs must degrade to plain cold compiles, not crash
+    lane construction).  Idempotent: repeat calls with the same root are
+    free; a changed root re-points the cache.
+    """
+    global _configured_dir
+    if root is None:
+        root = cache_root()
+    if root is None:
+        return None
+    xla_dir = os.path.join(root, "xla", topology_fingerprint())
+    with _lock:
+        if _configured_dir == xla_dir:
+            return xla_dir
+        try:
+            os.makedirs(xla_dir, exist_ok=True)
+        except OSError:
+            logger.warning("compile cache dir unusable: %s", xla_dir, exc_info=True)
+            return None
+        import jax
+
+        try:
+            jax.config.update("jax_compilation_cache_dir", xla_dir)
+        except Exception:
+            logger.warning("jax persistent compile cache unavailable", exc_info=True)
+            return None
+        # CPU/test compiles finish in milliseconds; without zeroing the
+        # floor nothing would ever be written and every restart test
+        # would silently exercise the cold path
+        for knob, value in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", 0),
+        ):
+            try:
+                jax.config.update(knob, value)
+            except Exception:
+                pass
+        _configured_dir = xla_dir
+        return xla_dir
+
+
+# -- plan ledger ------------------------------------------------------------
+
+
+def _plan_path(root: str, digest: str, fingerprint: str) -> str:
+    # digest and fingerprint are short hex; sanitize anyway so a hostile
+    # digest string can never escape the ledger directory
+    safe = "".join(c for c in f"{digest}-{fingerprint}" if c.isalnum() or c == "-")
+    return os.path.join(root, "plans", f"{safe}.json")
+
+
+def record_plan(
+    digest: str,
+    fingerprint: Optional[str] = None,
+    root: Optional[str] = None,
+) -> bool:
+    """Mark a plan-shape digest as compiled under this topology.
+
+    Atomic (tmp + rename) so a crash mid-write leaves either a valid
+    entry or none — never a truncated file another process would have
+    to tolerate (it would anyway: see ``known_plan``).
+    """
+    if root is None:
+        root = cache_root()
+    if root is None or not digest:
+        return False
+    if fingerprint is None:
+        fingerprint = topology_fingerprint()
+    path = _plan_path(root, digest, fingerprint)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "digest": digest,
+                    "fingerprint": fingerprint,
+                    "jaxVersion": __import__("jax").__version__,
+                    "recordedAtMs": int(time.time() * 1000),
+                },
+                f,
+            )
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        logger.warning("plan ledger write failed: %s", path, exc_info=True)
+        return False
+
+
+def known_plan(
+    digest: str,
+    fingerprint: Optional[str] = None,
+    root: Optional[str] = None,
+) -> bool:
+    """True when the ledger proves this digest compiled on THIS topology.
+
+    Every failure mode — missing file, unreadable file, corrupt JSON,
+    an alien entry whose recorded digest/fingerprint disagrees with its
+    filename — is a miss.  The ledger only reclassifies accounting; a
+    wrong False costs one cold-meter tick, a crash would cost the lane.
+    """
+    if root is None:
+        root = cache_root()
+    if root is None or not digest:
+        return False
+    if fingerprint is None:
+        fingerprint = topology_fingerprint()
+    path = _plan_path(root, digest, fingerprint)
+    try:
+        with open(path) as f:
+            entry = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return (
+        isinstance(entry, dict)
+        and entry.get("digest") == digest
+        and entry.get("fingerprint") == fingerprint
+    )
+
+
+def _reset_for_tests() -> None:
+    """Forget the idempotence guard so a test can re-point the cache."""
+    global _configured_dir
+    with _lock:
+        _configured_dir = None

@@ -81,6 +81,7 @@ import weakref
 from collections import deque
 from typing import Any, Callable, Deque, Dict, Hashable, List, Optional
 
+from pinot_tpu.engine import compilecache
 from pinot_tpu.server.scheduler import QueryAbandonedError
 
 # completed dispatches kept open (still coalescible) at once; beyond
@@ -417,6 +418,12 @@ class DeviceLane:
             stall_timeout_s = float(os.environ.get("PINOT_TPU_LANE_STALL_S", "120"))
         self.stall_timeout_s = stall_timeout_s
         self.fault_injector = fault_injector
+        # persistent compile cache (engine/compilecache.py): point jax's
+        # on-disk cache under PINOT_TPU_COMPILE_CACHE_DIR, isolated per
+        # backend/topology fingerprint.  Disabled (None) keeps the exact
+        # pre-r16 cold/warm behavior; the call is idempotent, so every
+        # lane of a group paying it is free.
+        self.persistent_cache_dir = compilecache.configure_jax_cache()
         # micro-batching tier config (module docstring): resolved once
         # at construction so a long-lived lane is immune to env churn
         self.batch_max = batch_max()
@@ -481,6 +488,8 @@ class DeviceLane:
             for name in ("lane.dispatches", "lane.coalesced", "lane.shed",
                          "lane.deviceFailures", "lane.restarts",
                          "compile.cold", "compile.warm",
+                         "compile.persistentHit", "compile.persistentMiss",
+                         "compile.prewarmed",
                          "compile.costAnalyses",
                          "compile.costAnalysisUnavailable",
                          "batch.launches", "batch.queries",
@@ -618,6 +627,42 @@ class DeviceLane:
         with self._cv:
             entry = self._compile.get(digest)
             return dict(entry) if entry is not None else None
+
+    def record_prewarmed(self, digest: Optional[str], compile_ms: float) -> bool:
+        """Register a background-prewarmed plan digest in the compile
+        timeline WITHOUT touching the serving-path meters.  The prewarm
+        worker (server/prewarm.py) calls this after an AOT
+        ``lower().compile()`` of the phantom kernel: the executable now
+        sits in the in-process jit cache (and the on-disk cache when
+        enabled), so the digest's first serving launch runs warm.
+        Counts on ``compile.prewarmed`` only — never compile.cold or
+        firstCallMs (accounting honesty), and never near the stall
+        watchdog (the compile ran off-lane).  No-op when the digest
+        already launched or prewarmed here."""
+        if digest is None:
+            return False
+        with self._cv:
+            if digest in self._compile:
+                return False
+            if len(self._compile) > 4096:
+                victim = min(
+                    self._compile, key=lambda k: self._compile[k]["firstAt"]
+                )
+                self._compile.pop(victim, None)
+            self._compile[digest] = {
+                # firstCallMs here is the MEASURED prewarm compile wall
+                # ms — the cost the serving path did NOT pay
+                "firstCallMs": round(compile_ms, 3),
+                "firstAt": round(time.time(), 3),
+                "launches": 0,
+                "launchMsTotal": 0.0,
+                "via": "prewarmed",
+            }
+        if self.metrics is not None:
+            self.metrics.meter("compile.prewarmed").mark()
+        if self.persistent_cache_dir is not None:
+            compilecache.record_plan(digest)
+        return True
 
     # -- occupancy (utilization plane) --------------------------------
     def _depth_tick_locked(self, now: Optional[float] = None) -> None:
@@ -1060,6 +1105,21 @@ class DeviceLane:
                 self._set_inflight(0)
             launch_ms = (time.perf_counter() - t0) * 1000
             cold = False
+            via = "cold"
+            if (
+                error is None
+                and d.plan_digest is not None
+                and self.persistent_cache_dir is not None
+                and d.plan_digest not in self._compile
+            ):
+                # classify a first launch BEFORE taking the lane lock —
+                # the plan-ledger lookup is disk I/O.  The unlocked
+                # membership pre-check can only cost a spurious stat;
+                # the authoritative entry check happens under _cv below.
+                if compilecache.known_plan(d.plan_digest):
+                    # the on-disk XLA cache served the binary: fast
+                    # launch, and NOT a serving-path cold compile
+                    via = "persistent"
             with self._cv:
                 stale = gen != self._generation
                 if not stale and self._busy_since is not None:
@@ -1106,6 +1166,11 @@ class DeviceLane:
                             "firstAt": round(time.time(), 3),
                             "launches": 1,
                             "launchMsTotal": round(launch_ms, 3),
+                            # how the first launch got its executable:
+                            # "cold" (paid the XLA compile here),
+                            # "persistent" (on-disk cache restored it),
+                            # or "prewarmed" via record_prewarmed()
+                            "via": via,
                         }
                         if d.cost_provider is not None:
                             # static cost analysis, once per digest, on
@@ -1154,11 +1219,27 @@ class DeviceLane:
                     self._lane_mark("deviceFailures")
                 elif d.plan_digest is not None:
                     if cold:
-                        self.metrics.meter("compile.cold").mark()
-                        self.metrics.timer("compile.firstCallMs").update(launch_ms)
+                        # accounting honesty (r16): only a launch that
+                        # actually PAID the XLA compile on the serving
+                        # path counts cold — a persistent-cache restore
+                        # is its own meter, and firstCallMs keeps
+                        # measuring compile cost, not restore cost
+                        if via == "persistent":
+                            self.metrics.meter("compile.persistentHit").mark()
+                        else:
+                            self.metrics.meter("compile.cold").mark()
+                            self.metrics.timer("compile.firstCallMs").update(
+                                launch_ms
+                            )
+                            if self.persistent_cache_dir is not None:
+                                self.metrics.meter("compile.persistentMiss").mark()
                     else:
                         self.metrics.meter("compile.warm").mark()
                 self.metrics.timer("phase.laneDispatch").update(launch_ms)
+            if cold and via == "cold" and self.persistent_cache_dir is not None:
+                # the compile just wrote an XLA cache entry; ledger it so
+                # the NEXT process classifies this digest as persistent
+                compilecache.record_plan(d.plan_digest)
             n_members = len(members)
             for mvalue, waiters in deliveries:
                 for w in waiters:

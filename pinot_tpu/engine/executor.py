@@ -493,33 +493,9 @@ class QueryExecutor:
         lane = sel.lane if sel is not None else self.lane
         sharding = self._mesh_sharding(mesh)
         raw_cols, gfwd_cols, hll_cols = self._role_columns(request, live, ctx)
-        # Columns the kernel reads ONLY through a role stream skip their
-        # base fwd/dict arrays: at 1B rows the dictId stream is the
-        # difference between fitting in HBM and not.  Filter leaves and
-        # selection outputs read base arrays, so those columns keep them.
-        skip_base: set = set()
-        if not request.is_selection:
-            # filter leaves need base arrays on device — EXCEPT leaves
-            # whose every use classifies docrange (the kernel compares
-            # row ids against host-computed bounds, reading no column)
-            filter_cols = (
-                {n.column for n in request.filter.walk() if n.is_leaf}
-                if request.filter is not None
-                else set()
-            ) - self._docrange_qualifying_cols(request, live)
-            from pinot_tpu.engine.plan import _agg_kind
-
-            # scalar/pair agg inputs OUTSIDE raw_cols (small dictionaries)
-            # read dict[fwd] on device — their base arrays must stay
-            gather_agg_cols = {
-                a.column
-                for a in request.aggregations
-                if _agg_kind(a.base_function) in ("scalar", "pair")
-                and a.column not in raw_cols
-            }
-            skip_base = (
-                set(raw_cols) | set(gfwd_cols) | set(hll_cols)
-            ) - filter_cols - gather_agg_cols
+        skip_base = self._skip_base_columns(
+            request, live, raw_cols, gfwd_cols, hll_cols
+        )
         staged = get_staged(
             live,
             sorted(needed),
@@ -840,6 +816,45 @@ class QueryExecutor:
                 plan, mesh, staged.num_segments, staged.n_pad
             ),
         )
+
+    def _skip_base_columns(
+        self,
+        request: BrokerRequest,
+        live: Sequence[ImmutableSegment],
+        raw_cols,
+        gfwd_cols,
+        hll_cols,
+    ) -> set:
+        """Columns the kernel reads ONLY through a role stream skip
+        their base fwd/dict arrays: at 1B rows the dictId stream is the
+        difference between fitting in HBM and not.  Filter leaves and
+        selection outputs read base arrays, so those columns keep them.
+        Shared by the staging path and the prewarm aval builder
+        (engine/explain.py) — the two must agree bit-for-bit or a
+        prewarmed executable never matches a serving launch."""
+        if request.is_selection:
+            return set()
+        # filter leaves need base arrays on device — EXCEPT leaves
+        # whose every use classifies docrange (the kernel compares
+        # row ids against host-computed bounds, reading no column)
+        filter_cols = (
+            {n.column for n in request.filter.walk() if n.is_leaf}
+            if request.filter is not None
+            else set()
+        ) - self._docrange_qualifying_cols(request, live)
+        from pinot_tpu.engine.plan import _agg_kind
+
+        # scalar/pair agg inputs OUTSIDE raw_cols (small dictionaries)
+        # read dict[fwd] on device — their base arrays must stay
+        gather_agg_cols = {
+            a.column
+            for a in request.aggregations
+            if _agg_kind(a.base_function) in ("scalar", "pair")
+            and a.column not in raw_cols
+        }
+        return (
+            set(raw_cols) | set(gfwd_cols) | set(hll_cols)
+        ) - filter_cols - gather_agg_cols
 
     # ------------------------------------------------------------------
     def _resolve_selection_columns(

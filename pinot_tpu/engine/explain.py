@@ -307,7 +307,15 @@ def build_explain_node(
                     lane.compile_info(pdigest) if lane is not None else None
                 )
                 if compile_entry is not None:
-                    compile_info = {"state": "warm", **compile_entry}
+                    # launched here -> warm; a prewarmed/persistent
+                    # entry that has NOT served yet reports how its
+                    # executable arrived (the r16 warm-start states)
+                    state = (
+                        "warm"
+                        if compile_entry.get("launches", 0) > 0
+                        else compile_entry.get("via", "warm")
+                    )
+                    compile_info = {"state": state, **compile_entry}
                     # static cost-analysis tri-state (utilization
                     # plane): a dict once the async analysis landed,
                     # explicit "unavailable" when the backend reported
@@ -317,8 +325,19 @@ def build_explain_node(
                     elif compile_entry["costAnalysis"] is None:
                         compile_info["costAnalysis"] = "unavailable"
                 else:
-                    # never launched here: no analysis exists yet
-                    compile_info = {"state": "cold", "costAnalysis": "unavailable"}
+                    # never launched here: no analysis exists yet.  The
+                    # plan ledger can still prove the on-disk cache
+                    # holds the binary — the first launch would restore,
+                    # not compile
+                    from pinot_tpu.engine import compilecache
+
+                    state = (
+                        "persistent"
+                        if compilecache.enabled()
+                        and compilecache.known_plan(pdigest)
+                        else "cold"
+                    )
+                    compile_info = {"state": state, "costAnalysis": "unavailable"}
                 # mesh decision record: which chip-group lane executes
                 # this shape, the mesh it shards over, and the XLA
                 # collectives the cross-chip merge lowers to (the
@@ -465,3 +484,172 @@ def build_explain_node(
     if device_info is not None:
         node["device"] = device_info
     return _json_safe(node)
+
+
+# ---------------------------------------------------------------------------
+# Prewarm compile specs (r16 warm-start plane): the phantom machinery
+# above, driven one step further — instead of *reporting* the StaticPlan
+# a query would compile, hand back an AOT-lowerable (kernel, avals) pair
+# so the prewarm worker (server/prewarm.py) can pay the XLA compile off
+# the serving path.  Still zero real staging: segment arrays enter the
+# lowering as ShapeDtypeStructs that mirror ``device.stage_segments``'s
+# shapes/dtypes exactly (including the skip-base elisions), so the
+# compiled executable — and the persistent-cache entry it writes — is
+# the one the first serving launch of this shape will ask for.
+# ---------------------------------------------------------------------------
+
+
+def _phantom_segment_avals(
+    phantom: StagedTable, needed, ctx, skip_base
+) -> Dict[str, Any]:
+    """ShapeDtypeStruct twin of ``device.segment_arrays(staged, needed)``
+    for a phantom staged table: same keys, same shapes, same dtypes as
+    real staging would upload — no device bytes."""
+    import jax
+
+    S, n_pad = phantom.num_segments, phantom.n_pad
+    fdt = np.dtype(config.np_float_dtype())
+    avals: Dict[str, Any] = {}
+    has_rows = False
+    for name in needed:
+        col = phantom.columns.get(name)
+        if col is None:
+            continue
+        idt = np.dtype(config.index_dtype(col.card_pad))
+        sb = name in skip_base and col.single_value
+        if col.single_value:
+            if not sb:
+                avals[f"{name}.fwd"] = jax.ShapeDtypeStruct((S, n_pad), idt)
+                has_rows = True
+        else:
+            avals[f"{name}.mv"] = jax.ShapeDtypeStruct((S, n_pad, col.mv_pad), idt)
+            avals[f"{name}.mvc"] = jax.ShapeDtypeStruct(
+                (S, n_pad), np.dtype(config.count_dtype(col.mv_pad))
+            )
+            has_rows = True
+        if col.is_numeric and not sb:
+            avals[f"{name}.dict"] = jax.ShapeDtypeStruct((S, col.card_pad), fdt)
+        if col.raw is not None:
+            avals[f"{name}.raw"] = jax.ShapeDtypeStruct((S, n_pad), fdt)
+            has_rows = True
+        if col.gfwd is not None:
+            gdt = np.dtype(
+                config.index_dtype(
+                    config.pad_card(ctx.column(name).global_cardinality)
+                )
+            )
+            avals[f"{name}.gfwd"] = jax.ShapeDtypeStruct((S, n_pad), gdt)
+            has_rows = True
+        if col.hll_bucket is not None:
+            avals[f"{name}.hllb"] = jax.ShapeDtypeStruct((S, n_pad), np.dtype(np.uint8))
+            avals[f"{name}.hllr"] = jax.ShapeDtypeStruct((S, n_pad), np.dtype(np.uint8))
+            has_rows = True
+        if col.mv_raw is not None:
+            avals[f"{name}.mvraw"] = jax.ShapeDtypeStruct((S, n_pad, col.mv_pad), fdt)
+            has_rows = True
+    if has_rows:
+        avals["num_docs"] = jax.ShapeDtypeStruct((S,), np.dtype(np.int32))
+    else:
+        avals["valid"] = jax.ShapeDtypeStruct((S, n_pad), np.dtype(np.bool_))
+    return avals
+
+
+def build_prewarm_spec(
+    executor,
+    segments: Sequence[ImmutableSegment],
+    request: BrokerRequest,
+) -> Optional[Dict[str, Any]]:
+    """AOT prewarm spec for one query shape, or None when the shape has
+    nothing lowerable to prewarm.
+
+    Walks the EXACT executor decision order (as ``build_explain_node``
+    does) and returns ``{"planDigest", "lane", "compile"}`` where
+    ``compile()`` pays the XLA compile of the kernel the first serving
+    launch would otherwise pay cold.  None is a *skip*, not a failure:
+
+    - host/postings/star-tree-only shapes compile no device kernel;
+    - mesh-sharded shapes need device-placed lowering (not supported —
+      sharded servers fall back to persistent-cache classification);
+    - chunked dispatch sequences are many programs, not one lowering;
+    - shapes already in the lane's compile timeline are warm already.
+    """
+    verdicts = prune_explain(segments, request)
+    live = [seg for seg, reason in verdicts if reason is None]
+    if not live:
+        return None
+    from pinot_tpu.startree.operator import is_fit_for_star_tree
+
+    normal = [s for s in live if not is_fit_for_star_tree(request, s)]
+    if not normal:
+        return None
+    total_docs = sum(s.num_docs for s in segments)
+    needed = set(request.referenced_columns())
+    sel_columns: Optional[List[str]] = None
+    if request.is_selection:
+        sel_columns = executor._resolve_selection_columns(request, normal[0])
+        needed.update(sel_columns)
+    selection = None
+    if getattr(executor, "lanes", None) is not None:
+        selection = executor.lane_selection(request)
+    exec_mesh = selection.group.mesh if selection is not None else executor.mesh
+    if exec_mesh is not None:
+        return None
+    lane = selection.lane if selection is not None else getattr(executor, "lane", None)
+    if lane is None:
+        return None
+    needed -= executor._docrange_only_columns(request, normal, sel_columns)
+    ctx = get_table_context(normal)
+    decision, state = index_path_decision(request, normal, ctx, total_docs)
+    if state is not None or plan_forced_host(request, ctx):
+        return None
+    raw_cols, gfwd_cols, hll_cols = executor._role_columns(request, normal, ctx)
+    phantom = _phantom_staged(
+        normal,
+        list(needed) + list(request.referenced_columns()),
+        raw_cols, gfwd_cols, hll_cols,
+    )
+    scratch: Dict[Any, Any] = {}
+    plan = build_static_plan(request, ctx, phantom, scratch=scratch)
+    if not plan.on_device:
+        return None
+    pdigest = plan_digest(plan)
+    if lane.compile_info(pdigest) is not None:
+        return None  # already cold/warm/prewarmed here: nothing to pay
+    q_np = build_query_inputs(request, plan, ctx, phantom, scratch=scratch)
+    block_ids, _scanned = executor._block_skip_ids(plan, q_np, normal, phantom)
+    from pinot_tpu.engine.kernel import (
+        chunk_rows_limit,
+        make_packed_block_table_kernel,
+        make_packed_table_kernel,
+        plan_chunkable,
+    )
+
+    _limit = chunk_rows_limit()
+    rows_total = phantom.num_segments * phantom.n_pad
+    if block_ids is not None and _limit and rows_total > _limit:
+        block_ids = None  # mirrors the executor's guard
+    if block_ids is None and _limit and rows_total > _limit and plan_chunkable(plan):
+        return None  # chunked dispatch sequence: not one lowerable program
+    skip_base = executor._skip_base_columns(
+        request, normal, raw_cols, gfwd_cols, hll_cols
+    )
+    seg_avals = _phantom_segment_avals(phantom, needed, ctx, skip_base)
+    if block_ids is not None:
+        from pinot_tpu.engine.zonemap import zone_block_rows
+
+        import jax
+
+        kernel = make_packed_block_table_kernel(plan, zone_block_rows())
+        ids = np.asarray(block_ids)
+        lower_args = (seg_avals, q_np, jax.ShapeDtypeStruct(ids.shape, ids.dtype))
+    else:
+        # the factories are lru_cached per plan: this is the SAME
+        # callable the serving launch will call, so an in-process AOT
+        # compile also seeds the persistent cache entry serving reads
+        kernel = make_packed_table_kernel(plan)
+        lower_args = (seg_avals, q_np)
+
+    def compile_now() -> None:
+        kernel.lower(*lower_args).compile()
+
+    return {"planDigest": pdigest, "lane": lane, "compile": compile_now}

@@ -375,6 +375,15 @@ class ServerInstance:
         )
         self._last_heal_total = 0
         self.history.add_tick_hook(self._history_tick)
+        # warm-start plane (server/prewarm.py): background compile
+        # driver for the fleet's hot plan shapes.  Inert until a starter
+        # wires a workload source (and PINOT_TPU_PREWARM_TOP_K > 0);
+        # segment loads then trigger passes and status()/heartbeats
+        # report the warming/ready flag brokers and the rebalancer
+        # consume.
+        from pinot_tpu.server.prewarm import PrewarmWorker
+
+        self.prewarm = PrewarmWorker(self)
 
     # serving-tier cost-vector keys mirrored into cost.tier.* meters —
     # the ONE source in engine/results.py, so a new tier cannot
@@ -476,6 +485,9 @@ class ServerInstance:
         # segment set changed: cached answers over the old cover are
         # superseded (the staleness fence's segment-lifecycle edge)
         self.result_cache.invalidate_table(self._raw_table(table))
+        # and the compile working set may have grown: kick a prewarm
+        # pass (debounced; inert without a wired workload source)
+        self.prewarm.request_prewarm(self._raw_table(table))
 
     def remove_segment(self, table: str, name: str) -> None:
         tdm = self.data_manager.table(table)
@@ -711,6 +723,9 @@ class ServerInstance:
         return {
             "name": self.name,
             "draining": self.draining,
+            "warming": self.prewarm.warming,
+            "ready": not self.prewarm.warming,
+            "prewarm": self.prewarm.state(),
             "lease": self.lease.snapshot(),
             "scheduler": self.scheduler.stats(),
             # single lane: the lane's stats verbatim; lane group: the
@@ -793,6 +808,7 @@ class ServerInstance:
         (queued lane waiters fail fast with LaneClosedError), stop the
         occupancy sampler, and force-stop any active profile capture."""
         self.scheduler.shutdown()
+        self.prewarm.stop()
         self.history.stop()
         self._stop_samplers()
         self.profiler.shutdown()

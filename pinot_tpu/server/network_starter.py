@@ -106,6 +106,10 @@ class ServerAdminHttpServer:
                     )
                 if self.path == "/debug/profile":
                     return self._send_json(inst.profiler.snapshot())
+                if self.path == "/debug/prewarm":
+                    # warm-start readiness surface (server/prewarm.py):
+                    # warming/ready flag + pass counters
+                    return self._send_json(inst.prewarm.state())
                 if self.path == "/debug/flightrec":
                     return self._send_json(inst.flightrec.snapshot())
                 from urllib.parse import parse_qs, urlparse
@@ -683,6 +687,12 @@ class NetworkedServerStarter:
         # (bounds recovery time: pending ONLINE transitions re-ack fast)
         self._msg_wake = threading.Event()
         self._threads: list = []
+        # fleet plan prewarming (server/prewarm.py): the worker pulls
+        # the controller's merged top-K workload for the tables this
+        # server hosts; segment loads (ONLINE transitions) trigger the
+        # passes, and the warming flag rides every heartbeat so the
+        # controller can gate rebalance trims and tell the brokers
+        self.server.prewarm.workload_source = self._fetch_workload
 
     # -- HTTP helpers --------------------------------------------------
     def _link(self, fn):
@@ -736,6 +746,17 @@ class NetworkedServerStarter:
                 return json.loads(r.read())
 
         return self._link(send)
+
+    def _fetch_workload(self, tables, n) -> list:
+        """Prewarm workload feed: the controller's fleet-merged top-K
+        plan shapes, narrowed to the given tables."""
+        import urllib.parse
+
+        qs = f"?n={int(n)}"
+        if tables:
+            qs += "&tables=" + urllib.parse.quote(",".join(tables))
+        out = self._get("/debug/workload" + qs)
+        return out.get("topByCount") or out.get("top") or []
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -810,7 +831,10 @@ class NetworkedServerStarter:
             try:
                 out = self._post(
                     f"/instances/{self.name}/heartbeat",
-                    {},
+                    # warm-start readiness rides the liveness beat: the
+                    # controller folds it into the cluster state (broker
+                    # deprioritization) and the rebalancer's trim gate
+                    {"warming": bool(self.server.prewarm.warming)},
                     timeout_s=self._hb_timeout_s,
                 )
                 # drain ack: the controller tells us (on the heartbeat it

@@ -50,6 +50,7 @@ class ServerStarter:
         server: ServerInstance,
         resources: ClusterResourceManager,
         data_dir: Optional[str] = None,
+        workload_source=None,
     ) -> None:
         self.server = server
         self.resources = resources
@@ -58,6 +59,11 @@ class ServerStarter:
         # the segment from serving (we never rename a dir we don't own)
         self.data_dir = data_dir
         self._local_crcs: Dict[str, int] = {}  # segment -> crc loaded
+        # fleet workload feed for the prewarm worker (server/prewarm.py):
+        # in-process harnesses pass a closure over a broker's plan-stat
+        # registry; segment loads below then trigger prewarm passes
+        if workload_source is not None:
+            server.prewarm.workload_source = workload_source
 
     def start(self) -> None:
         self.resources.register_instance(

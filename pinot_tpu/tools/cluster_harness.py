@@ -20,6 +20,14 @@ HTTP/TCP endpoints in one process, every link routed through a shared
 epoch-fenced commit plane under severed links (tier-1 twins in
 ``tests/test_partition.py``).
 
+``--scenario rolling-restart-warm`` is the warm-start acceptance
+(ISSUE 16): every server is replaced by a FRESH instance sharing only
+the persistent compile cache while the steady workload replays — zero
+failed queries, ``compile.cold == 0`` on restarted servers (persistent
+ledger + fleet prewarming), and readiness-gated movement (trims wait
+for warming destinations; the event ring proves it).  Tier-1 twin in
+``tests/test_warmstart.py``.
+
 ``--scenario elastic-fleet`` runs the fleet-breadth chaos acceptance
 (ISSUE 15): 100+ tables under mixed ingest+query closed-loop load,
 a forced hot-tenant skew, a live make-before-break rebalance, and a
@@ -548,6 +556,241 @@ def run_rolling_restart_scenario(
         }
     finally:
         cluster.stop()
+
+
+def _mirror_warming(cluster) -> None:
+    """In-process stand-in for the networked heartbeat readiness feed:
+    copy each live server's ``prewarm.warming`` flag into the
+    controller's InstanceState (what the stabilizer's trim gate
+    consults) and the broker's health tracker (what routing
+    deprioritizes on).  The networked starter does exactly this on
+    every heartbeat; scenarios that drive stabilizer rounds explicitly
+    mirror explicitly."""
+    res = cluster.controller.resources
+    for s in cluster.servers:
+        w = bool(s.prewarm.warming)
+        res.set_instance_warming(s.name, w)
+        cluster.broker.health.set_warming(s.name, w)
+
+
+def run_rolling_restart_warm_scenario(
+    num_servers: int = 3, replication: int = 2, num_segments: int = 6,
+    clients: int = 1, data_dir: Optional[str] = None,
+    cache_dir: Optional[str] = None,
+    steady_s: float = 0.7,
+    prewarm_timeout_s: float = 10.0,
+    p99_multiple: float = 8.0, p99_floor_ms: float = 150.0,
+    max_rounds: int = 120,
+) -> Dict[str, Any]:
+    """Rolling restart with WARM starts (ISSUE 16): every server is
+    drained, killed, and replaced by a genuinely fresh process image
+    (new ``ServerInstance`` — empty lane compile registries) sharing
+    only the persistent compile cache, while a closed-loop workload
+    replays the steady query mix.
+
+    Proves the full warm-start story end to end:
+
+    - ZERO failed queries across the whole roll;
+    - ``compile.cold == 0`` on every restarted server — the steady
+      phase recorded each plan digest in the persistent ledger, so the
+      restarts' first launches classify ``persistentHit``/``prewarmed``,
+      never cold;
+    - the stabilizer's movement waits for warming destinations: drain
+      drops and rebalance phase-2 trims defer while the receiving
+      server prewarms (``rebalanceTrimDeferred`` in the event ring),
+      and complete once it reports ready;
+    - prewarming never enters a serving lane: the lane watchdog/stall
+      counters on restarted servers stay zero;
+    - roll-phase p99 stays bounded vs the steady baseline.
+
+    ``clients=1`` by default: a sequential replay keeps the plan-shape
+    set exactly equal to the steady phase's (no micro-batched combo
+    shapes appearing for the first time mid-roll), which is what makes
+    the ``compile.cold == 0`` bar deterministic.
+    """
+    from pinot_tpu.engine import compilecache
+
+    cache_dir = cache_dir or tempfile.mkdtemp(prefix="pinot_tpu_warmcache_")
+    prev_env = os.environ.get("PINOT_TPU_COMPILE_CACHE_DIR")
+    os.environ["PINOT_TPU_COMPILE_CACHE_DIR"] = cache_dir
+    compilecache.configure_jax_cache(cache_dir)
+    cluster, physical, total = _build_scenario_cluster(
+        num_servers, replication, num_segments, data_dir
+    )
+    res = cluster.controller.resources
+    stab = cluster.controller.stabilizer
+    stab.prewarm_timeout_s = prewarm_timeout_s
+    stab.rebalance_hysteresis = 1  # rounds are driven explicitly here
+    restarted: List[str] = []
+    try:
+        # fleet workload feed: in-process, the broker's own plan-stat
+        # registry IS the fleet roll-up the controller would serve
+        def workload_source(tables, n):
+            return cluster.broker.workload_snapshot(top=n, tables=tables)[
+                "topByCount"
+            ]
+
+        for s in cluster.servers:
+            s.prewarm.workload_source = workload_source
+            s.prewarm.timeout_s = prewarm_timeout_s
+
+        pql = "SELECT sum(metInt), count(*) FROM testTable GROUP BY dimStr TOP 5"
+        count_pql = "SELECT count(*) FROM testTable"
+        # warm BOTH shapes the scenario ever issues before measuring:
+        # the steady baseline must not include the one-time cold, and
+        # every digest a restarted server can see must be in the
+        # persistent ledger before the first restart
+        for warm_pql in (pql, count_pql):
+            r = cluster.broker.handle_pql(warm_pql)
+            assert not r.exceptions, r.exceptions
+        # steady phase: populates the broker's workload registry (the
+        # prewarm feed) AND the persistent plan ledger (via this run's
+        # genuine colds) before any restart happens
+        steady_load = ClosedLoopLoad(cluster, pql, total, clients).start()
+        time.sleep(steady_s)
+        steady = steady_load.stop()
+        assert steady["failedQueries"] == 0, steady["failures"]
+
+        roll_load = ClosedLoopLoad(cluster, pql, total, clients).start()
+        rounds_per_server: Dict[str, int] = {}
+        for i in range(len(cluster.servers)):
+            old = cluster.servers[i]
+            name = old.name
+            # drain: replicas migrate off; each destination flips to
+            # warming as the moved segments load, so dropping the
+            # draining copy is readiness-gated (the deferral events
+            # below prove the wait happened)
+            cluster.controller.drain_instance(name)
+            used = 0
+            while used < max_rounds:
+                _mirror_warming(cluster)
+                stab.run_once()
+                used += 1
+                if cluster.controller.drain_status(name)["drained"]:
+                    break
+                time.sleep(0.05)
+            assert cluster.controller.drain_status(name)["drained"], name
+            rounds_per_server[name] = used
+            # restart: the process dies — a FRESH instance (empty
+            # compile registries) comes back under the same name with
+            # the same persistent cache dir
+            cluster.transport.set_down((name, 0))
+            res.set_instance_alive(name, False)
+            old.shutdown()
+            fresh = ServerInstance(name, max_pending=64)
+            fresh.prewarm.timeout_s = prewarm_timeout_s
+            starter = ServerStarter(fresh, res, workload_source=workload_source)
+            starter.start()
+            cluster.transport.register((name, 0), fresh.handle_request)
+            cluster.transport.set_down((name, 0), False)
+            res.set_instance_alive(name, True)
+            cluster.controller.undrain_instance(name)
+            cluster.servers[i] = fresh
+            cluster.server_starters[i] = starter
+            restarted.append(name)
+            # recovery: proactive rebalance re-homes load onto the
+            # empty restart; phase-2 trims wait for it to finish
+            # warming before the surplus source copies drop.  The skew
+            # bar drops only for this loop — an empty restart is a
+            # ~1.5x skew this topology's default bar would tolerate —
+            # so the steady phases stay free of rebalance churn
+            default_skew = stab.rebalance_skew_ratio
+            stab.rebalance_skew_ratio = 1.2
+            used = 0
+            while used < max_rounds:
+                _mirror_warming(cluster)
+                stab.run_once()
+                _mirror_warming(cluster)
+                hosts = any(
+                    name in reps
+                    for reps in res.get_ideal_state(physical).values()
+                )
+                if (
+                    hosts
+                    and not fresh.prewarm.warming
+                    and not stab._pending_moves
+                ):
+                    break
+                used += 1
+                time.sleep(0.05)
+            stab.rebalance_skew_ratio = default_skew
+        time.sleep(0.15)  # steady tail under the recovered fleet
+        roll = roll_load.stop()
+
+        state = _replication_state(cluster, physical)
+        events = stab.events()
+        deferrals = [e for e in events if e["event"] == "rebalanceTrimDeferred"]
+        timeouts = [e for e in events if e["event"] == "rebalancePrewarmTimeout"]
+        per_server: Dict[str, Dict[str, Any]] = {}
+        for s in cluster.servers:
+            m = s.metrics.snapshot()["meters"]
+
+            def count(name: str) -> int:
+                return int(m.get(name, {}).get("count", 0))
+
+            per_server[s.name] = {
+                "compileCold": count("compile.cold"),
+                "compileWarm": count("compile.warm"),
+                "persistentHits": count("compile.persistentHit"),
+                "prewarmed": count("compile.prewarmed"),
+                "prewarmCompiled": count("prewarm.compiled"),
+                "prewarmFailed": count("prewarm.failed"),
+                "laneRestarts": count("lane.restarts"),
+                "laneDeviceFailures": count("lane.deviceFailures"),
+            }
+        restarted_stats = [per_server[n] for n in restarted]
+        p99_limit = p99_multiple * max(steady["p99Ms"], p99_floor_ms)
+        by_class: Dict[str, int] = {}
+        for e in deferrals:
+            by_class[e["class"]] = by_class.get(e["class"], 0) + 1
+        final = cluster.query(count_pql)
+        return {
+            "scenario": "rolling-restart-warm",
+            "cacheDir": cache_dir,
+            "roundsPerServer": rounds_per_server,
+            "restarted": restarted,
+            "steady": steady,
+            **roll,
+            **state,
+            "servers": per_server,
+            "coldCompilesOnRestarted": sum(
+                s["compileCold"] for s in restarted_stats
+            ),
+            "warmStartsOnRestarted": sum(
+                s["persistentHits"] + s["prewarmed"] for s in restarted_stats
+            ),
+            "laneWatchdogClean": all(
+                s["laneRestarts"] == 0 and s["laneDeviceFailures"] == 0
+                for s in restarted_stats
+            ),
+            "trimDeferrals": len(deferrals),
+            "trimDeferralsByClass": by_class,
+            "trimDeferralSample": deferrals[:3],
+            "prewarmTimeouts": len(timeouts),
+            "prewarmDeferralMeter": int(
+                stab.metrics.snapshot()["meters"]
+                .get("rebalance.prewarmDeferrals", {})
+                .get("count", 0)
+            ),
+            "steadyP99Ms": steady["p99Ms"],
+            "rollP99Ms": roll["p99Ms"],
+            "p99LimitMs": round(p99_limit, 3),
+            "p99Bounded": roll["p99Ms"] <= p99_limit,
+            "finalDocs": final.num_docs_scanned,
+            "expectedDocs": total,
+            "finalComplete": not final.partial_response and not final.exceptions,
+            "noSegmentLoss": state["replicaSetSizes"] == [replication]
+            and final.num_docs_scanned == total
+            and not final.partial_response,
+        }
+    finally:
+        for s in cluster.servers:
+            s.prewarm.stop()
+        cluster.stop()
+        if prev_env is None:
+            os.environ.pop("PINOT_TPU_COMPILE_CACHE_DIR", None)
+        else:
+            os.environ["PINOT_TPU_COMPILE_CACHE_DIR"] = prev_env
 
 
 # ---------------------------------------------------------------------------
@@ -1956,6 +2199,7 @@ SCENARIOS = {
     "kill-server": run_kill_server_scenario,
     "drain": run_drain_scenario,
     "rolling-restart": run_rolling_restart_scenario,
+    "rolling-restart-warm": run_rolling_restart_warm_scenario,
     "elastic-fleet": run_elastic_fleet_scenario,
     "noisy-neighbor": run_noisy_neighbor_scenario,
     "join-under-flood": run_join_under_flood_scenario,
@@ -2006,6 +2250,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             replication=args.replication,
             num_segments=min(args.segments, 4),
             clients=args.clients,
+        )
+    elif args.scenario == "rolling-restart-warm":
+        # sequential replay (clients=1): the compile.cold == 0 bar is
+        # deterministic only when no novel micro-batched combo shape
+        # can appear for the first time mid-roll
+        out = SCENARIOS[args.scenario](
+            num_servers=args.servers,
+            replication=args.replication,
+            num_segments=args.segments,
         )
     elif args.scenario == "noisy-neighbor":
         out = SCENARIOS[args.scenario](

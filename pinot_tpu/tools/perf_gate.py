@@ -188,6 +188,34 @@ INGEST_CONFIG_KEYS = (
 
 INGEST_DEFAULT_BASELINE = "INGEST_r15.json"
 
+# restart-mode documents (tools/restart_bench.py, ISSUE 16): the
+# warm-restart story.  ``cold_free_restart`` is the exact structural
+# bar — 1.0 only when both restart phases kept ``compile.cold`` at
+# zero AND classified their first launches (persistentHit / prewarmed)
+# — any cold compile on a restart fails the gate outright.  The ratio
+# metrics band the recovered fraction of the cold cliff: the
+# persistent cache alone must keep the first query under ~72% of cold,
+# prewarming under ~2x its committed fraction (~5% of cold on the CPU
+# capture; on a real TPU the cold side is ~25s so these ratios
+# collapse toward zero).  first_query_over_steady_p50 rides a relative
+# band: CPU steady p50 is broker overhead (~2ms) so the re-trace
+# constant dominates the toy-scale ratio; the band catches it
+# regressing toward the cold multiple (~180x), not jitter.
+RESTART_METRIC_SPECS: Dict[str, Tuple[str, float]] = {
+    "value": ("lower", 2.5),
+    "cold_first_query_ms": ("lower", 2.5),
+    "restart_first_query_ms": ("lower", 2.5),
+    "steady_p50_ms": ("lower", 2.5),
+    "restart_over_cold": ("lower", 1.6),
+    "prewarm_over_cold": ("lower", 2.0),
+    "first_query_over_steady_p50": ("lower", 2.0),
+    "cold_free_restart": ("higher", 1.0),
+}
+
+RESTART_CONFIG_KEYS = ("total_rows", "num_segments", "platform")
+
+RESTART_DEFAULT_BASELINE = "RESTART_r16.json"
+
 
 def _is_serving(doc: Dict[str, Any]) -> bool:
     return str(doc.get("metric", "")).startswith("serving_")
@@ -203,6 +231,8 @@ def _doc_kind(doc: Dict[str, Any]) -> str:
         return "join"
     if metric.startswith("ingest_"):
         return "ingest"
+    if metric.startswith("restart_"):
+        return "restart"
     return "default"
 
 
@@ -217,6 +247,8 @@ def _specs_for(doc: Dict[str, Any]):
         return JOIN_METRIC_SPECS, JOIN_CONFIG_KEYS
     if kind == "ingest":
         return INGEST_METRIC_SPECS, INGEST_CONFIG_KEYS
+    if kind == "restart":
+        return RESTART_METRIC_SPECS, RESTART_CONFIG_KEYS
     return METRIC_SPECS, CONFIG_KEYS
 
 
@@ -368,6 +400,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "multichip": MULTICHIP_DEFAULT_BASELINE,
                 "join": JOIN_DEFAULT_BASELINE,
                 "ingest": INGEST_DEFAULT_BASELINE,
+                "restart": RESTART_DEFAULT_BASELINE,
             }.get(_doc_kind(current), "BENCH_r05.json")
         baseline = load_bench(baseline_path)
     except (OSError, ValueError, json.JSONDecodeError) as e:

@@ -527,10 +527,30 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     # compile timeline (engine/dispatch.py lane registry): first-call
     # launch of a device-plan digest pays trace + XLA compile
     "compile.cold": "device-plan digests launched for the first time "
-    "(cold compile measured)",
+    "(cold compile measured; persistent-cache hits and prewarmed shapes "
+    "excluded — serving-path genuine colds only)",
     "compile.warm": "device launches that reused an already-compiled plan",
     "compile.firstCallMs": "first-call (compile-inclusive) launch wall ms "
     "per device-plan digest",
+    # warm-start resilience (engine/compilecache.py + server/prewarm.py):
+    # the persistent compile cache splits the first-launch timeline into
+    # cold / persistent / prewarmed, and the prewarm worker drives
+    # compiles off the serving path
+    "compile.persistentHit": "first launches of a plan digest whose XLA "
+    "binary the persistent compile cache already held (restart warmth)",
+    "compile.persistentMiss": "genuine cold compiles while the persistent "
+    "cache was enabled (the entry is written for the next restart)",
+    "compile.prewarmed": "plan digests compiled by the background prewarm "
+    "worker before any serving query needed them",
+    "prewarm.shapes": "workload plan shapes considered by prewarm passes",
+    "prewarm.compiled": "prewarm shapes actually compiled into a lane's "
+    "registry (digest-exact, off the serving path)",
+    "prewarm.skipped": "prewarm shapes skipped (already compiled, "
+    "off-device plan, no exemplar, or deadline-capped)",
+    "prewarm.failed": "prewarm shapes that errored (parse/build/compile); "
+    "the shape compiles lazily — and honestly cold — on the serving path",
+    "server.warming": "1 while the prewarm worker is rebuilding the "
+    "compile working set (the heartbeat-reported readiness flag)",
     "compile.costAnalyses": "device-plan digests whose static XLA cost "
     "analysis (flops / bytes accessed) landed in the compile registry",
     "compile.costAnalysisUnavailable": "device-plan digests whose backend "
@@ -664,6 +684,9 @@ CONTROLLER_METRIC_CATALOG: Dict[str, str] = {
     "phase 1 (added) and phase 2 (source dropped)",
     "rebalance.imbalanceRatio": "worst per-tenant max/mean doc-x-cost "
     "load ratio seen by the last skew evaluation",
+    "rebalance.prewarmDeferrals": "replica removals deferred because the "
+    "surviving cover was still prewarming its compile working set "
+    "(bounded by PINOT_TPU_PREWARM_TIMEOUT_S)",
     "aliveServers": "registered server instances currently alive",
     "aliveBrokers": "registered broker instances currently alive",
     "deadInstances": "registered instances currently marked dead",

@@ -37,12 +37,19 @@ class _Entry:
         "digest", "summary", "table", "count", "shed_count", "failed_count",
         "coalesce_hits", "docs_scanned", "cost", "latency", "first_seen",
         "last_seen", "device_lat", "host_lat", "device_execs", "device_info",
+        "exemplar",
     )
 
     def __init__(self, digest: str, summary: str, table: str, now: float) -> None:
         self.digest = digest
         self.summary = summary
         self.table = table
+        # one representative query text per shape (first writer wins,
+        # bounded): literals are erased from the digest, so ANY member
+        # query re-parses to the digest's exact plan shape — this is
+        # what lets a prewarming server rebuild and compile the shape
+        # without ever having served it (r16 warm-start plane)
+        self.exemplar = ""
         self.count = 0
         self.shed_count = 0
         self.failed_count = 0
@@ -141,6 +148,7 @@ class PlanStatsStore:
         device_ms: Optional[float] = None,
         host_ms: Optional[float] = None,
         device_info: Optional[Dict[str, Any]] = None,
+        pql: str = "",
     ) -> None:
         now = time.time()
         with self._lock:
@@ -155,6 +163,8 @@ class PlanStatsStore:
                 e.summary = summary
             if table and not e.table:
                 e.table = table
+            if pql and not e.exemplar:
+                e.exemplar = str(pql)[:2048]
             e.last_seen = now
             self.total_recorded += 1
             if shed:
@@ -196,6 +206,7 @@ class PlanStatsStore:
             "digest": e.digest,
             "summary": e.summary,
             "table": e.table,
+            "exemplarPql": e.exemplar,
             "count": e.count,
             "shedCount": e.shed_count,
             "failedCount": e.failed_count,
@@ -251,21 +262,31 @@ class PlanStatsStore:
             float(c.get("deviceMs", 0)) + float(c.get("hostMs", 0))
         )
 
-    def top(self, k: int = 20, by: str = "count") -> List[Dict[str, Any]]:
+    def top(
+        self, k: int = 20, by: str = "count", tables=None
+    ) -> List[Dict[str, Any]]:
         # record() sits on the per-query response path and shares this
         # lock, so the O(digests) ranking runs on cheap scalar keys and
         # the expensive dicts (percentiles over the sample window) are
         # built only for the k survivors
+        if tables is not None:
+            # physical-suffix-insensitive: a prewarming server asks with
+            # the raw names it hosts, the broker records logical names
+            from pinot_tpu.engine.plandigest import _raw_table
+
+            wanted = {_raw_table(t) for t in tables}
         with self._lock:
+            entries = [
+                e
+                for e in self._entries.values()
+                if tables is None or _raw_table(e.table) in wanted
+            ]
             if by == "cost":
                 keyed = [
-                    (self._cost_key({"cost": e.cost}), e)
-                    for e in self._entries.values()
+                    (self._cost_key({"cost": e.cost}), e) for e in entries
                 ]
             else:
-                keyed = [
-                    ((e.count, e.last_seen), e) for e in self._entries.values()
-                ]
+                keyed = [((e.count, e.last_seen), e) for e in entries]
         keyed.sort(key=lambda pair: pair[0], reverse=True)
         survivors = [e for _, e in keyed[:k]]
         with self._lock:

@@ -104,6 +104,23 @@ def _no_leaked_ingest_pool_threads():
 
 
 @pytest.fixture(autouse=True)
+def _no_leaked_prewarm_threads():
+    """Prewarm workers (server/prewarm.py): the background compile
+    driver is one daemon thread per server, started lazily on the
+    first prewarm request; ``stop()`` (via ``ServerInstance.shutdown``)
+    must actually end it.  Workers still serving (live servers held by
+    fixtures) are exempt — a STOPPED worker whose thread survives is
+    the leak."""
+    yield
+    from pinot_tpu.server.prewarm import leaked_prewarm_threads
+
+    leaked = leaked_prewarm_threads(grace_s=2.0)
+    assert not leaked, (
+        f"prewarm worker threads leaked past stop(): {leaked}"
+    )
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_manager_threads():
     """Controller periodic managers (retention/validation/status/
     stabilizer): a stopped manager's worker must actually exit —
