@@ -60,6 +60,14 @@ class StagedColumn:
     hll_bucket: Optional[jnp.ndarray] = None  # uint8 [S, n_pad] HLL register index
     hll_rho: Optional[jnp.ndarray] = None  # uint8 [S, n_pad] HLL rank
     mv_raw: Optional[jnp.ndarray] = None  # float [S, n_pad, mv_pad] decoded MV values
+    # bit-sliced tier planes (engine/bitsliced.py): dictId bit-planes
+    # for bitwise filter/min/max evaluation, and value-offset planes
+    # (value - per-segment vmin) for popcount-fused SUM
+    bsi: Optional[jnp.ndarray] = None  # uint32 [S, W, n_pad//32] dictId planes
+    bsiv: Optional[jnp.ndarray] = None  # uint32 [S, Wv, n_pad//32] value-offset planes
+    bsi_width: int = 0
+    bsiv_width: int = 0
+    bsiv_min: Optional[Tuple[int, ...]] = None  # per-segment integer vmin
 
     @property
     def is_numeric(self) -> bool:
@@ -150,6 +158,8 @@ def stage_segments(
     ctx=None,
     skip_base_columns: Sequence[str] = (),
     sharding=None,
+    bsi_columns: Sequence[str] = (),
+    bsiv_columns: Sequence[str] = (),
 ) -> StagedTable:
     """Stack + pad + transfer the given columns of the segments.
 
@@ -241,6 +251,16 @@ def stage_segments(
                 hb, hr = _hll_streams(cols, S, n_pad)
                 sc.hll_rho = put(hr)  # rho first (see _augment_staged)
                 sc.hll_bucket = put(hb)
+            if name in bsi_columns:
+                sc.bsi_width = bsi_filter_width(cols)
+                sc.bsi = put(_bsi_planes(cols, S, n_pad, sc.bsi_width))
+            if name in bsiv_columns and sc.is_numeric:
+                spec = bsiv_value_spec(cols)
+                if spec is not None:
+                    sc.bsiv_width, sc.bsiv_min = spec
+                    sc.bsiv = put(
+                        _bsiv_planes(cols, S, n_pad, sc.bsiv_width, sc.bsiv_min)
+                    )
         else:
             mv_pad = max(1, max(c.metadata.max_num_multi_values for c in cols))
             mv_pad = config.pad_card(mv_pad)  # pow2 bucket
@@ -285,6 +305,66 @@ def _stack_dict_vals(cols, S: int, card_pad: int, fdt) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Bit-sliced tier staging (engine/bitsliced.py): plane layouts are
+# built host-side at staging time with the packing.py encoder, stacked
+# [S, W, n_pad//32], and attached as role arrays so realtime
+# staging-token advances invalidate them exactly like every other role.
+# ---------------------------------------------------------------------------
+
+
+def bsi_filter_width(cols) -> int:
+    """Uniform dictId plane count across segments: enough planes for
+    the widest per-segment dictionary."""
+    from pinot_tpu.engine.packing import bit_width
+
+    return max(bit_width(max(c.dictionary.cardinality - 1, 0)) for c in cols)
+
+
+def bsiv_value_spec(cols) -> "Optional[Tuple[int, Tuple[int, ...]]]":
+    """(plane count, per-segment integer vmin) for value-offset planes,
+    or None when any segment's dictionary is not exactly integral —
+    fused SUM is only offered where it is bit-exact vs the scan tier."""
+    from pinot_tpu.engine.packing import bit_width, integral_dictionary_values
+
+    vmins = []
+    width = 1
+    for c in cols:
+        iv = integral_dictionary_values(c.dictionary.values)
+        if iv is None:
+            return None
+        vmin, vmax = int(iv.min()), int(iv.max())
+        vmins.append(vmin)
+        width = max(width, bit_width(vmax - vmin))
+    if width > 32:
+        return None
+    return width, tuple(vmins)
+
+
+def _bsi_planes(cols, S: int, n_pad: int, width: int) -> np.ndarray:
+    from pinot_tpu.engine.packing import bitslice_encode
+
+    # round UP: segments smaller than one 32-row word still need a word
+    nw = max(1, (n_pad + 31) // 32)
+    planes = np.zeros((S, width, nw), dtype=np.uint32)
+    for i, c in enumerate(cols):
+        planes[i] = bitslice_encode(np.asarray(c.fwd), width, nw)
+    return planes
+
+
+def _bsiv_planes(
+    cols, S: int, n_pad: int, width: int, vmins: Tuple[int, ...]
+) -> np.ndarray:
+    from pinot_tpu.engine.packing import bitslice_encode, integral_dictionary_values
+
+    nw = max(1, (n_pad + 31) // 32)
+    planes = np.zeros((S, width, nw), dtype=np.uint32)
+    for i, c in enumerate(cols):
+        iv = integral_dictionary_values(c.dictionary.values)
+        planes[i] = bitslice_encode(iv[c.fwd] - vmins[i], width, nw)
+    return planes
+
+
+# ---------------------------------------------------------------------------
 # HBM staging ledger: byte-accurate accounting of what the staging
 # cache currently pins in device memory, per staged table / column /
 # role — the capacity signal multichip staging and broker admission
@@ -304,6 +384,8 @@ _ROLE_ATTRS = (
     ("hll_bucket", "hll"),
     ("hll_rho", "hll"),
     ("mv_raw", "mvRaw"),
+    ("bsi", "bsi"),
+    ("bsiv", "bsi"),
 )
 
 
@@ -571,6 +653,8 @@ def get_staged(
     ctx=None,
     skip_base_columns: Sequence[str] = (),
     sharding=None,
+    bsi_columns: Sequence[str] = (),
+    bsiv_columns: Sequence[str] = (),
 ) -> StagedTable:
     """Cached staging. The cache key covers only the base arrays; role
     arrays (raw/gfwd/hll streams) are attached to the cached
@@ -607,6 +691,8 @@ def get_staged(
                 ctx=ctx,
                 skip_base_columns=skip_base_columns,
                 sharding=sharding,
+                bsi_columns=bsi_columns,
+                bsiv_columns=bsiv_columns,
             )
             with _cache_guard:
                 if len(_stage_cache) > 32:
@@ -631,6 +717,8 @@ def get_staged(
                 base_columns=[
                     c for c in column_names if c not in set(skip_base_columns)
                 ],
+                bsi_columns=bsi_columns,
+                bsiv_columns=bsiv_columns,
             )
             if attached:
                 # re-measure (augmentation attached arrays) ONLY while
@@ -656,6 +744,8 @@ def _augment_staged(
     hll_columns: Sequence[str],
     ctx,
     base_columns: Sequence[str] = (),
+    bsi_columns: Sequence[str] = (),
+    bsiv_columns: Sequence[str] = (),
 ) -> int:
     """Attach missing role arrays to an already-staged table.  Returns
     the bytes newly uploaded (0 on a plain hit) so the caller can record
@@ -736,6 +826,34 @@ def _augment_staged(
         sc.hll_rho = put(hr)
         sc.hll_bucket = put(hb)
         attached += int(sc.hll_rho.nbytes) + int(sc.hll_bucket.nbytes)
+    for name in bsi_columns:
+        sc = st.columns.get(name)
+        if sc is None or sc.bsi is not None or not sc.single_value:
+            continue
+        cols = [seg.column(name) for seg in segments]
+        sc.bsi_width = bsi_filter_width(cols)
+        sc.bsi = put(_bsi_planes(cols, S, n_pad, sc.bsi_width))
+        attached += int(sc.bsi.nbytes)
+    for name in bsiv_columns:
+        sc = st.columns.get(name)
+        if (
+            sc is None
+            or sc.bsiv is not None
+            or not sc.single_value
+            or not sc.is_numeric
+        ):
+            continue
+        cols = [seg.column(name) for seg in segments]
+        spec = bsiv_value_spec(cols)
+        if spec is None:
+            continue
+        width, vmins = spec
+        planes = put(_bsiv_planes(cols, S, n_pad, width, vmins))
+        # width/vmin metadata FIRST: readers holding this cached table
+        # guard on bsiv, so the scalars must be visible once it is
+        sc.bsiv_width, sc.bsiv_min = width, vmins
+        sc.bsiv = planes
+        attached += int(sc.bsiv.nbytes)
     return attached
 
 

@@ -409,6 +409,35 @@ class QueryExecutor:
             self._phase("indexPath", t0)
             return ires
 
+        # mid-selectivity scalar aggregations the postings tier just
+        # declined evaluate as O(bit-width) bulk-bitwise passes over
+        # bit-sliced planes (engine/bitsliced.py) — single-device only;
+        # mesh placements keep the sharded scan path.  A device fault
+        # here falls through to the scan section's healing loop below
+        # instead of failing the query on an optimization tier.
+        if mesh is None:
+            from pinot_tpu.engine.bitsliced import try_bitsliced_path
+
+            try:
+                bres = try_bitsliced_path(
+                    self, request, live, ctx, total_docs, deadline,
+                    lane=sel.lane if sel is not None else None,
+                    lane_index=sel.index if sel is not None else 0,
+                )
+            except Exception as e:
+                from pinot_tpu.engine.dispatch import LaneClosedError
+                from pinot_tpu.server.scheduler import QueryAbandonedError
+
+                if isinstance(
+                    e, (QueryAbandonedError, LaneClosedError, TimeoutError)
+                ):
+                    raise
+                self._heal_mark("bitslicedFallbacks", error=str(e)[:200])
+                bres = None
+            if bres is not None:
+                self._phase("bitslicedPath", t0)
+                return bres
+
         # queries the planner can only send to the host (group space or
         # guaranteed pair overflow) skip device staging entirely
         from pinot_tpu.engine.plan import plan_forced_host

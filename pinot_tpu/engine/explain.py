@@ -256,6 +256,15 @@ def build_explain_node(
         ctx = get_table_context(normal)
 
         decision, state = index_path_decision(request, normal, ctx, total_docs)
+        bsi_decision, bsi_state = (None, None)
+        if state is None and exec_mesh is None:
+            # same tier order as the executor: bit-sliced engages only
+            # after postings declines, and only off-mesh
+            from pinot_tpu.engine.bitsliced import bitsliced_decision
+
+            bsi_decision, bsi_state = bitsliced_decision(
+                request, normal, ctx, total_docs
+            )
         if state is not None:
             est_bytes = int(decision.get("estMatches", 0)) * (
                 decision.get("residuals", 0) + 1
@@ -265,6 +274,65 @@ def build_explain_node(
                     seg, "postings", decision["reason"],
                     drivingColumn=decision.get("column"),
                 )
+        elif bsi_state is not None:
+            _spec, _leaves, _aggs, planes_total, _fp = bsi_state
+            est_bytes = (total_docs * planes_total) // 8
+            for seg in normal:
+                record(
+                    seg,
+                    "bitsliced",
+                    bsi_decision["reason"],
+                    planes=bsi_decision.get("planes"),
+                    planeCounts=bsi_decision.get("planeCounts"),
+                    fusedAggs=bsi_decision.get("fusedAggs"),
+                )
+            # the bit-sliced kernel is a lane-registered device plan
+            # like any scan: its digest must match what the real
+            # execution hands the lane (try_bitsliced_path), so the
+            # compile timeline and poison lookups stay digest-exact
+            pdigest = plan_digest(("bsi", _spec))
+            lane = (
+                selection.lane
+                if selection is not None
+                else getattr(executor, "lane", None)
+            )
+            compile_entry = (
+                lane.compile_info(pdigest) if lane is not None else None
+            )
+            if compile_entry is not None:
+                cstate = (
+                    "warm"
+                    if compile_entry.get("launches", 0) > 0
+                    else compile_entry.get("via", "warm")
+                )
+                compile_info = {"state": cstate, **compile_entry}
+                if "costAnalysis" not in compile_entry:
+                    compile_info["costAnalysis"] = "pending"
+                elif compile_entry["costAnalysis"] is None:
+                    compile_info["costAnalysis"] = "unavailable"
+            else:
+                from pinot_tpu.engine import compilecache
+
+                cstate = (
+                    "persistent"
+                    if compilecache.enabled() and compilecache.known_plan(pdigest)
+                    else "cold"
+                )
+                compile_info = {"state": cstate, "costAnalysis": "unavailable"}
+            lanes_obj = getattr(executor, "lanes", None)
+            n_lanes = lanes_obj.size if lanes_obj is not None else 1
+            device_info = {
+                "planDigest": pdigest,
+                "compile": compile_info,
+                "quarantined": False,
+                "mesh": {
+                    "shape": f"{n_lanes}x1",
+                    "lanes": n_lanes,
+                    "laneIndex": selection.index if selection is not None else 0,
+                    "shardAxis": None,
+                    "collective": None,
+                },
+            }
         elif plan_forced_host(request, ctx):
             est_bytes = _estimate_scan_bytes(normal, sorted(needed), 1.0)
             for seg in normal:
@@ -601,6 +669,12 @@ def build_prewarm_spec(
     ctx = get_table_context(normal)
     decision, state = index_path_decision(request, normal, ctx, total_docs)
     if state is not None or plan_forced_host(request, ctx):
+        return None
+    from pinot_tpu.engine.bitsliced import bitsliced_decision
+
+    if bitsliced_decision(request, normal, ctx, total_docs)[1] is not None:
+        # the bit-sliced tier compiles its own (tiny) kernel per spec,
+        # not the standard StaticPlan kernel this prewarm would pay for
         return None
     raw_cols, gfwd_cols, hll_cols = executor._role_columns(request, normal, ctx)
     phantom = _phantom_staged(

@@ -49,7 +49,10 @@ def _max_matches(total_docs: int) -> int:
     # keeps the host path an order of magnitude under the scan at any
     # size AND keeps unselective predicates on the device even for small
     # tables — this is a needle-query path, not a general fallback.
-    return total_docs // 64
+    # Constants live in engine/tiercost.py (PINOT_TPU_TIER_COST_*).
+    from pinot_tpu.engine.tiercost import postings_max_matches
+
+    return postings_max_matches(total_docs)
 
 
 def _mv_subset_hits(col, table: np.ndarray, rows: np.ndarray) -> np.ndarray:

@@ -1268,6 +1268,154 @@ def make_packed_batched_table_kernel(plan: StaticPlan) -> Callable:
 
 
 # ---------------------------------------------------------------------------
+# Bit-sliced (BSI) filter/aggregate tier (engine/bitsliced.py): the
+# bulk-bitwise formulation.  A predicate over a W-plane bit-sliced
+# column evaluates in O(W) wide bitwise passes over n/32 packed uint32
+# words; COUNT/SUM/MIN/MAX fuse into the SAME pass via popcounts and a
+# bit-serial candidate descent, so a qualifying mid-selectivity
+# aggregation never materializes row indices at all.
+#
+# The kernel spec is a plain hashable tuple (no StaticPlan — the tier
+# has its own, much smaller, plan space):
+#   (leaves, tree, sums, extremes)
+#   leaves   = ((kind, col, width, k_pad), ...)   kind in
+#              {"interval", "points", "points_none"}
+#   tree     = ("leaf", i) | ("and"|"or", child, ...)
+#   sums     = ((col, value_width), ...)          value-offset planes
+#   extremes = ((col, width, is_max), ...)        dictId planes
+# Inputs: segs = {"nd": int32 [S],
+#                 "p:<col>": uint32 [S, W, nw], "v:<col>": uint32 [S, Wv, nw]}
+#         q    = {"bounds:<i>": int32 [S, 2], "pts:<i>": int32 [S, k_pad]}
+# Outputs (per segment — host finalize owns the cross-segment merge so
+# it can apply per-segment vmin offsets and dictionary lookups):
+#   "count": int32 [S]; "psum:<col>": int32 [S, Wv]; "ext:<col>": int32 [S]
+# ---------------------------------------------------------------------------
+
+_U32_FULL = np.uint32(0xFFFFFFFF)
+
+
+def _bsi_valid_words(num_docs, n_words: int):
+    """uint32 [n_words] validity mask from the segment's doc count:
+    word j keeps bits for rows j*32 .. j*32+31 below num_docs."""
+    j = jax.lax.iota(jnp.int32, n_words)
+    bits = jnp.clip(num_docs - j * 32, 0, 32)
+    base = (
+        jnp.uint32(1) << jnp.clip(bits, 0, 31).astype(jnp.uint32)
+    ) - jnp.uint32(1)
+    return jnp.where(bits >= 32, jnp.uint32(_U32_FULL), base)
+
+
+def _bsi_ge(planes, t, width: int):
+    """Bitmap of rows whose value >= t (runtime int32 scalar) — the
+    bit-serial MSB->LSB descent: ``gt`` accumulates rows already proven
+    greater, ``eq`` tracks rows still matching t's prefix."""
+    gt = jnp.zeros_like(planes[0])
+    eq = jnp.full_like(planes[0], _U32_FULL)
+    for b in range(width - 1, -1, -1):
+        tb = ((t >> b) & 1).astype(jnp.uint32)
+        tmask = jnp.uint32(0) - tb  # 0x0 or 0xFFFFFFFF
+        gt = gt | (eq & planes[b] & ~tmask)
+        eq = eq & ~(planes[b] ^ tmask)
+    ge = gt | eq
+    if width < 31:
+        # t at/above 2^W would otherwise truncate to GE(t mod 2^W)
+        ge = jnp.where(t >= (1 << width), jnp.zeros_like(ge), ge)
+    return ge
+
+
+def _bsi_points(planes, pts, width: int):
+    """Bitmap of rows whose value is in ``pts`` (int32 [k], -1 padded) —
+    per-point XNOR descent, OR-reduced over the point axis."""
+    eq = jnp.full((pts.shape[0], planes.shape[1]), _U32_FULL, dtype=jnp.uint32)
+    for b in range(width):
+        pb = ((pts >> b) & 1).astype(jnp.uint32)[:, None]
+        eq = eq & ~(planes[b][None, :] ^ (jnp.uint32(0) - pb))
+    # -1 padding under the arithmetic shift above is all-ones and would
+    # alias dictId 2^W - 1: mask padded (and any out-of-width) points
+    ok = pts >= 0
+    if width < 31:
+        ok = ok & (pts < (1 << width))
+    eq = jnp.where(ok[:, None], eq, jnp.zeros_like(eq))
+    return jax.lax.reduce(eq, np.uint32(0), jax.lax.bitwise_or, (0,))
+
+
+def _bsi_extreme(planes, bitmap, width: int, is_max: bool):
+    """Bit-serial candidate descent: the extreme dictId among bitmap
+    rows (garbage when the bitmap is empty — callers mask on count)."""
+    cand = bitmap
+    out = jnp.int32(0)
+    for b in range(width - 1, -1, -1):
+        t = (cand & planes[b]) if is_max else (cand & ~planes[b])
+        any_t = jnp.any(t != 0)
+        cand = jnp.where(any_t, t, cand)
+        taken = any_t if is_max else ~any_t
+        out = out | (taken.astype(jnp.int32) << b)
+    return out
+
+
+def _bsi_eval_tree(node, bms):
+    if node[0] == "leaf":
+        return bms[node[1]]
+    acc = _bsi_eval_tree(node[1], bms)
+    for child in node[2:]:
+        m = _bsi_eval_tree(child, bms)
+        acc = (acc & m) if node[0] == "and" else (acc | m)
+    return acc
+
+
+def make_single_segment_bitsliced_kernel(spec) -> Callable:
+    leaves, tree, sums, extremes = spec
+
+    def single(seg: Dict[str, Any], q: Dict[str, Any]) -> Dict[str, Any]:
+        bms = []
+        n_words = None
+        for i, (kind, col, width, k_pad) in enumerate(leaves):
+            planes = seg[f"p:{col}"]
+            n_words = planes.shape[-1]
+            if kind == "interval":
+                lo, hi = q[f"bounds:{i}"][0], q[f"bounds:{i}"][1]
+                bm = _bsi_ge(planes, lo, width) & ~_bsi_ge(planes, hi, width)
+            else:
+                bm = _bsi_points(planes, q[f"pts:{i}"], width)
+                if kind == "points_none":
+                    bm = ~bm  # complement; padding cleared by vw below
+            bms.append(bm)
+        vw = _bsi_valid_words(seg["nd"], n_words)
+        bitmap = _bsi_eval_tree(tree, bms) & vw
+        pop = jax.lax.population_count
+        outs: Dict[str, Any] = {
+            "count": jnp.sum(pop(bitmap)).astype(jnp.int32)
+        }
+        for col, vwidth in sums:
+            outs[f"psum:{col}"] = (
+                jnp.sum(pop(seg[f"v:{col}"] & bitmap[None, :]), axis=1)
+                .astype(jnp.int32)
+            )
+        for col, width, is_max in extremes:
+            outs[f"ext:{'mx' if is_max else 'mn'}:{col}"] = _bsi_extreme(
+                seg[f"p:{col}"], bitmap, width, is_max
+            )
+        return outs
+
+    return single
+
+
+@functools.lru_cache(maxsize=256)
+def make_packed_bitsliced_kernel(spec) -> Callable:
+    """vmapped + jitted + packed-fetch bit-sliced tier kernel — same
+    caching/dispatch idiom as make_packed_table_kernel (the lru_cache
+    is what makes jit's executable cache effective)."""
+    single = make_single_segment_bitsliced_kernel(spec)
+
+    def table_fn(segs: Dict[str, Any], q: Dict[str, Any]) -> Dict[str, Any]:
+        return jax.vmap(single)(segs, q)
+
+    from pinot_tpu.engine.packing import make_packed_kernel
+
+    return make_packed_kernel(jax.jit(table_fn))
+
+
+# ---------------------------------------------------------------------------
 # Device hash join (engine/join.py JoinPlan -> one jitted program)
 # ---------------------------------------------------------------------------
 

@@ -241,6 +241,8 @@ GroupKey = Tuple[str, ...]
 #                      device/host work by construction
 #   segmentsPruned     segments dropped by metadata pruning (pruner.py)
 #   segmentsPostings   segments answered from host postings (invindex)
+#   segmentsBitsliced  segments answered by the bit-sliced bulk-bitwise
+#                      tier (engine/bitsliced.py — popcount-fused aggs)
 #   segmentsZonemap    segments scanned via the zone-map block kernel
 #   segmentsFullScan   segments scanned by the full device kernel
 #   segmentsHost       segments served by the host path (forced,
@@ -270,6 +272,7 @@ COST_KEYS = (
     "broadcastBytes",
     "segmentsPruned",
     "segmentsPostings",
+    "segmentsBitsliced",
     "segmentsZonemap",
     "segmentsFullScan",
     "segmentsHost",

@@ -1,19 +1,30 @@
-"""Filter-tier A/B matrix: selectivity x clustering x path (r4 #3).
+"""Filter-tier A/B matrix: selectivity x clustering x path (r4 #3, r17).
 
-The engine picks among three filter tiers (the reference's
+The engine picks among four filter tiers (the reference's
 Bitmap/Sorted vs Scan operator choice, ``BitmapBasedFilterOperator.java:34``
-vs ``ScanBasedFilterOperator.java:38``):
+vs ``ScanBasedFilterOperator.java:38``, plus the bit-sliced range tier):
 
-  invindex  host CSR postings, O(matches), doc-order independent
-  zonemap   per-64k-block pruning + device block gather (needs
-            clustered values)
-  fullscan  the device scan kernel, O(n)
+  invindex   host CSR postings, O(matches), doc-order independent
+  zonemap    per-64k-block pruning + device block gather (needs
+             clustered values)
+  bitsliced  packed bit-plane bitwise pass, O(bit-width) planes with
+             popcount-fused aggregates (engine/bitsliced.py, r17)
+  fullscan   the device scan kernel, O(n)
 
 This tool measures broker-path p50 for each (selectivity, clustering,
-path) cell so the crossovers in the path-choice logic are set from
-data, and reports per-cell winners.  Selectivity is swept with date
-windows on the CLUSTERED l_shipdate column and value sets on the
-SHUFFLED high-cardinality l_extendedprice column.
+path) cell so the crossovers in the path-choice logic
+(engine/tiercost.py) are set from data, and reports per-cell winners.
+Selectivity is swept with date windows on the CLUSTERED l_shipdate
+column and value sets + mid-selectivity ranges on the SHUFFLED
+high-cardinality l_extendedprice column (the wide-range cells are the
+bit-sliced tier's home turf: too many matches for postings, no
+clustering for the zone map, and fused aggregates spare the scan).
+
+The output document is a perf_gate kind (``metric:
+"filtermatrix_<platform>"``): ``tier_wins`` counts cells won per tier
+and ``bitsliced_midsel_wins`` counts shuffled mid-selectivity range
+cells the bit-sliced tier wins — the committed capture is
+FILTER_MATRIX_CPU_r17.json.
 
 Usage:
   python -m pinot_tpu.tools.filter_matrix                  # bench shape
@@ -28,10 +39,12 @@ import time
 from typing import Dict, List
 
 
-PATHS = {  # label -> (PINOT_TPU_INVINDEX, PINOT_TPU_ZONEMAP)
-    "invindex": ("1", "0"),
-    "zonemap": ("0", "1"),
-    "fullscan": ("0", "0"),
+# label -> (PINOT_TPU_INVINDEX, PINOT_TPU_ZONEMAP, PINOT_TPU_BITSLICED)
+PATHS = {
+    "invindex": ("1", "0", "0"),
+    "zonemap": ("0", "1", "0"),
+    "bitsliced": ("0", "0", "force"),
+    "fullscan": ("0", "0", "0"),
 }
 
 
@@ -82,6 +95,22 @@ def _price_points(segments) -> List[tuple]:
             k / card,
         )
 
+    def mid_range(frac: float, label: str):
+        # dictionary is sorted; an index window of `frac` of the
+        # cardinality approximates `frac` row selectivity on the
+        # uniformly-drawn price column — the wide-range cells no
+        # postings list or zone map helps with (r17)
+        k = max(1, int(card * frac))
+        mid = card // 2
+        lo = d.get(max(mid - k // 2, 0))
+        hi = d.get(min(mid + k // 2, card - 1))
+        return (
+            label,
+            f"SELECT sum(l_quantity), count(*) FROM lineitem "
+            f"WHERE l_extendedprice BETWEEN {lo!r} AND {hi!r}",
+            frac,
+        )
+
     return [
         (
             "eq_1val",
@@ -91,6 +120,8 @@ def _price_points(segments) -> List[tuple]:
         ),
         in_list(8, "in_8vals"),
         in_list(16, "in_16vals"),
+        mid_range(0.10, "range_10pct"),
+        mid_range(0.40, "range_40pct"),
     ]
 
 
@@ -106,12 +137,18 @@ def run_matrix(segments, reps: int) -> dict:
         resp = broker.handle_pql(pql)
         assert not resp.exceptions, resp.exceptions
         last["entries"] = resp.num_entries_scanned_in_filter
+        last["cost"] = resp.cost or {}
 
     runner = QueryRunner(run)
     cases = [("clustered", c) for c in _shipdate_windows(segments)] + [
         ("shuffled", c) for c in _price_points(segments)
     ]
-    flags = ("PINOT_TPU_INVINDEX", "PINOT_TPU_ZONEMAP", "PINOT_TPU_INDEX_MAX_MATCHES")
+    flags = (
+        "PINOT_TPU_INVINDEX",
+        "PINOT_TPU_ZONEMAP",
+        "PINOT_TPU_BITSLICED",
+        "PINOT_TPU_INDEX_MAX_MATCHES",
+    )
     saved = {k: os.environ.get(k) for k in flags}
     cells: List[dict] = []
     try:
@@ -121,9 +158,10 @@ def run_matrix(segments, reps: int) -> dict:
                 "case": label,
                 "selectivity": round(sel, 5),
             }
-            for path, (inv, zm) in PATHS.items():
+            for path, (inv, zm, bsi) in PATHS.items():
                 os.environ["PINOT_TPU_INVINDEX"] = inv
                 os.environ["PINOT_TPU_ZONEMAP"] = zm
+                os.environ["PINOT_TPU_BITSLICED"] = bsi
                 # invindex cells FORCE the postings path past its
                 # selectivity bail so every cell measures its own path
                 # (the crossover is what the matrix exists to find)
@@ -137,6 +175,13 @@ def run_matrix(segments, reps: int) -> dict:
                 row[f"{path}_p50_ms"] = rj["p50Ms"]
                 row[f"{path}_p90_ms"] = rj["p90Ms"]
                 row[f"{path}_entries_scanned"] = last.get("entries")
+                if path == "bitsliced":
+                    # "force" only skips the cost model — structurally
+                    # ineligible cells (non-fusable aggs, REGEX...) fall
+                    # through to the scan; the cost vector says which
+                    row["bitsliced_engaged"] = bool(
+                        last.get("cost", {}).get("segmentsBitsliced")
+                    )
             # zonemap cannot be forced past its half-table bail: mark
             # cells where it fell through to the scan (identical
             # filter-entry count) so they are not read as zonemap wins
@@ -146,6 +191,8 @@ def run_matrix(segments, reps: int) -> dict:
             row["winner"] = min(PATHS, key=lambda p: row[f"{p}_p50_ms"])
             if row["winner"] == "zonemap" and not row["zonemap_engaged"]:
                 row["winner"] = "fullscan"
+            if row["winner"] == "bitsliced" and not row["bitsliced_engaged"]:
+                row["winner"] = "fullscan"
             cells.append(row)
             print(json.dumps(row), flush=True)
     finally:
@@ -154,9 +201,22 @@ def run_matrix(segments, reps: int) -> dict:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+    tier_wins = {p: 0 for p in PATHS}
+    for row in cells:
+        tier_wins[str(row["winner"])] += 1
+    midsel = [
+        r
+        for r in cells
+        if r["shape"] == "shuffled" and str(r["case"]).startswith("range_")
+    ]
     return {
         "matrix": cells,
+        "tier_wins": tier_wins,
+        "bitsliced_midsel_wins": sum(
+            1 for r in midsel if r["winner"] == "bitsliced"
+        ),
         "total_rows": total_rows,
+        "num_segments": len(segments),
         "reps": reps,
     }
 
@@ -185,6 +245,8 @@ def main() -> None:
     print(json.dumps({"datagen_s": round(time.perf_counter() - t0, 1)}), flush=True)
     doc = run_matrix(segments, args.reps)
     doc["platform"] = jax.devices()[0].platform
+    doc["metric"] = f"filtermatrix_{doc['platform']}"
+    doc["value"] = doc["bitsliced_midsel_wins"]
     text = json.dumps(doc, indent=1)
     print(text)
     if args.out:

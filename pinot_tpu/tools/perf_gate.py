@@ -216,6 +216,30 @@ RESTART_CONFIG_KEYS = ("total_rows", "num_segments", "platform")
 
 RESTART_DEFAULT_BASELINE = "RESTART_r16.json"
 
+# filter-matrix documents (tools/filter_matrix.py, ISSUE 17): the
+# four-tier win map.  These are structural counts, not latencies: each
+# tier must keep winning its region of the (selectivity, clustering)
+# plane.  The 0.5 band on integer win counts means "keep at least half
+# your cells, and never drop to zero when the baseline had any" — a
+# tier's entire region collapsing (the bit-sliced tier silently
+# disengaging, postings losing the needle cells) fails the gate, while
+# a single boundary cell flapping between adjacent tiers does not.
+# ``bitsliced_midsel_wins`` / ``value`` is the r17 acceptance bar: the
+# bit-sliced tier must keep winning a shuffled mid-selectivity range
+# cell (baseline >= 1, so the 0.5 band floors current at >= 1).
+FILTERMATRIX_METRIC_SPECS: Dict[str, Tuple[str, float]] = {
+    "value": ("higher", 0.5),
+    "bitsliced_midsel_wins": ("higher", 0.5),
+    "tier_wins.invindex": ("higher", 0.5),
+    "tier_wins.zonemap": ("higher", 0.5),
+    "tier_wins.bitsliced": ("higher", 0.5),
+    "tier_wins.fullscan": ("higher", 0.5),
+}
+
+FILTERMATRIX_CONFIG_KEYS = ("total_rows", "num_segments", "platform")
+
+FILTERMATRIX_DEFAULT_BASELINE = "FILTER_MATRIX_CPU_r17.json"
+
 
 def _is_serving(doc: Dict[str, Any]) -> bool:
     return str(doc.get("metric", "")).startswith("serving_")
@@ -233,6 +257,8 @@ def _doc_kind(doc: Dict[str, Any]) -> str:
         return "ingest"
     if metric.startswith("restart_"):
         return "restart"
+    if metric.startswith("filtermatrix_"):
+        return "filtermatrix"
     return "default"
 
 
@@ -249,6 +275,8 @@ def _specs_for(doc: Dict[str, Any]):
         return INGEST_METRIC_SPECS, INGEST_CONFIG_KEYS
     if kind == "restart":
         return RESTART_METRIC_SPECS, RESTART_CONFIG_KEYS
+    if kind == "filtermatrix":
+        return FILTERMATRIX_METRIC_SPECS, FILTERMATRIX_CONFIG_KEYS
     return METRIC_SPECS, CONFIG_KEYS
 
 
@@ -401,6 +429,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "join": JOIN_DEFAULT_BASELINE,
                 "ingest": INGEST_DEFAULT_BASELINE,
                 "restart": RESTART_DEFAULT_BASELINE,
+                "filtermatrix": FILTERMATRIX_DEFAULT_BASELINE,
             }.get(_doc_kind(current), "BENCH_r05.json")
         baseline = load_bench(baseline_path)
     except (OSError, ValueError, json.JSONDecodeError) as e:

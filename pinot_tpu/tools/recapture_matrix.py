@@ -1,0 +1,81 @@
+"""One-command filter-matrix re-capture (r17).
+
+Re-measures the four-tier filter matrix at the committed capture's
+workload shape, writes the perf_gate-ready document, and (when a
+committed baseline exists) prints the gate verdict against it — the
+whole re-capture ritual in one invocation:
+
+  JAX_PLATFORMS=cpu python -m pinot_tpu.tools.recapture_matrix
+  python -m pinot_tpu.tools.recapture_matrix --out FILTER_MATRIX_CPU_r17.json
+
+Defaults reproduce the committed CPU capture shape (2 segments x 250k
+rows, 15 reps); pass the knobs through to scale up on a real device.
+The written document is what ``tools/perf_gate.py`` gates CI with
+(kind ``filtermatrix_*`` — tier win counts, not latencies).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="pinot_tpu-recapture-matrix", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("-segments", type=int, default=None)
+    ap.add_argument("-rows-per-segment", type=int, default=None, dest="rps")
+    ap.add_argument("-reps", type=int, default=15)
+    ap.add_argument(
+        "--out",
+        default="FILTER_MATRIX_CPU_r17.json",
+        help="capture path to (over)write",
+    )
+    ap.add_argument(
+        "--no-gate",
+        action="store_true",
+        help="skip the perf_gate comparison against the committed capture",
+    )
+    args = ap.parse_args()
+
+    import jax
+
+    from pinot_tpu.tools.datagen import synthetic_lineitem_segment
+    from pinot_tpu.tools.filter_matrix import run_matrix
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    n_seg = args.segments if args.segments is not None else (16 if on_tpu else 2)
+    rps = args.rps if args.rps is not None else (8_388_608 if on_tpu else 250_000)
+
+    t0 = time.perf_counter()
+    segments = [
+        synthetic_lineitem_segment(rps, seed=11 + i, name=f"li{i}")
+        for i in range(n_seg)
+    ]
+    print(json.dumps({"datagen_s": round(time.perf_counter() - t0, 1)}), flush=True)
+
+    doc = run_matrix(segments, args.reps)
+    doc["platform"] = jax.devices()[0].platform
+    doc["metric"] = f"filtermatrix_{doc['platform']}"
+    doc["value"] = doc["bitsliced_midsel_wins"]
+
+    gate_rc = 0
+    if not args.no_gate and os.path.exists(args.out):
+        # gate the fresh run against the capture we are about to replace
+        from pinot_tpu.tools.perf_gate import compare, load_bench
+
+        verdict = compare(load_bench(args.out), doc)
+        print(json.dumps(verdict, indent=1))
+        gate_rc = 1 if verdict["verdict"] == "fail" else 0
+
+    with open(args.out, "w") as f:
+        f.write(json.dumps(doc, indent=1) + "\n")
+    print(json.dumps({"wrote": args.out, "tier_wins": doc["tier_wins"]}))
+    return gate_rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -517,8 +517,17 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "cost.deviceMs": "per-query device-kernel ms (cost vector)",
     "cost.hostMs": "per-query host-path ms (cost vector)",
     "cost.tier.*": "per-serving-tier segment counts from the cost vector "
-    "(segmentsPruned/Postings/Zonemap/FullScan/Host/StarTree) — the "
-    "series /debug/plans tier mixes reconcile against",
+    "(segmentsPruned/Postings/Bitsliced/Zonemap/FullScan/Host/StarTree) — "
+    "the series /debug/plans tier mixes reconcile against",
+    # bit-sliced bulk-bitwise filter tier (engine/bitsliced.py, r17)
+    "filter.bitsliced.queries": "queries answered by the bit-sliced "
+    "bulk-bitwise tier (O(bit-width) plane passes, no row materialization)",
+    "filter.bitsliced.planes": "packed bit-planes evaluated by bit-sliced "
+    "kernels (filter + fused-aggregate planes)",
+    "filter.bitsliced.fusedAggs": "aggregates answered by popcount-fused "
+    "plane sums inside the bit-sliced kernel (no index materialization)",
+    "filter.bitsliced.bytes": "packed bit-plane bytes streamed by "
+    "bit-sliced kernel launches",
     # workload-introspection plane (utils/planstats.py, /debug/plans)
     "plan.recorded": "instance requests folded into the per-plan-digest "
     "stats registry",

@@ -98,11 +98,15 @@ def _run_concurrently_batched(broker, queries, settle_s: float = 0.8):
 
 
 @pytest.mark.parametrize("shape", BATCH_SHAPES, ids=["agg", "groupby", "distinct", "select"])
-def test_batched_matches_unbatched_payloads(shape):
+def test_batched_matches_unbatched_payloads(shape, monkeypatch):
     """Byte-identity differential: same-plan distinct-literal queries
     forced through one batched launch serve payloads identical to the
     serial (unbatched, no-lane) executor — and batches actually
     formed (the counters prove it, not just absence of errors)."""
+    # the scalar-agg shape would otherwise take the bit-sliced tier and
+    # never queue a scan plan on the lane — this suite exercises the
+    # batch-formation machinery itself
+    monkeypatch.setenv("PINOT_TPU_BITSLICED", "0")
     serial = _build_stack(pipeline=False)
     pipelined = _build_stack(pipeline=True)
     queries = _literal_ladder(shape)
@@ -295,11 +299,13 @@ def test_batched_launch_error_fans_out_to_every_member():
     lane.close()
 
 
-def test_poisoned_batched_plan_host_heals_every_member():
+def test_poisoned_batched_plan_host_heals_every_member(monkeypatch):
     """ISSUE 13 satellite: a plan the injector poisons fails its
     batched launch once, and EVERY member transparently host-heals to
     the payload the serial path serves."""
     from pinot_tpu.common.faults import DeviceFaultInjector
+
+    monkeypatch.setenv("PINOT_TPU_BITSLICED", "0")  # exercise the scan batch tier
 
     inj = DeviceFaultInjector(seed=3)
     serial = _build_stack(pipeline=False)
@@ -447,6 +453,7 @@ def test_explain_reports_batching_decision(monkeypatch):
     batchMax / windowMs / cacheHit), and EXPLAIN ANALYZE annotates the
     actuals off its own execution."""
     monkeypatch.setenv("PINOT_TPU_RESULT_CACHE", "1")
+    monkeypatch.setenv("PINOT_TPU_BITSLICED", "0")  # pin the scan tier so the batching node appears
     broker = _build_stack(pipeline=True)
     q = "SELECT sum(metInt), count(*) FROM testTable WHERE dimInt > 4800"
     plain = broker.handle_pql("EXPLAIN " + q)

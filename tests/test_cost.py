@@ -99,6 +99,7 @@ def _assert_invariant(resp, replies):
         resp.cost.get(k, 0)
         for k in (
             "segmentsPostings",
+            "segmentsBitsliced",
             "segmentsZonemap",
             "segmentsFullScan",
             "segmentsHost",
@@ -257,8 +258,9 @@ def test_cost_invariant_under_hedging(cost_cluster):
         assert not resp.exceptions
         assert resp.num_hedges >= 1
         assert resp.num_docs_scanned == baseline.num_docs_scanned == total
-        for k in ("segmentsPostings", "segmentsZonemap", "segmentsFullScan",
-                  "segmentsHost", "segmentsStarTree", "segmentsPruned"):
+        for k in ("segmentsPostings", "segmentsBitsliced", "segmentsZonemap",
+                  "segmentsFullScan", "segmentsHost", "segmentsStarTree",
+                  "segmentsPruned"):
             assert resp.cost.get(k, 0) == baseline.cost.get(k, 0), k
         assert resp.num_segments_queried == baseline.num_segments_queried
     finally:
@@ -388,7 +390,7 @@ def _independent_staged_bytes(staged) -> int:
         total += int(staged._valid.nbytes)
     for sc in staged.columns.values():
         for attr in ("fwd", "mv", "mv_counts", "dict_vals", "raw", "gfwd",
-                     "hll_bucket", "hll_rho", "mv_raw"):
+                     "hll_bucket", "hll_rho", "mv_raw", "bsi", "bsiv"):
             arr = getattr(sc, attr)
             if arr is not None:
                 total += int(arr.nbytes)

@@ -80,6 +80,10 @@ def test_sharded_matches_single_device(setup, pql):
     b = reduce_to_response(req_b, [QueryExecutor().execute(segments, req_b)])
     aj, bj = a.to_json(), b.to_json()
     aj.pop("cost", None); bj.pop("cost", None)  # timing is path-dependent
+    # filter-work accounting is tier-dependent: the single-device path
+    # may take the bit-sliced tier (counts plane words) while the mesh
+    # path scans rows — results stay exact either way
+    aj.pop("numEntriesScannedInFilter", None); bj.pop("numEntriesScannedInFilter", None)
     assert aj == bj
 
 

@@ -268,18 +268,31 @@ def test_hybrid_quickstart():
 
 
 def test_filter_matrix_smoke():
-    """The selectivity x clustering x path matrix runs all three tiers
-    per cell, forces the postings path, and labels zonemap fallthrough."""
+    """The selectivity x clustering x path matrix runs all four tiers
+    per cell, forces the postings path, and labels zonemap/bitsliced
+    fallthrough so neither tier is credited with a scan's win."""
     from pinot_tpu.tools.datagen import synthetic_lineitem_segment
-    from pinot_tpu.tools.filter_matrix import run_matrix
+    from pinot_tpu.tools.filter_matrix import PATHS, run_matrix
 
     segs = [synthetic_lineitem_segment(30000, seed=7, name="fm0")]
     doc = run_matrix(segs, reps=3)
-    assert len(doc["matrix"]) == 8
+    assert len(doc["matrix"]) == 10
+    tiers = tuple(PATHS)
+    assert tiers == ("invindex", "zonemap", "bitsliced", "fullscan")
     for row in doc["matrix"]:
-        for path in ("invindex", "zonemap", "fullscan"):
+        for path in tiers:
             assert row[f"{path}_p50_ms"] > 0
         assert isinstance(row["zonemap_engaged"], bool)
-        assert row["winner"] in ("invindex", "zonemap", "fullscan")
+        assert isinstance(row["bitsliced_engaged"], bool)
+        assert row["winner"] in tiers
         if row["winner"] == "zonemap":
             assert row["zonemap_engaged"]
+        if row["winner"] == "bitsliced":
+            assert row["bitsliced_engaged"]
+    # the shuffled fusable cells really engage the bit-sliced kernels
+    assert any(
+        r["bitsliced_engaged"] for r in doc["matrix"] if r["shape"] == "shuffled"
+    )
+    assert set(doc["tier_wins"]) == set(tiers)
+    assert sum(doc["tier_wins"].values()) == len(doc["matrix"])
+    assert "bitsliced_midsel_wins" in doc and "num_segments" in doc
