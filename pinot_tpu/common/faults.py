@@ -152,7 +152,9 @@ class LaunchRecord:
     submits)."""
 
     digest: Optional[str]
-    outcome: str  # "ok" | "fail_next" | "error_rate" | "poison" | "stall"
+    # "ok" | "fail_next" | "alloc_fail" | "error_rate" | "alloc_rate"
+    # | "poison" | "stall"
+    outcome: str
 
 
 class DeviceFaultInjector:
@@ -162,6 +164,12 @@ class DeviceFaultInjector:
 
     - ``fail_next(n, retryable=True)`` — the next ``n`` launches raise a
       typed ``DeviceExecutionError`` (transient blip or hard fault).
+    - ``alloc_fail_next(n)``          — the next ``n`` launches raise a
+      RAW RuntimeError with PJRT's RESOURCE_EXHAUSTED wording, so the
+      executor's real ``classify_device_error`` path produces the
+      ``resource_exhausted`` heal class (demote-then-retry, never
+      poison) exactly as a full HBM would — deterministically testable
+      without a real device.
     - ``stall_next(n, stall_s)``      — the next ``n`` launches sleep
       ``stall_s`` inside the lane thread before proceeding (the
       watchdog-restart trigger when ``stall_s`` exceeds the lane's
@@ -172,6 +180,9 @@ class DeviceFaultInjector:
       the plan to the device at all.
     - ``error_rate``                  — each launch fails (retryable)
       with probability p from a seeded RNG.
+    - ``alloc_error_rate``            — each launch raises the raw
+      RESOURCE_EXHAUSTED error with probability p from the same seeded
+      RNG (sustained memory pressure, not a one-shot).
 
     Every launch decision is recorded in ``launches`` so tests can
     assert which plans were poisoned/stalled and read back digests.
@@ -183,16 +194,22 @@ class DeviceFaultInjector:
         self.launches: List[LaunchRecord] = []
         self._fail_next = 0
         self._fail_retryable = True
+        self._alloc_fail_next = 0
         self._stall_next = 0
         self._stall_s = 0.0
         self._poisoned: set = set()
         self.error_rate = 0.0
+        self.alloc_error_rate = 0.0
 
     # -- fault programming --------------------------------------------
     def fail_next(self, n: int, retryable: bool = True) -> None:
         with self._lock:
             self._fail_next = n
             self._fail_retryable = retryable
+
+    def alloc_fail_next(self, n: int) -> None:
+        with self._lock:
+            self._alloc_fail_next = n
 
     def stall_next(self, n: int, stall_s: float) -> None:
         with self._lock:
@@ -206,10 +223,12 @@ class DeviceFaultInjector:
     def heal(self) -> None:
         with self._lock:
             self._fail_next = 0
+            self._alloc_fail_next = 0
             self._stall_next = 0
             self._stall_s = 0.0
             self._poisoned.clear()
             self.error_rate = 0.0
+            self.alloc_error_rate = 0.0
 
     def records_for(self, outcome: str) -> List[LaunchRecord]:
         with self._lock:
@@ -227,12 +246,31 @@ class DeviceFaultInjector:
                 raise DeviceExecutionError(
                     f"injected: poisoned plan {digest}", retryable=False
                 )
+            if self._alloc_fail_next > 0:
+                self._alloc_fail_next -= 1
+                self.launches.append(LaunchRecord(digest, "alloc_fail"))
+                # a RAW error, not a pre-typed DeviceExecutionError: the
+                # executor must exercise its real classification path
+                # (dispatch.classify_device_error -> resource_exhausted)
+                raise RuntimeError(
+                    "injected: RESOURCE_EXHAUSTED: out of memory while "
+                    "allocating device buffer"
+                )
             if self._fail_next > 0:
                 self._fail_next -= 1
                 retryable = self._fail_retryable
                 self.launches.append(LaunchRecord(digest, "fail_next"))
                 raise DeviceExecutionError(
                     "injected: device launch failure", retryable=retryable
+                )
+            if (
+                self.alloc_error_rate > 0.0
+                and self._rng.random() < self.alloc_error_rate
+            ):
+                self.launches.append(LaunchRecord(digest, "alloc_rate"))
+                raise RuntimeError(
+                    "injected: RESOURCE_EXHAUSTED: out of memory while "
+                    "allocating device buffer"
                 )
             if self.error_rate > 0.0 and self._rng.random() < self.error_rate:
                 self.launches.append(LaunchRecord(digest, "error_rate"))

@@ -92,6 +92,10 @@ class Controller:
         probe = _SkewProbe(self)
         self.stabilizer.cost_rate_fn = probe.cost_rates
         self.stabilizer.busy_fn = probe.busy
+        # r18: tiered-residency pressure (hot bytes / HBM cap per
+        # server) inflates a squeezed server's placement load so the
+        # planner drains it before allocation failures start healing
+        self.stabilizer.pressure_fn = probe.pressure
         # readiness gate for movement: a rebalance destination that is
         # still prewarming its compile working set (heartbeat-reported
         # warming flag) defers the old replica's trim until it is ready
@@ -459,6 +463,23 @@ def cost_rates_from_capacity(capacity: Dict[str, Any]) -> Dict[str, float]:
     return out
 
 
+def tier_pressure_from_capacity(capacity: Dict[str, Any]) -> Dict[str, float]:
+    """Per-server residency pressure (hot-tier bytes as a fraction of
+    the configured HBM cap, 0..1) out of a ``/debug/capacity`` rollup —
+    the rebalance planner's memory axis.  Servers without a residency
+    section (no cap configured, or pre-r18) simply don't appear."""
+    out: Dict[str, float] = {}
+    for name, entry in (capacity.get("servers") or {}).items():
+        res = entry.get("residency") or {}
+        try:
+            p = float(res.get("pressure") or 0.0)
+        except (TypeError, ValueError):
+            continue
+        if p > 0:
+            out[name] = p
+    return out
+
+
 def busy_from_utilization(util: Dict[str, Any]) -> Dict[str, float]:
     """Per-server device busy fractions out of a ``/debug/utilization``
     rollup — the rebalance planner's destination tiebreak (prefer the
@@ -490,6 +511,7 @@ class _SkewProbe:
         self._at = 0.0
         self._rates: Dict[str, float] = {}
         self._busy: Dict[str, float] = {}
+        self._pressure: Dict[str, float] = {}
 
     def _refresh(self) -> None:
         import time as _time
@@ -500,9 +522,9 @@ class _SkewProbe:
                 return
             self._at = now
         try:
-            self._rates = cost_rates_from_capacity(
-                collect_capacity(self.ctrl, timeout_s=1.5)
-            )
+            capacity = collect_capacity(self.ctrl, timeout_s=1.5)
+            self._rates = cost_rates_from_capacity(capacity)
+            self._pressure = tier_pressure_from_capacity(capacity)
             self._busy = busy_from_utilization(
                 collect_utilization(self.ctrl, timeout_s=1.5)
             )
@@ -516,6 +538,10 @@ class _SkewProbe:
     def busy(self) -> Dict[str, float]:
         self._refresh()
         return self._busy
+
+    def pressure(self) -> Dict[str, float]:
+        self._refresh()
+        return self._pressure
 
 
 def collect_cluster_metrics(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, Any]:
@@ -616,6 +642,24 @@ def collect_capacity(ctrl: "Controller", timeout_s: float = 3.0) -> Dict[str, An
                 "costDocsScanned": cost_rows,
                 "costBytesScanned": cost_bytes,
             }
+            res = payload.get("residency") or {}
+            if res:
+                # tiered-residency view (r18): how hard this server's
+                # hot tier presses against its HBM cap, and how much of
+                # its working set has been pushed down-tier
+                servers[name]["residency"] = {
+                    k: res.get(k)
+                    for k in (
+                        "pressure",
+                        "hbmCapBytes",
+                        "hotBytes",
+                        "warmBytes",
+                        "coldBytes",
+                        "hotTables",
+                        "warmTables",
+                        "coldTables",
+                    )
+                }
             total_staged += int(hbm.get("stagedBytes") or 0)
             total_lag += int(sum(lag.values()))
         elif role == "broker":

@@ -137,6 +137,13 @@ class SelfStabilizer(_PeriodicManager):
         #   busy_fn()      -> {server name: busyFraction in [0, 1]}
         self.cost_rate_fn = None
         self.busy_fn = None
+        # pluggable tier pressure (r18, wired by the Controller to the
+        # /debug/capacity residency section; None = no memory-pressure
+        # weighting):  pressure_fn() -> {server name: hot/cap in [0, 1]}
+        # — a server whose hot tier is pinned against its HBM cap has
+        # its placement load inflated up to 2x, so the planner moves
+        # segments OFF it before allocation failures start healing
+        self.pressure_fn = None
         # pluggable warm-start readiness (wired by the Controller to the
         # heartbeat-reported warming flags; None = everyone ready, the
         # pre-r16 behavior):  readiness_fn(server name) -> bool
@@ -642,11 +649,13 @@ class SelfStabilizer(_PeriodicManager):
             self._pending_moves.pop((table, seg), None)
 
     def _skew_inputs(self):
-        """(cost rates by raw table, busy fraction by server) from the
-        pluggable providers; failures degrade to docs-only weighting —
-        a dead rollup must never stall the convergence loop."""
+        """(cost rates by raw table, busy fraction by server, tier
+        pressure by server) from the pluggable providers; failures
+        degrade to docs-only weighting — a dead rollup must never stall
+        the convergence loop."""
         rates: Dict[str, float] = {}
         busy: Dict[str, float] = {}
+        pressure: Dict[str, float] = {}
         if self.cost_rate_fn is not None:
             try:
                 rates = dict(self.cost_rate_fn() or {})
@@ -657,7 +666,12 @@ class SelfStabilizer(_PeriodicManager):
                 busy = dict(self.busy_fn() or {})
             except Exception:
                 logger.warning("busy-fraction provider failed", exc_info=True)
-        return rates, busy
+        if self.pressure_fn is not None:
+            try:
+                pressure = dict(self.pressure_fn() or {})
+            except Exception:
+                logger.warning("tier-pressure provider failed", exc_info=True)
+        return rates, busy, pressure
 
     def _rebalance_tick(self, healthy, server_state) -> None:
         """One skew evaluation (+ possibly phase-1 move starts).  Load
@@ -671,7 +685,7 @@ class SelfStabilizer(_PeriodicManager):
         for table, seg in list(self._pending_moves):
             if res.get_ideal_state(table).get(seg) is None:
                 self._pending_moves.pop((table, seg), None)
-        rates, busy = self._skew_inputs()
+        rates, busy, pressure = self._skew_inputs()
         with res._lock:
             configs = dict(res.table_configs)
         max_rate = max(rates.values()) if rates else 0.0
@@ -718,6 +732,14 @@ class SelfStabilizer(_PeriodicManager):
                         and (table, seg) not in self._pending_moves
                     ):
                         movable.append((w, table, seg, set(replicas)))
+            # tier pressure (r18): a server running hot against its HBM
+            # cap reads as up to 2x its doc-x-cost load, so the planner
+            # drains it preemptively — rebalance is the slow, permanent
+            # answer to the pressure that demotion absorbs in the moment
+            for s in load:
+                p = pressure.get(s, 0.0)
+                if p > 0:
+                    load[s] *= 1.0 + min(1.0, max(0.0, float(p)))
             mean = sum(load.values()) / len(load)
             if mean <= 0:
                 self._skew_rounds.pop(tenant, None)

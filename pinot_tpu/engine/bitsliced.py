@@ -375,7 +375,40 @@ def try_bitsliced_path(
         skip_base_columns=all_cols,
         bsi_columns=bsi_cols,
         bsiv_columns=bsiv_cols,
+        pin=True,  # tier demotion must not race this launch
     )
+    from pinot_tpu.engine.residency import RESIDENCY
+
+    try:
+        return _dispatch_bitsliced(
+            executor, request, live, total_docs, deadline, lane,
+            lane_index, staged, spec, leaves, agg_descs, planes_total,
+            filter_planes, bsi_cols, bsiv_cols,
+        )
+    finally:
+        RESIDENCY.unpin(staged.token)
+
+
+def _dispatch_bitsliced(
+    executor,
+    request: BrokerRequest,
+    live: List[ImmutableSegment],
+    total_docs: int,
+    deadline: Optional[float],
+    lane,
+    lane_index: int,
+    staged,
+    spec,
+    leaves,
+    agg_descs,
+    planes_total: int,
+    filter_planes: int,
+    bsi_cols,
+    bsiv_cols,
+) -> Optional[IntermediateResult]:
+    from pinot_tpu.engine.dispatch import plan_digest
+    from pinot_tpu.engine.kernel import make_packed_bitsliced_kernel
+
     for col in bsi_cols:
         if staged.columns[col].bsi is None:
             return None  # staging declined (shape changed underneath)
@@ -397,13 +430,37 @@ def try_bitsliced_path(
     pdigest = plan_digest(("bsi", spec))
     cost: Dict[str, float] = {}
     kernel = make_packed_bitsliced_kernel(spec)
-    args = (
-        segs,
-        executor._to_device_inputs(q_np, plan=spec, digest=digest, cost=cost),
-    )
+    # lane micro-batching (PR 13 tier): the per-leaf bounds/points
+    # arrays are plain stackable int32s, so same-spec BSI queries with
+    # different literals ride ONE vmapped launch reading the resident
+    # planes once — the same amortization the scan kernels get
+    batch_spec = None
+    exec_info: Dict[str, Any] = {}
+    analysis_args = None
+    if lane is not None:
+        batch_spec = _bsi_batch_spec(executor, spec, staged, q_np, segs)
+    if batch_spec is not None:
+        # defer the solo upload into the launch closure (executor
+        # _device_section idiom): a member that rides a batched launch
+        # never uses its own device copy
+        args = lambda: (
+            segs,
+            executor._to_device_inputs(
+                q_np, plan=spec, digest=digest, cost=cost
+            ),
+        )
+        analysis_args = (segs, q_np)
+    else:
+        args = (
+            segs,
+            executor._to_device_inputs(
+                q_np, plan=spec, digest=digest, cost=cost
+            ),
+        )
     outs = executor._run_kernel(
         kernel, args, spec, staged, digest, None, deadline, pdigest,
-        cost=cost, lane=lane,
+        cost=cost, lane=lane, batch_spec=batch_spec, exec_info=exec_info,
+        analysis_args=analysis_args,
     )
 
     partials, matched = _finalize(request, agg_descs, staged, live, outs)
@@ -425,9 +482,56 @@ def try_bitsliced_path(
     )
     res._device_digest = pdigest
     res._lane_index = lane_index
+    res._batch_size = int(exec_info.get("batchSize", 1) or 1)
     m = executor.metrics
     m.meter("filter.bitsliced.queries").mark()
     m.meter("filter.bitsliced.planes").mark(planes_total)
     m.meter("filter.bitsliced.fusedAggs").mark(len(agg_descs))
     m.meter("filter.bitsliced.bytes").mark(dev_bytes)
     return res
+
+
+def _bsi_batch_spec(executor, spec, staged, q_np, segs):
+    """BatchSpec for same-spec bit-sliced dispatches (the BSI analog of
+    executor._batch_spec): key is (("bsi", spec), staging token, input
+    signature) — literal-bucketed spec identity x resident-plane
+    identity x structural input identity.  The row budget counts padded
+    docs, matching the scan tier's cap, so a batched plane launch can
+    never blow the compile-time working set."""
+    from pinot_tpu.engine.dispatch import BatchSpec
+    from pinot_tpu.engine.kernel import chunk_rows_limit
+    from pinot_tpu.engine.packing import batch_input_signature
+
+    limit = chunk_rows_limit()
+    rows = max(1, staged.num_segments * staged.n_pad)
+    if limit:
+        cap = limit // rows
+        max_members = 1
+        while max_members * 2 <= cap:
+            max_members *= 2
+    else:
+        max_members = 0
+    if max_members == 1:
+        return None  # one member already fills the budget
+    key = (("bsi", spec), staged.token, batch_input_signature(q_np))
+
+    def launch_batched(inputs_list):
+        from pinot_tpu.engine.device import to_device_inputs
+        from pinot_tpu.engine.kernel import make_packed_batched_bitsliced_kernel
+        from pinot_tpu.engine.packing import stack_query_inputs
+
+        bkernel = make_packed_batched_bitsliced_kernel(spec)
+        # pad member count to a power of two (repeat member 0, whose
+        # extra outputs are never sliced) — compile count stays bounded
+        # at log2 distinct batch shapes per spec
+        b = len(inputs_list)
+        b_pad = 1
+        while b_pad < b:
+            b_pad *= 2
+        if b_pad > b:
+            inputs_list = list(inputs_list) + [inputs_list[0]] * (b_pad - b)
+        stacked = stack_query_inputs(inputs_list)
+        qb = to_device_inputs(stacked)
+        return bkernel.fetch, bkernel.dispatch(segs, qb)
+
+    return BatchSpec(key, q_np, launch_batched, max_members=max_members)

@@ -655,6 +655,7 @@ def get_staged(
     sharding=None,
     bsi_columns: Sequence[str] = (),
     bsiv_columns: Sequence[str] = (),
+    pin: bool = False,
 ) -> StagedTable:
     """Cached staging. The cache key covers only the base arrays; role
     arrays (raw/gfwd/hll streams) are attached to the cached
@@ -663,7 +664,17 @@ def get_staged(
     (skip_base_columns) gets its base arrays backfilled if a later
     query needs them (e.g. a filter arrives on a former agg-only
     column).  ``sharding`` places the segment axis across a chip group
-    (mesh execution) and is part of the cache identity."""
+    (mesh execution) and is part of the cache identity.
+
+    Residency (engine/residency.py): a miss first checks the warm/cold
+    tiers — a demoted table promotes back via pure device_put of its
+    packed snapshot, zero re-encode — and every insert is registered
+    with the residency manager, which enforces the HBM byte/entry caps
+    by demoting the coldest unpinned tables instead of the old
+    clear-everything size cap.  ``pin=True`` refcounts the staged
+    table's token so tier demotion can never race this query's launch;
+    the caller MUST ``RESIDENCY.unpin(st.token)`` when done."""
+    from pinot_tpu.engine.residency import RESIDENCY
     # identity component: (name, claimed crc, instance token).  The
     # token (segment/immutable.py) is what makes a re-loaded copy of the
     # same segment a guaranteed MISS — name+crc alone would alias a
@@ -681,31 +692,71 @@ def get_staged(
     with _lock_for(key):
         st = _stage_cache.get(key)
         if st is None:
-            st = stage_segments(
-                segments,
-                sorted(column_names),
-                pad_segments_to=pad_segments_to,
-                raw_columns=raw_columns,
-                gfwd_columns=gfwd_columns,
-                hll_columns=hll_columns,
-                ctx=ctx,
-                skip_base_columns=skip_base_columns,
-                sharding=sharding,
-                bsi_columns=bsi_columns,
-                bsiv_columns=bsiv_columns,
-            )
+            # warm/cold promotion first: a demoted table's packed
+            # snapshot restores with pure device_puts — one read, zero
+            # re-encode (sharded placements are drop-only, never
+            # snapshotted, so they always re-stage from source)
+            snap = RESIDENCY.take_resident(key) if sharding is None else None
+            promoted = snap is not None
+            if promoted:
+                from pinot_tpu.engine.residency import restore_staged
+
+                st = restore_staged(snap)
+                # backfill any role/base arrays this query needs that
+                # the resident copy was demoted without
+                _augment_staged(
+                    st,
+                    segments,
+                    raw_columns,
+                    gfwd_columns,
+                    hll_columns,
+                    ctx,
+                    base_columns=[
+                        c
+                        for c in column_names
+                        if c not in set(skip_base_columns)
+                    ],
+                    bsi_columns=bsi_columns,
+                    bsiv_columns=bsiv_columns,
+                )
+            else:
+                st = stage_segments(
+                    segments,
+                    sorted(column_names),
+                    pad_segments_to=pad_segments_to,
+                    raw_columns=raw_columns,
+                    gfwd_columns=gfwd_columns,
+                    hll_columns=hll_columns,
+                    ctx=ctx,
+                    skip_base_columns=skip_base_columns,
+                    sharding=sharding,
+                    bsi_columns=bsi_columns,
+                    bsiv_columns=bsiv_columns,
+                )
+            table = _table_of(segments)
             with _cache_guard:
-                if len(_stage_cache) > 32:
-                    # size-cap clear: count every victim into the ledger
-                    # so the eviction is visible, not a silent byte drop
-                    for old in list(_stage_cache.values()):
-                        LEDGER.drop(old)
-                    _stage_cache.clear()
                 _stage_cache[key] = st
-                staged_bytes = LEDGER.update(st, _table_of(segments))
+                staged_bytes = LEDGER.update(st, table)
+                RESIDENCY.note_hot(
+                    key,
+                    st,
+                    table,
+                    staged_bytes,
+                    demotable=sharding is None,
+                    promoted=promoted,
+                )
             # a cold stage IS one H2D transfer burst of the measured
-            # array bytes (the utilization plane's upload accounting)
+            # array bytes (the utilization plane's upload accounting);
+            # a promotion's device_puts are the same physical transfer
             TRANSFERS.record_h2d(staged_bytes)
+            if promoted:
+                # async promotion ahead of dispatch: lift the table's
+                # remaining cold entries to warm in the background
+                RESIDENCY.prefetch_siblings(key, table)
+            # cap enforcement AFTER insert (outside _cache_guard): the
+            # coldest unpinned residents demote to warm/cold instead of
+            # the old clear-everything size cap
+            RESIDENCY.enforce(exclude_tokens=(st.token,))
         else:
             attached = _augment_staged(
                 st,
@@ -720,19 +771,26 @@ def get_staged(
                 bsi_columns=bsi_columns,
                 bsiv_columns=bsiv_columns,
             )
+            RESIDENCY.touch(key)
             if attached:
                 # re-measure (augmentation attached arrays) ONLY while
-                # still cache-resident: a concurrent size-cap clear
-                # already counted this table out, and updating after
-                # that would strand a ledger entry nothing will ever
-                # drop.  A plain hit (attached == 0 — the overwhelmingly
-                # common case) walks no arrays at all on this path.
+                # still cache-resident: a concurrent demotion already
+                # counted this table out, and updating after that would
+                # strand a ledger entry nothing will ever drop.  A
+                # plain hit (attached == 0 — the overwhelmingly common
+                # case) walks no arrays at all on this path.
                 with _cache_guard:
                     if _stage_cache.get(key) is st:
-                        LEDGER.update(st, _table_of(segments))
+                        nb = LEDGER.update(st, _table_of(segments))
+                        RESIDENCY.set_bytes(key, nb)
                 # augmentation's newly-attached role arrays ARE the H2D
                 # delta (zero on a plain cache hit — no phantom transfers)
                 TRANSFERS.record_h2d(attached)
+        if pin:
+            # refcount BEFORE releasing the key lock: demotion checks
+            # pins under the manager lock, and an unpinned window here
+            # could demote the table between staging and launch
+            RESIDENCY.pin(st.token)
     return st
 
 
@@ -874,10 +932,16 @@ def _hll_streams(cols, S: int, n_pad: int):
 
 
 def clear_staging_cache() -> None:
+    """Drop all staged tables AND their residency entries (every tier):
+    callers clear to force genuine re-staging — a retained warm copy
+    would silently turn the next stage into a promotion."""
+    from pinot_tpu.engine.residency import RESIDENCY
+
     with _cache_guard:
         for st in list(_stage_cache.values()):
             LEDGER.drop(st)
         _stage_cache.clear()
+    RESIDENCY.reset()
 
 
 def evict_staged_segment(segment_name: str) -> int:
@@ -887,6 +951,8 @@ def evict_staged_segment(segment_name: str) -> int:
     segment misses the cache); eviction just releases the quarantined
     copy's device arrays instead of waiting for the size-cap clear.
     Returns the number of cache entries dropped."""
+    from pinot_tpu.engine.residency import RESIDENCY
+
     with _cache_guard:
         victims = []
         for key in list(_stage_cache):
@@ -896,7 +962,12 @@ def evict_staged_segment(segment_name: str) -> int:
             st = _stage_cache.pop(key, None)
             if st is not None:
                 LEDGER.drop(st)
-        return len(victims)
+    # residency hygiene runs on the SAME contract: the quarantined
+    # copy's warm/cold snapshots must not survive either (a re-loaded
+    # segment mints new tokens, so they could never be promoted — but
+    # they would pin host RAM/disk for nothing)
+    RESIDENCY.drop_segment(segment_name)
+    return len(victims)
 
 
 def to_device_inputs(tree, sharding=None):

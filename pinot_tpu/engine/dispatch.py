@@ -156,7 +156,12 @@ class DeviceExecutionError(RuntimeError):
     type error, compile failure, injected poison), so the executor
     quarantines the plan and serves via the host path.  ``stalled``
     marks watchdog-detected wedges (never device-retried: the retry
-    would wedge the fresh lane thread for another full timeout)."""
+    would wedge the fresh lane thread for another full timeout).
+    ``resource_exhausted`` marks device allocation failures — a
+    DISTINCT heal class (engine/residency.py): retrying into the same
+    full HBM would fail identically, so the executor demotes the
+    coldest residents first, and never poisons the plan (the plan is
+    healthy; the device was just full)."""
 
     def __init__(
         self,
@@ -164,11 +169,13 @@ class DeviceExecutionError(RuntimeError):
         retryable: bool,
         cause: Optional[BaseException] = None,
         stalled: bool = False,
+        resource_exhausted: bool = False,
     ) -> None:
         super().__init__(message)
         self.retryable = retryable
         self.cause = cause
         self.stalled = stalled
+        self.resource_exhausted = resource_exhausted
 
 
 # substrings that mark a launch failure as transient: PJRT/XLA status
@@ -188,6 +195,16 @@ _RETRYABLE_MARKERS = (
     "tunnel",
 )
 
+# substrings marking the failure as ALLOCATION pressure (PJRT's
+# RESOURCE_EXHAUSTED status and XLA's allocator wording): retryable,
+# but only after the residency manager has made room — see
+# DeviceExecutionError.resource_exhausted above.
+_OOM_MARKERS = (
+    "resource_exhausted",
+    "out of memory",
+    "out-of-memory",
+)
+
 
 def classify_device_error(exc: BaseException) -> DeviceExecutionError:
     """Wrap a raw launch exception in the typed error (idempotent)."""
@@ -195,8 +212,11 @@ def classify_device_error(exc: BaseException) -> DeviceExecutionError:
         return exc
     text = f"{type(exc).__name__}: {exc}"
     low = text.lower()
-    retryable = any(marker in low for marker in _RETRYABLE_MARKERS)
-    return DeviceExecutionError(text, retryable=retryable, cause=exc)
+    oom = any(marker in low for marker in _OOM_MARKERS)
+    retryable = oom or any(marker in low for marker in _RETRYABLE_MARKERS)
+    return DeviceExecutionError(
+        text, retryable=retryable, cause=exc, resource_exhausted=oom
+    )
 
 
 def plan_digest(plan: Any) -> str:

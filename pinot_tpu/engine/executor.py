@@ -220,7 +220,17 @@ class QueryExecutor:
         self._poison_ttl_s = float(_os.environ.get("PINOT_TPU_POISON_TTL_S", "300"))
 
     # -- self-healing bookkeeping --------------------------------------
-    _HEAL_COUNTERS = ("deviceFailures", "deviceRetries", "hostFailovers", "poisonSkips")
+    _HEAL_COUNTERS = (
+        "deviceFailures",
+        "deviceRetries",
+        "hostFailovers",
+        "poisonSkips",
+        # allocation-failure heals: RESOURCE_EXHAUSTED launches that
+        # recovered by demoting the coldest residents and retrying
+        # (engine/residency.py) — never poisoned, host only as last
+        # resort
+        "resourceExhausted",
+    )
 
     def _heal_mark(self, name: str, **tags) -> None:
         self.metrics.meter(f"heal.{name}").mark()
@@ -465,11 +475,29 @@ class QueryExecutor:
 
         poison_ref: Dict[str, Any] = {}  # device section records the key
         last: Optional[DeviceExecutionError] = None
-        for attempt in (0, 1):
+        # attempt budget: one plain device retry for transients (PR 3),
+        # plus one extra round reserved for RESOURCE_EXHAUSTED — an OOM
+        # retried into the same full HBM would fail identically, so
+        # each OOM round first demotes the coldest unpinned residents
+        # (engine/residency.py) to make room.  Host failover stays the
+        # LAST resort.
+        for attempt in (0, 1, 2):
             if attempt:
                 if last is None or not last.retryable:
                     break  # poison/stall: deterministic, a device retry
                     # would fail (or wedge the fresh lane) identically
+                if getattr(last, "resource_exhausted", False):
+                    from pinot_tpu.engine.residency import RESIDENCY
+
+                    exclude = tuple(
+                        t for t in (poison_ref.get("token"),) if t is not None
+                    )
+                    freed = RESIDENCY.demote_for_pressure(
+                        exclude_tokens=exclude
+                    )
+                    self._heal_mark("resourceExhausted", freedBytes=freed)
+                elif attempt > 1:
+                    break  # plain transients get exactly ONE device retry
                 self._heal_mark("deviceRetries")
             try:
                 return self._device_section(
@@ -494,7 +522,12 @@ class QueryExecutor:
         # waiters each land here and each finalize from the host.
         from pinot_tpu.engine.host_fallback import execute_host
 
-        if poison_ref.get("key") is not None:
+        if poison_ref.get("key") is not None and not getattr(
+            last, "resource_exhausted", False
+        ):
+            # OOM never poisons: the plan is healthy, the device was
+            # full — quarantining it would strand a good plan on the
+            # slow host path after pressure subsides
             self._poison(poison_ref["key"], str(last))
         self._heal_mark("hostFailovers", reason=str(last)[:200])
         t0 = time.perf_counter()
@@ -525,6 +558,9 @@ class QueryExecutor:
         skip_base = self._skip_base_columns(
             request, live, raw_cols, gfwd_cols, hll_cols
         )
+        # pin=True: the staged table's token is refcounted for this
+        # query's whole device section, so tier demotion under memory
+        # pressure (engine/residency.py) can never race the launch
         staged = get_staged(
             live,
             sorted(needed),
@@ -535,7 +571,39 @@ class QueryExecutor:
             ctx=ctx,
             skip_base_columns=skip_base,
             sharding=sharding,
+            pin=True,
         )
+        # the OOM heal's demotion pass must not evict the very table
+        # this query is about to retry against
+        poison_ref["token"] = staged.token
+        from pinot_tpu.engine.residency import RESIDENCY
+
+        try:
+            return self._device_section_staged(
+                live, request, deadline, ctx, needed, sel_columns,
+                total_docs, t0, poison_ref, sel, mesh, lane, sharding,
+                staged,
+            )
+        finally:
+            RESIDENCY.unpin(staged.token)
+
+    def _device_section_staged(
+        self,
+        live: List[ImmutableSegment],
+        request: BrokerRequest,
+        deadline: Optional[float],
+        ctx: TableContext,
+        needed: set,
+        sel_columns: Optional[List[str]],
+        total_docs: int,
+        t0: float,
+        poison_ref: Dict[str, Any],
+        sel,
+        mesh,
+        lane,
+        sharding,
+        staged,
+    ) -> IntermediateResult:
         t0 = self._phase("staging", t0)
         scratch: Dict[Any, Any] = {}  # plan->inputs table cache (regex)
         plan = build_static_plan(request, ctx, staged, scratch=scratch)

@@ -179,10 +179,20 @@ def _staged_snapshot(table: str, segment_names: Sequence[str]) -> Dict[str, Any]
         entries += 1
         bytes_total += int(e.get("bytes") or 0)
         columns.update((e.get("columns") or {}).keys())
+    # per-segment residency tier (engine/residency.py): which of this
+    # query's segments sit hot (HBM), warm (host snapshot), cold (disk
+    # spool) — anything the manager has never seen is "unstaged".
+    # Matching mirrors the ledger rules above: physical table names,
+    # empty falls back to segment-name membership.
+    from pinot_tpu.engine.residency import RESIDENCY
+
+    tiers = RESIDENCY.segment_tiers(raw, segment_names, raw_match=True)
+    residency = {s: tiers.get(s, "unstaged") for s in segment_names}
     return {
         "hbmBytes": bytes_total,
         "stagedTables": entries,
         "columns": sorted(columns),
+        "residency": residency,
     }
 
 

@@ -1415,6 +1415,31 @@ def make_packed_bitsliced_kernel(spec) -> Callable:
     return make_packed_kernel(jax.jit(table_fn))
 
 
+@functools.lru_cache(maxsize=128)
+def make_packed_batched_bitsliced_kernel(spec) -> Callable:
+    """Cross-query batched bit-sliced kernel — the BSI tier joining the
+    lane micro-batching plane (make_packed_batched_table_kernel's exact
+    shape, applied to the plane kernels): ONE launch evaluates B
+    same-spec queries over the SAME resident bit-planes, each member's
+    per-leaf ``bounds:<i>``/``pts:<i>`` arrays stacked along a new
+    leading batch axis.
+
+    The plane arrays broadcast (``in_axes=(None, 0)`` — never copied
+    per member), so B distinct range/IN literals over one bit-sliced
+    column cost one O(W) bitwise pass instead of B.  Every output leaf
+    gains a leading [B] axis the lane slices per member; member b's
+    outputs are the computation the solo launch would have produced
+    (tests/test_bitsliced.py holds the two together byte-identically)."""
+    single = make_single_segment_bitsliced_kernel(spec)
+
+    def table_fn(segs: Dict[str, Any], q: Dict[str, Any]) -> Dict[str, Any]:
+        return jax.vmap(single)(segs, q)
+
+    from pinot_tpu.engine.packing import make_packed_kernel
+
+    return make_packed_kernel(jax.jit(jax.vmap(table_fn, in_axes=(None, 0))))
+
+
 # ---------------------------------------------------------------------------
 # Device hash join (engine/join.py JoinPlan -> one jitted program)
 # ---------------------------------------------------------------------------

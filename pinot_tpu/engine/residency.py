@@ -1,0 +1,729 @@
+"""Three-tier staged-table residency: HBM (hot) <-> host RAM (warm) <->
+disk (cold), driven by workload heat.
+
+PIMDAL's thesis (2504.01948) is that data MOVEMENT, not compute, is the
+bottleneck to manage — and at fleet scale (100+ tables, PR 15) the
+working set simply does not fit HBM.  This module turns the staging
+cache's old all-or-nothing size-cap clear into an explicit residency
+model:
+
+  hot   — device arrays live in ``device._stage_cache`` / the staging
+          ledger; queries launch against them directly.
+  warm  — the SAME packed layout snapshotted to host numpy arrays
+          (one D2H per array); promotion back to HBM is a pure
+          device_put, zero re-encode.
+  cold  — the warm snapshot spooled to disk as one ``.npz`` in the
+          packed layout (one read, zero re-encode); column/shape
+          metadata stays in RAM so promotion needs no segment access.
+
+Heat is the instrument the PR 10 ledger and ``/debug/plans`` already
+suggested: an exponentially-decayed touch counter per resident table,
+weighted by its reload cost (``tiercost.h2d_cost_ns``) — frequency x
+cost, so a rarely-hit giant outranks a hot midget only when re-loading
+the giant would actually hurt more.
+
+Correctness invariants:
+
+- Demotion NEVER invalidates an in-flight launch.  The staging token is
+  process-unique, Python references keep a demoted table's device
+  arrays alive until its last launch finishes, and queries additionally
+  ``pin()`` their staged table (refcount by token) so the victim picker
+  skips anything mid-flight — demotion can free the HBM of a table a
+  query needs *next*, never one it is using *now*.
+- Promotion mints a NEW staging token (``restore_staged`` builds a
+  fresh StagedTable), so the PR 3 alias-safety invariant holds across a
+  demote -> promote round trip: an old token can never match a new
+  resident.
+- Tier transitions are ledger-exact: demote drops the ledger entry
+  (visible as an eviction), promote re-measures and re-registers, and
+  the warm/cold byte totals are measured off the actual numpy arrays.
+
+Caps (read fresh per call, junk-safe — the tiercost knob idiom):
+
+  PINOT_TPU_HBM_CAP_BYTES       hot-tier byte cap; 0/unset = uncapped.
+  PINOT_TPU_HOST_CAP_BYTES      warm-tier byte cap; 0/unset = warm
+                                snapshots never spill to disk by bytes.
+  PINOT_TPU_STAGE_CACHE_ENTRIES hot entry-count cap (default 32 — the
+                                pre-residency size cap, now a demotion
+                                threshold instead of a clear-all).
+  PINOT_TPU_RESIDENCY_DIR       cold spool directory (default: a
+                                process-lifetime temp dir).
+
+Lock order (deadlock discipline): ``device._cache_guard`` is always
+acquired BEFORE ``RESIDENCY._lock``; the ledger's internal lock is a
+leaf.  Demotion never takes per-key staging locks.
+"""
+from __future__ import annotations
+
+import atexit
+import itertools
+import os
+import queue
+import shutil
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _int_knob(env: str, default: int) -> int:
+    raw = os.environ.get(env)
+    if raw:
+        try:
+            return int(float(raw))
+        except ValueError:
+            pass
+    return default
+
+
+def hbm_cap_bytes() -> int:
+    """Hot-tier (HBM) byte cap; 0 = uncapped (the pre-residency
+    behavior, minus the entry-count cap below)."""
+    return _int_knob("PINOT_TPU_HBM_CAP_BYTES", 0)
+
+
+def host_cap_bytes() -> int:
+    """Warm-tier (host RAM) byte cap; 0 = warm snapshots stay in RAM."""
+    return _int_knob("PINOT_TPU_HOST_CAP_BYTES", 0)
+
+
+def stage_cache_entry_cap() -> int:
+    """Hot entry-count cap — the old 32-entry size cap, kept as a
+    demotion threshold so unbounded distinct tables still can't pin
+    unbounded HBM even with no byte cap configured."""
+    return _int_knob("PINOT_TPU_STAGE_CACHE_ENTRIES", 32)
+
+
+# ---------------------------------------------------------------------------
+# Packed-layout snapshot / restore (the zero-re-encode contract)
+# ---------------------------------------------------------------------------
+
+
+def snapshot_staged(st) -> Tuple[Dict[str, Any], int]:
+    """Snapshot a StagedTable's device arrays to host numpy in the SAME
+    packed layout.  Returns (snapshot, host bytes).  The snapshot holds
+    everything ``restore_staged`` needs — no segment objects, so a cold
+    table promotes without touching the segment store."""
+    from pinot_tpu.engine.device import _ROLE_ATTRS
+
+    nbytes = 0
+    nd = np.asarray(st.num_docs_arr)
+    nbytes += int(nd.nbytes)
+    columns: Dict[str, Dict[str, Any]] = {}
+    for name, sc in st.columns.items():
+        arrays: Dict[str, np.ndarray] = {}
+        for attr, _role in _ROLE_ATTRS:
+            arr = getattr(sc, attr)
+            if arr is None:
+                continue
+            host = np.asarray(arr)
+            arrays[attr] = host
+            nbytes += int(host.nbytes)
+        columns[name] = {
+            "meta": {
+                "stored_type": sc.stored_type,
+                "single_value": sc.single_value,
+                "card_pad": sc.card_pad,
+                "mv_pad": sc.mv_pad,
+                "cards": sc.cards,
+                "bsi_width": sc.bsi_width,
+                "bsiv_width": sc.bsiv_width,
+                "bsiv_min": sc.bsiv_min,
+            },
+            "arrays": arrays,
+        }
+    snap = {
+        "segment_names": st.segment_names,
+        "num_segments": st.num_segments,
+        "n_pad": st.n_pad,
+        "num_docs": st.num_docs,
+        "num_docs_arr": nd,
+        "columns": columns,
+    }
+    return snap, nbytes
+
+
+def restore_staged(snap: Dict[str, Any]):
+    """Rebuild a hot StagedTable from a warm snapshot: one device_put
+    per array, zero re-encode.  Mints a NEW staging token (dataclass
+    default), so the promoted table can never alias a launch that was
+    in flight against the demoted one."""
+    import jax.numpy as jnp
+
+    from pinot_tpu.engine.device import StagedColumn, StagedTable
+
+    st = StagedTable(
+        segment_names=tuple(snap["segment_names"]),
+        num_segments=int(snap["num_segments"]),
+        n_pad=int(snap["n_pad"]),
+        num_docs=tuple(snap["num_docs"]),
+        num_docs_arr=jnp.asarray(snap["num_docs_arr"]),
+    )
+    for name, col in snap["columns"].items():
+        meta = col["meta"]
+        sc = StagedColumn(
+            name=name,
+            stored_type=meta["stored_type"],
+            single_value=bool(meta["single_value"]),
+            card_pad=int(meta["card_pad"]),
+            mv_pad=int(meta["mv_pad"]),
+            cards=tuple(meta["cards"]),
+            bsi_width=int(meta["bsi_width"]),
+            bsiv_width=int(meta["bsiv_width"]),
+            bsiv_min=meta["bsiv_min"],
+        )
+        for attr, host in col["arrays"].items():
+            setattr(sc, attr, jnp.asarray(host))
+        st.columns[name] = sc
+    return st
+
+
+# ---------------------------------------------------------------------------
+# The residency manager
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Entry:
+    key: Tuple
+    table: str
+    segments: Tuple[str, ...]
+    state: str  # "hot" | "warm" | "cold"
+    nbytes: int
+    demotable: bool
+    heat: float = 1.0
+    last_touch: float = field(default_factory=time.monotonic)
+    staged: Any = None  # StagedTable while hot (identity check on demote)
+    snap: Optional[Dict[str, Any]] = None  # packed snapshot while warm
+    path: Optional[str] = None  # .npz spool file while cold
+    meta: Optional[Dict[str, Any]] = None  # shape/column meta while cold
+
+
+_COUNTER_NAMES = (
+    "demotions",  # hot -> warm
+    "promotions",  # warm/cold -> hot
+    "coldDemotions",  # warm -> cold (disk spill)
+    "coldLoads",  # cold -> warm (disk read, promotion or prefetch)
+    "coldDrops",  # spool unwritable: entry dropped instead of spilled
+    "pressureDemotions",  # demotions forced by an OOM heal, not a cap
+    "capEvictions",  # non-demotable (sharded) entries dropped at cap
+    "prefetches",  # async cold -> warm lifts ahead of dispatch
+    "demotedBytes",
+    "promotedBytes",
+)
+
+
+class ResidencyManager:
+    """Process-global tier state for staged tables (one per process,
+    like the staging cache it manages)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._entries: Dict[Tuple, _Entry] = {}
+        self._pins: Dict[int, int] = {}  # staging token -> refcount
+        self._token_keys: Dict[int, Tuple] = {}  # hot token -> cache key
+        self._dir: Optional[str] = None
+        self._dir_owned = False
+        self._file_seq = itertools.count()
+        self.counters: Dict[str, int] = {n: 0 for n in _COUNTER_NAMES}
+        # async promotion worker (cold -> warm ahead of lane dispatch):
+        # lazily started, daemon, swallows I/O errors (prefetch is an
+        # optimization — the synchronous path stays correct without it)
+        self._prefetch_q: "queue.Queue[Tuple]" = queue.Queue()
+        self._prefetch_thread: Optional[threading.Thread] = None
+
+    # -- pins (in-flight queries) -------------------------------------
+    def pin(self, token: int) -> None:
+        with self._lock:
+            self._pins[token] = self._pins.get(token, 0) + 1
+
+    def unpin(self, token: int) -> None:
+        with self._lock:
+            n = self._pins.get(token, 0) - 1
+            if n > 0:
+                self._pins[token] = n
+            else:
+                self._pins.pop(token, None)
+
+    def pin_count(self, token: int) -> int:
+        with self._lock:
+            return self._pins.get(token, 0)
+
+    # -- heat -----------------------------------------------------------
+    def _halflife_s(self) -> float:
+        from pinot_tpu.engine import tiercost
+
+        return tiercost.residency_halflife_s()
+
+    def _decayed_heat(self, e: _Entry, now: float) -> float:
+        hl = max(1e-3, self._halflife_s())
+        return e.heat * (0.5 ** (max(0.0, now - e.last_touch) / hl))
+
+    def _score(self, e: _Entry, now: float) -> float:
+        """Victim ordering: decayed touch frequency x reload cost —
+        the /debug/plans frequency-x-cost shape applied to residency.
+        Lowest score = coldest = first demoted."""
+        from pinot_tpu.engine import tiercost
+
+        return self._decayed_heat(e, now) * tiercost.h2d_cost_ns(
+            max(1, e.nbytes)
+        )
+
+    def _touch_locked(self, e: _Entry, weight: float = 1.0) -> None:
+        now = time.monotonic()
+        e.heat = self._decayed_heat(e, now) + weight
+        e.last_touch = now
+
+    # -- registration (called by device.get_staged) --------------------
+    def note_hot(
+        self,
+        key: Tuple,
+        staged,
+        table: str,
+        nbytes: int,
+        demotable: bool,
+        promoted: bool,
+    ) -> None:
+        """A table just became HBM-resident (cold stage or promotion).
+        Caller holds ``device._cache_guard``."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                e = _Entry(
+                    key=key,
+                    table=table,
+                    segments=tuple(staged.segment_names),
+                    state="hot",
+                    nbytes=int(nbytes),
+                    demotable=demotable,
+                )
+                self._entries[key] = e
+            else:
+                self._remove_payload_locked(e)
+                e.state, e.nbytes, e.demotable = "hot", int(nbytes), demotable
+                self._touch_locked(e)
+            e.staged = staged
+            self._token_keys[staged.token] = key
+            if promoted:
+                self.counters["promotions"] += 1
+                self.counters["promotedBytes"] += int(nbytes)
+
+    def touch(self, key: Tuple) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                self._touch_locked(e)
+
+    def set_bytes(self, key: Tuple, nbytes: int) -> None:
+        """Role augmentation re-measured a hot table."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.state == "hot":
+                e.nbytes = int(nbytes)
+
+    def take_resident(self, key: Tuple) -> Optional[Dict[str, Any]]:
+        """Pop the warm/cold payload for promotion (caller holds the
+        per-key staging lock, so nobody else promotes this key
+        concurrently).  Returns the packed snapshot, or None if the key
+        has no resident copy."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.state == "hot":
+                return None
+            if e.state == "warm":
+                return e.snap
+            return self._load_cold_locked(e)
+
+    def drop_key(self, key: Tuple) -> None:
+        """Entry removed entirely (quarantine eviction / cache clear):
+        a warm or cold copy must NOT survive — a later re-load of the
+        same segments mints new staging tokens and can never produce
+        this key again, so any retained payload would be dead weight."""
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is not None:
+                self._remove_payload_locked(e)
+                if e.staged is not None:
+                    self._token_keys.pop(e.staged.token, None)
+
+    def drop_segment(self, segment_name: str) -> int:
+        """Drop every entry (any tier) containing the segment — the
+        quarantine path's residency hygiene."""
+        with self._lock:
+            victims = [
+                k for k, e in self._entries.items() if segment_name in e.segments
+            ]
+            for k in victims:
+                self.drop_key(k)
+            return len(victims)
+
+    # -- demotion / enforcement ----------------------------------------
+    def enforce(self, exclude_tokens: Sequence[int] = ()) -> int:
+        """Demote until the hot tier fits its caps (byte cap + entry
+        cap).  Returns HBM bytes freed.  Pinned and excluded tables are
+        never victims, so the hot set a query is actively using can
+        exceed the cap — the cap bounds *idle* residency, not
+        correctness."""
+        from pinot_tpu.engine import device as dev
+
+        freed = 0
+        exclude = set(exclude_tokens)
+        cap = hbm_cap_bytes()
+        entry_cap = stage_cache_entry_cap()
+        while True:
+            with dev._cache_guard:
+                with self._lock:
+                    hot = [e for e in self._entries.values() if e.state == "hot"]
+                    hot_bytes = sum(e.nbytes for e in hot)
+                    over = (cap > 0 and hot_bytes > cap) or (
+                        entry_cap > 0 and len(dev._stage_cache) > entry_cap
+                    )
+                    if not over:
+                        break
+                    victim = self._pick_victim_locked(hot, exclude)
+                    if victim is None:
+                        break  # everything hot is pinned/excluded
+                    freed += self._demote_locked(victim, dev)
+        self._enforce_warm_cap()
+        return freed
+
+    def demote_for_pressure(
+        self, exclude_tokens: Sequence[int] = (), min_bytes: int = 1
+    ) -> int:
+        """OOM heal hook: the device just refused an allocation, so free
+        the coldest unpinned residents regardless of the configured cap
+        (the cap clearly overestimates what actually fits).  Returns
+        bytes freed (0 = nothing demotable — the caller's retry will
+        fail over to the host path)."""
+        from pinot_tpu.engine import device as dev
+
+        freed = 0
+        exclude = set(exclude_tokens)
+        while freed < max(1, min_bytes):
+            with dev._cache_guard:
+                with self._lock:
+                    hot = [e for e in self._entries.values() if e.state == "hot"]
+                    victim = self._pick_victim_locked(hot, exclude)
+                    if victim is None:
+                        break
+                    freed += self._demote_locked(victim, dev)
+                    self.counters["pressureDemotions"] += 1
+        self._enforce_warm_cap()
+        return freed
+
+    def _pick_victim_locked(
+        self, hot: List[_Entry], exclude: set
+    ) -> Optional[_Entry]:
+        now = time.monotonic()
+        best: Optional[_Entry] = None
+        best_score = 0.0
+        for e in hot:
+            tok = e.staged.token if e.staged is not None else None
+            if tok is None or tok in exclude or self._pins.get(tok, 0) > 0:
+                continue
+            score = self._score(e, now)
+            if not e.demotable:
+                # sharded placements have no single-device snapshot
+                # path; they remain drop-only, ranked after every
+                # demotable entry so data is preferentially preserved
+                score += 1e18
+            if best is None or score < best_score:
+                best, best_score = e, score
+        return best
+
+    def _demote_locked(self, e: _Entry, dev) -> int:
+        """hot -> warm (or outright drop for non-demotable entries).
+        Caller holds ``dev._cache_guard`` + ``self._lock``."""
+        st = dev._stage_cache.get(e.key)
+        if st is not None and st is e.staged:
+            dev._stage_cache.pop(e.key, None)
+        hot_bytes = int(e.nbytes)
+        staged = e.staged
+        if staged is not None:
+            dev.LEDGER.drop(staged)
+            self._token_keys.pop(staged.token, None)
+        e.staged = None
+        if not e.demotable or staged is None:
+            self._entries.pop(e.key, None)
+            self.counters["capEvictions"] += 1
+            return hot_bytes
+        snap, host_bytes = snapshot_staged(staged)
+        dev.TRANSFERS.record_d2h(host_bytes)
+        e.snap, e.state, e.nbytes = snap, "warm", host_bytes
+        self.counters["demotions"] += 1
+        self.counters["demotedBytes"] += host_bytes
+        return hot_bytes
+
+    def _enforce_warm_cap(self) -> None:
+        """Spill coldest warm snapshots to disk while over the host
+        byte cap.  Disk-unwritable degrades to dropping the entry (the
+        segments still exist — a future query re-stages from source)."""
+        cap = host_cap_bytes()
+        if cap <= 0:
+            return
+        with self._lock:
+            while True:
+                warm = [e for e in self._entries.values() if e.state == "warm"]
+                if sum(e.nbytes for e in warm) <= cap or not warm:
+                    return
+                now = time.monotonic()
+                victim = min(warm, key=lambda e: self._score(e, now))
+                self._spill_locked(victim)
+
+    def _spool_dir(self) -> Optional[str]:
+        if self._dir is None:
+            configured = os.environ.get("PINOT_TPU_RESIDENCY_DIR")
+            try:
+                if configured:
+                    os.makedirs(configured, exist_ok=True)
+                    self._dir = configured
+                else:
+                    self._dir = tempfile.mkdtemp(prefix="pinot_tpu_resid_")
+                    self._dir_owned = True
+                    atexit.register(
+                        shutil.rmtree, self._dir, ignore_errors=True
+                    )
+            except OSError:
+                self._dir = None
+        return self._dir
+
+    def _spill_locked(self, e: _Entry) -> None:
+        """warm -> cold: arrays to one .npz in the packed layout;
+        shape/column metadata stays in RAM so promotion never touches
+        the segment store."""
+        snap = e.snap
+        d = self._spool_dir()
+        if snap is None or d is None:
+            self._entries.pop(e.key, None)
+            self.counters["coldDrops"] += 1
+            return
+        arrays: Dict[str, np.ndarray] = {"nd:num_docs_arr": snap["num_docs_arr"]}
+        order = sorted(snap["columns"])
+        meta = {
+            "segment_names": snap["segment_names"],
+            "num_segments": snap["num_segments"],
+            "n_pad": snap["n_pad"],
+            "num_docs": snap["num_docs"],
+            "column_order": order,
+            "column_meta": {n: snap["columns"][n]["meta"] for n in order},
+            "column_attrs": {
+                n: sorted(snap["columns"][n]["arrays"]) for n in order
+            },
+        }
+        for ci, name in enumerate(order):
+            for attr, arr in snap["columns"][name]["arrays"].items():
+                arrays[f"{ci}:{attr}"] = arr
+        path = os.path.join(d, f"resid_{os.getpid()}_{next(self._file_seq)}.npz")
+        try:
+            with open(path, "wb") as f:
+                np.savez(f, **arrays)
+        except OSError:
+            self._entries.pop(e.key, None)
+            self.counters["coldDrops"] += 1
+            return
+        e.snap, e.state, e.path, e.meta = None, "cold", path, meta
+        self.counters["coldDemotions"] += 1
+
+    def _load_cold_locked(self, e: _Entry) -> Optional[Dict[str, Any]]:
+        """cold -> packed snapshot (one sequential read, zero
+        re-encode).  On read failure the entry is dropped — the caller
+        falls back to staging from source segments."""
+        meta, path = e.meta, e.path
+        if meta is None or path is None:
+            self._entries.pop(e.key, None)
+            return None
+        try:
+            with np.load(path) as z:
+                files = dict(z)
+        except (OSError, ValueError):
+            self._entries.pop(e.key, None)
+            self.counters["coldDrops"] += 1
+            return None
+        columns: Dict[str, Dict[str, Any]] = {}
+        for ci, name in enumerate(meta["column_order"]):
+            columns[name] = {
+                "meta": meta["column_meta"][name],
+                "arrays": {
+                    attr: files[f"{ci}:{attr}"]
+                    for attr in meta["column_attrs"][name]
+                },
+            }
+        snap = {
+            "segment_names": meta["segment_names"],
+            "num_segments": meta["num_segments"],
+            "n_pad": meta["n_pad"],
+            "num_docs": meta["num_docs"],
+            "num_docs_arr": files["nd:num_docs_arr"],
+            "columns": columns,
+        }
+        nbytes = int(files["nd:num_docs_arr"].nbytes) + sum(
+            int(a.nbytes)
+            for col in columns.values()
+            for a in col["arrays"].values()
+        )
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        e.snap, e.state, e.path, e.meta, e.nbytes = snap, "warm", None, None, nbytes
+        self.counters["coldLoads"] += 1
+        return snap
+
+    def _remove_payload_locked(self, e: _Entry) -> None:
+        if e.path is not None:
+            try:
+                os.unlink(e.path)
+            except OSError:
+                pass
+        e.snap, e.path, e.meta = None, None, None
+
+    # -- async promotion (cold -> warm ahead of lane dispatch) ---------
+    def prefetch_siblings(self, key: Tuple, table: str) -> None:
+        """A promotion just happened for ``table``: lift its OTHER cold
+        entries to warm in the background, so the table's next
+        segment-set launch pays a RAM copy instead of a disk read —
+        the async-promotion half of the tier contract."""
+        with self._lock:
+            targets = [
+                k
+                for k, e in self._entries.items()
+                if e.state == "cold" and e.table == table and k != key
+            ]
+            if not targets:
+                return
+            for k in targets:
+                self._prefetch_q.put(k)
+            if self._prefetch_thread is None or not self._prefetch_thread.is_alive():
+                self._prefetch_thread = threading.Thread(
+                    target=self._prefetch_loop,
+                    name="residency-prefetch",
+                    daemon=True,
+                )
+                self._prefetch_thread.start()
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            try:
+                k = self._prefetch_q.get(timeout=5.0)
+            except queue.Empty:
+                return
+            try:
+                with self._lock:
+                    e = self._entries.get(k)
+                    if e is not None and e.state == "cold":
+                        if self._load_cold_locked(e) is not None:
+                            self.counters["prefetches"] += 1
+            except Exception:
+                pass  # prefetch is best-effort by contract
+
+    # -- observability --------------------------------------------------
+    def _tier_totals_locked(self) -> Dict[str, Tuple[int, int]]:
+        out = {"hot": [0, 0], "warm": [0, 0], "cold": [0, 0]}
+        for e in self._entries.values():
+            out[e.state][0] += 1
+            out[e.state][1] += e.nbytes
+        return {k: (v[0], v[1]) for k, v in out.items()}
+
+    def hot_bytes(self) -> int:
+        with self._lock:
+            return self._tier_totals_locked()["hot"][1]
+
+    def warm_bytes(self) -> int:
+        with self._lock:
+            return self._tier_totals_locked()["warm"][1]
+
+    def cold_bytes(self) -> int:
+        with self._lock:
+            return self._tier_totals_locked()["cold"][1]
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self.counters.get(name, 0)
+
+    def pressure(self) -> float:
+        """Hot bytes as a fraction of the HBM cap (0.0 when uncapped) —
+        the signal ingest backpressure and the rebalancer learn."""
+        cap = hbm_cap_bytes()
+        if cap <= 0:
+            return 0.0
+        return self.hot_bytes() / cap
+
+    def segment_tiers(
+        self,
+        table: str,
+        segment_names: Sequence[str],
+        raw_match: bool = False,
+    ) -> Dict[str, str]:
+        """Best residency state per segment ("hot" > "warm" > "cold"),
+        for EXPLAIN's per-segment reporting; unknown segments are
+        simply absent (caller reports them "unstaged").  With
+        ``raw_match`` the table comparison strips TYPE suffixes
+        (EXPLAIN's logical-vs-physical naming); entries whose table is
+        unknown (segment metadata without a table_name) match on
+        segment membership alone, mirroring the ledger snapshot
+        rules."""
+        if raw_match and table:
+            from pinot_tpu.engine.plandigest import _raw_table
+
+            table = _raw_table(table)
+        rank = {"hot": 0, "warm": 1, "cold": 2}
+        wanted = set(segment_names)
+        out: Dict[str, str] = {}
+        with self._lock:
+            for e in self._entries.values():
+                etable = e.table
+                if raw_match and etable:
+                    from pinot_tpu.engine.plandigest import _raw_table
+
+                    etable = _raw_table(etable)
+                if table and etable and etable != table:
+                    continue
+                for s in e.segments:
+                    if s in wanted and (
+                        s not in out or rank[e.state] < rank[out[s]]
+                    ):
+                        out[s] = e.state
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-safe tier view for server status() / /debug/residency /
+        the controller capacity rollup."""
+        with self._lock:
+            totals = self._tier_totals_locked()
+            by_table: Dict[str, Dict[str, int]] = {}
+            for e in self._entries.values():
+                t = by_table.setdefault(e.table, {"hot": 0, "warm": 0, "cold": 0})
+                t[e.state] += 1
+            cap = hbm_cap_bytes()
+            hot_bytes = totals["hot"][1]
+            return {
+                "hbmCapBytes": cap,
+                "hostCapBytes": host_cap_bytes(),
+                "hotTables": totals["hot"][0],
+                "hotBytes": hot_bytes,
+                "warmTables": totals["warm"][0],
+                "warmBytes": totals["warm"][1],
+                "coldTables": totals["cold"][0],
+                "coldBytes": totals["cold"][1],
+                "pinnedTokens": len(self._pins),
+                "pressure": round(hot_bytes / cap, 4) if cap > 0 else 0.0,
+                "counters": dict(self.counters),
+                "byTable": by_table,
+            }
+
+    def reset(self) -> None:
+        """Drop all tier state (tests / chaos scenarios).  Pins are
+        preserved — they belong to in-flight queries, not to entries."""
+        with self._lock:
+            for e in list(self._entries.values()):
+                self._remove_payload_locked(e)
+            self._entries.clear()
+            self._token_keys.clear()
+            for n in self.counters:
+                self.counters[n] = 0
+
+
+RESIDENCY = ResidencyManager()

@@ -36,6 +36,13 @@ _DEFAULTS = {
     # touch (filter + fused-agg planes); above it the encoding stops
     # paying for itself against the plain scan
     "BSI_MAX_PLANES": 24.0,
+    # host->device reload cost (engine/residency.py victim scoring):
+    # per-byte PCIe/tunnel transfer plus the same dispatch floor — a
+    # demotion candidate's score is touch-frequency x THIS, so evicting
+    # a big table is charged what re-promoting it will actually cost
+    "H2D_NS_PER_BYTE": 0.0625,  # ~16 GB/s effective H2D
+    # exponential-decay halflife (seconds) of the residency heat signal
+    "RESIDENCY_HALFLIFE_S": 30.0,
 }
 
 
@@ -73,3 +80,14 @@ def bitsliced_cost_ns(total_docs: int, planes: int) -> float:
 
 def bsi_max_planes() -> int:
     return int(_knob("BSI_MAX_PLANES"))
+
+
+def h2d_cost_ns(nbytes: int) -> float:
+    """Cost of re-promoting ``nbytes`` from host to device — the
+    reload-cost half of the residency heat score."""
+    return nbytes * _knob("H2D_NS_PER_BYTE") + _knob("DISPATCH_FLOOR_NS")
+
+
+def residency_halflife_s() -> float:
+    """Heat-decay halflife for tier victim selection."""
+    return _knob("RESIDENCY_HALFLIFE_S")

@@ -37,6 +37,15 @@ Watermarks default OFF (0 = unlimited) and come from the environment:
 ``PINOT_TPU_INGEST_HBM_HIGH_BYTES`` / ``..._LOW_BYTES`` (low defaults
 to 80% of high) and ``PINOT_TPU_INGEST_MUTABLE_HIGH_BYTES`` /
 ``..._LOW_BYTES``.
+
+Tier pressure (r18): when a residency HBM cap is configured
+(``PINOT_TPU_HBM_CAP_BYTES``, engine/residency.py) the governor also
+watches ``RESIDENCY.pressure()`` — hot bytes as a fraction of the cap —
+pausing at ``PINOT_TPU_INGEST_RESIDENCY_HIGH_FRAC`` (default 0.95) and
+resuming below ``..._LOW_FRAC`` (default 0.8).  Ingest learns memory
+pressure BEFORE allocation failures do: a working set pushing the hot
+tier against its cap throttles new rows instead of racing queries for
+the last HBM bytes.
 """
 from __future__ import annotations
 
@@ -95,6 +104,25 @@ class IngestBackpressure:
             hbm_bytes_fn = LEDGER.total_bytes
         self._hbm_bytes = hbm_bytes_fn
         self._mutable_bytes = mutable_bytes_fn or (lambda: 0.0)
+        # tier-pressure pool (engine/residency.py): fractions of the
+        # configured HBM cap; inert (pressure reads 0.0) while no cap
+        # is set, so default behavior is unchanged
+        self.residency_high_frac = float(
+            _env_bytes("PINOT_TPU_INGEST_RESIDENCY_HIGH_FRAC", 0.95)
+        )
+        self.residency_low_frac = float(
+            _env_bytes(
+                "PINOT_TPU_INGEST_RESIDENCY_LOW_FRAC",
+                0.8 if self.residency_high_frac > 0 else 0.0,
+            )
+        )
+
+        def _residency_pressure() -> float:
+            from pinot_tpu.engine.residency import RESIDENCY
+
+            return RESIDENCY.pressure()
+
+        self._residency_pressure = _residency_pressure
         # one decision per poll interval: watermark reads (ledger lock,
         # data-manager walk) stay off the per-batch hot path
         self.poll_interval_s = poll_interval_s
@@ -118,7 +146,23 @@ class IngestBackpressure:
 
     @property
     def enabled(self) -> bool:
-        return self.hbm_high > 0 or self.mutable_high > 0
+        return (
+            self.hbm_high > 0
+            or self.mutable_high > 0
+            or self._residency_enabled()
+        )
+
+    def _residency_enabled(self) -> bool:
+        """Tier-pressure pool is live only while a residency HBM cap is
+        configured (knob read fresh — chaos scenarios flip it mid-run)."""
+        if self.residency_high_frac <= 0:
+            return False
+        from pinot_tpu.engine.residency import hbm_cap_bytes
+
+        try:
+            return hbm_cap_bytes() > 0
+        except Exception:
+            return False
 
     @property
     def paused(self) -> bool:
@@ -142,6 +186,8 @@ class IngestBackpressure:
             self._last_poll = now
             hbm = self._read(self._hbm_bytes)
             mutable = self._read(self._mutable_bytes)
+            res_on = self._residency_enabled()
+            pressure = self._read(self._residency_pressure) if res_on else 0.0
             if not self._paused:
                 reason = None
                 if self.hbm_high > 0 and hbm >= self.hbm_high:
@@ -152,6 +198,11 @@ class IngestBackpressure:
                     reason = (
                         f"mutable {int(mutable)}B >= high watermark "
                         f"{int(self.mutable_high)}B"
+                    )
+                elif res_on and pressure >= self.residency_high_frac:
+                    reason = (
+                        f"residency pressure {pressure:.2f} >= "
+                        f"{self.residency_high_frac:.2f} of HBM cap"
                     )
                 if reason is not None:
                     self._paused = True
@@ -166,7 +217,10 @@ class IngestBackpressure:
                 mutable_ok = (
                     self.mutable_high <= 0 or mutable <= self.mutable_low
                 )
-                if hbm_ok and mutable_ok:
+                residency_ok = (
+                    not res_on or pressure <= self.residency_low_frac
+                )
+                if hbm_ok and mutable_ok and residency_ok:
                     self._paused = False
                     self._reason = ""
                     self._resumes += 1
@@ -213,7 +267,12 @@ class IngestBackpressure:
                     "hbmLowBytes": self.hbm_low,
                     "mutableHighBytes": self.mutable_high,
                     "mutableLowBytes": self.mutable_low,
+                    "residencyHighFrac": self.residency_high_frac,
+                    "residencyLowFrac": self.residency_low_frac,
                 },
+                "residencyPressure": round(
+                    self._read(self._residency_pressure), 4
+                ),
                 "maxBatchRows": self.max_batch_rows,
                 "events": list(self._events),
             }

@@ -316,6 +316,30 @@ class ServerInstance:
         self.metrics.gauge("hbm.qinputCacheBytes").set_fn(
             lambda: self.executor._qinput_cache_bytes
         )
+        # tiered residency plane (engine/residency.py — process-global,
+        # like the ledger): per-tier bytes/counts, cap pressure, and
+        # the demotion/promotion cycle counters
+        from pinot_tpu.engine.residency import RESIDENCY
+
+        self.metrics.gauge("residency.hotBytes").set_fn(RESIDENCY.hot_bytes)
+        self.metrics.gauge("residency.warmBytes").set_fn(RESIDENCY.warm_bytes)
+        self.metrics.gauge("residency.coldBytes").set_fn(RESIDENCY.cold_bytes)
+        self.metrics.gauge("residency.pressure").set_fn(RESIDENCY.pressure)
+        for _rc in (
+            "demotions",
+            "promotions",
+            "coldDemotions",
+            "coldLoads",
+            "pressureDemotions",
+            "prefetches",
+        ):
+            self.metrics.gauge(f"residency.{_rc}").set_fn(
+                (lambda name: lambda: RESIDENCY.counter(name))(_rc)
+            )
+        for _rt in ("hot", "warm", "cold"):
+            self.metrics.gauge(f"residency.{_rt}Tables").set_fn(
+                (lambda t: lambda: RESIDENCY.snapshot()[f"{t}Tables"])(_rt)
+            )
         # ingest backpressure governor (realtime/backpressure.py):
         # watermark pause/resume against the HBM staging ledger and the
         # instance's consuming-segment memory, shared by every realtime
@@ -717,6 +741,7 @@ class ServerInstance:
         heal["crcFailures"] = self.metrics.meter("crcFailures").count
         heal["quarantinedSegments"] = self.metrics.meter("quarantinedSegments").count
         from pinot_tpu.engine.device import LEDGER
+        from pinot_tpu.engine.residency import RESIDENCY
 
         hbm = LEDGER.snapshot()
         hbm["qinputCacheBytes"] = self.executor._qinput_cache_bytes
@@ -734,6 +759,7 @@ class ServerInstance:
             "mesh": self.topology.snapshot(),
             "selfHealing": heal,
             "hbm": hbm,
+            "residency": RESIDENCY.snapshot(),
             "device": self.device_utilization(),
             "ingest": self.ingest_backpressure.snapshot(),
             "rescache": self.result_cache.snapshot(),

@@ -112,6 +112,13 @@ class ServerAdminHttpServer:
                     return self._send_json(inst.prewarm.state())
                 if self.path == "/debug/flightrec":
                     return self._send_json(inst.flightrec.snapshot())
+                if self.path == "/debug/residency":
+                    # tiered residency plane (engine/residency.py):
+                    # per-tier bytes/entries, cap pressure, and the
+                    # demotion/promotion cycle counters
+                    from pinot_tpu.engine.residency import RESIDENCY
+
+                    return self._send_json(RESIDENCY.snapshot())
                 from urllib.parse import parse_qs, urlparse
 
                 url = urlparse(self.path)

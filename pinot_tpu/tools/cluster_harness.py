@@ -28,6 +28,14 @@ ledger + fleet prewarming), and readiness-gated movement (trims wait
 for warming destinations; the event ring proves it).  Tier-1 twin in
 ``tests/test_warmstart.py``.
 
+``--scenario hbm-pressure`` runs the tiered-residency chaos acceptance
+(ISSUE 18): addressable staged data ~8x the HBM cap under a hot
+closed loop + cold-table sweep — zero failed queries, hot-set p99
+bounded against its uncapped baseline, demotion/promotion/cold-load
+counters proving HBM <-> host <-> disk cycled, and an injected
+allocation failure healed by demotion (tier-1 twin in
+``tests/test_chaos_hbm_pressure.py``).
+
 ``--scenario elastic-fleet`` runs the fleet-breadth chaos acceptance
 (ISSUE 15): 100+ tables under mixed ingest+query closed-loop load,
 a forced hot-tenant skew, a live make-before-break rebalance, and a
@@ -1150,6 +1158,223 @@ def run_ingest_backpressure_scenario(
 
 
 # ---------------------------------------------------------------------------
+# HBM-pressure scenario (ISSUE 18): addressable staged data ~8x the
+# residency HBM cap under closed-loop mixed load — the tiered
+# residency manager (engine/residency.py) must keep the hot set
+# resident while cold tables cycle HBM <-> host <-> disk, and an
+# injected allocation failure must heal by demotion, never by
+# poisoning the plan.  Shared by the CLI and
+# tests/test_chaos_hbm_pressure.py.
+# ---------------------------------------------------------------------------
+
+
+def run_hbm_pressure_scenario(
+    num_tables: int = 10,
+    rows_per_table: int = 96,
+    clients: int = 3,
+    baseline_s: float = 1.0,
+    load_s: float = 4.0,
+    data_dir: Optional[str] = None,
+    seed: int = 421,
+) -> Dict[str, Any]:
+    """One server hosting ``num_tables`` identical tables whose total
+    staged footprint is ~8x the HBM cap the scenario then imposes:
+
+    - a hot table runs a closed loop while a sweeper cycles queries
+      over every cold table, forcing continuous demotion (hot tier
+      over cap), spill (warm tier over host cap) and promotion (cold
+      tables re-queried) — the counters must prove all three tiers
+      cycled, with ZERO failed queries and byte-exact counts;
+    - the hot set stays protected: its p99 under pressure is compared
+      against its own uncapped baseline (heat scoring must keep the
+      closed-loop table out of the victim pool);
+    - a seeded allocation failure (``DeviceFaultInjector
+      .alloc_fail_next``) lands on a hot query mid-pressure: the
+      executor must classify RESOURCE_EXHAUSTED, demote, retry and
+      answer correctly — ``heal.resourceExhausted`` marks, nothing is
+      poisoned, no host failover.
+
+    Caps are measured, not assumed: the per-table footprint comes from
+    the staging ledger delta of the first stage, so the scenario holds
+    its ~8x oversubscription on any platform/dtype.
+    """
+    from pinot_tpu.common.faults import DeviceFaultInjector
+    from pinot_tpu.engine.device import LEDGER, clear_staging_cache
+    from pinot_tpu.engine.residency import RESIDENCY
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.tools.datagen import random_rows
+
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("PINOT_TPU_HBM_CAP_BYTES", "PINOT_TPU_HOST_CAP_BYTES")
+    }
+    clear_staging_cache()  # measured footprints start from zero
+    cluster = InProcessCluster(num_servers=1, data_dir=data_dir)
+    try:
+        names = [f"tierT{i}" for i in range(num_tables)]
+        totals: Dict[str, int] = {}
+        for name in names:
+            schema = _tenant_schema(name)
+            physical = cluster.add_offline_table(schema, replication=1)
+            rows = random_rows(schema, rows_per_table, seed=seed)
+            half = rows_per_table // 2
+            cluster.upload(
+                physical, build_segment(schema, rows[:half], physical, f"{name}s0")
+            )
+            cluster.upload(
+                physical, build_segment(schema, rows[half:], physical, f"{name}s1")
+            )
+            totals[name] = rows_per_table
+
+        hot = names[0]
+
+        # aggregation over several columns so each table stages a real
+        # packed footprint (a bare count(*) stages only the num-docs
+        # array and would make the byte caps meaningless)
+        def pql_for(name: str) -> str:
+            return (
+                "SELECT sum(metInt), sum(metFloat), sum(metDouble), "
+                f"max(dimInt), max(dimLong) FROM {name} GROUP BY dimStr"
+            )
+
+        hot_pql = pql_for(hot)
+
+        # measure the per-table staged footprint off the first stage's
+        # ledger delta, then warm every table so "addressable" is the
+        # real uncapped total
+        before = LEDGER.total_bytes()
+        r = cluster.broker.handle_pql(hot_pql)
+        assert not r.exceptions, r.exceptions
+        table_bytes = max(1, int(LEDGER.total_bytes() - before))
+        for name in names[1:]:
+            r = cluster.broker.handle_pql(pql_for(name))
+            assert not r.exceptions, r.exceptions
+        addressable = int(LEDGER.total_bytes())
+
+        # phase 1: the hot table's UNCAPPED baseline
+        base = ClosedLoopLoad(cluster, hot_pql, totals[hot], clients).start()
+        time.sleep(baseline_s)
+        baseline = base.stop()
+
+        # phase 2: impose the caps — hot tier fits ~1.25 tables
+        # (addressable/cap ~= 8x for the default 10 tables), warm tier
+        # ~2.5 more, the rest lives on disk
+        cap = max(1, int(table_bytes * num_tables / 8.0))
+        os.environ["PINOT_TPU_HBM_CAP_BYTES"] = str(cap)
+        os.environ["PINOT_TPU_HOST_CAP_BYTES"] = str(int(table_bytes * 2.5))
+        # apply the new cap to the already-resident set (enforcement
+        # otherwise runs on staging inserts, and everything is cached):
+        # the operator's cap change takes effect immediately
+        RESIDENCY.enforce()
+        counters0 = {
+            n: RESIDENCY.counter(n)
+            for n in ("demotions", "promotions", "coldDemotions", "coldLoads")
+        }
+
+        # phase 3: hot closed loop + cold-table sweeper, concurrently
+        stop = threading.Event()
+        sweep_errors: List[str] = []
+        sweeps = [0]
+
+        def sweeper() -> None:
+            i = 0
+            while not stop.is_set():
+                name = names[1 + (i % (num_tables - 1))]
+                i += 1
+                try:
+                    resp = cluster.broker.handle_pql(pql_for(name))
+                except Exception as e:
+                    sweep_errors.append(f"{name}: {type(e).__name__}: {e}")
+                    continue
+                sweeps[0] += 1
+                if resp.exceptions or resp.num_docs_scanned != totals[name]:
+                    if len(sweep_errors) < 8:
+                        sweep_errors.append(
+                            f"{name}: docs={resp.num_docs_scanned}/{totals[name]} "
+                            f"exc={[e.message for e in resp.exceptions][:2]}"
+                        )
+
+        hot_load = ClosedLoopLoad(cluster, hot_pql, totals[hot], clients).start()
+        sweep_thread = threading.Thread(target=sweeper, daemon=True)
+        sweep_thread.start()
+        time.sleep(load_s)
+        stop.set()
+        hot_summary = hot_load.stop()
+        sweep_thread.join(timeout=10)
+
+        # phase 4: seeded allocation failure on a hot query, still
+        # under pressure — must heal by demotion, never poison
+        server = cluster.servers[0]
+        inj = DeviceFaultInjector(seed=seed)
+        lanes = server.lanes.lanes if server.lanes is not None else []
+        for lane in lanes:
+            lane.fault_injector = inj
+        heal_before = dict(server.executor.healing_stats())
+        inj.alloc_fail_next(1)
+        try:
+            resp = cluster.broker.handle_pql(hot_pql)
+        finally:
+            for lane in lanes:
+                lane.fault_injector = None
+        heal_after = dict(server.executor.healing_stats())
+        oom_healed = (
+            not resp.exceptions
+            and resp.num_docs_scanned == totals[hot]
+            and heal_after["resourceExhausted"]
+            > heal_before["resourceExhausted"]
+            and heal_after["hostFailovers"] == heal_before["hostFailovers"]
+            and heal_after["poisonedPlans"] == 0
+        )
+
+        deltas = {
+            n: RESIDENCY.counter(n) - counters0[n] for n in counters0
+        }
+        import jax
+
+        hot_p99 = hot_summary["p99Ms"]
+        base_p99 = baseline["p99Ms"]
+        failed = (
+            hot_summary["failedQueries"]
+            + len(sweep_errors)
+            + (0 if oom_healed else 1)
+        )
+        return {
+            "scenario": "hbm-pressure",
+            "metric": "tiered_hbm_pressure",
+            "value": round(addressable / cap, 3),
+            "addressable_over_cap": round(addressable / cap, 3),
+            "num_tables": num_tables,
+            "platform": jax.default_backend(),
+            "tableBytes": table_bytes,
+            "addressableBytes": addressable,
+            "hbmCapBytes": cap,
+            "hot_p99_ms": hot_p99,
+            "baseline_p99_ms": base_p99,
+            "hot_p99_over_baseline": round(hot_p99 / max(base_p99, 1e-3), 3),
+            "demotions": deltas["demotions"],
+            "promotions": deltas["promotions"],
+            "cold_demotions": deltas["coldDemotions"],
+            "cold_loads": deltas["coldLoads"],
+            "coldSweeps": sweeps[0],
+            "hotLoad": hot_summary,
+            "hotBaseline": baseline,
+            "sweepErrors": sweep_errors,
+            "oomHealed": oom_healed,
+            "selfHealing": heal_after,
+            "residency": RESIDENCY.snapshot(),
+            "failedQueries": failed,
+        }
+    finally:
+        cluster.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        clear_staging_cache()  # cap-era residue must not leak to callers
+
+
+# ---------------------------------------------------------------------------
 # Elastic-fleet scenario (ISSUE 15): 100+ tables under mixed
 # ingest+query closed-loop load, a forced hot-tenant skew, a live
 # make-before-break rebalance, and a mid-rebalance controller restart.
@@ -2204,6 +2429,7 @@ SCENARIOS = {
     "noisy-neighbor": run_noisy_neighbor_scenario,
     "join-under-flood": run_join_under_flood_scenario,
     "ingest-backpressure": run_ingest_backpressure_scenario,
+    "hbm-pressure": run_hbm_pressure_scenario,
     "partition-server": run_partition_server_scenario,
     "partition-controller": run_partition_controller_scenario,
     "asymmetric-partition": run_asymmetric_partition_scenario,
@@ -2235,7 +2461,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         print(_json.dumps(out, indent=2))
         return 0 if out["failedQueries"] == 0 else 1
-    if args.scenario in ("ingest-backpressure", "asymmetric-partition", "split-brain"):
+    if args.scenario in (
+        "ingest-backpressure",
+        "hbm-pressure",
+        "asymmetric-partition",
+        "split-brain",
+    ):
         out = SCENARIOS[args.scenario]()
     elif args.scenario == "partition-server":
         out = SCENARIOS[args.scenario](

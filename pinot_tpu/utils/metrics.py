@@ -466,6 +466,8 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "heal.deviceRetries": "transient device failures retried on device",
     "heal.hostFailovers": "queries transparently served via the host path",
     "heal.poisonSkips": "queries that skipped a quarantined device plan",
+    "heal.resourceExhausted": "device allocation failures healed by "
+    "residency demotion + retry (never poisoned)",
     "lane.depth": "device-lane queue depth (lane-group servers: summed "
     "over every lane)",
     "lane.inflight": "device-lane launches currently inside the launch call",
@@ -596,6 +598,23 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "hbm.stagedTables": "staged-table cache entries currently resident",
     "hbm.evictedBytes": "staged bytes released by cache evictions",
     "hbm.qinputCacheBytes": "bytes pinned by the device query-input cache",
+    # tiered residency (engine/residency.py RESIDENCY; per-process):
+    # hot = HBM, warm = host-RAM packed snapshots, cold = on-disk
+    "residency.hotBytes": "staged bytes resident in the hot (HBM) tier",
+    "residency.warmBytes": "packed snapshot bytes in the warm (host) tier",
+    "residency.coldBytes": "packed snapshot bytes spooled to the cold "
+    "(disk) tier",
+    "residency.hotTables": "staged-table entries in the hot tier",
+    "residency.warmTables": "staged-table entries in the warm tier",
+    "residency.coldTables": "staged-table entries in the cold tier",
+    "residency.pressure": "hot bytes / configured HBM cap (0 = uncapped)",
+    "residency.demotions": "hot->warm demotions (HBM freed, layout kept)",
+    "residency.promotions": "warm/cold->hot promotions (zero re-encode)",
+    "residency.coldDemotions": "warm->cold disk spills",
+    "residency.coldLoads": "cold->warm disk reads (promotion or prefetch)",
+    "residency.pressureDemotions": "demotions forced by a "
+    "RESOURCE_EXHAUSTED heal rather than a configured cap",
+    "residency.prefetches": "async cold->warm lifts ahead of dispatch",
     # distributed-join plane (engine/join.py): per-phase server counters
     "join.extracts": "join side-extraction phase requests served",
     "join.execs": "join executions (hash build + probe) served",

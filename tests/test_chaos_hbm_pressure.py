@@ -1,0 +1,44 @@
+"""Tier-1 twin of ``cluster_harness --scenario hbm-pressure`` (ISSUE 18):
+addressable data is pinned far above the HBM cap under closed-loop mixed
+load — the hot set keeps answering, cold tables cycle through warm/disk
+and back (demotion AND promotion counters move), and an injected device
+allocation failure heals as ``resourceExhausted`` without a host
+failover or a poisoned plan.  Zero failed queries end to end."""
+import pytest
+
+from pinot_tpu.tools.cluster_harness import run_hbm_pressure_scenario
+
+
+@pytest.mark.chaos
+def test_hbm_pressure_scenario_cycles_tiers_with_zero_failures(tmp_path):
+    out = run_hbm_pressure_scenario(
+        num_tables=8,
+        rows_per_table=64,
+        clients=2,
+        baseline_s=0.6,
+        load_s=2.0,
+        data_dir=str(tmp_path),
+        seed=421,
+    )
+
+    # the headline: nothing failed while addressable >> HBM cap
+    assert out["failedQueries"] == 0, out
+    assert out["sweepErrors"] == [], out["sweepErrors"][:3]
+    assert out["addressable_over_cap"] >= 4.0
+    assert out["addressableBytes"] > out["hbmCapBytes"]
+
+    # tiers actually cycled: victims left HBM AND came back
+    assert out["demotions"] > 0
+    assert out["promotions"] > 0
+    assert out["cold_loads"] > 0
+    assert out["coldSweeps"] > 0
+
+    # the hot set stayed bounded — generous band, this is a CI box
+    assert out["hotLoad"]["okQueries"] > 0
+    assert out["hot_p99_ms"] <= 10.0 * max(out["baseline_p99_ms"], 25.0)
+
+    # OOM healed as its own class: answered on device, never poisoned
+    assert out["oomHealed"] is True
+    heal = out["selfHealing"]
+    assert heal["resourceExhausted"] >= 1
+    assert heal["poisonedPlans"] == 0

@@ -1,0 +1,240 @@
+"""Tiered staged-table residency (engine/residency.py, ISSUE 18):
+snapshot/restore byte identity, ledger-exact tier transitions, pin
+refcounts vs the victim picker, warm -> cold spill and reload, and the
+entry-cap demotion racing concurrent staging."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine import device as device_mod
+from pinot_tpu.engine.device import (
+    _ROLE_ATTRS,
+    LEDGER,
+    clear_staging_cache,
+    get_staged,
+)
+from pinot_tpu.engine.residency import (
+    RESIDENCY,
+    restore_staged,
+    snapshot_staged,
+)
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.tools.datagen import make_test_schema, random_rows
+
+SCHEMA = make_test_schema(with_mv=True)
+COLS = ["dimStr", "dimInt", "metInt", "metDouble", "dimIntMV"]
+
+
+def _make_segs(table: str, n: int = 200, seed: int = 5):
+    rows = random_rows(SCHEMA, n, seed=seed)
+    return [
+        build_segment(SCHEMA, rows[: n // 2], table, f"{table}a"),
+        build_segment(SCHEMA, rows[n // 2 :], table, f"{table}b"),
+    ]
+
+
+@pytest.fixture(autouse=True)
+def _clean_tiers():
+    clear_staging_cache()
+    yield
+    for k in ("PINOT_TPU_HBM_CAP_BYTES", "PINOT_TPU_HOST_CAP_BYTES",
+              "PINOT_TPU_STAGE_CACHE_ENTRIES"):
+        os.environ.pop(k, None)
+    clear_staging_cache()
+
+
+def _arrays_of(st):
+    """Every device array of a StagedTable as numpy, keyed by
+    (column index, role attr) — the byte-identity comparison set."""
+    out = {}
+    for name, col in st.columns.items():
+        for attr, _ in _ROLE_ATTRS:
+            arr = getattr(col, attr, None)
+            if arr is not None:
+                out[(name, attr)] = np.asarray(arr)
+    out[("nd", "num_docs_arr")] = np.asarray(st.num_docs_arr)
+    return out
+
+
+def test_snapshot_restore_round_trip_is_byte_identical():
+    segs = _make_segs("rtrip")
+    st = get_staged(segs, COLS, raw_columns=["metDouble"])
+    before = _arrays_of(st)
+    snap, nbytes = snapshot_staged(st)
+    assert nbytes > 0
+    restored = restore_staged(snap)
+    after = _arrays_of(restored)
+    assert sorted(before) == sorted(after)
+    for k in before:
+        assert np.array_equal(before[k], after[k]), k
+    # alias safety: promotion mints a NEW process-unique token
+    assert restored.token != st.token
+    # packed metadata survives (names, pads, cardinalities)
+    assert sorted(st.columns) == sorted(restored.columns)
+    for name, a in st.columns.items():
+        b = restored.columns[name]
+        assert (a.stored_type, a.single_value, a.cards) == (
+            b.stored_type, b.single_value, b.cards
+        )
+
+
+def test_demote_promote_keeps_ledger_exact():
+    segs = _make_segs("ledg")
+    st = get_staged(segs, COLS)
+    hot_bytes = LEDGER.total_bytes()
+    assert hot_bytes > 0
+    key = RESIDENCY._token_keys[st.token]
+    os.environ["PINOT_TPU_HBM_CAP_BYTES"] = "1"
+    freed = RESIDENCY.enforce()
+    assert freed > 0
+    # demotion IS a ledger drop: hot bytes return to zero while the
+    # warm snapshot holds the payload
+    assert LEDGER.total_bytes() == 0
+    assert RESIDENCY.warm_bytes() > 0
+    assert key not in device_mod._stage_cache
+    os.environ.pop("PINOT_TPU_HBM_CAP_BYTES")
+    # promotion re-registers the same footprint
+    st2 = get_staged(segs, COLS)
+    assert st2.token != st.token
+    assert LEDGER.total_bytes() == hot_bytes
+    assert RESIDENCY.counter("promotions") == 1
+    assert RESIDENCY.counter("demotions") == 1
+
+
+def test_promoted_arrays_match_fresh_staging():
+    segs = _make_segs("prom")
+    st = get_staged(segs, COLS, raw_columns=["metInt"])
+    want = _arrays_of(st)
+    os.environ["PINOT_TPU_HBM_CAP_BYTES"] = "1"
+    RESIDENCY.enforce()
+    os.environ.pop("PINOT_TPU_HBM_CAP_BYTES")
+    got = _arrays_of(get_staged(segs, COLS, raw_columns=["metInt"]))
+    assert sorted(want) == sorted(got)
+    for k in want:
+        assert np.array_equal(want[k], got[k]), k
+
+
+def test_warm_spills_cold_and_reloads():
+    segs = _make_segs("cold")
+    st = get_staged(segs, COLS)
+    want = _arrays_of(st)
+    os.environ["PINOT_TPU_HBM_CAP_BYTES"] = "1"
+    os.environ["PINOT_TPU_HOST_CAP_BYTES"] = "1"
+    RESIDENCY.enforce()
+    assert RESIDENCY.counter("coldDemotions") == 1
+    assert RESIDENCY.cold_bytes() > 0
+    assert RESIDENCY.warm_bytes() == 0
+    os.environ.pop("PINOT_TPU_HBM_CAP_BYTES")
+    os.environ.pop("PINOT_TPU_HOST_CAP_BYTES")
+    got = _arrays_of(get_staged(segs, COLS))
+    assert RESIDENCY.counter("coldLoads") == 1
+    assert RESIDENCY.counter("promotions") == 1
+    for k in want:
+        assert np.array_equal(want[k], got[k]), k
+    # byte identity across ALL THREE states (hot -> warm -> cold ->
+    # hot) is the zero-re-encode contract
+
+
+def test_pin_blocks_demotion_until_unpin():
+    segs = _make_segs("pin")
+    st = get_staged(segs, COLS, pin=True)
+    os.environ["PINOT_TPU_HBM_CAP_BYTES"] = "1"
+    assert RESIDENCY.enforce() == 0  # pinned: not a victim
+    assert LEDGER.total_bytes() > 0
+    RESIDENCY.unpin(st.token)
+    assert RESIDENCY.enforce() > 0
+    assert LEDGER.total_bytes() == 0
+
+
+def test_pin_refcount_survives_nested_queries():
+    segs = _make_segs("ref")
+    st = get_staged(segs, COLS, pin=True)
+    get_staged(segs, COLS, pin=True)  # same key: second in-flight query
+    assert RESIDENCY.pin_count(st.token) == 2
+    RESIDENCY.unpin(st.token)
+    os.environ["PINOT_TPU_HBM_CAP_BYTES"] = "1"
+    assert RESIDENCY.enforce() == 0  # still one holder
+    RESIDENCY.unpin(st.token)
+    assert RESIDENCY.enforce() > 0
+    assert RESIDENCY.pin_count(st.token) == 0
+
+
+def test_entry_cap_demotes_coldest_not_clears_all():
+    os.environ["PINOT_TPU_STAGE_CACHE_ENTRIES"] = "2"
+    all_segs = [_make_segs(f"cap{i}", n=60, seed=i) for i in range(4)]
+    for segs in all_segs:
+        get_staged(segs, COLS)
+    # cache bounded, nothing lost: overflow went warm, not dropped
+    assert len(device_mod._stage_cache) <= 2
+    assert RESIDENCY.counter("demotions") >= 2
+    snap = RESIDENCY.snapshot()
+    assert snap["hotTables"] + snap["warmTables"] + snap["coldTables"] == 4
+
+
+def test_concurrent_staging_races_entry_cap_eviction():
+    """Threads staging distinct tables under a tiny entry cap while
+    re-promoting each other's victims: every get_staged must return a
+    correct pinned table (pin taken inside the key lock), and the
+    refcounts must drain to zero."""
+    os.environ["PINOT_TPU_STAGE_CACHE_ENTRIES"] = "2"
+    tables = [_make_segs(f"race{i}", n=60, seed=10 + i) for i in range(5)]
+    want_nd = [
+        sum(s.metadata.num_docs for s in segs) for segs in tables
+    ]
+    errors = []
+
+    def worker(idx: int) -> None:
+        try:
+            for round_ in range(8):
+                segs = tables[(idx + round_) % len(tables)]
+                st = get_staged(segs, COLS, pin=True)
+                try:
+                    nd = int(np.asarray(st.num_docs_arr).sum())
+                    expect = want_nd[(idx + round_) % len(tables)]
+                    if nd != expect:
+                        errors.append(f"docs {nd} != {expect}")
+                finally:
+                    RESIDENCY.unpin(st.token)
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors[:4]
+    snap = RESIDENCY.snapshot()
+    assert snap["pinnedTokens"] == 0
+    assert len(device_mod._stage_cache) <= 2 + 4  # cap + in-flight pins
+
+
+def test_clear_staging_cache_resets_all_tiers():
+    segs = _make_segs("clr")
+    get_staged(segs, COLS)
+    os.environ["PINOT_TPU_HBM_CAP_BYTES"] = "1"
+    RESIDENCY.enforce()
+    assert RESIDENCY.warm_bytes() > 0
+    clear_staging_cache()
+    snap = RESIDENCY.snapshot()
+    assert snap["hotTables"] == snap["warmTables"] == snap["coldTables"] == 0
+    # a retained warm copy would silently turn the next stage into a
+    # promotion — clear means clear
+    os.environ.pop("PINOT_TPU_HBM_CAP_BYTES")
+    get_staged(segs, COLS)
+    assert RESIDENCY.counter("promotions") == 0
+
+
+def test_drop_segment_drops_every_tier():
+    segs = _make_segs("dropseg")
+    get_staged(segs, COLS)
+    os.environ["PINOT_TPU_HBM_CAP_BYTES"] = "1"
+    RESIDENCY.enforce()
+    os.environ.pop("PINOT_TPU_HBM_CAP_BYTES")
+    assert RESIDENCY.drop_segment(segs[0].segment_name) == 1
+    assert RESIDENCY.warm_bytes() == 0
+    # the quarantine path: a later re-stage starts from source
+    get_staged(segs, COLS)
+    assert RESIDENCY.counter("promotions") == 0

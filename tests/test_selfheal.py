@@ -256,6 +256,36 @@ def test_stalled_dispatch_restarts_lane_and_still_answers(heal_stack):
 
 
 @pytest.mark.chaos
+def test_alloc_failure_heals_as_resource_exhausted(heal_stack):
+    """A device allocation failure (injected RESOURCE_EXHAUSTED) is a
+    distinct heal class: demote-and-retry answers on DEVICE with
+    byte-identical results — no host failover, no plan poisoning."""
+    broker, reference, inj = heal_stack
+    pql = CHAOS_QUERIES[1]
+    want = _payload(reference.handle_pql(pql))
+    server = broker.local_servers[0]
+    heal0 = dict(server.status()["selfHealing"])
+
+    inj.alloc_fail_next(1)
+    resp = broker.handle_pql(pql)
+    assert not resp.exceptions
+    assert _payload(resp) == want
+    assert "alloc_fail" in [r.outcome for r in inj.launches]
+
+    heal = server.status()["selfHealing"]
+    assert heal["resourceExhausted"] >= heal0["resourceExhausted"] + 1
+    assert heal["deviceFailures"] >= heal0["deviceFailures"] + 1
+    # OOM never poisons and never leaves the device
+    assert heal["hostFailovers"] == heal0["hostFailovers"]
+    assert heal["poisonedPlans"] == heal0["poisonedPlans"]
+
+    # the healed plan keeps serving on device afterwards
+    again = broker.handle_pql(pql)
+    assert _payload(again) == want
+    assert inj.launches[-1].outcome == "ok"
+
+
+@pytest.mark.chaos
 def test_coalesced_waiters_all_get_failover_result(heal_stack):
     """Acceptance (c): waiters coalesced onto a failing dispatch all
     receive the failover RESULT — never the raw device exception."""
