@@ -262,12 +262,14 @@ def _closed_loop(broker, queries, clients: int, duration_s: float) -> dict:
 def _strip_timing(resp) -> str:
     """Canonical BrokerResponse payload for differential comparison:
     everything except the wall-clock field, the broker-assigned
-    per-query requestId, and the cost vector (path-dependent by
+    per-query requestId, the cost vector (path-dependent by
     construction: serial vs pipelined time device work differently and
-    coalesce hits only exist pipelined)."""
+    coalesce hits only exist pipelined), and the event-time freshness
+    stamp (wall-clock-relative by definition — two executions of the
+    same query legitimately observe different staleness)."""
     return json.dumps(
         {k: v for k, v in resp.to_json().items()
-         if k not in ("timeUsedMs", "requestId", "cost")},
+         if k not in ("timeUsedMs", "requestId", "cost", "freshnessMs")},
         sort_keys=True,
     )
 
@@ -791,6 +793,94 @@ def _serving_main() -> None:
     print(json.dumps(doc, indent=1))
 
 
+def _audit_main() -> None:
+    """Audit-plane mode (PINOT_TPU_BENCH_MODE=audit, ISSUE 19): the two
+    numbers the audit plane must keep honest forever.  (1) Overhead —
+    closed-loop ok-QPS on two fresh identical brokers, audit defaults ON
+    (shadow sampler + replica double-scatter at their shipped 1-in-N
+    rates) vs audit fully OFF (PINOT_TPU_AUDIT_SAMPLE_N=0,
+    PINOT_TPU_AUDIT_REPLICA_N=0); the sampling-overhead traps from
+    serving mode apply verbatim (pre-opened admission window, ok-QPS
+    ratio, never raw qps).  (2) Detection — the seeded wrong-answer
+    scenario from tools/cluster_harness.py: arm a device-tier result
+    corruption under load, measure how long the shadow auditor takes to
+    flag + quarantine it.  Prints ONE JSON document (perf-gated by
+    tools/perf_gate.py AUDIT_METRIC_SPECS against AUDIT_r19.json)."""
+    from pinot_tpu.tools.cluster_harness import (
+        run_audit_divergence_scenario,
+        single_server_broker,
+    )
+
+    num_segments = int(os.environ.get("PINOT_TPU_BENCH_SEGMENTS", "4"))
+    rows_per_segment = int(os.environ.get("PINOT_TPU_BENCH_ROWS_PER_SEGMENT", "250000"))
+    duration_s = float(os.environ.get("PINOT_TPU_BENCH_AUDIT_DURATION_S", "6"))
+    clients = int(os.environ.get("PINOT_TPU_BENCH_AUDIT_CLIENTS", "16"))
+
+    segments = _build_segments(num_segments, rows_per_segment)
+
+    import sys
+
+    import jax
+
+    doc = {
+        "metric": "audit_overhead_ok_qps_ratio",
+        "platform": jax.devices()[0].platform,
+        "num_segments": num_segments,
+        "total_rows": num_segments * rows_per_segment,
+        "duration_s": duration_s,
+        "clients": clients,
+    }
+
+    runs = {}
+    for key in ("on", "off"):
+        os.environ["PINOT_TPU_ADMISSION_WINDOW_INIT"] = str(max(64, 2 * clients))
+        if key == "off":
+            os.environ["PINOT_TPU_AUDIT_SAMPLE_N"] = "0"
+            os.environ["PINOT_TPU_AUDIT_REPLICA_N"] = "0"
+        try:
+            b = single_server_broker("lineitem", segments, pipeline=True)
+        finally:
+            os.environ.pop("PINOT_TPU_ADMISSION_WINDOW_INIT", None)
+            os.environ.pop("PINOT_TPU_AUDIT_SAMPLE_N", None)
+            os.environ.pop("PINOT_TPU_AUDIT_REPLICA_N", None)
+        for _ in range(2):  # warm staging + compile before measuring
+            resp = b.handle_pql(Q1_PQL)
+            assert not resp.exceptions, resp.exceptions
+        runs[key] = _closed_loop(b, [Q1_PQL], clients, duration_s)
+        server = b.local_servers[0]
+        runs[key]["audit"] = server.auditor.snapshot()
+        server.auditor.stop()
+        b.shutdown()
+        print(json.dumps({"mode_done": f"audit-overhead-{key}"}),
+              file=sys.stderr, flush=True)
+    on_run, off_run = runs["on"], runs["off"]
+    ratio = round(on_run["ok_qps"] / max(off_run["ok_qps"], 1e-9), 4)
+    doc["value"] = ratio
+    doc["audit_overhead"] = {
+        "auditOnQps": on_run["ok_qps"],
+        "auditOffQps": off_run["ok_qps"],
+        "okQpsRatio": ratio,
+        "auditOnP99Ms": on_run["p99_ms"],
+        "auditOffP99Ms": off_run["p99_ms"],
+        "errors": {"on": on_run["errors"], "off": off_run["errors"]},
+        "auditorOn": on_run["audit"],
+        "note": "ok-qps (shed/error responses excluded) on fresh identical "
+        "pipelined brokers with the admission window pre-opened; on = "
+        "shipped audit defaults (shadow 1-in-64, replica 1-in-256, "
+        "budgeted background oracle re-execution), off = both samplers "
+        "disabled; repeated_q1 closed loop",
+    }
+
+    res = run_audit_divergence_scenario()
+    print(json.dumps({"mode_done": "audit-divergence"}), file=sys.stderr, flush=True)
+    doc["divergence"] = res
+    doc["detect_ms"] = res.get("detectMs")
+    doc["detected"] = 1 if res.get("detected") else 0
+    doc["post_quarantine_mismatches"] = res.get("postQuarantineMismatches")
+    doc["divergence_failed_queries"] = res.get("failedQueries")
+    print(json.dumps(doc, indent=1))
+
+
 def _multichip_main() -> None:
     """Mesh serving-ladder mode (PINOT_TPU_BENCH_MODE=multichip): the
     SAME broker-path workload served by three execution-plane configs
@@ -1027,6 +1117,14 @@ def main() -> None:
     if mode == "join":
         try:
             _join_main()
+        finally:
+            if deadline is not None:
+                deadline.cancel()
+        return
+
+    if mode == "audit":
+        try:
+            _audit_main()
         finally:
             if deadline is not None:
                 deadline.cancel()

@@ -234,8 +234,18 @@ class BrokerRequestHandler:
                 "slo": self.slo.snapshot,
                 "workload": lambda: self.workload_snapshot(top=20),
                 "admission": self.admission.snapshot,
+                # lazy: the replica auditor is constructed just below
+                "audit": lambda: self.replica_audit.snapshot(),
             },
         )
+        # correctness & freshness audit plane (ISSUE 19): background
+        # replica divergence sampler (utils/audit.py, always-on unless
+        # PINOT_TPU_AUDIT_REPLICA_N=0) + the event-time freshness
+        # timer, pre-registered so /metrics shows the series at zero
+        from pinot_tpu.utils.audit import ReplicaAuditor
+
+        self.replica_audit = ReplicaAuditor(self)
+        self.metrics.timer("freshness.lagMs")
         self._last_dropped = 0
         self._shed_burst_threshold = max(
             1, int(os.environ.get("PINOT_TPU_FLIGHTREC_SHED_BURST", "32"))
@@ -417,6 +427,7 @@ class BrokerRequestHandler:
             getattr(request, "table_name", "") or "",
             resp.time_used_ms,
             failed_q,
+            freshness_ms=resp.freshness_ms,
         )
         phases = dict(getattr(resp, "phase_ms", ()) or ())
         phases["parse"] = round(parse_ms, 3)
@@ -437,6 +448,14 @@ class BrokerRequestHandler:
                 "planDigest": plan_digest,
                 "table": getattr(request, "table_name", None),
                 "timeUsedMs": round(resp.time_used_ms, 3),
+                # event-time staleness of the served answer (None for
+                # offline-only queries): the /debug/queries twin of the
+                # response's freshnessMs
+                "freshnessMs": (
+                    round(resp.freshness_ms, 3)
+                    if resp.freshness_ms is not None
+                    else None
+                ),
                 "phasesMs": phases,
                 # the merged cost vector: "why was this slow" answerable
                 # from the log entry alone (rows/bytes, device vs host)
@@ -498,6 +517,7 @@ class BrokerRequestHandler:
     def shutdown(self) -> None:
         """Stop the history recorder thread (idempotent); the scatter
         pool's daemon workers die with the process as before."""
+        self.replica_audit.stop()
         self.history.stop()
 
     def handle_request(
@@ -658,6 +678,25 @@ class BrokerRequestHandler:
         red_ms = (time.perf_counter() - t_red) * 1000
         self.metrics.timer("reduce").update(red_ms)
         resp.request_id = request_id
+        # event-time freshness: now − the stalest realtime watermark
+        # that contributed to this answer (server stamps min-combine
+        # across the gather; broker derives the client-visible lag).
+        # Offline-only answers have no stamped part and keep the key
+        # absent — byte-identical to the pre-audit-plane payload.
+        fmins = [
+            p.freshness["minEventMs"]
+            for p in parts
+            if getattr(p, "freshness", None) is not None
+            and p.freshness.get("minEventMs") is not None
+        ]
+        if fmins:
+            from pinot_tpu.broker.freshness import now_ms
+
+            resp.freshness_ms = max(0.0, now_ms() - min(fmins))
+            self.metrics.timer("freshness.lagMs").update(resp.freshness_ms)
+            self.metrics.gauge(f"freshness.{table}.lagMs").set(
+                round(resp.freshness_ms, 3)
+            )
         if request.explain:
             resp.explain = self._assemble_explain(request, plan_nodes, resp)
         # per-table cost attribution into the metrics registry: who is
@@ -696,6 +735,10 @@ class BrokerRequestHandler:
             "scatterGather": round(sg_ms, 3),
             "reduce": round(red_ms, 3),
         }
+        # replica-divergence sampling hook (utils/audit.py): a cheap
+        # counter for the non-sampled majority, a bounded background
+        # re-issue for the winners
+        self.replica_audit.offer(request, batches, request_id, timeout_ms, resp)
         return resp
 
     def _assemble_explain(
@@ -738,6 +781,8 @@ class BrokerRequestHandler:
                 for k, v in sorted(resp.cost.items())
             }
             out["actualDocsScanned"] = resp.num_docs_scanned
+            if resp.freshness_ms is not None:
+                out["freshnessMs"] = round(resp.freshness_ms, 3)
         return out
 
     def workload_snapshot(self, top: int = 20, tables=None) -> Dict[str, Any]:
@@ -1383,6 +1428,17 @@ class BrokerHttpServer:
                         )
                     if url.path == "/debug/flightrec":
                         return self._respond(broker.flightrec.snapshot())
+                    if url.path == "/debug/audit":
+                        # correctness & freshness plane: replica-audit
+                        # counters + the event-time watermark summary
+                        from pinot_tpu.broker.freshness import WATERMARKS
+
+                        return self._respond(
+                            {
+                                "replica": broker.replica_audit.snapshot(),
+                                "freshness": WATERMARKS.snapshot(),
+                            }
+                        )
                     if url.path == "/debug/workload":
                         qs = parse_qs(url.query)
                         # ?n= is the prewarm-facing alias for ?top=

@@ -57,6 +57,20 @@ class SlowQueryLog:
                 self._ring.append(dict(entry, ts=round(time.time(), 3)))
         return notable
 
+    def annotate(self, request_id: str, **kv: Any) -> bool:
+        """Post-hoc enrichment of a recorded entry (the audit plane's
+        ``auditRef`` cross-link lands AFTER the query was logged — the
+        audit runs asynchronously).  Returns True when the entry was
+        still in the ring."""
+        if not request_id:
+            return False
+        with self._lock:
+            for entry in self._ring:
+                if entry.get("requestId") == request_id:
+                    entry.update(kv)
+                    return True
+        return False
+
     def entries(self) -> List[Dict[str, Any]]:
         """Newest first."""
         with self._lock:

@@ -310,6 +310,11 @@ def serialize_result(res: IntermediateResult) -> bytes:
     # non-join reply, absent for peers predating the join plane
     w.value(getattr(res, "join_payload", None))
 
+    # trailing optional event-time freshness stamp ({"minEventMs": ...},
+    # broker/freshness.py): None for offline-only replies, absent for
+    # peers predating the audit plane — same mixed-version contract
+    w.value(getattr(res, "freshness", None))
+
     payload = w.getvalue()
     return MAGIC + struct.pack("<Q", len(payload)) + payload
 
@@ -357,6 +362,9 @@ def deserialize_result(data: bytes) -> IntermediateResult:
     if r.pos < len(r.data):
         # trailing join-exchange payload (absent from older peers)
         res.join_payload = r.value()
+    if r.pos < len(r.data):
+        # trailing event-time freshness stamp (absent from older peers)
+        res.freshness = r.value()
     return res
 
 

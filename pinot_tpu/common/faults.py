@@ -153,7 +153,7 @@ class LaunchRecord:
 
     digest: Optional[str]
     # "ok" | "fail_next" | "alloc_fail" | "error_rate" | "alloc_rate"
-    # | "poison" | "stall"
+    # | "poison" | "stall" | "corrupt"
     outcome: str
 
 
@@ -180,6 +180,14 @@ class DeviceFaultInjector:
       the plan to the device at all.
     - ``error_rate``                  — each launch fails (retryable)
       with probability p from a seeded RNG.
+    - ``corrupt_results(n, tier=..., digest_substring=..., delta=...)``
+      — WRONG-ANSWER injection for the audit plane (utils/audit.py):
+      unlike every mode above, a corrupted execution SUCCEEDS — the
+      executor consults ``check_corrupt`` after the tier produced its
+      result and perturbs one numeric aggregation partial by ``delta``.
+      No error is raised, so the self-healing ladder (retry, failover,
+      poison) can NEVER catch it; only the shadow differential audit
+      can.  The host tier is never corrupted (it is the oracle).
     - ``alloc_error_rate``            — each launch raises the raw
       RESOURCE_EXHAUSTED error with probability p from the same seeded
       RNG (sustained memory pressure, not a one-shot).
@@ -200,6 +208,10 @@ class DeviceFaultInjector:
         self._poisoned: set = set()
         self.error_rate = 0.0
         self.alloc_error_rate = 0.0
+        self._corrupt_next = 0
+        self._corrupt_tier = ""
+        self._corrupt_digest = ""
+        self._corrupt_delta = 1.0
 
     # -- fault programming --------------------------------------------
     def fail_next(self, n: int, retryable: bool = True) -> None:
@@ -220,6 +232,47 @@ class DeviceFaultInjector:
         with self._lock:
             self._poisoned.add(digest)
 
+    def corrupt_results(
+        self,
+        n: int = 1,
+        tier: str = "",
+        digest_substring: str = "",
+        delta: float = 1.0,
+    ) -> None:
+        """Arm wrong-answer injection: the next ``n`` executions whose
+        serving tier matches ``tier`` (empty = any non-host tier) and
+        whose plan-shape digest contains ``digest_substring`` get one
+        numeric aggregation partial perturbed by ``delta``."""
+        with self._lock:
+            self._corrupt_next = n
+            self._corrupt_tier = tier
+            self._corrupt_digest = digest_substring
+            self._corrupt_delta = delta
+
+    @property
+    def corruption_armed(self) -> bool:
+        """Cheap pre-check so the executor only derives a plan digest
+        for the consult when a corruption budget is actually armed."""
+        return self._corrupt_next > 0
+
+    def check_corrupt(self, plan_digest: Optional[str], tier: str) -> Optional[float]:
+        """Executor consult after a tier produced a result: the delta to
+        apply, or None.  Decrements the armed budget on a match."""
+        with self._lock:
+            if self._corrupt_next <= 0:
+                return None
+            if tier == "host":
+                return None  # the oracle stays correct, always
+            if self._corrupt_tier and tier != self._corrupt_tier:
+                return None
+            if self._corrupt_digest and self._corrupt_digest not in (
+                plan_digest or ""
+            ):
+                return None
+            self._corrupt_next -= 1
+            self.launches.append(LaunchRecord(plan_digest, "corrupt"))
+            return self._corrupt_delta
+
     def heal(self) -> None:
         with self._lock:
             self._fail_next = 0
@@ -229,6 +282,10 @@ class DeviceFaultInjector:
             self._poisoned.clear()
             self.error_rate = 0.0
             self.alloc_error_rate = 0.0
+            self._corrupt_next = 0
+            self._corrupt_tier = ""
+            self._corrupt_digest = ""
+            self._corrupt_delta = 1.0
 
     def records_for(self, outcome: str) -> List[LaunchRecord]:
         with self._lock:
@@ -288,6 +345,31 @@ class DeviceFaultInjector:
             # sleep OUTSIDE the injector lock, inside the lane thread:
             # this is the wedge the watchdog must detect
             time.sleep(stall)
+
+
+def apply_result_corruption(result, delta: float) -> bool:
+    """Perturb one numeric field of ``result``'s first aggregation
+    partial (scalar list or first group) in place — the wrong-answer the
+    armed ``corrupt_results`` mode injects.  Returns True when a field
+    was actually perturbed (selection-only results have no numeric
+    partial to corrupt and stay untouched)."""
+    partials = None
+    aggs = getattr(result, "aggregations", None)
+    if aggs:
+        partials = aggs
+    else:
+        groups = getattr(result, "groups", None)
+        if groups:
+            partials = groups[next(iter(groups))]
+    if not partials:
+        return False
+    p = partials[0]
+    for attr in ("count", "total", "value", "mn", "mx"):
+        v = getattr(p, attr, None)
+        if isinstance(v, float):
+            setattr(p, attr, v + float(delta))
+            return True
+    return False
 
 
 # ---------------------------------------------------------------------------

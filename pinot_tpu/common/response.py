@@ -138,6 +138,14 @@ class BrokerResponse:
     # for EXPLAIN / EXPLAIN ANALYZE queries (the structured plan tree)
     plan_digest: str = ""
     explain: Optional[Dict[str, Any]] = None
+    # event-time freshness of the answer (broker/freshness.py): now −
+    # the stalest consumed event-time watermark over the realtime
+    # partitions that served this query.  None for offline-only answers
+    # — the key is then absent from the JSON, so pure-offline responses
+    # stay byte-identical to the pre-audit-plane payloads.  Like
+    # timeUsedMs/requestId, every byte-identity differential oracle
+    # strips it (it is wall-clock-dependent accounting, not data).
+    freshness_ms: Optional[float] = None
 
     def to_json(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
@@ -167,6 +175,8 @@ class BrokerResponse:
                 for k, v in sorted(self.cost.items())
             }
         d["timeUsedMs"] = round(self.time_used_ms, 3)
+        if self.freshness_ms is not None:
+            d["freshnessMs"] = round(self.freshness_ms, 3)
         if self.plan_digest:
             d["planDigest"] = self.plan_digest
         if self.explain is not None:

@@ -22,6 +22,7 @@ from pinot_tpu.common.schema import Schema
 from pinot_tpu.common.tableconfig import TableConfig
 from pinot_tpu.controller import dashboard
 from pinot_tpu.controller.managers import (
+    CrcAuditManager,
     RetentionManager,
     SegmentStatusChecker,
     ValidationManager,
@@ -75,6 +76,9 @@ class Controller:
             self.resources, realtime_manager=self.realtime_manager
         )
         self.status_checker = SegmentStatusChecker(self.resources)
+        # correctness audit plane (ISSUE 19): periodic cross-replica
+        # CRC sweep over every alive server's /debug/segments claims
+        self.crc_audit = CrcAuditManager(self.resources)
 
         from pinot_tpu.controller.stabilizer import SelfStabilizer
 
@@ -156,6 +160,7 @@ class Controller:
             self.retention_manager.start()
             self.validation_manager.start()
             self.status_checker.start()
+            self.crc_audit.start()
             self.stabilizer.start()
 
     def _recover(self) -> None:
@@ -447,6 +452,7 @@ class Controller:
         self.retention_manager.stop()
         self.validation_manager.stop()
         self.status_checker.stop()
+        self.crc_audit.stop()
         self.stabilizer.stop()
 
 
@@ -1215,6 +1221,9 @@ class ControllerHttpServer:
                         )
                     if parts == ["debug", "flightrec"]:
                         return self._respond(ctrl.flightrec.snapshot())
+                    if parts == ["debug", "audit"]:
+                        # cross-replica CRC sweep rollup (CrcAuditManager)
+                        return self._respond(ctrl.crc_audit.snapshot())
                     if parts == ["debug", "stabilizer"]:
                         return self._respond(ctrl.stabilizer.debug_snapshot())
                     if len(parts) == 3 and parts[0] == "instances" and parts[2] == "drain":

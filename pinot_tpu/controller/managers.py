@@ -165,6 +165,117 @@ class ValidationManager(_PeriodicManager):
             self.realtime_manager.ensure_consuming_segments()
 
 
+class CrcAuditManager(_PeriodicManager):
+    """Cross-replica checksum sweep (ISSUE 19, the control-plane half of
+    the audit plane): every round pulls each alive server's claimed
+    segment CRCs (``/debug/segments``) and compares the replica sets —
+    against each other AND against the property-store metadata CRC the
+    segment was registered with.  A disagreement means replicas of the
+    same immutable segment serve different bytes (torn download, bit
+    rot, a stale copy a failed refresh left behind) — the divergence
+    class the per-query shadow auditor cannot see because a broker
+    normally scatters each segment to exactly one replica.
+
+    Consuming mutable segments carry no CRC claim and are skipped; a
+    server with no admin URL (in-process deployments) is skipped and
+    counted, never treated as divergent.  The fetch is pluggable
+    (``crc_fn(name, url) -> {table: {segment: crc}}``) so tests drive
+    the sweep deterministically without HTTP."""
+
+    def __init__(
+        self,
+        resources: ClusterResourceManager,
+        interval_s: float = 300.0,
+        crc_fn=None,
+        timeout_s: float = 3.0,
+    ) -> None:
+        super().__init__(interval_s, metrics_scope="crcAudit")
+        self.resources = resources
+        self.crc_fn = crc_fn or self._http_crcs
+        self.timeout_s = timeout_s
+        self._rollup_lock = threading.Lock()
+        self._last: Dict = {"runs": 0, "segmentsChecked": 0, "mismatches": []}
+        # pre-registered so the sweep plane shows zeros before round one
+        for m in (
+            "audit.sweep.runs",
+            "audit.sweep.segmentsChecked",
+            "audit.sweep.skippedInstances",
+        ):
+            self.metrics.meter(m)
+        self.metrics.gauge("audit.crcMismatches").set(0)
+
+    def _http_crcs(self, name: str, url: str) -> Dict:
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(
+            url.rstrip("/") + "/debug/segments", timeout=self.timeout_s
+        ) as resp:
+            return json.loads(resp.read().decode()).get("segments", {})
+
+    def run_once(self) -> None:
+        per_server: Dict[str, Dict] = {}
+        skipped = 0
+        for inst in self.resources.instances_snapshot():
+            if inst.role != "server" or not inst.alive:
+                continue
+            if not inst.url:
+                skipped += 1
+                continue
+            try:
+                per_server[inst.name] = self.crc_fn(inst.name, inst.url) or {}
+            except Exception:
+                skipped += 1
+        checked = 0
+        mismatches: List[Dict] = []
+        for table in self.resources.tables():
+            ideal = self.resources.get_ideal_state(table)
+            for seg, replicas in ideal.items():
+                crcs = {
+                    server: per_server[server][table][seg]
+                    for server in replicas
+                    if per_server.get(server, {}).get(table, {}).get(seg)
+                    is not None
+                }
+                if not crcs:
+                    continue
+                checked += 1
+                info = self.resources.get_segment_metadata(table, seg) or {}
+                expected = getattr(info.get("metadata"), "crc", None)
+                vals = set(crcs.values())
+                if len(vals) > 1 or (
+                    expected is not None and vals != {expected}
+                ):
+                    mismatches.append(
+                        {
+                            "table": table,
+                            "segment": seg,
+                            "expectedCrc": expected,
+                            "replicaCrcs": dict(crcs),
+                        }
+                    )
+        self.metrics.meter("audit.sweep.runs").mark()
+        self.metrics.meter("audit.sweep.segmentsChecked").mark(checked)
+        if skipped:
+            self.metrics.meter("audit.sweep.skippedInstances").mark(skipped)
+        self.metrics.gauge("audit.crcMismatches").set(len(mismatches))
+        with self._rollup_lock:
+            self._last = {
+                "runs": self._last["runs"] + 1,
+                "segmentsChecked": checked,
+                "skippedInstances": skipped,
+                "serversPolled": sorted(per_server),
+                "mismatches": mismatches,
+            }
+
+    def snapshot(self) -> Dict:
+        """Latest sweep rollup (the controller's ``/debug/audit``)."""
+        with self._rollup_lock:
+            out = dict(self._last)
+        out["intervalS"] = self.interval_s
+        return out
+
+
 class SegmentStatusChecker(_PeriodicManager):
     def __init__(self, resources: ClusterResourceManager, interval_s: float = 300.0) -> None:
         super().__init__(interval_s, metrics_scope="segmentStatus")

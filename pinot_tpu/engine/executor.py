@@ -218,6 +218,10 @@ class QueryExecutor:
         import os as _os
 
         self._poison_ttl_s = float(_os.environ.get("PINOT_TPU_POISON_TTL_S", "300"))
+        # audit-plane quarantine flag: True once any ("audit", digest,
+        # tier) key entered the poison map, so the serving path only
+        # pays a plan-digest derivation when a quarantine could apply
+        self._has_audit_poison = False
 
     # -- self-healing bookkeeping --------------------------------------
     _HEAL_COUNTERS = (
@@ -287,6 +291,114 @@ class QueryExecutor:
         rolled-out runtime fix makes old poison verdicts stale)."""
         with self._heal_lock:
             self._poisoned.clear()
+        self._has_audit_poison = False
+
+    # -- audit-plane quarantine (utils/audit.py) -----------------------
+    def audit_quarantine(self, digest: str, tier: str, reason: str) -> None:
+        """Shadow-audit verdict: ``tier`` produced a WRONG answer for
+        plan shape ``digest``.  Rides the same TTL'd poison map as the
+        device-failure quarantine — the serving path skips the
+        quarantined tier for that shape (postings/bitsliced fall
+        through to the next tier, device fails over to host) until the
+        TTL re-admits it."""
+        self._poison(("audit", str(digest), str(tier)), f"audit: {reason}")
+        self._has_audit_poison = True
+        self._heal_mark("auditQuarantines", tier=tier)
+
+    def audit_quarantined_snapshot(self) -> List[Dict[str, Any]]:
+        """Live audit-quarantine entries for ``/debug/audit``."""
+        now = time.monotonic()
+        out: List[Dict[str, Any]] = []
+        with self._heal_lock:
+            for key, (reason, exp) in self._poisoned.items():
+                if (
+                    isinstance(key, tuple)
+                    and len(key) == 3
+                    and key[0] == "audit"
+                    and now < exp
+                ):
+                    out.append(
+                        {
+                            "planDigest": key[1],
+                            "tier": key[2],
+                            "reason": reason,
+                            "ttlRemainingS": round(exp - now, 3),
+                        }
+                    )
+        return out
+
+    def _audit_digest(self, request: BrokerRequest) -> Optional[str]:
+        """The shape digest for quarantine checks — derived ONLY when
+        some audit quarantine exists (zero serving-path overhead while
+        the audit plane has never fired)."""
+        if not self._has_audit_poison:
+            return None
+        from pinot_tpu.engine.plandigest import plan_shape_digest
+
+        return plan_shape_digest(request)
+
+    def _audit_blocked(self, digest: Optional[str], tier: str) -> bool:
+        if digest is None:
+            return False
+        if self._is_poisoned(("audit", digest, tier)):
+            self._heal_mark("auditTierSkips", tier=tier)
+            return True
+        return False
+
+    def _fault_injector(self):
+        lane = self.lane
+        inj = getattr(lane, "fault_injector", None) if lane is not None else None
+        if inj is None and self.lanes is not None:
+            for lane in self.lanes.lanes:
+                inj = getattr(lane, "fault_injector", None)
+                if inj is not None:
+                    break
+        return inj
+
+    def _finish_tier(
+        self, result: IntermediateResult, request: BrokerRequest, tier: str
+    ) -> IntermediateResult:
+        """Every ``_execute_engine`` exit point: stamp which serving
+        tier produced the answer (the audit plane's quarantine key) and
+        consult the armed wrong-answer injection, if any (chaos tests
+        only — production lanes have no fault injector)."""
+        result._served_tier = tier
+        inj = self._fault_injector()
+        if inj is not None and getattr(inj, "corruption_armed", False):
+            from pinot_tpu.engine.plandigest import plan_shape_digest
+
+            delta = inj.check_corrupt(plan_shape_digest(request), tier)
+            if delta is not None:
+                from pinot_tpu.common.faults import apply_result_corruption
+
+                apply_result_corruption(result, delta)
+        return result
+
+    def execute_host_oracle(
+        self, segments: Sequence[ImmutableSegment], request: BrokerRequest
+    ) -> IntermediateResult:
+        """The shadow-audit oracle: re-execute ``request`` over the
+        exact views a production reply served, on the always-correct
+        host path — no device lane, no result cache, no tier ladder.
+        Pruning is correctness-preserving, so the payload (modulo
+        accounting) must match whatever tier served production."""
+        from pinot_tpu.engine.host_fallback import execute_host
+
+        segments = list(segments)
+        total_docs = sum(s.num_docs for s in segments)
+        live = prune_segments(segments, request)
+        if not live:
+            res = self._empty_result(request, total_docs)
+        else:
+            sel_columns = (
+                self._resolve_selection_columns(request, live[0])
+                if request.is_selection
+                else None
+            )
+            ctx = get_table_context(live)
+            res = execute_host(live, ctx, request, total_docs, sel_columns)
+        res._served_tier = "host"
+        return res
 
     # -- mesh / lane-group routing -------------------------------------
     def lane_selection(self, request: BrokerRequest):
@@ -371,6 +483,11 @@ class QueryExecutor:
                 merged.merge(p)
             merged.total_docs = total_docs
             merged.add_cost(segmentsPruned=pruned)
+            merged._served_tier = (
+                "starTree"
+                if not normal
+                else getattr(parts[-1], "_served_tier", "starTree")
+            )
             return merged
 
         result = self._execute_engine(live, request, deadline)
@@ -409,15 +526,22 @@ class QueryExecutor:
 
         ctx = get_table_context(live)
 
+        # audit-plane quarantine (utils/audit.py): a tier caught
+        # serving wrong answers for this shape is skipped — derived
+        # only while some audit quarantine is live
+        audit_digest = self._audit_digest(request)
+
         # selective predicates answer from host postings in O(matches)
         # (engine/invindex_path.py — BitmapBasedFilterOperator analog);
         # unselective ones fall through to the device scan below
         from pinot_tpu.engine.invindex_path import try_index_path
 
-        ires = try_index_path(request, live, ctx, total_docs, sel_columns)
+        ires = None
+        if not self._audit_blocked(audit_digest, "postings"):
+            ires = try_index_path(request, live, ctx, total_docs, sel_columns)
         if ires is not None:
             self._phase("indexPath", t0)
-            return ires
+            return self._finish_tier(ires, request, "postings")
 
         # mid-selectivity scalar aggregations the postings tier just
         # declined evaluate as O(bit-width) bulk-bitwise passes over
@@ -425,7 +549,7 @@ class QueryExecutor:
         # mesh placements keep the sharded scan path.  A device fault
         # here falls through to the scan section's healing loop below
         # instead of failing the query on an optimization tier.
-        if mesh is None:
+        if mesh is None and not self._audit_blocked(audit_digest, "bitsliced"):
             from pinot_tpu.engine.bitsliced import try_bitsliced_path
 
             try:
@@ -446,7 +570,7 @@ class QueryExecutor:
                 bres = None
             if bres is not None:
                 self._phase("bitslicedPath", t0)
-                return bres
+                return self._finish_tier(bres, request, "bitsliced")
 
         # queries the planner can only send to the host (group space or
         # guaranteed pair overflow) skip device staging entirely
@@ -457,7 +581,7 @@ class QueryExecutor:
 
             res = execute_host(live, ctx, request, total_docs, sel_columns)
             self._phase("hostPath", t0)
-            return res
+            return self._finish_tier(res, request, "host")
 
         # -- device section under the self-healing contract -----------
         # The WHOLE device path (staging, H2D uploads, kernel dispatch,
@@ -472,6 +596,18 @@ class QueryExecutor:
             classify_device_error,
         )
         from pinot_tpu.server.scheduler import QueryAbandonedError
+
+        if self._audit_blocked(audit_digest, "device"):
+            # wrong-answer quarantine: unlike a device FAILURE (which
+            # retries), a tier caught lying never gets another attempt
+            # inside the TTL — straight to the host oracle path
+            from pinot_tpu.engine.host_fallback import execute_host
+
+            self._heal_mark("hostFailovers", reason="auditQuarantine")
+            t0 = time.perf_counter()
+            res = execute_host(live, ctx, request, total_docs, sel_columns)
+            self._phase("hostFailover", t0)
+            return self._finish_tier(res, request, "host")
 
         poison_ref: Dict[str, Any] = {}  # device section records the key
         last: Optional[DeviceExecutionError] = None
@@ -500,9 +636,13 @@ class QueryExecutor:
                     break  # plain transients get exactly ONE device retry
                 self._heal_mark("deviceRetries")
             try:
-                return self._device_section(
-                    live, request, deadline, ctx, needed, sel_columns,
-                    pad_to, total_docs, t0, poison_ref, sel=sel, mesh=mesh,
+                return self._finish_tier(
+                    self._device_section(
+                        live, request, deadline, ctx, needed, sel_columns,
+                        pad_to, total_docs, t0, poison_ref, sel=sel, mesh=mesh,
+                    ),
+                    request,
+                    "device",
                 )
             except (QueryAbandonedError, LaneClosedError, TimeoutError):
                 raise
@@ -533,7 +673,7 @@ class QueryExecutor:
         t0 = time.perf_counter()
         res = execute_host(live, ctx, request, total_docs, sel_columns)
         self._phase("hostFailover", t0)
-        return res
+        return self._finish_tier(res, request, "host")
 
     def _device_section(
         self,

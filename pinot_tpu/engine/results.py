@@ -352,6 +352,13 @@ class IntermediateResult:
         # the result joins the reduce merge; always None on the normal
         # single-table serving path.
         self.join_payload: Optional[Dict[str, Any]] = None
+        # event-time freshness stamp (broker/freshness.py): for replies
+        # covering realtime tables, {"minEventMs": <max consumed
+        # event-time in ms, min over served partitions>}.  Merged with
+        # MIN semantics — the broker's freshnessMs must reflect the
+        # STALEST data that contributed to the answer.  None for
+        # offline-only replies and for peers predating the audit plane.
+        self.freshness: Optional[Dict[str, Any]] = None
 
     def add_cost(self, **kv: float) -> None:
         """Accumulate cost-vector components (key-wise add)."""
@@ -363,6 +370,15 @@ class IntermediateResult:
         self.exceptions.extend(other.exceptions)
         self.unserved_segments.extend(other.unserved_segments)
         self.plan_info.extend(other.plan_info)
+        # freshness min-combines: an answer is only as fresh as its
+        # stalest contributing realtime partition
+        of = getattr(other, "freshness", None)
+        if of is not None and of.get("minEventMs") is not None:
+            mine = self.freshness
+            if mine is None or mine.get("minEventMs") is None:
+                self.freshness = dict(of)
+            else:
+                mine["minEventMs"] = min(mine["minEventMs"], of["minEventMs"])
         # cost vectors are additive by construction: the broker's merged
         # totals equal the sum of the per-server totals EXACTLY
         for k, v in other.cost.items():

@@ -28,7 +28,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from pinot_tpu.common.fencing import StaleEpochError, epoch_int
-from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.schema import Schema, time_unit_to_millis
 from pinot_tpu.common.tableconfig import StreamConfig, TableConfig
 from pinot_tpu.controller.resource_manager import (
     CONSUMING,
@@ -826,10 +826,34 @@ class RealtimeSegmentDataManager:
         self._paused = False
         self._paused_gauge_name = f"ingest.paused.{table}.p{partition}"
         self._paused_fn = lambda: 1 if self._paused else 0
+        # event-time freshness (broker/freshness.py): every indexed
+        # batch advances the process-wide (table, partition) watermark
+        # to the max of the schema time column; the per-partition lag
+        # gauge (now − watermark, ms) re-registers across segment
+        # rollover exactly like ingest.lag.* — the series is continuous
+        # per (table, partition)
+        from pinot_tpu.broker.freshness import WATERMARKS, now_ms
+
+        self._time_col = schema.time_column_name
+        self._time_unit_ms = (
+            time_unit_to_millis(schema.time_field.time_unit)
+            if schema.time_field is not None
+            else None
+        )
+        self._freshness_gauge_name = f"freshness.lag.{table}.p{partition}"
+
+        def _freshness_probe(_t=table, _p=partition):
+            w = WATERMARKS.get(_t, _p)
+            return round(max(0.0, now_ms() - w), 3) if w is not None else 0
+        self._freshness_fn = _freshness_probe
         if self._metrics is not None:
             lag_key = f"{table}.p{partition}"
             self._metrics.gauge(f"ingest.lag.{lag_key}").set_fn(self._lag_probe)
             self._metrics.gauge(f"ingest.paused.{lag_key}").set_fn(self._paused_fn)
+            if self._time_col is not None:
+                self._metrics.gauge(f"freshness.lag.{lag_key}").set_fn(
+                    self._freshness_fn
+                )
 
     def lag(self) -> Optional[int]:
         """Consumer lag in rows: latest available offset on this
@@ -847,6 +871,9 @@ class RealtimeSegmentDataManager:
         if self._metrics is not None:
             self._metrics.gauge(self._lag_gauge_name).clear_fn(self._lag_probe)
             self._metrics.gauge(self._paused_gauge_name).clear_fn(self._paused_fn)
+            self._metrics.gauge(self._freshness_gauge_name).clear_fn(
+                self._freshness_fn
+            )
 
     def _mark_rows(self, n: int) -> None:
         if n and self._metrics is not None:
@@ -861,6 +888,17 @@ class RealtimeSegmentDataManager:
         cache = getattr(self.server, "result_cache", None)
         if cache is not None and cache.enabled:
             cache.on_offset_advance(self.table, self.partition, self.offset)
+
+    def _advance_watermark(self, time_values) -> None:
+        """Event-time watermark advance for one indexed batch
+        (broker/freshness.py; monotone — replays can never regress it)."""
+        if self._time_unit_ms is None:
+            return
+        from pinot_tpu.broker.freshness import WATERMARKS, batch_max_event_ms
+
+        event_ms = batch_max_event_ms(time_values, self._time_unit_ms)
+        if event_ms is not None:
+            WATERMARKS.advance(self.table, self.partition, event_ms)
 
     # -- consumption ---------------------------------------------------
     def _fetch_and_index(self, limit: int) -> int:
@@ -915,6 +953,8 @@ class RealtimeSegmentDataManager:
                 self.offset = next_offset
                 self.mutable.end_offset = next_offset
                 self._mark_rows(n)
+                if self._time_col is not None:
+                    self._advance_watermark(cols.get(self._time_col))
                 self._notify_offset_advance()
                 return n
         rows, next_offset = self.stream.fetch(self.partition, self.offset, limit)
@@ -923,6 +963,10 @@ class RealtimeSegmentDataManager:
         self.offset = next_offset
         self.mutable.end_offset = next_offset
         self._mark_rows(len(rows))
+        if rows and self._time_col is not None:
+            self._advance_watermark(
+                [r.get(self._time_col) for r in rows if self._time_col in r]
+            )
         if advanced:
             self._notify_offset_advance()
         return len(rows)

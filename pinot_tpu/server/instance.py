@@ -395,6 +395,8 @@ class ServerInstance:
                 "plans": lambda: self.plan_stats.snapshot(top=20),
                 "device": self.device_utilization,
                 "status": self.status,
+                # lazy: the auditor is constructed a few lines below
+                "audit": lambda: self.auditor.snapshot(),
             },
         )
         self._last_heal_total = 0
@@ -408,6 +410,13 @@ class ServerInstance:
         from pinot_tpu.server.prewarm import PrewarmWorker
 
         self.prewarm = PrewarmWorker(self)
+        # continuous correctness audit (utils/audit.py): background
+        # shadow differential sampler re-checking 1-in-N production
+        # replies against the host oracle — always on by default
+        # (PINOT_TPU_AUDIT_SAMPLE_N=0 disables)
+        from pinot_tpu.utils.audit import ShadowAuditor
+
+        self.auditor = ShadowAuditor(self)
 
     # serving-tier cost-vector keys mirrored into cost.tier.* meters —
     # the ONE source in engine/results.py, so a new tier cannot
@@ -619,6 +628,16 @@ class ServerInstance:
         self._record_plan_stats(req, result, outcome, exec_ms)
         self.metrics.timer("queryExecution").update(exec_ms)
         self.metrics.meter("queries").mark()
+        # event-time freshness stamp (broker/freshness.py): realtime
+        # tables carry their stalest consumed partition watermark on the
+        # reply so the broker can derive freshnessMs; offline tables
+        # have no watermark entries and stamp nothing — their payloads
+        # stay byte-identical to the pre-audit-plane wire format
+        from pinot_tpu.broker.freshness import WATERMARKS
+
+        wm = WATERMARKS.table_min_ms(req["table"])
+        if wm is not None:
+            result.freshness = {"minEventMs": wm}
         # backpressure snapshot on EVERY reply (including sheds): the
         # broker's AIMD admission window reads it to back off before
         # this server has to shed with 210s
@@ -763,9 +782,36 @@ class ServerInstance:
             "device": self.device_utilization(),
             "ingest": self.ingest_backpressure.snapshot(),
             "rescache": self.result_cache.snapshot(),
+            "audit": self.auditor.snapshot(),
             "plans": self.plan_stats.snapshot(top=20),
             "metrics": self.metrics.snapshot(),
         }
+
+    def audit_snapshot(self) -> dict:
+        """``/debug/audit`` (admin surface): the shadow-audit sampler's
+        counters + quarantined (digest, tier) pairs."""
+        return self.auditor.snapshot()
+
+    def segment_crcs(self) -> dict:
+        """``/debug/segments``: every hosted sealed segment's claimed
+        CRC, for the controller's cross-replica checksum sweep
+        (``CrcAuditManager``).  Consuming mutable segments carry no CRC
+        claim yet and are omitted."""
+        out: Dict[str, Dict[str, int]] = {}
+        for tname in self.data_manager.table_names():
+            tdm = self.data_manager.table(tname)
+            if tdm is None:
+                continue
+            acquired = tdm.acquire_segments()
+            try:
+                for sdm in acquired:
+                    meta = getattr(sdm.segment, "metadata", None)
+                    crc = getattr(meta, "crc", None)
+                    if crc is not None:
+                        out.setdefault(tname, {})[sdm.name] = int(crc)
+            finally:
+                tdm.release_segments(acquired)
+        return {"segments": out}
 
     def profile_start(self, timeout_s: Optional[float] = None) -> dict:
         """Begin (or join) an on-demand profile capture: the jax
@@ -835,6 +881,7 @@ class ServerInstance:
         occupancy sampler, and force-stop any active profile capture."""
         self.scheduler.shutdown()
         self.prewarm.stop()
+        self.auditor.stop()
         self.history.stop()
         self._stop_samplers()
         self.profiler.shutdown()
@@ -1007,6 +1054,12 @@ class ServerInstance:
                                 getattr(result, "_batch_size", 1) or 1
                             )
                         result.plan_info = [node]
+                if not missing:
+                    # shadow-audit sampling hook (utils/audit.py): the
+                    # held views pin the exact served snapshot; the
+                    # offer itself is one counter increment for the
+                    # non-sampled 1-in-N losers
+                    self.auditor.offer(req, request, views, result)
                 result.unserved_segments = missing
             finally:
                 tdm.release_segments(acquired)

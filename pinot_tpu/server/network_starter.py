@@ -119,6 +119,15 @@ class ServerAdminHttpServer:
                     from pinot_tpu.engine.residency import RESIDENCY
 
                     return self._send_json(RESIDENCY.snapshot())
+                if self.path == "/debug/segments":
+                    # per-segment CRC map for the controller's
+                    # cross-replica checksum sweep (CrcAuditManager)
+                    return self._send_json(inst.segment_crcs())
+                if self.path == "/debug/audit":
+                    # shadow-audit plane (utils/audit.py): sampler
+                    # counters, quarantined (digest, tier) pairs, and
+                    # the recent-divergence ring
+                    return self._send_json(inst.audit_snapshot())
                 from urllib.parse import parse_qs, urlparse
 
                 url = urlparse(self.path)
@@ -267,10 +276,35 @@ class RemoteConsumer:
         self._paused = False
         self._paused_gauge_name = f"ingest.paused.{table}.p{self.partition}"
         self._paused_fn = lambda: 1 if self._paused else 0
+        # event-time freshness (broker/freshness.py): this consumer
+        # advances the per-(table, partition) watermark from the schema
+        # time column as it indexes — the same series the in-process
+        # consumer (realtime/llc.py) reports, keyed so rollover and
+        # pool resizes keep it continuous
+        from pinot_tpu.broker.freshness import WATERMARKS, now_ms
+        from pinot_tpu.common.schema import time_unit_to_millis
+
+        self._time_col = schema.time_column_name
+        self._time_unit_ms = (
+            time_unit_to_millis(schema.time_field.time_unit)
+            if schema.time_field is not None
+            else None
+        )
+        self._freshness_gauge_name = f"freshness.lag.{table}.p{self.partition}"
+
+        def _freshness_probe(_t=table, _p=self.partition):
+            w = WATERMARKS.get(_t, _p)
+            return round(max(0.0, now_ms() - w), 3) if w is not None else 0
+
+        self._freshness_fn = _freshness_probe
         if self._metrics is not None:
             lag_key = f"{table}.p{self.partition}"
             self._metrics.gauge(f"ingest.lag.{lag_key}").set_fn(self._lag_probe)
             self._metrics.gauge(f"ingest.paused.{lag_key}").set_fn(self._paused_fn)
+            if self._time_col is not None:
+                self._metrics.gauge(f"freshness.lag.{lag_key}").set_fn(
+                    self._freshness_fn
+                )
 
     def lag(self) -> Optional[int]:
         return self._lag_probe()
@@ -284,6 +318,9 @@ class RemoteConsumer:
         if self._metrics is not None:
             self._metrics.gauge(self._lag_gauge_name).clear_fn(self._lag_probe)
             self._metrics.gauge(self._paused_gauge_name).clear_fn(self._paused_fn)
+            self._metrics.gauge(self._freshness_gauge_name).clear_fn(
+                self._freshness_fn
+            )
 
     def start(self) -> None:
         self.starter.server.add_segment(self.table, self.mutable)
@@ -305,6 +342,15 @@ class RemoteConsumer:
             budget = self._governor.clamp_batch(budget)
         rows, next_offset = self.stream.fetch(self.partition, self.offset, budget)
         self.mutable.index_batch(rows)
+        if rows and self._time_col is not None and self._time_unit_ms is not None:
+            from pinot_tpu.broker.freshness import WATERMARKS, batch_max_event_ms
+
+            event_ms = batch_max_event_ms(
+                [r.get(self._time_col) for r in rows if self._time_col in r],
+                self._time_unit_ms,
+            )
+            if event_ms is not None:
+                WATERMARKS.advance(self.table, self.partition, event_ms)
         advanced = next_offset != self.offset
         self.offset = next_offset
         self.mutable.end_offset = next_offset

@@ -36,6 +36,14 @@ counters proving HBM <-> host <-> disk cycled, and an injected
 allocation failure healed by demotion (tier-1 twin in
 ``tests/test_chaos_hbm_pressure.py``).
 
+``--scenario audit-divergence`` runs the correctness-audit chaos
+acceptance (ISSUE 19): a seeded fault injector silently corrupts one
+serving tier's aggregates under closed-loop load — the shadow
+differential auditor must detect the divergence within budget,
+quarantine the (plan digest, tier), and every answer after the
+quarantine must be byte-identical to the pre-corruption reference
+with zero failed queries (tier-1 twin in ``tests/test_audit.py``).
+
 ``--scenario elastic-fleet`` runs the fleet-breadth chaos acceptance
 (ISSUE 15): 100+ tables under mixed ingest+query closed-loop load,
 a forced hot-tenant skew, a live make-before-break rebalance, and a
@@ -162,6 +170,7 @@ class InProcessCluster:
         self.broker.shutdown()
         for s in self.servers:
             s.history.stop()
+            s.auditor.stop()
         self.controller.stop()
 
 
@@ -1375,6 +1384,169 @@ def run_hbm_pressure_scenario(
 
 
 # ---------------------------------------------------------------------------
+# Audit-divergence scenario (ISSUE 19): a seeded fault injector makes
+# one serving tier return silently-wrong aggregates under closed-loop
+# load — the shadow differential auditor must catch it, quarantine the
+# (plan digest, tier), and the cluster must keep answering byte-
+# correctly (served off the quarantined tier) with ZERO failed queries.
+# Shared by the CLI and tests/test_audit.py.
+# ---------------------------------------------------------------------------
+
+
+def run_audit_divergence_scenario(
+    num_segments: int = 2,
+    rows: int = 96,
+    clients: int = 2,
+    load_s: float = 2.0,
+    detect_budget_s: float = 12.0,
+    corrupt_n: int = 3,
+    data_dir: Optional[str] = None,
+    seed: int = 1907,
+) -> Dict[str, Any]:
+    """One server, one offline table, a closed query loop — and a
+    seeded ``DeviceFaultInjector.corrupt_results`` that perturbs the
+    next ``corrupt_n`` served aggregates on whatever non-host tier
+    answers.  The corruption raises no exception, so the self-healing
+    ladder (PR 3) can never see it: only the shadow differential
+    auditor can.  Acceptance:
+
+    - the divergence is DETECTED (``audit.divergences``) within
+      ``detect_budget_s`` and the (plan digest, tier) is quarantined;
+    - every query after the quarantine is byte-identical (accounting
+      stripped) to the pre-corruption reference — the quarantined tier
+      is steered around, not retried;
+    - zero failed queries: the wrong answers themselves complete
+      without exceptions (that is the point), and nothing else breaks.
+    """
+    from pinot_tpu.common.faults import DeviceFaultInjector
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.tools.datagen import random_rows
+    from pinot_tpu.utils.audit import (
+        SamplerBudget,
+        payloads_equivalent,
+        strip_accounting,
+    )
+
+    # sample every completed query with an effectively-unmetered private
+    # budget so detection latency measures the audit loop, not the
+    # sampler (the process-wide default budget stays untouched)
+    saved_env = {
+        k: os.environ.get(k) for k in ("PINOT_TPU_AUDIT_SAMPLE_N",)
+    }
+    os.environ["PINOT_TPU_AUDIT_SAMPLE_N"] = "1"
+    cluster = InProcessCluster(num_servers=1, data_dir=data_dir)
+    inj = DeviceFaultInjector(seed=seed)
+    server = cluster.servers[0]
+    server.auditor.budget = SamplerBudget(per_s=1000.0, burst=64.0)
+    lanes = server.lanes.lanes if server.lanes is not None else []
+    try:
+        schema = _tenant_schema("auditT")
+        physical = cluster.add_offline_table(schema, replication=1)
+        all_rows = random_rows(schema, rows, seed=seed)
+        per = max(1, rows // num_segments)
+        for i in range(num_segments):
+            chunk = all_rows[i * per:(i + 1) * per] or all_rows[-per:]
+            cluster.upload(
+                physical, build_segment(schema, chunk, physical, f"audits{i}")
+            )
+        pql = (
+            "SELECT sum(metInt), sum(metFloat), max(dimInt) "
+            "FROM auditT GROUP BY dimStr"
+        )
+
+        # pre-corruption reference payload (accounting stripped — the
+        # same strip the auditor itself compares under)
+        ref_resp = cluster.broker.handle_pql(pql)
+        assert not ref_resp.exceptions, ref_resp.exceptions
+        reference = strip_accounting(ref_resp.to_json())
+        expected_docs = ref_resp.num_docs_scanned
+
+        load = ClosedLoopLoad(cluster, pql, expected_docs, clients).start()
+        time.sleep(min(0.5, load_s))  # steady state before the fault
+
+        for lane in lanes:
+            lane.fault_injector = inj
+        if not lanes and server.executor.lane is not None:
+            server.executor.lane.fault_injector = inj
+        # delta sized to dominate the auditor's float32-accumulation
+        # tolerance band on these group sums by orders of magnitude — a
+        # "wrong answer" here must be unambiguously wrong, not a rounding
+        # argument (payloads_equivalent rel_tol is 5e-4)
+        inj.corrupt_results(n=corrupt_n, delta=100.0)
+        armed_at = time.monotonic()
+
+        # wait for the audit plane to catch it
+        detected_s: Optional[float] = None
+        quarantined: List[Dict[str, Any]] = []
+        while time.monotonic() - armed_at < detect_budget_s:
+            quarantined = server.executor.audit_quarantined_snapshot()
+            if quarantined:
+                detected_s = time.monotonic() - armed_at
+                break
+            time.sleep(0.05)
+        inj.heal()  # unfired corruption budget must not leak forward
+
+        time.sleep(min(1.0, load_s))  # post-quarantine serving window
+        summary = load.stop()
+
+        # correctness after quarantine: repeated answers must be
+        # byte-identical to EACH OTHER (one tier serves now — no
+        # flapping) and equivalent to the pre-corruption reference
+        # (float32-vs-float64 accumulation tolerance only; the injected
+        # delta is orders of magnitude larger)
+        post_mismatches = 0
+        post_baseline = None
+        for _ in range(8):
+            resp = cluster.broker.handle_pql(pql)
+            payload = strip_accounting(resp.to_json())
+            if post_baseline is None:
+                post_baseline = payload
+            if (
+                resp.exceptions
+                or payload != post_baseline
+                or not payloads_equivalent(payload, reference)
+            ):
+                post_mismatches += 1
+        audit_snap = server.auditor.snapshot()
+        heal = server.executor.healing_stats()
+        divergences = audit_snap["divergences"]
+        recent = audit_snap.get("recentDivergences") or []
+        detect_ms = max((d.get("detectMs") or 0.0) for d in recent) if recent else None
+
+        failed = (
+            summary["failedQueries"]
+            + (0 if detected_s is not None else 1)
+            + post_mismatches
+        )
+        return {
+            "scenario": "audit-divergence",
+            "metric": "audit_detect_s",
+            "value": round(detected_s, 3) if detected_s is not None else None,
+            "detected": detected_s is not None,
+            "detectWallS": round(detected_s, 3) if detected_s is not None else None,
+            "detectMs": detect_ms,
+            "divergences": divergences,
+            "quarantined": quarantined,
+            "auditTierSkips": heal.get("auditTierSkips", 0),
+            "postQuarantineMismatches": post_mismatches,
+            "load": summary,
+            "audit": audit_snap,
+            "failedQueries": failed,
+        }
+    finally:
+        for lane in lanes:
+            lane.fault_injector = None
+        if server.executor.lane is not None:
+            server.executor.lane.fault_injector = None
+        cluster.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
 # Elastic-fleet scenario (ISSUE 15): 100+ tables under mixed
 # ingest+query closed-loop load, a forced hot-tenant skew, a live
 # make-before-break rebalance, and a mid-rebalance controller restart.
@@ -2430,6 +2602,7 @@ SCENARIOS = {
     "join-under-flood": run_join_under_flood_scenario,
     "ingest-backpressure": run_ingest_backpressure_scenario,
     "hbm-pressure": run_hbm_pressure_scenario,
+    "audit-divergence": run_audit_divergence_scenario,
     "partition-server": run_partition_server_scenario,
     "partition-controller": run_partition_controller_scenario,
     "asymmetric-partition": run_asymmetric_partition_scenario,
@@ -2464,6 +2637,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.scenario in (
         "ingest-backpressure",
         "hbm-pressure",
+        "audit-divergence",
         "asymmetric-partition",
         "split-brain",
     ):

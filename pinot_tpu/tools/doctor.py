@@ -51,6 +51,7 @@ CONTROLLER_ENDPOINTS = [
     "/debug/capacity",
     "/debug/workload",
     "/debug/utilization",
+    "/debug/audit",
     "/clusterstate",
 ]
 BROKER_ENDPOINTS = [
@@ -61,6 +62,7 @@ BROKER_ENDPOINTS = [
     "/debug/history",
     "/debug/admission",
     "/debug/workload",
+    "/debug/audit",
     "/debug/flightrec",
 ]
 SERVER_ENDPOINTS = [
@@ -69,6 +71,7 @@ SERVER_ENDPOINTS = [
     "/debug/plans",
     "/debug/history",
     "/debug/profile",
+    "/debug/audit",
     "/debug/flightrec",
 ]
 
@@ -181,17 +184,56 @@ def summarize(bundle: Dict[str, Any]) -> Dict[str, Any]:
     errors = 0
     retained_tails = 0
     flight_bundles = len(ctrl.get("flightBundles") or [])
+    # correctness & freshness audit rollup (ISSUE 19): total divergence
+    # evidence across every plane, plus the stalest realtime tables —
+    # the postmortem lines an operator reads before anything else
+    shadow_divergences = 0
+    replica_divergences = 0
+    quarantined: List[Dict[str, Any]] = []
+    worst_freshness: List[Dict[str, Any]] = []
+    audit_bundle_count = 0
+    ctrl_audit = ctrl.get("/debug/audit") or {}
+    crc_mismatches = (
+        len(ctrl_audit.get("mismatches") or [])
+        if isinstance(ctrl_audit, dict)
+        else 0
+    )
+
+    def _count_audit_bundles(bundles) -> int:
+        return sum(
+            1
+            for b in bundles or []
+            if isinstance(b, dict)
+            and str(b.get("reason", "")).lower().endswith("divergence")
+        )
+
+    audit_bundle_count += _count_audit_bundles(ctrl.get("flightBundles"))
     for entry in instances.values():
         roles[entry.get("role") or "?"] = roles.get(entry.get("role") or "?", 0) + 1
         if "error" in entry:
             errors += 1
             continue
         flight_bundles += len(entry.get("flightBundles") or [])
+        audit_bundle_count += _count_audit_bundles(entry.get("flightBundles"))
         for ep, payload in (entry.get("endpoints") or {}).items():
             if isinstance(payload, dict) and "error" in payload and len(payload) == 1:
                 errors += 1
             if ep.startswith("/debug/tails") and isinstance(payload, dict):
                 retained_tails += int(payload.get("retained") or 0)
+            if ep == "/debug/audit" and isinstance(payload, dict):
+                if entry.get("role") == "server":
+                    shadow_divergences += int(payload.get("divergences") or 0)
+                    quarantined.extend(payload.get("quarantined") or [])
+                elif entry.get("role") == "broker":
+                    replica = payload.get("replica") or {}
+                    replica_divergences += int(replica.get("divergences") or 0)
+                    fresh = payload.get("freshness")
+                    if isinstance(fresh, dict) and fresh.get("tables"):
+                        from pinot_tpu.broker.freshness import (
+                            worst_freshness_tables,
+                        )
+
+                        worst_freshness = worst_freshness_tables(fresh)
     return {
         "instances": roles,
         "fetchErrors": errors,
@@ -199,6 +241,14 @@ def summarize(bundle: Dict[str, Any]) -> Dict[str, Any]:
         "worstBurning": slo.get("worstBurning") or [],
         "retainedTails": retained_tails,
         "flightBundles": flight_bundles,
+        "audit": {
+            "shadowDivergences": shadow_divergences,
+            "replicaDivergences": replica_divergences,
+            "crcMismatches": crc_mismatches,
+            "quarantined": quarantined,
+            "divergenceBundles": audit_bundle_count,
+            "worstFreshnessTables": worst_freshness,
+        },
     }
 
 

@@ -267,6 +267,32 @@ TIERED_CONFIG_KEYS = ("num_tables", "platform")
 
 TIERED_DEFAULT_BASELINE = "TIERED_r18.json"
 
+# audit-plane documents (PINOT_TPU_BENCH_MODE=audit, ISSUE 19): the two
+# promises the correctness/freshness audit plane must keep forever.
+# ``value`` / ``audit_overhead.okQpsRatio`` is serving ok-QPS with the
+# shipped audit defaults ON over audit fully OFF — the background
+# shadow oracle + replica double-scatter must cost <= ~5% of serving
+# throughput (baseline ratio ~1.0, band 0.95 floors it near 0.95; ratio
+# is fresh-broker/pre-opened-window ok-QPS, same traps as the serving
+# sampling_overhead spec).  ``detect_ms`` bounds how long the shadow
+# auditor takes to flag + quarantine a seeded device-tier wrong answer
+# under closed-loop load (milliseconds on the in-process harness; the
+# wide band gates order-of-magnitude rot, not scheduler jitter).
+# ``detected`` is structural: the seeded corruption must ALWAYS be
+# caught — a gate run where it slipped through fails outright.
+AUDIT_METRIC_SPECS: Dict[str, Tuple[str, float]] = {
+    "value": ("higher", 0.95),
+    "audit_overhead.okQpsRatio": ("higher", 0.95),
+    "audit_overhead.auditOnQps": ("higher", 0.40),
+    "detect_ms": ("lower", 50.0),
+    "detected": ("higher", 1.0),
+    "divergence.divergences": ("higher", 0.5),
+}
+
+AUDIT_CONFIG_KEYS = ("total_rows", "num_segments", "clients", "platform")
+
+AUDIT_DEFAULT_BASELINE = "AUDIT_r19.json"
+
 
 def _is_serving(doc: Dict[str, Any]) -> bool:
     return str(doc.get("metric", "")).startswith("serving_")
@@ -288,6 +314,8 @@ def _doc_kind(doc: Dict[str, Any]) -> str:
         return "filtermatrix"
     if metric.startswith("tiered_"):
         return "tiered"
+    if metric.startswith("audit_"):
+        return "audit"
     return "default"
 
 
@@ -308,6 +336,8 @@ def _specs_for(doc: Dict[str, Any]):
         return FILTERMATRIX_METRIC_SPECS, FILTERMATRIX_CONFIG_KEYS
     if kind == "tiered":
         return TIERED_METRIC_SPECS, TIERED_CONFIG_KEYS
+    if kind == "audit":
+        return AUDIT_METRIC_SPECS, AUDIT_CONFIG_KEYS
     return METRIC_SPECS, CONFIG_KEYS
 
 
@@ -462,6 +492,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "restart": RESTART_DEFAULT_BASELINE,
                 "filtermatrix": FILTERMATRIX_DEFAULT_BASELINE,
                 "tiered": TIERED_DEFAULT_BASELINE,
+                "audit": AUDIT_DEFAULT_BASELINE,
             }.get(_doc_kind(current), "BENCH_r05.json")
         baseline = load_bench(baseline_path)
     except (OSError, ValueError, json.JSONDecodeError) as e:

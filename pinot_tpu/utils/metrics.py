@@ -443,6 +443,20 @@ BROKER_METRIC_CATALOG: Dict[str, str] = {
     "controller may be the partitioned one)",
     "netfaults.*": "injected link faults observed by this role's "
     "transports (dropped/replyDropped/delayed/duplicated/flaky)",
+    # correctness & freshness audit plane (ISSUE 19): replica
+    # double-scatter sampling + event-time freshness on responses
+    "audit.replicaChecks": "sampled queries double-scattered to an "
+    "alternate covering replica and compared (accounting stripped)",
+    "audit.replicaDivergences": "replica pairs whose stripped payloads "
+    "differed — a real correctness signal, flight-recorded",
+    "audit.replicaDropped": "replica-audit samples dropped (queue full "
+    "or sampler budget exhausted — never blocks serving)",
+    "audit.replicaErrors": "replica-audit probes that errored before a "
+    "comparison (either side failed; not counted as divergence)",
+    "freshness.lagMs": "event-time staleness of merged responses "
+    "(now - min realtime watermark across merged parts)",
+    "freshness.*.lagMs": "per-table freshnessMs of the latest "
+    "realtime-serving response",
 }
 
 SERVER_METRIC_CATALOG: Dict[str, str] = {
@@ -468,6 +482,10 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "heal.poisonSkips": "queries that skipped a quarantined device plan",
     "heal.resourceExhausted": "device allocation failures healed by "
     "residency demotion + retry (never poisoned)",
+    "heal.auditQuarantines": "(plan digest, tier) pairs quarantined by "
+    "the shadow differential auditor (wrong answer caught)",
+    "heal.auditTierSkips": "queries steered off an audit-quarantined "
+    "serving tier (answered by the next tier / host)",
     "lane.depth": "device-lane queue depth (lane-group servers: summed "
     "over every lane)",
     "lane.inflight": "device-lane launches currently inside the launch call",
@@ -623,6 +641,24 @@ SERVER_METRIC_CATALOG: Dict[str, str] = {
     "join.shuffleBytes": "shuffle-exchange bytes RECEIVED by this server "
     "(the skew-balance observable: compare across servers)",
     "join.broadcastBytes": "broadcast build-side bytes received",
+    # correctness & freshness audit plane (ISSUE 19): shadow
+    # differential sampling against the host oracle + event-time
+    # watermarks per consuming partition
+    "audit.samples": "completed queries re-executed against the host "
+    "oracle by the shadow auditor (1-in-N sampled, off the serving path)",
+    "audit.divergences": "shadow re-executions whose stripped payload "
+    "differed from the served answer (wrong answer detected)",
+    "audit.quarantines": "(plan digest, tier) quarantines placed by the "
+    "shadow auditor on divergence",
+    "audit.dropped": "audit samples dropped (queue full or sampler "
+    "budget exhausted — auditing never blocks serving)",
+    "audit.errors": "shadow re-executions that errored before a "
+    "comparison (not counted as divergence)",
+    "audit.queueDepth": "shadow-audit jobs currently queued",
+    "audit.shadowMs": "host-oracle re-execution wall ms per audit sample",
+    "audit.detectMs": "query-completion to divergence-detection wall ms",
+    "freshness.lag.*": "per-(table, partition) event-time lag ms "
+    "(now - max ingested event time)",
     # ingest observability (realtime consumers hosted on this server)
     "ingest.rowsConsumed": "stream rows consumed into mutable segments",
     "ingest.commitMs": "segment commit latency (convert + persist round)",
@@ -739,6 +775,15 @@ CONTROLLER_METRIC_CATALOG: Dict[str, str] = {
     "history.series": "distinct series in the latest history sample",
     "flightrec.dumps": "flight-recorder bundles written on notable events",
     "flightrec.bundles": "flight-recorder bundles currently on disk",
+    # correctness audit plane (ISSUE 19): periodic cross-replica
+    # checksum sweep over registered segment CRCs
+    "audit.sweep.runs": "cross-replica CRC sweep rounds completed",
+    "audit.sweep.segmentsChecked": "segment replica-sets compared by "
+    "the latest sweeps",
+    "audit.sweep.skippedInstances": "instances skipped by sweeps "
+    "(unreachable or no admin URL)",
+    "audit.crcMismatches": "segments whose replicas currently disagree "
+    "on content CRC (cross-replica divergence)",
     "*.missingReplicas": "per-table replicas missing from the external view",
     "*.errorReplicas": "per-table replicas in ERROR state",
     "*.percentSegmentsAvailable": "per-table % of segments with a live replica",

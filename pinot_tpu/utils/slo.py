@@ -51,16 +51,25 @@ def default_objective() -> Dict[str, float]:
         "latencyMs": _env_f("PINOT_TPU_SLO_LATENCY_MS", 500.0),
         "latencyTarget": _env_f("PINOT_TPU_SLO_LATENCY_TARGET", 0.99),
         "availabilityTarget": _env_f("PINOT_TPU_SLO_AVAILABILITY_TARGET", 0.999),
+        # event-time freshness objective (ISSUE 19): fraction of
+        # realtime-serving queries with freshnessMs under the threshold
+        # must stay >= freshnessTarget.  Threshold 0 (the default)
+        # disables the objective — its budget contributes no burn entry
+        # (the _burn budget<=0 guard), so pure-offline fleets see no
+        # behavior change.
+        "freshnessMs": _env_f("PINOT_TPU_SLO_FRESHNESS_MS", 0.0),
+        "freshnessTarget": _env_f("PINOT_TPU_SLO_FRESHNESS_TARGET", 0.99),
     }
 
 
 class _Counts:
-    __slots__ = ("total", "latency_breaches", "failures")
+    __slots__ = ("total", "latency_breaches", "failures", "freshness_breaches")
 
     def __init__(self) -> None:
         self.total = 0
         self.latency_breaches = 0
         self.failures = 0
+        self.freshness_breaches = 0
 
 
 class SloTracker:
@@ -107,9 +116,17 @@ class SloTracker:
             metrics.gauge("slo.worstBurnRate1h").set(0.0)
 
     # -- write side ----------------------------------------------------
-    def observe(self, table: str, latency_ms: float, failed: bool) -> None:
+    def observe(
+        self,
+        table: str,
+        latency_ms: float,
+        failed: bool,
+        freshness_ms: Optional[float] = None,
+    ) -> None:
         """Fold one finished query into the table's cumulative counters
-        (called on the broker response path — scalars only)."""
+        (called on the broker response path — scalars only).
+        ``freshness_ms`` is the response's event-time staleness (None
+        for offline-only answers, which never breach freshness)."""
         if not table:
             return
         with self._lock:
@@ -124,6 +141,13 @@ class SloTracker:
                 c.latency_breaches += 1
             elif latency_ms >= obj["latencyMs"]:
                 c.latency_breaches += 1
+            threshold = obj.get("freshnessMs") or 0.0
+            if (
+                threshold > 0
+                and freshness_ms is not None
+                and freshness_ms >= threshold
+            ):
+                c.freshness_breaches += 1
 
     def set_objective(self, table: str, obj: Optional[Dict[str, Any]]) -> None:
         """Table-config override (None clears back to env defaults).
@@ -140,6 +164,12 @@ class SloTracker:
                 ),
                 "availabilityTarget": float(
                     obj.get("availabilityTarget") or base["availabilityTarget"]
+                ),
+                "freshnessMs": float(
+                    obj.get("freshnessMs") or base["freshnessMs"]
+                ),
+                "freshnessTarget": float(
+                    obj.get("freshnessTarget") or base["freshnessTarget"]
                 ),
             }
 
@@ -166,6 +196,7 @@ class SloTracker:
                 out[f"slo.{table}.total"] = c.total
                 out[f"slo.{table}.latencyBreaches"] = c.latency_breaches
                 out[f"slo.{table}.failures"] = c.failures
+                out[f"slo.{table}.freshnessBreaches"] = c.freshness_breaches
             return out
 
     # -- evaluation ----------------------------------------------------
@@ -206,6 +237,14 @@ class SloTracker:
             obj = self.objective(table)
             lat_budget = 1.0 - obj["latencyTarget"]
             avail_budget = 1.0 - obj["availabilityTarget"]
+            # the third objective rides the same multi-window machinery:
+            # a zero threshold zeroes the budget, and the _burn guard
+            # then contributes no entry at all
+            fresh_budget = (
+                1.0 - obj.get("freshnessTarget", 0.99)
+                if (obj.get("freshnessMs") or 0.0) > 0
+                else 0.0
+            )
             entry: Dict[str, Any] = {"objective": obj, "windows": {}}
             rates5: List[float] = []
             rates1h: List[float] = []
@@ -215,8 +254,15 @@ class SloTracker:
             ):
                 lat = self._burn(table, "latencyBreaches", lat_budget, window_s)
                 avail = self._burn(table, "failures", avail_budget, window_s)
-                entry["windows"][wname] = {"latency": lat, "availability": avail}
-                for b in (lat, avail):
+                fresh = self._burn(
+                    table, "freshnessBreaches", fresh_budget, window_s
+                )
+                entry["windows"][wname] = {
+                    "latency": lat,
+                    "availability": avail,
+                    "freshness": fresh,
+                }
+                for b in (lat, avail, fresh):
                     if b is not None:
                         sink.append(b["burnRate"])
             b5 = max(rates5, default=0.0)

@@ -135,3 +135,19 @@ def _no_leaked_manager_threads():
         f"controller-manager threads leaked past stop(): "
         f"{[t.name for t in leaked]}"
     )
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_audit_threads():
+    """Audit samplers (utils/audit.py): shadow/replica auditor workers
+    are lazy daemon threads started on the first enqueued sample;
+    ``stop()`` (via ServerInstance.shutdown / Broker.shutdown) must
+    actually end them.  Still-enabled auditors on live fixtures are
+    exempt — a STOPPED auditor whose worker survives is the leak."""
+    yield
+    from pinot_tpu.utils.audit import leaked_audit_threads
+
+    leaked = leaked_audit_threads(grace_s=2.0)
+    assert not leaked, (
+        f"audit worker threads leaked past stop(): {leaked}"
+    )

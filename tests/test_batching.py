@@ -37,7 +37,7 @@ def _payload(resp) -> str:
         {
             k: v
             for k, v in resp.to_json().items()
-            if k not in ("timeUsedMs", "requestId", "cost")
+            if k not in ("timeUsedMs", "requestId", "cost", "freshnessMs")
         },
         sort_keys=True,
     )

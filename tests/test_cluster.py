@@ -146,6 +146,7 @@ def test_cluster_matches_oracle(cluster, pql):
     # requestId/planDigest are broker-assigned (the oracle issues
     # neither); cost is path-dependent execution accounting
     for k in ("timeUsedMs", "requestId", "planDigest", "cost",
+              "freshnessMs",  # wall-clock-relative event-time staleness
               "numEntriesScannedInFilter",
               "numEntriesScannedPostFilter", "numSegmentsQueried",
               "numServersQueried", "numServersResponded"):

@@ -46,10 +46,10 @@ def test_cost_wire_backward_compat_old_payload_without_cost():
     still deserialize — mixed-version operation."""
     data = serialize_result(IntermediateResult(num_docs_scanned=7))
     # the trailing optional fields are empty cost dict (b"d"+i64(0) = 9
-    # bytes), empty backpressure dict (9), empty plan-info list (9) and
-    # the join-payload None (b"N" = 1); chop all four and fix the length
-    # header to emulate the pre-cost wire format
-    payload = data[16:-28]
+    # bytes), empty backpressure dict (9), empty plan-info list (9), the
+    # join-payload None (b"N" = 1) and the freshness None (1); chop all
+    # five and fix the length header to emulate the pre-cost wire format
+    payload = data[16:-29]
     old = MAGIC + struct.pack("<Q", len(payload)) + payload
     res = deserialize_result(old)
     assert res.num_docs_scanned == 7

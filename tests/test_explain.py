@@ -80,9 +80,9 @@ def test_plan_info_wire_roundtrip_and_backward_compat():
     assert out.plan_info == res.plan_info
     # a payload from a pre-introspection peer (no trailing plan list)
     # must still deserialize: chop the trailing empty list (b"l"+i64(0))
-    # plus the later join-payload None (b"N")
+    # plus the later join-payload None (b"N") and freshness None (b"N")
     data = serialize_result(IntermediateResult(num_docs_scanned=3))
-    payload = data[16:-10]
+    payload = data[16:-11]
     old = MAGIC + struct.pack("<Q", len(payload)) + payload
     back = deserialize_result(old)
     assert back.num_docs_scanned == 3 and back.plan_info == []

@@ -227,10 +227,11 @@ def test_deadline_abandonment_with_lane_bottleneck():
 
 def _payload(resp) -> str:
     # cost excluded like timeUsedMs: it records HOW the path executed
-    # (coalesce hits, device ms), which differs serial vs pipelined
+    # (coalesce hits, device ms), which differs serial vs pipelined;
+    # freshnessMs is wall-clock-relative staleness, never payload
     return json.dumps(
         {k: v for k, v in resp.to_json().items()
-         if k not in ("timeUsedMs", "requestId", "cost")},
+         if k not in ("timeUsedMs", "requestId", "cost", "freshnessMs")},
         sort_keys=True,
     )
 

@@ -36,6 +36,7 @@ _PATH_DEPENDENT = {
     "numEntriesScannedPostFilter",
     "cost",  # cost vector describes HOW a path executed (device vs host
     # ms, serving tier) — path-dependent by construction
+    "freshnessMs",  # wall-clock-relative event-time staleness, not payload
 }
 
 
