@@ -23,6 +23,7 @@ from pinot_tpu.common.tableconfig import TableConfig
 from pinot_tpu.controller import dashboard
 from pinot_tpu.controller.managers import (
     CrcAuditManager,
+    DeepStoreScrubber,
     RetentionManager,
     SegmentStatusChecker,
     ValidationManager,
@@ -79,6 +80,13 @@ class Controller:
         # correctness audit plane (ISSUE 19): periodic cross-replica
         # CRC sweep over every alive server's /debug/segments claims
         self.crc_audit = CrcAuditManager(self.resources)
+        # disaster-recovery plane (ISSUE 20): background deep-store
+        # scrub + reverse replication of lost/corrupt durable copies
+        # from live servers' verified replicas
+        self.deepstore_scrubber = DeepStoreScrubber(self.resources, self.store)
+        # fetch-path feedback: servers that download CRC-failing bytes
+        # report the store copy suspect through the resource manager
+        self.resources.report_store_suspect = self.deepstore_scrubber.report_suspect
 
         from pinot_tpu.controller.stabilizer import SelfStabilizer
 
@@ -161,6 +169,7 @@ class Controller:
             self.validation_manager.start()
             self.status_checker.start()
             self.crc_audit.start()
+            self.deepstore_scrubber.start()
             self.stabilizer.start()
 
     def _recover(self) -> None:
@@ -414,6 +423,8 @@ class Controller:
             "segmentStatus": self.status_checker.metrics.snapshot(),
             "stabilizer": self.stabilizer.metrics.snapshot(),
             "retention": self.retention_manager.metrics.snapshot(),
+            "deepstore": self.deepstore_scrubber.metrics.snapshot(),
+            "durability": self.property_store.metrics.snapshot(),
         }
 
     def metrics_text(self) -> str:
@@ -426,6 +437,8 @@ class Controller:
                 self.status_checker.metrics,
                 self.stabilizer.metrics,
                 self.retention_manager.metrics,
+                self.deepstore_scrubber.metrics,
+                self.property_store.metrics,
             ]
         )
 
@@ -453,7 +466,9 @@ class Controller:
         self.validation_manager.stop()
         self.status_checker.stop()
         self.crc_audit.stop()
+        self.deepstore_scrubber.stop()
         self.stabilizer.stop()
+        self.property_store.close()
 
 
 def cost_rates_from_capacity(capacity: Dict[str, Any]) -> Dict[str, float]:
@@ -1224,6 +1239,9 @@ class ControllerHttpServer:
                     if parts == ["debug", "audit"]:
                         # cross-replica CRC sweep rollup (CrcAuditManager)
                         return self._respond(ctrl.crc_audit.snapshot())
+                    if parts == ["debug", "deepstore"]:
+                        # deep-store scrub/repair rollup + evidence rows
+                        return self._respond(ctrl.deepstore_scrubber.snapshot())
                     if parts == ["debug", "stabilizer"]:
                         return self._respond(ctrl.stabilizer.debug_snapshot())
                     if len(parts) == 3 and parts[0] == "instances" and parts[2] == "drain":
@@ -1332,6 +1350,16 @@ class ControllerHttpServer:
                         )
                     if parts == ["instances"]:
                         return self._respond(ctrl.gateway.register(self._read_json()))
+                    if parts == ["deepstore", "suspect"]:
+                        # networked fetch-path feedback: a server's
+                        # download failed CRC against the store copy
+                        body = self._read_json()
+                        ctrl.deepstore_scrubber.report_suspect(
+                            str(body.get("table", "")),
+                            str(body.get("segment", "")),
+                            str(body.get("source", "")),
+                        )
+                        return self._respond({"status": "reported"})
                     if len(parts) == 3 and parts[0] == "instances" and parts[2] == "heartbeat":
                         # readiness (warming flag) rides the beat body
                         return self._respond(

@@ -17,13 +17,19 @@ tests calling run_once directly — deterministic, no sleeps).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 import weakref
 from typing import Dict, List, Optional
 
 from pinot_tpu.common.schema import time_unit_to_millis
-from pinot_tpu.controller.resource_manager import ClusterResourceManager, ERROR, ONLINE
+from pinot_tpu.controller.resource_manager import (
+    CONSUMING,
+    ClusterResourceManager,
+    ERROR,
+    ONLINE,
+)
 from pinot_tpu.utils.metrics import ControllerMetrics
 
 logger = logging.getLogger(__name__)
@@ -272,6 +278,240 @@ class CrcAuditManager(_PeriodicManager):
         """Latest sweep rollup (the controller's ``/debug/audit``)."""
         with self._rollup_lock:
             out = dict(self._last)
+        out["intervalS"] = self.interval_s
+        return out
+
+
+class DeepStoreScrubber(_PeriodicManager):
+    """Background re-verification of the controller's durable segment
+    copies, with reverse replication for lost/corrupt ones.
+
+    The reference's deep store (NFS/HDFS) has storage-level redundancy
+    and ``RetentionManager``-adjacent validators; our controller-local
+    ``SegmentStore`` is a single copy that nobody reads between upload
+    and the next server fetch — bit rot there is invisible until a
+    replica tries to load it.  This manager (a ``CrcAuditManager``
+    sibling) walks the store on a cadence, re-verifies each copy's CRC
+    under the shared ``SamplerBudget`` (scrubbing must never starve
+    serving), and repairs a bad copy *from a live server's verified
+    local copy* — the reverse of the normal fetch direction, possible
+    because servers CRC-verify every segment they load.
+
+    Servers also push suspects: a fetch that fails CRC against the
+    store copy reports it here (``report_suspect``), so a rotten copy
+    is repaired on the next round instead of poisoning every future
+    replica placement.  The copy fetch is pluggable
+    (``copy_fn(name, url, table, segment) -> bytes``) so in-process
+    tests drive repairs without HTTP."""
+
+    def __init__(
+        self,
+        resources: ClusterResourceManager,
+        store,
+        interval_s: float = 300.0,
+        budget=None,
+        copy_fn=None,
+        timeout_s: float = 10.0,
+    ) -> None:
+        super().__init__(interval_s, metrics_scope="deepstore")
+        from pinot_tpu.utils.audit import BUDGET
+
+        self.resources = resources
+        self.store = store
+        self.budget = budget if budget is not None else BUDGET
+        self.copy_fn = copy_fn or self._http_copy
+        self.timeout_s = timeout_s
+        self._suspect_lock = threading.Lock()
+        self._suspects: List[Dict] = []
+        self._rollup_lock = threading.Lock()
+        self._last: Dict = {
+            "runs": 0,
+            "copiesChecked": 0,
+            "corruptCopies": 0,
+            "repairs": 0,
+            "repairFailures": 0,
+            "budgetDenied": 0,
+            "evidence": [],
+        }
+        for m in (
+            "deepstore.scrub.runs",
+            "deepstore.scrub.copiesChecked",
+            "deepstore.scrub.budgetDenied",
+            "deepstore.corruptCopies",
+            "deepstore.repairs",
+            "deepstore.repairFailures",
+            "deepstore.suspectsReported",
+        ):
+            self.metrics.meter(m)
+        self.metrics.gauge("deepstore.suspectsPending").set(0)
+
+    # -- suspect intake (fetch-path feedback) -------------------------
+
+    def report_suspect(self, table: str, segment: str, source: str = "") -> None:
+        """A fetch failed CRC against the store copy: queue that copy
+        for priority verification on the next scrub round."""
+        with self._suspect_lock:
+            if any(
+                s["table"] == table and s["segment"] == segment
+                for s in self._suspects
+            ):
+                return
+            self._suspects.append(
+                {"table": table, "segment": segment, "source": source}
+            )
+            pending = len(self._suspects)
+        self.metrics.meter("deepstore.suspectsReported").mark()
+        self.metrics.gauge("deepstore.suspectsPending").set(pending)
+
+    def _http_copy(self, name: str, url: str, table: str, segment: str) -> bytes:
+        import urllib.request
+
+        if not url:
+            raise RuntimeError(f"server {name} has no admin URL")
+        with urllib.request.urlopen(
+            url.rstrip("/") + f"/segments/{table}/{segment}/copy",
+            timeout=self.timeout_s,
+        ) as resp:
+            return resp.read()
+
+    # -- scrub round --------------------------------------------------
+
+    def _targets(self) -> List[Dict]:
+        """Suspects first (priority), then the cadence walk over every
+        segment the metadata expects a durable copy for (CONSUMING
+        realtime segments have none yet)."""
+        with self._suspect_lock:
+            targets = list(self._suspects)
+            self._suspects = []
+        seen = {(t["table"], t["segment"]) for t in targets}
+        for table in self.resources.tables():
+            ideal = self.resources.get_ideal_state(table)
+            for seg, replicas in ideal.items():
+                if (table, seg) in seen:
+                    continue
+                if replicas and all(s == CONSUMING for s in replicas.values()):
+                    continue
+                targets.append({"table": table, "segment": seg, "source": ""})
+        return targets
+
+    def run_once(self) -> None:
+        checked = 0
+        denied = 0
+        corrupt: List[Dict] = []
+        repaired = 0
+        repair_failures = 0
+        evidence: List[Dict] = []
+        for target in self._targets():
+            table, seg = target["table"], target["segment"]
+            if not self.budget.take():
+                denied += 1
+                if target["source"]:
+                    # keep a server-reported suspect for the next round
+                    # rather than dropping the report on the floor
+                    self.report_suspect(table, seg, target["source"])
+                continue
+            info = self.resources.get_segment_metadata(table, seg) or {}
+            expected = getattr(info.get("metadata"), "crc", None)
+            try:
+                self.store.verify_copy(table, seg, expected_crc=expected)
+                checked += 1
+                continue
+            except FileNotFoundError:
+                reason = "missing"
+            except Exception as e:
+                reason = f"corrupt: {e}"
+            checked += 1
+            row = {
+                "table": table,
+                "segment": seg,
+                "reason": reason,
+                "reportedBy": target["source"] or None,
+                "repairedFrom": None,
+            }
+            corrupt.append(row)
+            src = self._repair(table, seg, expected)
+            if src:
+                row["repairedFrom"] = src
+                repaired += 1
+            else:
+                repair_failures += 1
+            evidence.append(row)
+
+        self.metrics.meter("deepstore.scrub.runs").mark()
+        self.metrics.meter("deepstore.scrub.copiesChecked").mark(checked)
+        if denied:
+            self.metrics.meter("deepstore.scrub.budgetDenied").mark(denied)
+        if corrupt:
+            self.metrics.meter("deepstore.corruptCopies").mark(len(corrupt))
+        if repaired:
+            self.metrics.meter("deepstore.repairs").mark(repaired)
+        if repair_failures:
+            self.metrics.meter("deepstore.repairFailures").mark(repair_failures)
+        with self._suspect_lock:
+            pending = len(self._suspects)
+        self.metrics.gauge("deepstore.suspectsPending").set(pending)
+        with self._rollup_lock:
+            self._last = {
+                "runs": self._last["runs"] + 1,
+                "copiesChecked": checked,
+                "corruptCopies": len(corrupt),
+                "repairs": self._last["repairs"] + repaired,
+                "repairFailures": repair_failures,
+                "budgetDenied": denied,
+                "evidence": (self._last["evidence"] + evidence)[-32:],
+            }
+
+    def _repair(self, table: str, seg: str, expected_crc) -> Optional[str]:
+        """Reverse replication: pull verified bytes from a live ONLINE
+        replica, re-verify them independently, and install as the new
+        durable copy.  Returns the donor server name or None."""
+        import tempfile
+
+        from pinot_tpu.segment.format import read_segment, verify_segment_crc
+
+        view = self.resources.get_external_view(table).get(seg, {})
+        urls = {
+            inst.name: inst.url
+            for inst in self.resources.instances_snapshot()
+            if inst.role == "server" and inst.alive
+        }
+        for server, state in sorted(view.items()):
+            if state != ONLINE or server not in urls:
+                continue
+            try:
+                data = self.copy_fn(server, urls[server], table, seg)
+                if not data:
+                    continue
+                # verify the donated bytes before trusting them: parse,
+                # recompute the data CRC, and match the registered crc
+                with tempfile.TemporaryDirectory() as td:
+                    fpath = os.path.join(td, "columns.pnt")
+                    with open(fpath, "wb") as f:
+                        f.write(data)
+                    donated = read_segment(fpath)
+                    verify_segment_crc(donated, source=f"repair:{server}")
+                    if (
+                        expected_crc
+                        and donated.metadata.crc
+                        and donated.metadata.custom.get("dataCrc")
+                        and int(donated.metadata.crc) != int(expected_crc)
+                    ):
+                        continue
+                self.store.save_bytes(table, seg, data)
+                self.store.verify_copy(table, seg, expected_crc=expected_crc)
+                return server
+            except Exception:
+                logger.exception(
+                    "deep-store repair of %s/%s from %s failed", table, seg, server
+                )
+        return None
+
+    def snapshot(self) -> Dict:
+        """Latest scrub rollup (the controller's ``/debug/deepstore``)."""
+        with self._rollup_lock:
+            out = dict(self._last)
+        with self._suspect_lock:
+            out["suspectsPending"] = len(self._suspects)
         out["intervalS"] = self.interval_s
         return out
 

@@ -115,6 +115,11 @@ class ClusterResourceManager:
                     self._draining_flags.add(name)
         self._view_listeners: List[Callable[[str, Dict[str, Dict[str, str]]], None]] = []
         self._instance_listeners: List[Callable[[str, bool], None]] = []
+        # deep-store suspect intake: the controller points this at its
+        # DeepStoreScrubber.report_suspect so in-process servers can
+        # flag a store copy whose bytes failed CRC on fetch
+        # (table, segment, source_uri) -> None; None = no scrubber
+        self.report_store_suspect: Optional[Callable[[str, str, str], None]] = None
         self._assign_rr = 0
         # monotonically bumped on every view/instance change; remote
         # brokers poll it to decide when to rebuild routing

@@ -9,9 +9,16 @@ from __future__ import annotations
 
 import os
 import shutil
-from typing import List, Optional
+import zlib
+from typing import Dict, List, Optional
 
-from pinot_tpu.segment.format import SEGMENT_FILE_NAME, read_segment, write_segment
+from pinot_tpu.segment.format import (
+    SEGMENT_FILE_NAME,
+    SegmentIntegrityError,
+    read_segment,
+    verify_segment_crc,
+    write_segment,
+)
 from pinot_tpu.segment.immutable import ImmutableSegment
 
 
@@ -70,3 +77,85 @@ class SegmentStore:
         if not os.path.isdir(d):
             return []
         return sorted(os.listdir(d))
+
+    def list_tables(self) -> List[str]:
+        return sorted(
+            t for t in os.listdir(self.base_dir)
+            if os.path.isdir(os.path.join(self.base_dir, t))
+        )
+
+    def segment_file_path(self, table: str, segment_name: str) -> str:
+        return os.path.join(self.segment_dir(table, segment_name), SEGMENT_FILE_NAME)
+
+    def verify_copy(
+        self, table: str, segment_name: str, expected_crc: Optional[int] = None
+    ) -> ImmutableSegment:
+        """Re-verify the durable copy (the deep-store scrub primitive).
+
+        Raises ``FileNotFoundError`` for a lost copy and
+        ``SegmentIntegrityError`` for an unreadable / CRC-failing one,
+        or one whose verifiable CRC no longer matches the registered
+        metadata (``expected_crc``)."""
+        d = self.segment_dir(table, segment_name)
+        path = os.path.join(d, SEGMENT_FILE_NAME)
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        try:
+            seg = read_segment(d)
+        except SegmentIntegrityError:
+            raise
+        except Exception as e:
+            raise SegmentIntegrityError(
+                f"store copy {table}/{segment_name} unreadable: {e!r}"
+            ) from e
+        verify_segment_crc(seg, source=f"store:{table}/{segment_name}")
+        if (
+            expected_crc
+            and seg.metadata.crc
+            and seg.metadata.custom.get("dataCrc")
+            and int(seg.metadata.crc) != int(expected_crc)
+        ):
+            raise SegmentIntegrityError(
+                f"store copy {table}/{segment_name}: CRC {seg.metadata.crc} != "
+                f"registered {expected_crc}"
+            )
+        return seg
+
+    def save_bytes(self, table: str, segment_name: str, data: bytes) -> str:
+        """Install raw segment-file bytes as the durable copy (reverse
+        replication from a server), via tmp+rename so a concurrent
+        download never sees a partial file."""
+        d = self.segment_dir(table, segment_name)
+        os.makedirs(d, exist_ok=True)
+        dest = os.path.join(d, SEGMENT_FILE_NAME)
+        tmp = dest + ".repair.tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, dest)
+        return d
+
+    def file_crc32(self, table: str, segment_name: str) -> Optional[int]:
+        """crc32 of the raw store file bytes (the backup-manifest
+        fingerprint — byte-level, catches rot the header can't)."""
+        path = self.segment_file_path(table, segment_name)
+        try:
+            with open(path, "rb") as f:
+                return zlib.crc32(f.read()) & 0xFFFFFFFF
+        except OSError:
+            return None
+
+    def manifest(self) -> Dict[str, Dict[str, Dict[str, int]]]:
+        """{table: {segment: {sizeBytes, crc32}}} over every durable
+        copy (the backup archive's segment manifest)."""
+        out: Dict[str, Dict[str, Dict[str, int]]] = {}
+        for table in self.list_tables():
+            for seg in self.list_segments(table):
+                path = self.segment_file_path(table, seg)
+                if not os.path.exists(path):
+                    continue
+                crc = self.file_crc32(table, seg)
+                out.setdefault(table, {})[seg] = {
+                    "sizeBytes": os.path.getsize(path),
+                    "crc32": crc if crc is not None else 0,
+                }
+        return out

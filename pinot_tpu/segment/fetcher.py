@@ -143,7 +143,13 @@ class SegmentFetcherFactory:
             )
         return f
 
-    def fetch(self, uri: str, dest_path: str, expected_crc: Optional[int] = None):
+    def fetch(
+        self,
+        uri: str,
+        dest_path: str,
+        expected_crc: Optional[int] = None,
+        suspect_cb=None,
+    ):
         """Fetch ``uri`` to ``dest_path``; with ``expected_crc`` the
         download lands in a side file, is parsed and CRC-verified, and
         only then atomically renamed into place — a corrupt copy raises
@@ -152,7 +158,13 @@ class SegmentFetcherFactory:
         server's quarantine/re-fetch loop depends on never installing
         bad bytes).  Returns the already-parsed, already-verified
         segment on the verified path (None otherwise) so callers don't
-        decode + CRC multi-GB files a second time."""
+        decode + CRC multi-GB files a second time.
+
+        ``suspect_cb(uri, exc)`` fires when the FETCHED bytes fail
+        verification (not on stale versions): the source copy — usually
+        the controller's deep store — is the suspect, and the callback
+        routes the evidence to the ``DeepStoreScrubber`` so the rotten
+        copy gets repaired instead of poisoning every future fetch."""
         os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
         if expected_crc is None:
             self.for_uri(uri).fetch(uri, dest_path)
@@ -184,11 +196,20 @@ class SegmentFetcherFactory:
                     f"fetched segment from {uri}: metadata CRC "
                     f"{seg.metadata.crc} != expected {expected_crc} (stale copy)"
                 )
-        except BaseException:
+        except BaseException as exc:
             try:
                 os.remove(tmp)
             except OSError:
                 pass
+            if (
+                suspect_cb is not None
+                and isinstance(exc, SegmentIntegrityError)
+                and not isinstance(exc, SegmentStaleError)
+            ):
+                try:
+                    suspect_cb(uri, exc)
+                except Exception:
+                    pass  # reporting is best-effort, never masks the fetch error
             raise
         os.replace(tmp, dest_path)
         return seg

@@ -813,6 +813,48 @@ class ServerInstance:
                 tdm.release_segments(acquired)
         return {"segments": out}
 
+    def segment_copy_bytes(self, table: str, segment: str) -> Optional[bytes]:
+        """Serialize this server's loaded copy of a sealed segment for
+        reverse replication (the ``DeepStoreScrubber`` repairing a
+        lost/corrupt deep-store copy from a live replica).  The copy is
+        CRC-verified BEFORE serialization — a donor must never launder
+        its own rot into the durable store.  Returns None when the
+        segment isn't hosted here, is still mutable (consuming), or
+        fails verification."""
+        import tempfile
+
+        from pinot_tpu.segment.format import (
+            SEGMENT_FILE_NAME,
+            SegmentIntegrityError,
+            verify_segment_crc,
+            write_segment,
+        )
+
+        tdm = self.data_manager.table(table)
+        if tdm is None:
+            return None
+        acquired = tdm.acquire_segments()
+        try:
+            for sdm in acquired:
+                if sdm.name != segment:
+                    continue
+                seg = sdm.segment
+                if getattr(seg, "metadata", None) is None or not hasattr(
+                    seg, "columns"
+                ):
+                    return None  # mutable consuming segment: no durable form
+                try:
+                    verify_segment_crc(seg, source=f"donor:{self.name}")
+                except SegmentIntegrityError:
+                    return None
+                with tempfile.TemporaryDirectory() as td:
+                    write_segment(seg, td)
+                    with open(os.path.join(td, SEGMENT_FILE_NAME), "rb") as f:
+                        return f.read()
+        finally:
+            tdm.release_segments(acquired)
+        return None
+
     def profile_start(self, timeout_s: Optional[float] = None) -> dict:
         """Begin (or join) an on-demand profile capture: the jax
         profiler trace starts/extends AND the lane occupancy sampler

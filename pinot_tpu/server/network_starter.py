@@ -123,6 +123,23 @@ class ServerAdminHttpServer:
                     # per-segment CRC map for the controller's
                     # cross-replica checksum sweep (CrcAuditManager)
                     return self._send_json(inst.segment_crcs())
+                if (
+                    self.path.startswith("/segments/")
+                    and self.path.endswith("/copy")
+                ):
+                    # reverse replication donor: the DeepStoreScrubber
+                    # repairing a lost/corrupt deep-store copy pulls the
+                    # verified bytes of this server's replica
+                    p = self.path.strip("/").split("/")
+                    if len(p) == 4:
+                        data = inst.segment_copy_bytes(p[1], p[2])
+                        if data is not None:
+                            return self._send(data, "application/octet-stream")
+                    return self._send(
+                        b'{"error": "segment not donatable"}',
+                        "application/json",
+                        404,
+                    )
                 if self.path == "/debug/audit":
                     # shadow-audit plane (utils/audit.py): sampler
                     # counters, quarantined (digest, tier) pairs, and
@@ -1130,6 +1147,18 @@ class NetworkedServerStarter:
                 return False
             except SegmentIntegrityError:
                 self.server.record_crc_failure(table, segment)
+                # the DOWNLOADED bytes are bad: the store copy is the
+                # suspect — report it so the controller's scrubber can
+                # repair it from a healthy replica (reverse replication)
+                try:
+                    self._post(
+                        "/deepstore/suspect",
+                        {"table": table, "segment": segment, "source": uri},
+                    )
+                except Exception:
+                    logger.warning(
+                        "could not report store suspect %s/%s", table, segment
+                    )
                 logger.exception(
                     "downloaded copy of %s/%s failed integrity verification; "
                     "leaving unserved", table, segment,

@@ -125,6 +125,20 @@ class ServerStarter:
     def _local_segment_dir(self, table: str, segment: str) -> str:
         return os.path.join(self.data_dir, table, segment)
 
+    def _report_store_suspect(self, table: str, segment: str, uri: str) -> None:
+        """Feed the controller's DeepStoreScrubber: the STORE copy
+        served bytes that failed CRC, so the store side — not just the
+        local copy — is suspect and due for reverse replication."""
+        cb = getattr(self.resources, "report_store_suspect", None)
+        if cb is None:
+            return
+        try:
+            cb(table, segment, uri or "")
+        except Exception:
+            logger.exception(
+                "failed to report store suspect %s/%s", table, segment
+            )
+
     def _load_from_store(
         self, table: str, segment: str, info: Dict[str, Any], crc: Optional[int]
     ) -> Optional["object"]:
@@ -150,7 +164,12 @@ class ServerStarter:
 
                 with tempfile.TemporaryDirectory() as td:
                     seg_obj = DEFAULT_FACTORY.fetch(
-                        uri, os.path.join(td, SEGMENT_FILE_NAME), expected_crc=crc
+                        uri,
+                        os.path.join(td, SEGMENT_FILE_NAME),
+                        expected_crc=crc,
+                        suspect_cb=lambda u, e: self._report_store_suspect(
+                            table, segment, u
+                        ),
                     )
                     if seg_obj is None:  # crc unknown: self-verify claim
                         seg_obj = read_segment(td)
@@ -162,6 +181,7 @@ class ServerStarter:
             # directory this server does not own
             self.server.record_crc_failure(table, segment)
             self.server.quarantine_segment(table, segment)
+            self._report_store_suspect(table, segment, uri or path or "")
             logger.exception(
                 "segment %s/%s failed integrity verification at %s",
                 table, segment, path or uri,
@@ -191,7 +211,14 @@ class ServerStarter:
                     os.makedirs(d, exist_ok=True)
                     # the factory returns the parsed + verified segment:
                     # no second decode/CRC pass over a multi-GB file
-                    fetched = DEFAULT_FACTORY.fetch(uri, fpath, expected_crc=crc)
+                    fetched = DEFAULT_FACTORY.fetch(
+                        uri,
+                        fpath,
+                        expected_crc=crc,
+                        suspect_cb=lambda u, e: self._report_store_suspect(
+                            table, segment, u
+                        ),
+                    )
                     if fetched is not None:
                         return fetched
                 seg_obj = read_segment(d)

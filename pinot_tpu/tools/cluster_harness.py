@@ -2592,6 +2592,259 @@ def _zombie_stabilizer_write(ctrl_a, physical: str) -> None:
         raise RuntimeError("stabilizer made no write attempt (test rig issue)")
 
 
+# ---------------------------------------------------------------------------
+# Disaster-recovery scenario (ISSUE 20): consistent online backup under
+# closed-loop load, a seeded deep-store corruption scrubbed + repaired
+# from a live replica, then the controller property store DESTROYED
+# mid-load and the cluster restored from archive + deep store alone —
+# byte-identical answers, zero committed-row loss, drain flags and
+# epoch fencing preserved.  Shared by the CLI, DR_r20.json generation,
+# and tests/test_disaster_recovery.py.
+# ---------------------------------------------------------------------------
+
+
+def run_disaster_recovery_scenario(
+    num_servers: int = 3,
+    replication: int = 2,
+    num_segments: int = 6,
+    clients: int = 3,
+    rt_rows_per_segment: int = 40,
+    window_s: float = 0.5,
+    data_dir: Optional[str] = None,
+    archive_path: Optional[str] = None,
+    seed: int = 2020,
+) -> Dict[str, Any]:
+    import json
+    import shutil as _shutil
+
+    from pinot_tpu.common.fencing import StaleEpochError
+    from pinot_tpu.common.tableconfig import StreamConfig
+    from pinot_tpu.realtime.llc import RESP_KEEP, make_segment_name
+    from pinot_tpu.realtime.stream import FileBasedStreamProvider
+    from pinot_tpu.tools.backup import create_backup, restore_backup
+    from pinot_tpu.tools.datagen import random_rows
+    from pinot_tpu.utils.audit import SamplerBudget, strip_accounting
+
+    cluster, physical, total = _build_scenario_cluster(
+        num_servers, replication, num_segments, data_dir, seed=seed
+    )
+    old_ctrl = cluster.controller
+    archive = archive_path or os.path.join(cluster.data_dir, "dr_backup.tar.gz")
+    try:
+        # -- 1. drain one server (the flag must survive the disaster) --
+        drained = "server2" if num_servers >= 3 else None
+        if drained:
+            _drain_one(cluster, drained)
+
+        # -- 2. realtime table: commit two segments' worth of rows -----
+        rt_schema = _tenant_schema("rtTable")
+        stream_file = os.path.join(cluster.data_dir, "rt_p0.jsonl")
+        rt_rows = random_rows(rt_schema, rt_rows_per_segment * 3, seed=seed + 1)
+        with open(stream_file, "w") as f:
+            for r in rt_rows[: rt_rows_per_segment * 2]:
+                f.write(json.dumps(r) + "\n")
+        cluster.controller.add_schema(rt_schema)
+        rt_config = TableConfig(
+            table_name="rtTable",
+            table_type="REALTIME",
+            replication=1,
+            stream=StreamConfig(rows_per_segment=rt_rows_per_segment),
+        )
+        rt_physical = cluster.controller.add_realtime_table(
+            rt_config, FileBasedStreamProvider([stream_file])
+        )
+        rt_seg = [make_segment_name(rt_physical, 0, i) for i in range(3)]
+        dm0 = cluster.controller.realtime_manager.consumers_of(rt_seg[0])[0]
+        dm0.consume_step(max_rows=100_000)
+        assert dm0.try_commit() == RESP_KEEP
+        dm1 = cluster.controller.realtime_manager.consumers_of(rt_seg[1])[0]
+        dm1.consume_step(max_rows=100_000)
+        assert dm1.try_commit() == RESP_KEEP
+        rt_committed = rt_rows_per_segment * 2
+        rt_pql = "SELECT count(*) FROM rtTable"
+        assert cluster.query(rt_pql).num_docs_scanned == rt_committed
+
+        # -- 3. canonical pre-disaster payloads (byte-identity bar) ----
+        canon = [
+            "SELECT count(*) FROM testTable",
+            "SELECT sum(metInt), max(dimInt) FROM testTable GROUP BY dimStr",
+            rt_pql,
+        ]
+        baseline_payloads = {}
+        for q in canon:
+            resp = cluster.query(q)
+            assert not resp.exceptions and not resp.partial_response, q
+            baseline_payloads[q] = strip_accounting(resp.to_json())
+
+        # -- 4. closed-loop load for the rest of the scenario ----------
+        load = ClosedLoopLoad(
+            cluster, "SELECT count(*) FROM testTable", total, clients
+        ).start()
+        t0 = time.monotonic()
+        time.sleep(window_s)
+        ok0, tA = load.ok, time.monotonic()
+        baseline_qps = ok0 / max(1e-6, tA - t0)
+
+        # -- 5. consistent online backup (timed, under load) -----------
+        backup_stats = create_backup(cluster.data_dir, archive)
+
+        # -- 6. seed deep-store corruption; scrub detects + repairs ----
+        store = cluster.controller.store
+        victim_seg = "seg0"
+        victim_path = store.segment_file_path(physical, victim_seg)
+        with open(victim_path, "r+b") as f:
+            f.seek(-16, os.SEEK_END)
+            f.write(b"\xde\xad\xbe\xef" * 4)
+
+        def in_process_copy(name, url, table, segment):
+            for s in cluster.servers:
+                if s.name == name:
+                    return s.segment_copy_bytes(table, segment)
+            return None
+
+        scrub = cluster.controller.deepstore_scrubber
+        scrub.copy_fn = in_process_copy
+        scrub.budget = SamplerBudget(per_s=100_000.0, burst=10_000.0)
+        scrub_t0 = time.monotonic()
+        okA = load.ok
+        scrub.run_once()
+        time.sleep(window_s)  # serving window with the scrub round in it
+        scrub_t1, okB = time.monotonic(), load.ok
+        scrub_qps = (okB - okA) / max(1e-6, scrub_t1 - scrub_t0)
+        scrub_snap = scrub.snapshot()
+        scrub_repaired = False
+        try:
+            info = cluster.controller.resources.get_segment_metadata(
+                physical, victim_seg
+            ) or {}
+            store.verify_copy(
+                physical, victim_seg,
+                expected_crc=getattr(info.get("metadata"), "crc", None),
+            )
+            scrub_repaired = True
+        except Exception:
+            pass
+        ok_qps_ratio = min(1.0, scrub_qps / max(1e-6, baseline_qps))
+
+        # -- 7. DISASTER: property store destroyed mid-load ------------
+        _shutil.rmtree(os.path.join(cluster.data_dir, "property_store"))
+        time.sleep(0.2)  # queries keep flowing: broker routing survives
+        old_ctrl.stop()
+
+        # -- 8. restore: new controller from archive + deep store ------
+        restore_t0 = time.monotonic()
+        restore_stats = restore_backup(archive, cluster.data_dir)
+        new_ctrl = Controller(cluster.data_dir)
+        new_ctrl.stabilizer.grace_s = 0.0
+        cluster.controller = new_ctrl
+        # servers first (replays refill the external views), then the
+        # broker (re-seeds routing from those views) — the elastic-fleet
+        # in-process restart pattern
+        for server in cluster.servers:
+            ServerStarter(server, new_ctrl.resources).start()
+        BrokerStarter(cluster.broker, new_ctrl.resources).start()
+        first_query_s = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            resp = cluster.query("SELECT count(*) FROM testTable")
+            if (
+                not resp.exceptions
+                and not resp.partial_response
+                and resp.num_docs_scanned == total
+            ):
+                first_query_s = time.monotonic() - restore_t0
+                break
+            time.sleep(0.05)
+        time.sleep(window_s)  # post-restore serving window under load
+        summary = load.stop()
+
+        # -- 9. acceptance accounting ----------------------------------
+        byte_identical = True
+        for q in canon:
+            resp = cluster.query(q)
+            if (
+                resp.exceptions
+                or resp.partial_response
+                or strip_accounting(resp.to_json()) != baseline_payloads[q]
+            ):
+                byte_identical = False
+        drain_preserved = (
+            drained is None
+            or drained in new_ctrl.resources._draining_flags
+        )
+        # fencing: the pre-disaster zombie's writes must still be
+        # rejected against the restored store
+        try:
+            old_ctrl.property_store.put("tables", "zombieWrite", {"x": 1})
+            fencing_preserved = False
+        except StaleEpochError:
+            fencing_preserved = True
+        # realtime: committed rows exactly once, consumption resumes
+        rt_after = cluster.query(rt_pql).num_docs_scanned
+        rt_committed_preserved = rt_after == rt_committed
+        rt_resumed = False
+        try:
+            with open(stream_file, "a") as f:
+                for r in rt_rows[rt_rows_per_segment * 2 :]:
+                    f.write(json.dumps(r) + "\n")
+            dm2 = new_ctrl.realtime_manager.consumers_of(rt_seg[2])[0]
+            dm2.consume_step(max_rows=100_000)
+            rt_resumed = (
+                dm2.try_commit() == RESP_KEEP
+                and cluster.query(rt_pql).num_docs_scanned
+                == rt_rows_per_segment * 3
+            )
+        except Exception:
+            rt_resumed = False
+
+        scrub_detected = scrub_snap["corruptCopies"] >= 1
+        failed = (
+            summary["failedQueries"]
+            + (0 if first_query_s is not None else 1)
+            + (0 if byte_identical else 1)
+            + (0 if drain_preserved else 1)
+            + (0 if fencing_preserved else 1)
+            + (0 if rt_committed_preserved else 1)
+            + (0 if rt_resumed else 1)
+            + (0 if (scrub_detected and scrub_repaired) else 1)
+        )
+        return {
+            "scenario": "disaster-recovery",
+            "metric": "dr_restore_first_query_s",
+            "platform": "cpu",
+            "num_segments": num_segments,
+            "clients": clients,
+            "value": round(first_query_s, 4) if first_query_s else None,
+            "backup": backup_stats,
+            "restore": {
+                "restoreToFirstQuerySeconds": (
+                    round(first_query_s, 4) if first_query_s else None
+                ),
+                "restoreSeconds": round(restore_stats["restoreSeconds"], 4),
+                "segmentsVerified": restore_stats["segmentsVerified"],
+                "segmentsMissing": restore_stats["segmentsMissing"],
+                "segmentsCorrupt": restore_stats["segmentsCorrupt"],
+                "byteIdentical": byte_identical,
+                "drainFlagPreserved": drain_preserved,
+                "fencingPreserved": fencing_preserved,
+                "rtCommittedPreserved": rt_committed_preserved,
+                "rtResumed": rt_resumed,
+            },
+            "scrub": {
+                "detected": scrub_detected,
+                "repaired": scrub_repaired,
+                "okQpsRatio": round(ok_qps_ratio, 4),
+                "baselineQps": round(baseline_qps, 2),
+                "scrubQps": round(scrub_qps, 2),
+                "snapshot": scrub_snap,
+            },
+            "load": summary,
+            "failedQueries": failed,
+        }
+    finally:
+        cluster.stop()
+
+
 SCENARIOS = {
     "kill-server": run_kill_server_scenario,
     "drain": run_drain_scenario,
@@ -2607,6 +2860,7 @@ SCENARIOS = {
     "partition-controller": run_partition_controller_scenario,
     "asymmetric-partition": run_asymmetric_partition_scenario,
     "split-brain": run_split_brain_scenario,
+    "disaster-recovery": run_disaster_recovery_scenario,
 }
 
 

@@ -294,6 +294,32 @@ AUDIT_CONFIG_KEYS = ("total_rows", "num_segments", "clients", "platform")
 AUDIT_DEFAULT_BASELINE = "AUDIT_r19.json"
 
 
+# disaster-recovery documents (cluster_harness --scenario
+# disaster-recovery, ISSUE 20): the durability plane's forever
+# promises.  Wall-clock rows (backup under load, restore-to-first-
+# successful-query) get wide bands — they gate order-of-magnitude rot
+# on the tiny harness cluster, not scheduler jitter.  The structural
+# rows are absolute: restored answers must stay byte-identical to the
+# pre-disaster payloads, and the scrubber must ALWAYS detect and
+# repair the seeded corrupt store copy.  ``scrub.okQpsRatio`` is
+# serving ok-QPS while a scrub round runs over the pre-scrub baseline
+# window (clamped at 1.0) — scrubbing must never cost more than ~5%
+# of serving throughput.
+DR_METRIC_SPECS: Dict[str, Tuple[str, float]] = {
+    "value": ("lower", 5.0),
+    "backup.backupSeconds": ("lower", 5.0),
+    "restore.restoreToFirstQuerySeconds": ("lower", 5.0),
+    "restore.byteIdentical": ("higher", 1.0),
+    "scrub.okQpsRatio": ("higher", 0.95),
+    "scrub.detected": ("higher", 1.0),
+    "scrub.repaired": ("higher", 1.0),
+}
+
+DR_CONFIG_KEYS = ("num_segments", "clients", "platform")
+
+DR_DEFAULT_BASELINE = "DR_r20.json"
+
+
 def _is_serving(doc: Dict[str, Any]) -> bool:
     return str(doc.get("metric", "")).startswith("serving_")
 
@@ -316,6 +342,8 @@ def _doc_kind(doc: Dict[str, Any]) -> str:
         return "tiered"
     if metric.startswith("audit_"):
         return "audit"
+    if metric.startswith("dr_"):
+        return "dr"
     return "default"
 
 
@@ -338,6 +366,8 @@ def _specs_for(doc: Dict[str, Any]):
         return TIERED_METRIC_SPECS, TIERED_CONFIG_KEYS
     if kind == "audit":
         return AUDIT_METRIC_SPECS, AUDIT_CONFIG_KEYS
+    if kind == "dr":
+        return DR_METRIC_SPECS, DR_CONFIG_KEYS
     return METRIC_SPECS, CONFIG_KEYS
 
 
@@ -493,6 +523,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "filtermatrix": FILTERMATRIX_DEFAULT_BASELINE,
                 "tiered": TIERED_DEFAULT_BASELINE,
                 "audit": AUDIT_DEFAULT_BASELINE,
+                "dr": DR_DEFAULT_BASELINE,
             }.get(_doc_kind(current), "BENCH_r05.json")
         baseline = load_bench(baseline_path)
     except (OSError, ValueError, json.JSONDecodeError) as e:

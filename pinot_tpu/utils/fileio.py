@@ -11,25 +11,40 @@ import os
 import tempfile
 
 
-def atomic_write(path: str, text, binary: bool = False) -> None:
+def atomic_write(path: str, text, binary: bool = False, fsync: bool = True) -> None:
     """Write ``text`` to ``path`` via a same-directory temp file with
-    fsync-before-rename (crash-durable whole-file replace)."""
+    fsync-before-rename (crash-durable whole-file replace).
+
+    ``fsync=False`` skips both the file fsync and the directory fsync:
+    the replace is still atomic against concurrent readers (they see
+    old or new content, never a partial file) but may revert to the old
+    content after power loss.  Callers that journal their mutations
+    (the property store) use this for the per-key mirror files, since
+    the journal — not the mirror — is the recovery source of truth.
+    """
     dirname = os.path.dirname(path) or "."
     fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb" if binary else "w") as f:
             f.write(text)
             f.flush()
-            os.fsync(f.fileno())
+            if fsync:
+                os.fsync(f.fileno())
         os.replace(tmp, path)
         # fsync the directory too: without it the rename itself may not
         # survive power loss, reverting to the old file
-        dfd = os.open(dirname, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
+        if fsync:
+            fsync_dir(dirname)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+
+
+def fsync_dir(dirname: str) -> None:
+    """fsync a directory so renames/creates within it are durable."""
+    dfd = os.open(dirname or ".", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)

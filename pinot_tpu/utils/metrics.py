@@ -784,6 +784,35 @@ CONTROLLER_METRIC_CATALOG: Dict[str, str] = {
     "(unreachable or no admin URL)",
     "audit.crcMismatches": "segments whose replicas currently disagree "
     "on content CRC (cross-replica divergence)",
+    # disaster-recovery plane (ISSUE 20): journaled metadata durability
+    "durability.journalAppends": "property-store mutations framed into "
+    "the op journal (controller/journal.py)",
+    "durability.snapshots": "full-state journal snapshots cut "
+    "(periodic + forced backup-prep)",
+    "durability.corruptRecords": "property-store record files found "
+    "truncated/garbled and quarantined aside",
+    "durability.recordsHealed": "property-store records regenerated "
+    "from the journal-recovered state",
+    "durability.journalTornTailTruncations": "torn journal tail frames "
+    "truncated during recovery (crash mid-append)",
+    "durability.corruptSnapshots": "journal snapshots found unreadable "
+    "and quarantined (recovery fell back to the log)",
+    # disaster-recovery plane (ISSUE 20): deep-store scrub + reverse
+    # replication of lost/corrupt durable copies
+    "deepstore.scrub.runs": "deep-store scrub rounds completed",
+    "deepstore.scrub.copiesChecked": "durable copies CRC re-verified "
+    "by scrub rounds",
+    "deepstore.scrub.budgetDenied": "scrub checks skipped by the "
+    "shared sampler budget (serving protected)",
+    "deepstore.corruptCopies": "durable copies found lost or corrupt",
+    "deepstore.repairs": "durable copies re-replicated from a live "
+    "server's verified replica (reverse replication)",
+    "deepstore.repairFailures": "corrupt durable copies with no "
+    "healthy donor replica available",
+    "deepstore.suspectsReported": "store-copy suspects reported by "
+    "server fetch paths (CRC-failing downloads)",
+    "deepstore.suspectsPending": "store-copy suspects queued for the "
+    "next scrub round",
     "*.missingReplicas": "per-table replicas missing from the external view",
     "*.errorReplicas": "per-table replicas in ERROR state",
     "*.percentSegmentsAvailable": "per-table % of segments with a live replica",

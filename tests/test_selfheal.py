@@ -534,12 +534,12 @@ def test_corrupt_local_segment_quarantined_refetched_and_serving(tmp_path):
     mid_recovery = {}
     real_fetch = fetcher_mod.DEFAULT_FACTORY.fetch
 
-    def spying_fetch(uri, dest_path, expected_crc=None):
+    def spying_fetch(uri, dest_path, expected_crc=None, **kwargs):
         if "q1" in uri and "mid" not in mid_recovery:
             mid_recovery["mid"] = broker.handle_pql(
                 "SELECT count(*) FROM healTable"
             )
-        return real_fetch(uri, dest_path, expected_crc=expected_crc)
+        return real_fetch(uri, dest_path, expected_crc=expected_crc, **kwargs)
 
     fetcher_mod.DEFAULT_FACTORY.fetch = spying_fetch
     try:
